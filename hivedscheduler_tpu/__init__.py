@@ -1,0 +1,21 @@
+"""tpu-hive: a TPU-native cluster scheduler with the capabilities of HiveD.
+
+Re-designed from microsoft/hivedscheduler (reference surveyed in SURVEY.md) for
+TPU pods on Kubernetes/GKE:
+
+- the GPU cell hierarchy (GPU -> PCIe switch -> NVLink node -> rack) becomes an
+  ICI-mesh hierarchy (chip -> tray -> cube -> pod slice) with coordinate cells,
+- the buddy-cell allocator hands out *contiguous* mesh slices via mesh tiling,
+- the scheduler-extender binding delivers chip isolation through the Cloud TPU
+  device plugin (``TPU_VISIBLE_CHIPS``) instead of ``NVIDIA_VISIBLE_DEVICES``,
+- the workload runtime (``hivedscheduler_tpu.parallel`` / ``.models`` /
+  ``.ops``) consumes the scheduler's bind decision and builds a
+  ``jax.sharding.Mesh`` over the allocated sub-mesh for SPMD training.
+
+Capability parity targets (reference file:line cited per module):
+virtual-cluster topology guarantees, gang scheduling via affinity groups,
+guaranteed/opportunistic priorities, intra/inter-VC and lazy preemption,
+bad-hardware awareness, and work-preserving reconfiguration.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+"""Scheduling algorithm core: cell model, placement search, buddy allocation.
+
+TPU-native analogue of the reference's ``pkg/algorithm``.
+"""

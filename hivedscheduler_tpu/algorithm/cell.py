@@ -1,0 +1,291 @@
+"""Cell data model: generic cells, physical cells, virtual cells.
+
+TPU-native analogue of the reference's ``pkg/algorithm/cell.go``. A Cell is a
+set of chips affinitized by ICI topology, organized as a tree via parent/child
+pointers. Physical cells in mesh chains additionally carry their sub-mesh
+geometry (origin + shape), making "contiguous slice" part of the cell's
+identity rather than an emergent property.
+
+State/healthiness mirroring between a physical cell, its bound virtual cell,
+and both API statuses follows ``cell.go:195-204`` (state), ``cell.go:302-312``
+(healthiness) and the SetVirtualCell/SetPhysicalCell shallow-copy linking
+(``cell.go:253-279``, ``cell.go:398-417``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.algorithm.constants import (
+    CELL_FREE,
+    FREE_PRIORITY,
+)
+
+log = logging.getLogger(__name__)
+
+CellChain = str
+CellLevel = int
+CellPriority = int
+
+
+def cell_equal(c1: Optional["Cell"], c2: Optional["Cell"]) -> bool:
+    """Reference: CellEqual, cell.go:50-56."""
+    if c1 is None or c2 is None:
+        return c1 is None and c2 is None
+    return c1.address == c2.address
+
+
+class Cell:
+    """Base cell (reference: GenericCell, cell.go:58-127)."""
+
+    def __init__(
+        self,
+        chain: CellChain,
+        level: CellLevel,
+        address: str,
+        at_or_higher_than_node: bool,
+        total_leaf_cell_num: int,
+    ):
+        self.chain = chain
+        self.level = level
+        self.address = address
+        self.parent: Optional[Cell] = None
+        self.children: List[Cell] = []
+        self.at_or_higher_than_node = at_or_higher_than_node
+        self.priority: CellPriority = FREE_PRIORITY
+        self.state: str = CELL_FREE
+        # healthy is orthogonal to priority and state; all children healthy =>
+        # healthy. Cells start healthy and are mass-marked bad by
+        # HivedAlgorithm.init_bad_nodes until node informs arrive.
+        self.healthy: bool = True
+        self.total_leaf_cell_num = total_leaf_cell_num
+        self.used_leaf_cell_num_at_priorities: Dict[CellPriority, int] = {}
+
+    def set_priority(self, p: CellPriority) -> None:
+        self.priority = p
+
+    def increase_used_leaf_cell_num_at_priority(self, p: CellPriority, delta: int) -> None:
+        n = self.used_leaf_cell_num_at_priorities.get(p, 0) + delta
+        if n == 0:
+            self.used_leaf_cell_num_at_priorities.pop(p, None)
+        else:
+            self.used_leaf_cell_num_at_priorities[p] = n
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<{type(self).__name__} {self.chain}/{self.address} L{self.level} P{self.priority} {self.state}>"
+
+
+class PhysicalCell(Cell):
+    """A cell in the physical cluster (reference: cell.go:130-312)."""
+
+    def __init__(
+        self,
+        chain: CellChain,
+        level: CellLevel,
+        at_or_higher_than_node: bool,
+        total_leaf_cell_num: int,
+        cell_type: str,
+        address: str,
+        is_node_level: bool,
+        mesh_origin: Optional[Tuple[int, ...]] = None,
+        mesh_shape: Optional[Tuple[int, ...]] = None,
+    ):
+        super().__init__(chain, level, address, at_or_higher_than_node, total_leaf_cell_num)
+        self.nodes: List[str] = []  # node names inside the cell
+        self.leaf_cell_indices: List[int] = []  # [-1] above node level
+        self.using_group = None  # type: Optional[object]  # AlgoAffinityGroup
+        self.reserving_or_reserved_group = None  # type: Optional[object]
+        self.virtual_cell: Optional["VirtualCell"] = None
+        self.split = False
+        self.pinned = False
+        # TPU mesh geometry (None for generic chains).
+        self.mesh_origin = mesh_origin
+        self.mesh_shape = mesh_shape
+        self.api_status = api.PhysicalCellStatus(
+            cell_type=cell_type,
+            is_node_level=is_node_level,
+            cell_address=address,
+            cell_state=CELL_FREE,
+            cell_healthiness=api.CELL_HEALTHY,
+            cell_priority=FREE_PRIORITY,
+            mesh_origin=mesh_origin,
+            mesh_shape=mesh_shape,
+        )
+
+    def set_children(self, children: List[Cell]) -> None:
+        self.children = children
+        for cc in children:
+            assert isinstance(cc, PhysicalCell)
+            self.api_status.cell_children.append(cc.api_status)
+
+    def set_priority(self, p: CellPriority) -> None:
+        self.priority = p
+        self.api_status.cell_priority = p
+        if self.api_status.virtual_cell is not None:
+            self.api_status.virtual_cell.cell_priority = p
+
+    def set_state(self, s: str) -> None:
+        """Propagates to the bound virtual cell and all status mirrors
+        (reference: cell.go:195-204)."""
+        self.state = s
+        self.api_status.cell_state = s
+        if self.virtual_cell is not None:
+            self.virtual_cell.state = s
+            self.virtual_cell.api_status.cell_state = s
+            self.api_status.virtual_cell.cell_state = s
+            self.virtual_cell.api_status.physical_cell.cell_state = s
+
+    def get_physical_placement(self) -> Tuple[List[str], List[int]]:
+        return self.nodes, self.leaf_cell_indices
+
+    def get_physical_placement_string(self) -> str:
+        return f"{self.nodes}:{self.leaf_cell_indices}"
+
+    def set_physical_resources(self, nodes: List[str], leaf_cell_indices: List[int]) -> None:
+        self.nodes = nodes
+        self.leaf_cell_indices = leaf_cell_indices
+
+    def add_using_group(self, g) -> None:
+        if self.using_group is not None:
+            log.error(
+                "Found another using affinity group %s when adding using group %s to cell %s",
+                self.using_group.name, g.name, self.address,
+            )
+        self.using_group = g
+
+    def delete_using_group(self, g) -> None:
+        if self.using_group is None or self.using_group.name != g.name:
+            log.error("Using affinity group %s not found when deleting from cell %s",
+                      g.name, self.address)
+        self.using_group = None
+
+    def add_reserving_or_reserved_group(self, g) -> None:
+        if self.reserving_or_reserved_group is not None:
+            log.error(
+                "Found another reserving/reserved group %s when adding group %s to cell %s",
+                self.reserving_or_reserved_group.name, g.name, self.address,
+            )
+        self.reserving_or_reserved_group = g
+
+    def delete_reserving_or_reserved_group(self, g) -> None:
+        if (
+            self.reserving_or_reserved_group is None
+            or self.reserving_or_reserved_group.name != g.name
+        ):
+            log.error("Reserving/reserved group %s not found when deleting from cell %s",
+                      g.name, self.address)
+        self.reserving_or_reserved_group = None
+
+    def set_virtual_cell(self, cell: Optional["VirtualCell"]) -> None:
+        """Reference: cell.go:253-279 — keep a pointer-free shallow copy of the
+        peer's status in the API mirror."""
+        self.virtual_cell = cell
+        if cell is None:
+            self.api_status.virtual_cell = None
+            self.api_status.vc = ""
+        else:
+            vcs = _shallow_copy_virtual_status(cell.api_status)
+            self.api_status.virtual_cell = vcs
+            self.api_status.vc = cell.vc
+
+    def set_healthiness(self, h: str) -> None:
+        """Reference: cell.go:302-312."""
+        log.info("Cell %s is set to %s", self.address, h)
+        self.healthy = h == api.CELL_HEALTHY
+        self.api_status.cell_healthiness = h
+        if self.virtual_cell is not None:
+            self.virtual_cell.healthy = self.healthy
+            self.api_status.virtual_cell.cell_healthiness = h
+            self.virtual_cell.api_status.cell_healthiness = h
+            self.virtual_cell.api_status.physical_cell.cell_healthiness = h
+
+
+class VirtualCell(Cell):
+    """A cell in a VC (reference: cell.go:314-423)."""
+
+    def __init__(
+        self,
+        vc: str,
+        chain: CellChain,
+        level: CellLevel,
+        at_or_higher_than_node: bool,
+        total_leaf_cell_num: int,
+        preassigned_cell: Optional["VirtualCell"],
+        cell_type: str,
+        address: str,
+        is_node_level: bool,
+    ):
+        super().__init__(chain, level, address, at_or_higher_than_node, total_leaf_cell_num)
+        self.vc = vc
+        self.pid: str = ""  # pinned cell id
+        self.preassigned_cell = preassigned_cell
+        self.physical_cell: Optional[PhysicalCell] = None
+        self.api_status = api.VirtualCellStatus(
+            cell_type=cell_type,
+            is_node_level=is_node_level,
+            cell_address=address,
+            cell_state=CELL_FREE,
+            cell_healthiness=api.CELL_HEALTHY,
+            cell_priority=FREE_PRIORITY,
+        )
+
+    def set_children(self, children: List[Cell]) -> None:
+        self.children = children
+        for cc in children:
+            assert isinstance(cc, VirtualCell)
+            self.api_status.cell_children.append(cc.api_status)
+
+    def set_priority(self, p: CellPriority) -> None:
+        self.priority = p
+        self.api_status.cell_priority = p
+        if self.api_status.physical_cell is not None:
+            self.api_status.physical_cell.cell_priority = p
+
+    def set_pinned_cell_id(self, pid: str) -> None:
+        self.pid = pid
+
+    def set_physical_cell(self, cell: Optional[PhysicalCell]) -> None:
+        """Reference: cell.go:398-417."""
+        self.physical_cell = cell
+        if cell is None:
+            self.api_status.physical_cell = None
+            self.state = CELL_FREE
+            self.healthy = True
+            self.api_status.cell_healthiness = api.CELL_HEALTHY
+            self.api_status.cell_state = CELL_FREE
+        else:
+            self.healthy = cell.healthy
+            pcs = _shallow_copy_physical_status(cell.api_status)
+            self.api_status.physical_cell = pcs
+            self.api_status.cell_healthiness = pcs.cell_healthiness
+
+
+def _shallow_copy_physical_status(s: api.PhysicalCellStatus) -> api.PhysicalCellStatus:
+    out = api.PhysicalCellStatus(
+        cell_type=s.cell_type,
+        cell_address=s.cell_address,
+        cell_state=s.cell_state,
+        cell_healthiness=s.cell_healthiness,
+        cell_priority=s.cell_priority,
+        leaf_cell_type=s.leaf_cell_type,
+        is_node_level=s.is_node_level,
+        mesh_origin=s.mesh_origin,
+        mesh_shape=s.mesh_shape,
+        vc=s.vc,
+    )
+    return out
+
+
+def _shallow_copy_virtual_status(s: api.VirtualCellStatus) -> api.VirtualCellStatus:
+    out = api.VirtualCellStatus(
+        cell_type=s.cell_type,
+        cell_address=s.cell_address,
+        cell_state=s.cell_state,
+        cell_healthiness=s.cell_healthiness,
+        cell_priority=s.cell_priority,
+        leaf_cell_type=s.leaf_cell_type,
+        is_node_level=s.is_node_level,
+    )
+    return out

@@ -1,0 +1,510 @@
+"""Spec → cell-tree constructors.
+
+TPU-native analogue of the reference's ``pkg/algorithm/config.go``:
+
+- ``cellTypeConstructor`` (``config.go:45-108``) → ``build_chain_levels``:
+  per-chain level tables (level, childNumber, hasNode, isMultiNodes,
+  leafCellType, leafCellNumber), built either from the generic child-count
+  cellTypes or from an ICI-mesh declaration (``algorithm/mesh.py``);
+- ``physicalCellConstructor`` (``config.go:110-235``) → ``PhysicalTreeBuilder``:
+  instantiates PhysicalCell trees; node-level cells pass their address down as
+  the node name, multi-node cells merge child node lists; mesh chains generate
+  the whole tree geometrically from the top cell's (origin, shape);
+- ``virtualCellConstructor`` (``config.go:237-413``) → ``VirtualTreeBuilder``:
+  per-VC virtual trees from ``virtualCells`` (``chain.type`` path syntax) and
+  ``pinnedCells``, computing ``vcFreeCellNum``;
+- ``ParseConfig`` (``config.go:442-477``) → ``parse_config`` returning the
+  same bundle of maps consumed by HivedAlgorithm.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.api.config import Config
+from hivedscheduler_tpu.algorithm.cell import CellChain, CellLevel, PhysicalCell, VirtualCell
+from hivedscheduler_tpu.algorithm.mesh import MeshChain, coord_str, tile_origins
+from hivedscheduler_tpu.algorithm.constants import LOWEST_LEVEL
+from hivedscheduler_tpu.algorithm.types import CellList, ChainCellList
+
+
+@dataclass
+class ChainLevel:
+    """One level of a chain's level table (reference: cellChainElement,
+    config.go:34-43)."""
+
+    level: CellLevel
+    cell_type: str
+    child_cell_type: str = ""
+    child_number: int = 0
+    has_node: bool = False  # at or higher than node level
+    is_multi_nodes: bool = False
+    leaf_cell_type: str = ""
+    leaf_cell_number: int = 1
+    shape: Optional[Tuple[int, ...]] = None  # mesh chains only
+
+    @property
+    def is_node_level(self) -> bool:
+        return self.has_node and not self.is_multi_nodes
+
+
+@dataclass
+class ParsedConfig:
+    """Output bundle (reference: ParseConfig's 10 return values,
+    config.go:442-477, plus the chain level tables and mesh geometries)."""
+
+    physical_full_list: Dict[CellChain, ChainCellList] = field(default_factory=dict)
+    physical_free_list: Dict[CellChain, ChainCellList] = field(default_factory=dict)
+    vc_free_cell_num: Dict[str, Dict[CellChain, Dict[CellLevel, int]]] = field(default_factory=dict)
+    virtual_non_pinned_full: Dict[str, Dict[CellChain, ChainCellList]] = field(default_factory=dict)
+    virtual_non_pinned_free: Dict[str, Dict[CellChain, ChainCellList]] = field(default_factory=dict)
+    virtual_pinned_cells: Dict[str, Dict[str, ChainCellList]] = field(default_factory=dict)
+    physical_pinned_cells: Dict[str, Dict[str, PhysicalCell]] = field(default_factory=dict)
+    cell_level_to_leaf_cell_num: Dict[CellChain, Dict[CellLevel, int]] = field(default_factory=dict)
+    leaf_cell_type_to_chain: Dict[str, List[CellChain]] = field(default_factory=dict)
+    cell_level_to_type: Dict[CellChain, Dict[CellLevel, str]] = field(default_factory=dict)
+    chain_levels: Dict[CellChain, List[ChainLevel]] = field(default_factory=dict)
+    mesh_chains: Dict[CellChain, MeshChain] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Level tables
+# ---------------------------------------------------------------------------
+
+
+def _build_generic_levels(
+    top_type: str, cell_types: Dict[str, api.CellTypeSpec]
+) -> List[ChainLevel]:
+    """Walk a generic cellTypes chain from its top type down to the leaf
+    (reference: cellTypeConstructor.addCellChain, config.go:59-102)."""
+    path: List[Tuple[str, api.CellTypeSpec]] = []
+    ct: Optional[str] = top_type
+    seen = set()
+    while ct is not None and ct in cell_types and cell_types[ct].mesh is None:
+        if ct in seen:
+            raise ValueError(f"cellTypes cycle detected at {ct}")
+        seen.add(ct)
+        spec = cell_types[ct]
+        path.append((ct, spec))
+        ct = spec.child_cell_type
+    leaf_type = ct if ct is not None else top_type
+    levels: List[ChainLevel] = [
+        ChainLevel(
+            level=LOWEST_LEVEL,
+            cell_type=leaf_type,
+            leaf_cell_type=leaf_type,
+            leaf_cell_number=1,
+        )
+    ]
+    for name, spec in reversed(path):
+        below = levels[-1]
+        levels.append(
+            ChainLevel(
+                level=below.level + 1,
+                cell_type=name,
+                child_cell_type=below.cell_type,
+                child_number=spec.child_cell_number,
+                has_node=below.has_node or spec.is_node_level,
+                is_multi_nodes=below.has_node,
+                leaf_cell_type=below.leaf_cell_type,
+                leaf_cell_number=below.leaf_cell_number * spec.child_cell_number,
+            )
+        )
+    return levels
+
+
+def _build_mesh_levels(mesh_chain: MeshChain) -> List[ChainLevel]:
+    levels: List[ChainLevel] = []
+    for lv in mesh_chain.levels:
+        levels.append(
+            ChainLevel(
+                level=lv.level,
+                cell_type=lv.cell_type,
+                child_cell_type="" if lv.level == 1 else levels[-1].cell_type,
+                child_number=lv.child_number,
+                has_node=lv.at_or_higher_than_node,
+                is_multi_nodes=lv.is_multi_nodes,
+                leaf_cell_type=mesh_chain.spec.chip_type,
+                leaf_cell_number=lv.leaf_cell_number,
+                shape=lv.shape,
+            )
+        )
+    return levels
+
+
+def build_chain_levels(
+    chain: CellChain,
+    cell_types: Dict[str, api.CellTypeSpec],
+    mesh_chains: Dict[CellChain, MeshChain],
+) -> List[ChainLevel]:
+    spec = cell_types.get(chain)
+    if spec is not None and spec.mesh is not None:
+        if chain not in mesh_chains:
+            mesh_chains[chain] = MeshChain(chain, spec.mesh)
+        return _build_mesh_levels(mesh_chains[chain])
+    return _build_generic_levels(chain, cell_types)
+
+
+def _level_of_type(levels: List[ChainLevel], cell_type: str) -> Optional[ChainLevel]:
+    for lv in levels:
+        if lv.cell_type == cell_type:
+            return lv
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Physical tree builder
+# ---------------------------------------------------------------------------
+
+
+class PhysicalTreeBuilder:
+    """Reference: physicalCellConstructor, config.go:110-235."""
+
+    def __init__(self, cell_types: Dict[str, api.CellTypeSpec]):
+        self.cell_types = cell_types
+        self.full_list: Dict[CellChain, ChainCellList] = {}
+        self.free_list: Dict[CellChain, ChainCellList] = {}
+        self.pinned_cells: Dict[str, PhysicalCell] = {}
+        self.chain_levels: Dict[CellChain, List[ChainLevel]] = {}
+        self.mesh_chains: Dict[CellChain, MeshChain] = {}
+
+    def build(self, specs: List[api.PhysicalCellSpec]) -> None:
+        for spec in specs:
+            chain = spec.cell_type
+            levels = self.chain_levels.get(chain)
+            if levels is None:
+                levels = build_chain_levels(chain, self.cell_types, self.mesh_chains)
+                self.chain_levels[chain] = levels
+            top = levels[-1]
+            if top.cell_type != chain:
+                raise ValueError(f"physicalCells top cellType {chain} is not a chain top")
+            if not top.has_node:
+                raise ValueError(f"top cell must be node-level or above: {chain}")
+            if chain in self.mesh_chains:
+                root = self._build_mesh_cell(
+                    chain, self.mesh_chains[chain], spec, top.level,
+                    (0,) * len(self.mesh_chains[chain].spec.topology),
+                )
+            else:
+                root = self._build_generic_cell(chain, levels, spec, top, "")
+            root.api_status.leaf_cell_type = top.leaf_cell_type
+            free = self.free_list.setdefault(chain, ChainCellList.new(top.level))
+            free[root.level].append(root)
+
+    def _register(
+        self,
+        chain: CellChain,
+        lv: ChainLevel,
+        pid: str,
+        address: str,
+        mesh_origin: Optional[Tuple[int, ...]] = None,
+    ) -> PhysicalCell:
+        """Reference: physicalCellConstructor.addCell, config.go:186-204."""
+        cell = PhysicalCell(
+            chain=chain,
+            level=lv.level,
+            at_or_higher_than_node=lv.has_node,
+            total_leaf_cell_num=lv.leaf_cell_number,
+            cell_type=lv.cell_type,
+            address=address,
+            is_node_level=lv.is_node_level,
+            mesh_origin=mesh_origin,
+            mesh_shape=lv.shape,
+        )
+        full = self.full_list.setdefault(chain, ChainCellList())
+        full.setdefault(lv.level, []).append(cell)
+        if pid:
+            self.pinned_cells[pid] = cell
+            cell.pinned = True
+        return cell
+
+    # -- generic chains ------------------------------------------------------
+
+    def _build_generic_cell(
+        self,
+        chain: CellChain,
+        levels: List[ChainLevel],
+        spec: api.PhysicalCellSpec,
+        lv: ChainLevel,
+        current_node: str,
+    ) -> PhysicalCell:
+        """Reference: buildChildCell, config.go:140-183."""
+        last = spec.cell_address.split("/")[-1]
+        if lv.is_node_level:
+            current_node = last
+        cell = self._register(chain, lv, spec.pinned_cell_id, spec.cell_address)
+        if lv.level == LOWEST_LEVEL:
+            cell.set_physical_resources([current_node], [int(last)])
+            return cell
+        child_lv = levels[lv.level - 2]
+        nodes: List[str] = []
+        leaf_indices: List[int] = []
+        children: CellList = []
+        for child_spec in spec.cell_children:
+            child = self._build_generic_cell(chain, levels, child_spec, child_lv, current_node)
+            child.parent = cell
+            children.append(child)
+            if lv.is_multi_nodes:
+                nodes.extend(child.nodes)
+            else:
+                leaf_indices.extend(child.leaf_cell_indices)
+        cell.set_children(children)
+        if lv.is_multi_nodes:
+            leaf_indices = [-1]
+        else:
+            nodes = [current_node]
+        cell.set_physical_resources(nodes, leaf_indices)
+        return cell
+
+    # -- mesh chains ---------------------------------------------------------
+
+    def _mesh_pin_lookup(
+        self, spec: api.PhysicalCellSpec, mesh_chain: MeshChain
+    ) -> Dict[Tuple[int, Tuple[int, ...]], str]:
+        """Pinned sub-cells of a mesh chain are declared as cellChildren with a
+        named level type and an origin coordinate address (``x-y-z``)."""
+        pins: Dict[Tuple[int, Tuple[int, ...]], str] = {}
+        for child in spec.cell_children:
+            level = mesh_chain.level_of_type(child.cell_type)
+            if level is None:
+                raise ValueError(
+                    f"pinned cell type {child.cell_type} is not a level of mesh chain "
+                    f"{mesh_chain.chain_name}"
+                )
+            origin = tuple(int(x) for x in child.cell_address.split("/")[-1].split("-"))
+            lv = mesh_chain.level(level)
+            dims = len(mesh_chain.spec.topology)
+            if (
+                len(origin) != dims
+                or any(o % s for o, s in zip(origin, lv.shape))
+                or any(o + s > t for o, s, t in zip(origin, lv.shape, mesh_chain.spec.topology))
+            ):
+                raise ValueError(
+                    f"pinned cell origin {origin} is not an aligned in-bounds {lv.shape} tile "
+                    f"origin in mesh chain {mesh_chain.chain_name}"
+                )
+            pins[(level, origin)] = child.pinned_cell_id
+        return pins
+
+    def _build_mesh_cell(
+        self,
+        chain: CellChain,
+        mesh_chain: MeshChain,
+        spec: api.PhysicalCellSpec,
+        top_level: int,
+        top_origin: Tuple[int, ...],
+    ) -> PhysicalCell:
+        pins = self._mesh_pin_lookup(spec, mesh_chain)
+        top_address = spec.cell_address
+        levels = self.chain_levels[chain]
+
+        def rec(level: int, origin: Tuple[int, ...], current_node: str) -> PhysicalCell:
+            lv = levels[level - 1]
+            if lv.is_node_level:
+                address = mesh_chain.node_name(top_address, origin)
+                current_node = address
+            elif level == top_level:
+                address = top_address
+            elif lv.has_node:
+                address = f"{top_address}/s{coord_str(origin)}"
+            elif level == LOWEST_LEVEL:
+                address = f"{current_node}/{mesh_chain.chip_index_in_host(origin)}"
+            else:
+                address = f"{current_node}/m{coord_str(origin)}"
+            pid = spec.pinned_cell_id if level == top_level else pins.get((level, origin), "")
+            cell = self._register(chain, lv, pid, address, mesh_origin=origin)
+            if level == LOWEST_LEVEL:
+                cell.set_physical_resources(
+                    [current_node], [mesh_chain.chip_index_in_host(origin)]
+                )
+                return cell
+            child_lv = levels[level - 2]
+            nodes: List[str] = []
+            leaf_indices: List[int] = []
+            children: CellList = []
+            for child_origin in tile_origins(origin, lv.shape, child_lv.shape):
+                child = rec(level - 1, child_origin, current_node)
+                child.parent = cell
+                children.append(child)
+                if lv.is_multi_nodes:
+                    nodes.extend(child.nodes)
+                else:
+                    leaf_indices.extend(child.leaf_cell_indices)
+            cell.set_children(children)
+            if lv.is_multi_nodes:
+                leaf_indices = [-1]
+            else:
+                nodes = [current_node]
+            cell.set_physical_resources(nodes, leaf_indices)
+            return cell
+
+        return rec(top_level, top_origin, "")
+
+
+# ---------------------------------------------------------------------------
+# Virtual tree builder
+# ---------------------------------------------------------------------------
+
+
+class VirtualTreeBuilder:
+    """Reference: virtualCellConstructor, config.go:237-413."""
+
+    def __init__(
+        self,
+        cell_types: Dict[str, api.CellTypeSpec],
+        chain_levels: Dict[CellChain, List[ChainLevel]],
+        mesh_chains: Dict[CellChain, MeshChain],
+        raw_pinned_physical: Dict[str, PhysicalCell],
+    ):
+        self.cell_types = cell_types
+        self.chain_levels = chain_levels
+        self.mesh_chains = mesh_chains
+        self.raw_pinned_physical = raw_pinned_physical
+        self.vc_free_cell_num: Dict[str, Dict[CellChain, Dict[CellLevel, int]]] = {}
+        self.non_pinned_full: Dict[str, Dict[CellChain, ChainCellList]] = {}
+        self.non_pinned_free: Dict[str, Dict[CellChain, ChainCellList]] = {}
+        self.pinned_list: Dict[str, Dict[str, ChainCellList]] = {}
+        self.pinned_physical: Dict[str, Dict[str, PhysicalCell]] = {}
+
+    def _levels_for(self, chain: CellChain) -> List[ChainLevel]:
+        levels = self.chain_levels.get(chain)
+        if levels is None:
+            levels = build_chain_levels(chain, self.cell_types, self.mesh_chains)
+            self.chain_levels[chain] = levels
+        return levels
+
+    def build(self, specs: Dict[str, api.VirtualClusterSpec]) -> None:
+        for vc, spec in specs.items():
+            self.vc_free_cell_num[vc] = {}
+            self.non_pinned_full[vc] = {}
+            self.non_pinned_free[vc] = {}
+            self.pinned_list[vc] = {}
+            self.pinned_physical[vc] = {}
+            num_cells = 0
+            for vcell in spec.virtual_cells:
+                parts = vcell.cell_type.split(".")
+                chain = parts[0]
+                root_type = parts[-1]
+                levels = self._levels_for(chain)
+                root_lv = _level_of_type(levels, root_type)
+                if root_lv is None:
+                    raise ValueError(
+                        f"cellType {vcell.cell_type} in VC {vc} not found in chain {chain}"
+                    )
+                self.vc_free_cell_num[vc].setdefault(chain, {})
+                self.vc_free_cell_num[vc][chain][root_lv.level] = (
+                    self.vc_free_cell_num[vc][chain].get(root_lv.level, 0) + vcell.cell_number
+                )
+                for _ in range(vcell.cell_number):
+                    root = self._build_tree(
+                        vc, chain, levels, root_lv, f"{vc}/{num_cells}", pid=""
+                    )
+                    free = self.non_pinned_free[vc].setdefault(chain, ChainCellList())
+                    free.setdefault(root.level, []).append(root)
+                    num_cells += 1
+            for pcell in spec.pinned_cells:
+                pid = pcell.pinned_cell_id
+                pc = self.raw_pinned_physical.get(pid)
+                if pc is None:
+                    raise ValueError(
+                        f"pinned cell not found in physicalCells: VC: {vc}, ID: {pid}"
+                    )
+                self.pinned_physical[vc][pid] = pc
+                levels = self._levels_for(pc.chain)
+                root_lv = levels[pc.level - 1]
+                self.vc_free_cell_num[vc].setdefault(pc.chain, {})
+                self.vc_free_cell_num[vc][pc.chain][pc.level] = (
+                    self.vc_free_cell_num[vc][pc.chain].get(pc.level, 0) + 1
+                )
+                self._build_tree(vc, pc.chain, levels, root_lv, f"{vc}/{num_cells}", pid=pid)
+                num_cells += 1
+
+    def _build_tree(
+        self,
+        vc: str,
+        chain: CellChain,
+        levels: List[ChainLevel],
+        root_lv: ChainLevel,
+        address: str,
+        pid: str,
+    ) -> VirtualCell:
+        root_holder: List[Optional[VirtualCell]] = [None]
+
+        def rec(lv: ChainLevel, addr: str) -> VirtualCell:
+            cell = VirtualCell(
+                vc=vc,
+                chain=chain,
+                level=lv.level,
+                at_or_higher_than_node=lv.has_node,
+                total_leaf_cell_num=lv.leaf_cell_number,
+                preassigned_cell=None,
+                cell_type=lv.cell_type,
+                address=addr,
+                is_node_level=lv.is_node_level,
+            )
+            if pid:
+                plist = self.pinned_list[vc].setdefault(pid, ChainCellList())
+                plist.setdefault(lv.level, []).append(cell)
+                cell.set_pinned_cell_id(pid)
+            else:
+                full = self.non_pinned_full[vc].setdefault(chain, ChainCellList())
+                full.setdefault(lv.level, []).append(cell)
+            if root_holder[0] is None:
+                root_holder[0] = cell
+            cell.preassigned_cell = root_holder[0]
+            if lv.level == LOWEST_LEVEL:
+                return cell
+            # Child addresses carry flat indices within the preassigned cell:
+            # offset resets to 0 under the root (reference: config.go:326-333).
+            parts = addr.split("/")
+            offset = 0 if len(parts) == 2 else int(parts[-1]) * lv.child_number
+            children: CellList = []
+            child_lv = levels[lv.level - 2]
+            for i in range(lv.child_number):
+                child = rec(child_lv, f"{addr}/{offset + i}")
+                child.parent = cell
+                children.append(child)
+            cell.set_children(children)
+            return cell
+
+        root = rec(root_lv, address)
+        root.api_status.leaf_cell_type = root_lv.leaf_cell_type
+        return root
+
+
+# ---------------------------------------------------------------------------
+# Top level
+# ---------------------------------------------------------------------------
+
+
+def parse_config(config: Config) -> ParsedConfig:
+    """Reference: ParseConfig, config.go:442-477."""
+    cell_types = config.physical_cluster.cell_types
+    pb = PhysicalTreeBuilder(cell_types)
+    pb.build(config.physical_cluster.physical_cells)
+
+    vb = VirtualTreeBuilder(cell_types, pb.chain_levels, pb.mesh_chains, pb.pinned_cells)
+    vb.build(config.virtual_clusters)
+
+    out = ParsedConfig(
+        physical_full_list=pb.full_list,
+        physical_free_list=pb.free_list,
+        vc_free_cell_num=vb.vc_free_cell_num,
+        virtual_non_pinned_full=vb.non_pinned_full,
+        virtual_non_pinned_free=vb.non_pinned_free,
+        virtual_pinned_cells=vb.pinned_list,
+        physical_pinned_cells=vb.pinned_physical,
+        chain_levels=pb.chain_levels,
+        mesh_chains=pb.mesh_chains,
+    )
+    for chain in pb.full_list:
+        levels = pb.chain_levels[chain]
+        out.cell_level_to_leaf_cell_num[chain] = {
+            lv.level: lv.leaf_cell_number for lv in levels
+        }
+        out.cell_level_to_type[chain] = {lv.level: lv.cell_type for lv in levels}
+        leaf_type = levels[-1].leaf_cell_type
+        out.leaf_cell_type_to_chain.setdefault(leaf_type, []).append(chain)
+    return out

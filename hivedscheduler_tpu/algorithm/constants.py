@@ -1,0 +1,41 @@
+"""Internal priorities, levels, cell and affinity-group states.
+
+Reference: ``pkg/algorithm/constants.go:30-80``. The state semantics are
+documented in the reference's ``doc/design/state-machine.md`` (AG events e0-e8,
+cell events e0-e9); our port of that doc lives in ``doc/design/state-machine.md``.
+"""
+
+from hivedscheduler_tpu.api import constants as api_constants
+
+# --- internal cell priorities ----------------------------------------------
+MAX_GUARANTEED_PRIORITY = api_constants.MAX_GUARANTEED_PRIORITY
+MIN_GUARANTEED_PRIORITY = api_constants.MIN_GUARANTEED_PRIORITY
+OPPORTUNISTIC_PRIORITY = api_constants.OPPORTUNISTIC_PRIORITY
+FREE_PRIORITY = OPPORTUNISTIC_PRIORITY - 1
+
+# --- levels -----------------------------------------------------------------
+LOWEST_LEVEL = 1
+HIGHEST_LEVEL = 2**31 - 1
+
+# --- cell states ------------------------------------------------------------
+# No group is using, reserving, or has reserved the cell. A Free cell's
+# priority must be FREE_PRIORITY. (A Free cell may still be *bound* when it is
+# a doomed bad cell; such cells must not be picked for new bindings.)
+CELL_FREE = "Free"
+# A group is using this cell; nobody is reserving it.
+CELL_USED = "Used"
+# A group is using this cell AND another group is reserving it (preemption in
+# flight). The cell's priority is the *reserving* group's, so non-higher
+# priority groups cannot take it.
+CELL_RESERVING = "Reserving"
+# No group is using this cell and a group has reserved it (victims already
+# gone, preemptor not yet allocated).
+CELL_RESERVED = "Reserved"
+
+# --- affinity group states --------------------------------------------------
+# All cells of the group are Used.
+GROUP_ALLOCATED = "Allocated"
+# The group is preempting others; its cells are Reserving or Reserved.
+GROUP_PREEMPTING = "Preempting"
+# The group is being preempted; its cells are Used or Reserving.
+GROUP_BEING_PREEMPTED = "BeingPreempted"

@@ -1,0 +1,183 @@
+"""ICI-mesh geometry: contiguous sub-mesh cells, tiling, chain expansion.
+
+This is the TPU-first replacement for the reference's generic child-count cell
+hierarchy (``pkg/algorithm/config.go:45-108``). A cell in a mesh chain is a
+*contiguous sub-mesh* identified by (origin, shape) inside the chain's full ICI
+topology. Buddy split = tiling a cell by the next-lower level's shape; buddy
+merge = rejoining all tiles of one parent. Because every level's shape tiles
+the next level's shape exactly (validated here), contiguity of every allocated
+slice is a construction-time guarantee instead of an emergent property — this
+is what yields zero ICI-mesh fragmentation for aligned requests.
+
+The expansion produces the same ``cellChainElement``-style level table the rest
+of the algorithm consumes (level, childNumber, hasNode, isMultiNodes,
+leafCellType, leafCellNumber), so VC-safety accounting and buddy allocation
+carry over from the reference unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
+
+from hivedscheduler_tpu.api.types import MeshSpec
+
+Coord = Tuple[int, ...]
+Shape = Tuple[int, ...]
+
+
+def volume(shape: Shape) -> int:
+    return math.prod(shape)
+
+
+def tiles(child: Shape, parent: Shape) -> bool:
+    """True iff a grid of `child`-shaped sub-meshes exactly tiles `parent`."""
+    return len(child) == len(parent) and all(p % c == 0 for c, p in zip(child, parent))
+
+
+def tile_origins(parent_origin: Coord, parent_shape: Shape, child_shape: Shape) -> List[Coord]:
+    """Origins of the `child_shape` tiles inside the parent sub-mesh, in
+    row-major order (last axis fastest). Deterministic order makes buddy
+    split/merge and golden tests stable."""
+    assert tiles(child_shape, parent_shape), (child_shape, parent_shape)
+    counts = [p // c for p, c in zip(parent_shape, child_shape)]
+    out: List[Coord] = []
+
+    def rec(dim: int, prefix: List[int]) -> None:
+        if dim == len(counts):
+            out.append(tuple(o + i * c for o, i, c in zip(parent_origin, prefix, child_shape)))
+            return
+        for i in range(counts[dim]):
+            rec(dim + 1, prefix + [i])
+
+    rec(0, [])
+    return out
+
+
+def submesh_coords(origin: Coord, shape: Shape) -> Iterator[Coord]:
+    """All chip coordinates inside the sub-mesh, row-major."""
+    for o in tile_origins(origin, shape, (1,) * len(shape)):
+        yield o
+
+
+def coord_str(coord: Coord) -> str:
+    return "-".join(str(c) for c in coord)
+
+
+def row_major_index(coord: Coord, origin: Coord, shape: Shape) -> int:
+    """Flat index of `coord` within the sub-mesh (used for in-host chip
+    indices handed to TPU_VISIBLE_CHIPS)."""
+    idx = 0
+    for c, o, s in zip(coord, origin, shape):
+        assert o <= c < o + s, (coord, origin, shape)
+        idx = idx * s + (c - o)
+    return idx
+
+
+@dataclass(frozen=True)
+class MeshLevel:
+    """One level of an expanded mesh chain (ascending from chip = level 1)."""
+
+    level: int
+    cell_type: str
+    shape: Shape
+    child_number: int  # tiles of the level below per cell (0 at chip level)
+    is_node_level: bool  # shape == hostShape: maps 1:1 to a K8s node/host
+    at_or_higher_than_node: bool
+    is_multi_nodes: bool
+    leaf_cell_number: int
+
+
+class MeshChain:
+    """Expanded level table of an ICI-mesh cell chain.
+
+    Built from a ``MeshSpec``: chip level and host level are auto-inserted if
+    not among the named levels; the chain's own name is the top level with
+    shape == topology."""
+
+    def __init__(self, chain_name: str, spec: MeshSpec):
+        self.chain_name = chain_name
+        self.spec = spec
+        dims = len(spec.topology)
+        if len(spec.host_shape) != dims:
+            raise ValueError(
+                f"mesh chain {chain_name}: hostShape rank {len(spec.host_shape)} != "
+                f"topology rank {dims}"
+            )
+        if not tiles(spec.host_shape, spec.topology):
+            raise ValueError(
+                f"mesh chain {chain_name}: hostShape {spec.host_shape} does not tile "
+                f"topology {spec.topology}"
+            )
+
+        # Collect (name, shape) ascending: chip, [host], named..., top.
+        shapes: List[Tuple[str, Shape]] = [(spec.chip_type, (1,) * dims)]
+        named = [(lv.name, lv.shape) for lv in spec.levels]
+        host_named = any(s == spec.host_shape for _, s in named)
+        if not host_named and spec.host_shape != (1,) * dims and spec.host_shape != spec.topology:
+            named.append((f"{chain_name}-host", spec.host_shape))
+        named = [nv for nv in named if nv[1] != (1,) * dims and nv[1] != spec.topology]
+        named.sort(key=lambda nv: volume(nv[1]))
+        shapes.extend(named)
+        shapes.append((chain_name, spec.topology))
+
+        host_vol = volume(spec.host_shape)
+        self.levels: List[MeshLevel] = []
+        for i, (name, shape) in enumerate(shapes):
+            if i > 0:
+                prev = shapes[i - 1][1]
+                if not tiles(prev, shape) or volume(shape) <= volume(prev):
+                    raise ValueError(
+                        f"mesh chain {chain_name}: level {name} shape {shape} is not an "
+                        f"exact super-tile of {shapes[i - 1][0]} shape {prev}"
+                    )
+            vol = volume(shape)
+            self.levels.append(
+                MeshLevel(
+                    level=i + 1,
+                    cell_type=name,
+                    shape=shape,
+                    child_number=0 if i == 0 else vol // volume(shapes[i - 1][1]),
+                    is_node_level=shape == spec.host_shape,
+                    at_or_higher_than_node=vol >= host_vol,
+                    is_multi_nodes=vol > host_vol,
+                    leaf_cell_number=vol,
+                )
+            )
+        names = [lv.cell_type for lv in self.levels]
+        if len(set(names)) != len(names):
+            raise ValueError(f"mesh chain {chain_name}: duplicate level names {names}")
+
+    @property
+    def top_level(self) -> int:
+        return len(self.levels)
+
+    @property
+    def host_level(self) -> int:
+        for lv in self.levels:
+            if lv.is_node_level:
+                return lv.level
+        return self.top_level  # single-host chain (hostShape == topology)
+
+    def level_of_type(self, cell_type: str) -> Optional[int]:
+        for lv in self.levels:
+            if lv.cell_type == cell_type:
+                return lv.level
+        return None
+
+    def level(self, level: int) -> MeshLevel:
+        return self.levels[level - 1]
+
+    def node_name(self, top_address: str, host_origin: Coord) -> str:
+        """Stable node name for the host whose sub-mesh starts at host_origin,
+        e.g. ``pod-a/2-0-0``. Deployments map these to real hostnames via the
+        physical-cell spec's cellAddress."""
+        return f"{top_address}/{coord_str(host_origin)}"
+
+    def host_origin_of(self, coord: Coord) -> Coord:
+        return tuple((c // h) * h for c, h in zip(coord, self.spec.host_shape))
+
+    def chip_index_in_host(self, coord: Coord) -> int:
+        """In-host chip index handed off via TPU_VISIBLE_CHIPS."""
+        return row_major_index(coord, self.host_origin_of(coord), self.spec.host_shape)

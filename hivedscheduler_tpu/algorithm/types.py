@@ -1,0 +1,273 @@
+"""Algorithm-internal types: cell lists, scheduling requests, affinity groups,
+group placements and binding paths.
+
+TPU-native analogue of the reference's ``pkg/algorithm/types.go``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.algorithm.cell import Cell, CellChain, CellLevel, CellPriority, PhysicalCell, VirtualCell, cell_equal
+from hivedscheduler_tpu.algorithm.constants import GROUP_PREEMPTING
+from hivedscheduler_tpu.k8s.types import Pod
+
+CellList = List[Cell]
+
+
+def cell_list_contains(cl: CellList, c: Cell) -> bool:
+    return any(cell_equal(cc, c) for cc in cl)
+
+
+def cell_list_remove(cl: CellList, c: Cell) -> CellList:
+    """Swap-remove, mirroring CellList.remove (types.go:78-95)."""
+    for i, cc in enumerate(cl):
+        if cell_equal(cc, c):
+            cl[i] = cl[-1]
+            cl.pop()
+            return cl
+    raise AssertionError(f"Cell not found in list when removing: {c.address}")
+
+
+def cell_list_to_string(cl: CellList) -> str:
+    parts = []
+    for c in cl:
+        if isinstance(c, PhysicalCell):
+            parts.append(f"{c.address}({c.priority})({c.get_physical_placement_string()})")
+        else:
+            parts.append(f"{c.address}({c.priority})")
+    return ", ".join(parts)
+
+
+class ChainCellList(Dict[CellLevel, CellList]):
+    """Per-level cell lists of one chain (reference: types.go:98-130).
+
+    Like the reference's Go map, reading an absent level yields an empty list
+    (``__missing__`` inserts it), and instances may be sparse — e.g. a VC free
+    list holds only its preassigned cells' level."""
+
+    def __missing__(self, level: CellLevel) -> CellList:
+        lst: CellList = []
+        self[level] = lst
+        return lst
+
+    @staticmethod
+    def new(top: CellLevel) -> "ChainCellList":
+        ccl = ChainCellList()
+        for i in range(1, top + 1):
+            ccl[i] = []
+        return ccl
+
+    def contains(self, c: Cell, level: CellLevel) -> bool:
+        return cell_list_contains(self.get(level, []), c)
+
+    def remove(self, c: Cell, level: CellLevel) -> None:
+        self[level] = cell_list_remove(self[level], c)
+
+    def shallow_copy(self) -> "ChainCellList":
+        copied = ChainCellList()
+        for level in self:
+            copied[level] = list(self[level])
+        return copied
+
+    def __str__(self) -> str:
+        return "".join(
+            f"level {level}: {cell_list_to_string(self[level])}\n" for level in sorted(self)
+        )
+
+
+@dataclass
+class SchedulingRequest:
+    """Reference: schedulingRequest, types.go:43-52."""
+
+    vc: str = ""
+    pinned_cell_id: str = ""
+    chain: CellChain = ""
+    affinity_group_name: str = ""
+    affinity_group_pod_nums: Dict[int, int] = field(default_factory=dict)  # leafCellNum -> podNum
+    priority: CellPriority = 0
+    suggested_nodes: Set[str] = field(default_factory=set)
+    ignore_suggested_nodes: bool = False
+
+
+# placements: leafCellNum -> list over pods -> list of leaf cells of the pod
+GroupPhysicalPlacement = Dict[int, List[CellList]]
+GroupVirtualPlacement = Dict[int, List[CellList]]
+
+
+def physical_placement_to_node_leaf_cell_indices(
+    p: GroupPhysicalPlacement,
+) -> Dict[str, List[int]]:
+    """Reference: nodeToLeafCellIndices, types.go:223-238."""
+    out: Dict[str, List[int]] = {}
+    for pod_placements in p.values():
+        for pod_placement in pod_placements:
+            for leaf_cell in pod_placement:
+                assert isinstance(leaf_cell, PhysicalCell)
+                nodes, indices = leaf_cell.get_physical_placement()
+                out.setdefault(nodes[0], []).append(indices[0])
+    return out
+
+
+def virtual_placement_to_preassigned_leaf_cells(
+    p: GroupVirtualPlacement,
+) -> Dict[str, List[str]]:
+    """Reference: preassignedCellToLeafCells, types.go:244-261."""
+    out: Dict[str, List[str]] = {}
+    for pod_placements in p.values():
+        for pod_placement in pod_placements:
+            for leaf_cell in pod_placement:
+                assert isinstance(leaf_cell, VirtualCell)
+                pre = leaf_cell.preassigned_cell
+                out.setdefault(pre.address, []).append(leaf_cell.address)
+    return out
+
+
+def virtual_to_physical_placement(
+    p: GroupVirtualPlacement,
+    bindings: Dict[str, PhysicalCell],
+    leaf_cell_nums: List[int],
+) -> GroupPhysicalPlacement:
+    """Reference: toPhysicalPlacement, types.go:263-280."""
+    physical: GroupPhysicalPlacement = {}
+    for pod_leaf_cell_num in leaf_cell_nums:
+        pod_placements = p[pod_leaf_cell_num]
+        physical[pod_leaf_cell_num] = [
+            [bindings[leaf_cell.address] for leaf_cell in pod_placement]
+            for pod_placement in pod_placements
+        ]
+    return physical
+
+
+@dataclass
+class CellBindingPathVertex:
+    """Vertex of a binding-path tree (reference: types.go:342-347)."""
+
+    cell: VirtualCell
+    children_to_bind: List["CellBindingPathVertex"] = field(default_factory=list)
+
+
+def to_binding_paths(
+    p: GroupVirtualPlacement,
+    leaf_cell_nums: List[int],
+    bindings: Dict[str, PhysicalCell],
+) -> Tuple[List[CellBindingPathVertex], List[List[CellBindingPathVertex]]]:
+    """Collect the unbound virtual ancestors of all placed leaf cells and group
+    them into binding-path trees (reference: toBindingPaths, types.go:285-340).
+
+    Returns (preassigned roots, groups of non-preassigned roots that share an
+    already-bound parent — grouped so they can be mapped to buddy physical
+    cells together). Already-bound leaf cells are recorded into ``bindings``.
+    """
+    all_vertices: Dict[str, CellBindingPathVertex] = {}
+    preassigned: List[CellBindingPathVertex] = []
+    non_preassigned: List[List[CellBindingPathVertex]] = []
+    for pod_leaf_cell_num in leaf_cell_nums:
+        for pod_placement in p[pod_leaf_cell_num]:
+            for leaf_cell in pod_placement:
+                assert isinstance(leaf_cell, VirtualCell)
+                if leaf_cell.physical_cell is not None:
+                    bindings[leaf_cell.address] = leaf_cell.physical_cell
+                    continue
+                binding_path: List[VirtualCell] = []
+                c: Optional[Cell] = leaf_cell
+                while c is not None:
+                    vc = c
+                    assert isinstance(vc, VirtualCell)
+                    if vc.physical_cell is not None or vc.address in all_vertices:
+                        break
+                    binding_path.append(vc)
+                    c = c.parent
+                path_root = binding_path[-1]
+                n = CellBindingPathVertex(cell=path_root)
+                all_vertices[path_root.address] = n
+                parent = path_root.parent
+                if parent is None:
+                    preassigned.append(n)
+                elif parent.physical_cell is not None:  # type: ignore[union-attr]
+                    for group in non_preassigned:
+                        if cell_equal(parent, group[0].cell.parent):
+                            group.append(n)
+                            break
+                    else:
+                        non_preassigned.append([n])
+                else:
+                    parent_node = all_vertices[path_root.parent.address]
+                    parent_node.children_to_bind.append(n)
+                for c2 in reversed(binding_path[:-1]):
+                    n2 = CellBindingPathVertex(cell=c2)
+                    all_vertices[c2.parent.address].children_to_bind.append(n2)
+                    all_vertices[c2.address] = n2
+    return preassigned, non_preassigned
+
+
+class AlgoAffinityGroup:
+    """Algorithm-internal affinity group (reference: types.go:133-214)."""
+
+    def __init__(
+        self,
+        spec: api.AffinityGroupSpec,
+        vc: str,
+        lazy_preemption_enable: bool,
+        ignore_k8s_suggested_nodes: bool,
+        priority: int,
+        state: str,
+    ):
+        self.name = spec.name
+        self.vc = vc
+        self.lazy_preemption_enable = lazy_preemption_enable
+        # If False we avoid binding cells on non-suggested nodes (best-effort;
+        # bad nodes are always avoided).
+        self.ignore_k8s_suggested_nodes = ignore_k8s_suggested_nodes
+        self.priority = priority
+        self.total_pod_nums: Dict[int, int] = {}
+        for m in spec.members:
+            self.total_pod_nums[m.leaf_cell_number] = (
+                self.total_pod_nums.get(m.leaf_cell_number, 0) + m.pod_number
+            )
+        self.allocated_pods: Dict[int, List[Optional[Pod]]] = {}
+        self.preempting_pods: Dict[str, Pod] = {} if state == GROUP_PREEMPTING else None
+        self.physical_leaf_cell_placement: GroupPhysicalPlacement = {}
+        self.virtual_leaf_cell_placement: GroupVirtualPlacement = {}
+        self.state = state
+        self.lazy_preemption_status: Optional[api.LazyPreemptionStatus] = None
+        for leaf_cell_num, pod_num in self.total_pod_nums.items():
+            self.physical_leaf_cell_placement[leaf_cell_num] = [
+                [None] * leaf_cell_num for _ in range(pod_num)
+            ]
+            self.virtual_leaf_cell_placement[leaf_cell_num] = [
+                [None] * leaf_cell_num for _ in range(pod_num)
+            ]
+            self.allocated_pods[leaf_cell_num] = [None] * pod_num
+
+    def to_affinity_group(self) -> api.AffinityGroup:
+        """Reference: ToAffinityGroup, types.go:185-214."""
+        status = api.AffinityGroupStatus(
+            vc=self.vc,
+            priority=self.priority,
+            state=self.state,
+            lazy_preemption_status=self.lazy_preemption_status,
+        )
+        if self.physical_leaf_cell_placement:
+            try:
+                status.physical_placement = physical_placement_to_node_leaf_cell_indices(
+                    self.physical_leaf_cell_placement
+                )
+            except (AssertionError, AttributeError):
+                pass  # placement not fully decided yet
+        if self.virtual_leaf_cell_placement:
+            try:
+                status.virtual_placement = virtual_placement_to_preassigned_leaf_cells(
+                    self.virtual_leaf_cell_placement
+                )
+            except (AssertionError, AttributeError):
+                pass
+        for pods in self.allocated_pods.values():
+            for p in pods:
+                if p is not None:
+                    status.allocated_pods.append(p.uid)
+        if self.preempting_pods:
+            status.preempting_pods.extend(self.preempting_pods.keys())
+        return api.AffinityGroup(name=self.name, status=status)

@@ -1,0 +1,6 @@
+"""External contracts: config specs, annotations, wire types, inspect DTOs.
+
+TPU-native analogue of the reference's ``pkg/api`` (types at
+``pkg/api/types.go:42-273``, constants at ``pkg/api/constants.go:42-94``,
+config at ``pkg/api/config.go:39-230``).
+"""

@@ -1,0 +1,53 @@
+"""Annotation / resource-name contract, priority ranges, HTTP paths.
+
+TPU-native analogue of the reference's ``pkg/api/constants.go``. The three-
+annotation contract (spec / isolation / bind-info, ``constants.go:42-55``) is
+kept because it doubles as the crash-recovery store; the isolation handoff
+targets the Cloud TPU device plugin (``TPU_VISIBLE_CHIPS``) instead of
+``NVIDIA_VISIBLE_DEVICES`` (reference: ``doc/user-manual.md:164-175``).
+"""
+
+GROUP_NAME = "hivedscheduler.microsoft.com"
+COMPONENT_NAME = "tpu-hive"
+
+# --- Pod contract -----------------------------------------------------------
+# A pod opts in by declaring this (fake) resource limit on some container
+# (reference: constants.go:42, internal/utils.go:116-139).
+RESOURCE_NAME_POD_SCHEDULING_ENABLE = f"{GROUP_NAME}/pod-scheduling-enable"
+
+# User-written scheduling request (reference: constants.go:46).
+ANNOTATION_POD_SCHEDULING_SPEC = f"{GROUP_NAME}/pod-scheduling-spec"
+
+# Scheduler-written chip-isolation decision, consumed by the TPU device plugin
+# as TPU_VISIBLE_CHIPS (reference GPU analogue: constants.go:50).
+ANNOTATION_POD_CHIP_ISOLATION = f"{GROUP_NAME}/pod-leaf-cell-isolation"
+
+# Scheduler-written durable placement record; replayed at startup for crash
+# recovery (reference: constants.go:55, scheduler.go:306-337).
+ANNOTATION_POD_BIND_INFO = f"{GROUP_NAME}/pod-bind-info"
+
+# Environment variable the Cloud TPU device plugin / tpu runtime reads.
+ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+
+# --- Priorities (reference: constants.go:57-62) -----------------------------
+MAX_GUARANTEED_PRIORITY = 1000
+MIN_GUARANTEED_PRIORITY = 0
+OPPORTUNISTIC_PRIORITY = -1
+
+# --- Web server routes (reference: constants.go:72-94) ----------------------
+VERSION_PREFIX = "/v1"
+EXTENDER_PATH = VERSION_PREFIX + "/extender"
+FILTER_PATH = EXTENDER_PATH + "/filter"
+BIND_PATH = EXTENDER_PATH + "/bind"
+PREEMPT_PATH = EXTENDER_PATH + "/preempt"
+
+INSPECT_PATH = VERSION_PREFIX + "/inspect"
+AFFINITY_GROUPS_PATH = INSPECT_PATH + "/affinitygroups/"
+CLUSTER_STATUS_PATH = INSPECT_PATH + "/clusterstatus"
+PHYSICAL_CLUSTER_PATH = CLUSTER_STATUS_PATH + "/physicalcluster"
+VIRTUAL_CLUSTERS_PATH = CLUSTER_STATUS_PATH + "/virtualclusters/"
+
+# --- Config (reference: constants.go:65) ------------------------------------
+ENV_CONFIG_FILE = "CONFIG"
+DEFAULT_CONFIG_FILE_PATH = "./tpu-hive.yaml"
+DEFAULT_WEB_SERVER_ADDRESS = ":30096"

@@ -1,0 +1,548 @@
+"""Config specs, scheduling/bind wire types, inspect DTOs.
+
+TPU-native analogue of the reference's ``pkg/api/types.go``:
+
+- cluster config specs (``types.go:42-76``) extended with an ICI-mesh chain
+  spec (``mesh:``) so a cell type can be declared as a contiguous sub-mesh
+  hierarchy instead of a generic child-count tree;
+- ``PodSchedulingSpec`` / ``AffinityGroupSpec`` (``types.go:78-98``) with
+  ``chipType``/``chipNumber`` TPU aliases (and backward-compatible
+  ``gpuType``/``gpuNumber``/``leafCellType`` keys, mirroring
+  ``internal/utils.go:189-197``);
+- ``PodBindInfo`` — the durable placement record (``types.go:100-118``);
+- inspect DTOs with physical<->virtual cross-links (``types.go:140-273``).
+
+Everything (de)serializes to the reference's camelCase YAML/JSON keys so
+existing HiveD configs and clients carry over.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+CellType = str
+CellAddress = str
+PinnedCellId = str
+VirtualClusterName = str
+
+
+class WebServerError(Exception):
+    """HTTP-mapped error (reference: types.go:122-137)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"Code: {code}, Message: {message}")
+        self.code = code
+        self.message = message
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"code": self.code, "message": self.message}
+
+
+def as_bad_request(message: str) -> WebServerError:
+    return WebServerError(400, message)
+
+
+# ---------------------------------------------------------------------------
+# Physical cluster spec
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MeshLevelSpec:
+    """One named level of an ICI-mesh chain: a contiguous sub-mesh shape.
+
+    Each level's shape must tile the next level's shape exactly, so buddy
+    split/merge is mesh tiling and contiguity is guaranteed by construction
+    (TPU-first replacement for the reference's child-count levels).
+    """
+
+    name: CellType
+    shape: Tuple[int, ...]
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "MeshLevelSpec":
+        return MeshLevelSpec(name=d["name"], shape=tuple(int(x) for x in d["shape"]))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "shape": list(self.shape)}
+
+
+@dataclass
+class MeshSpec:
+    """ICI-mesh declaration of a cell chain.
+
+    ``topology`` is the full mesh of the top cell (e.g. ``[8, 8, 16]`` for a
+    v5p-1024 pod), ``chipType`` names the leaf cells, ``hostShape`` is the
+    sub-mesh directly attached to one host/node (e.g. ``[2, 2, 1]`` for v5p's
+    4-chip hosts), and ``levels`` are the named allocatable shapes in
+    ascending order. Chip level and host level are implicit (auto-inserted if
+    not listed)."""
+
+    topology: Tuple[int, ...]
+    chip_type: CellType
+    host_shape: Tuple[int, ...]
+    levels: List[MeshLevelSpec] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "MeshSpec":
+        return MeshSpec(
+            topology=tuple(int(x) for x in d["topology"]),
+            chip_type=d["chipType"],
+            host_shape=tuple(int(x) for x in d["hostShape"]),
+            levels=[MeshLevelSpec.from_dict(x) for x in d.get("levels", [])],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "topology": list(self.topology),
+            "chipType": self.chip_type,
+            "hostShape": list(self.host_shape),
+            "levels": [x.to_dict() for x in self.levels],
+        }
+
+
+@dataclass
+class CellTypeSpec:
+    """Reference: types.go:46-50, plus the TPU ``mesh`` extension.
+
+    Exactly one of (child_cell_type+child_cell_number) or ``mesh`` may be set;
+    neither set means a leaf cell type."""
+
+    child_cell_type: Optional[CellType] = None
+    child_cell_number: int = 0
+    is_node_level: bool = False
+    mesh: Optional[MeshSpec] = None
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "CellTypeSpec":
+        d = d or {}
+        return CellTypeSpec(
+            child_cell_type=d.get("childCellType"),
+            child_cell_number=int(d.get("childCellNumber", 0)),
+            is_node_level=bool(d.get("isNodeLevel", False)),
+            mesh=MeshSpec.from_dict(d["mesh"]) if d.get("mesh") else None,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.mesh is not None:
+            out["mesh"] = self.mesh.to_dict()
+        else:
+            if self.child_cell_type is not None:
+                out["childCellType"] = self.child_cell_type
+                out["childCellNumber"] = self.child_cell_number
+            if self.is_node_level:
+                out["isNodeLevel"] = True
+        return out
+
+
+@dataclass
+class PhysicalCellSpec:
+    """Reference: types.go:53-59."""
+
+    cell_type: CellType
+    cell_address: CellAddress = ""
+    pinned_cell_id: PinnedCellId = ""
+    cell_children: List["PhysicalCellSpec"] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PhysicalCellSpec":
+        return PhysicalCellSpec(
+            cell_type=d.get("cellType", ""),
+            cell_address=str(d.get("cellAddress", "")),
+            pinned_cell_id=d.get("pinnedCellId", ""),
+            cell_children=[PhysicalCellSpec.from_dict(c) for c in d.get("cellChildren", [])],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"cellType": self.cell_type, "cellAddress": self.cell_address}
+        if self.pinned_cell_id:
+            out["pinnedCellId"] = self.pinned_cell_id
+        if self.cell_children:
+            out["cellChildren"] = [c.to_dict() for c in self.cell_children]
+        return out
+
+
+@dataclass
+class PhysicalClusterSpec:
+    """Reference: types.go:41-44."""
+
+    cell_types: Dict[CellType, CellTypeSpec] = field(default_factory=dict)
+    physical_cells: List[PhysicalCellSpec] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PhysicalClusterSpec":
+        return PhysicalClusterSpec(
+            cell_types={k: CellTypeSpec.from_dict(v) for k, v in (d.get("cellTypes") or {}).items()},
+            physical_cells=[PhysicalCellSpec.from_dict(c) for c in d.get("physicalCells", [])],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "cellTypes": {k: v.to_dict() for k, v in self.cell_types.items()},
+            "physicalCells": [c.to_dict() for c in self.physical_cells],
+        }
+
+
+# ---------------------------------------------------------------------------
+# Virtual cluster spec
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class VirtualCellSpec:
+    """Reference: types.go:69-72. ``cell_type`` uses the ``chain.type`` path
+    syntax for non-top cell types (reference: config.go:370-374)."""
+
+    cell_number: int
+    cell_type: CellType
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "VirtualCellSpec":
+        return VirtualCellSpec(cell_number=int(d["cellNumber"]), cell_type=d["cellType"])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"cellNumber": self.cell_number, "cellType": self.cell_type}
+
+
+@dataclass
+class PinnedCellSpec:
+    """Reference: types.go:74-76."""
+
+    pinned_cell_id: PinnedCellId
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PinnedCellSpec":
+        return PinnedCellSpec(pinned_cell_id=d["pinnedCellId"])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"pinnedCellId": self.pinned_cell_id}
+
+
+@dataclass
+class VirtualClusterSpec:
+    """Reference: types.go:64-67."""
+
+    virtual_cells: List[VirtualCellSpec] = field(default_factory=list)
+    pinned_cells: List[PinnedCellSpec] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "VirtualClusterSpec":
+        return VirtualClusterSpec(
+            virtual_cells=[VirtualCellSpec.from_dict(c) for c in d.get("virtualCells", [])],
+            pinned_cells=[PinnedCellSpec.from_dict(c) for c in d.get("pinnedCells", [])],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"virtualCells": [c.to_dict() for c in self.virtual_cells]}
+        if self.pinned_cells:
+            out["pinnedCells"] = [c.to_dict() for c in self.pinned_cells]
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Pod scheduling spec + bind info
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AffinityGroupMemberSpec:
+    """Reference: types.go:95-98."""
+
+    pod_number: int
+    leaf_cell_number: int
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "AffinityGroupMemberSpec":
+        n = d.get("chipNumber", d.get("leafCellNumber", d.get("gpuNumber", 0)))
+        return AffinityGroupMemberSpec(
+            pod_number=int(d["podNumber"]), leaf_cell_number=int(n or 0)
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"podNumber": self.pod_number, "leafCellNumber": self.leaf_cell_number}
+
+
+@dataclass
+class AffinityGroupSpec:
+    """Reference: types.go:90-93."""
+
+    name: str
+    members: List[AffinityGroupMemberSpec] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "AffinityGroupSpec":
+        return AffinityGroupSpec(
+            name=d.get("name", ""),
+            members=[AffinityGroupMemberSpec.from_dict(m) for m in d.get("members", [])],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "members": [m.to_dict() for m in self.members]}
+
+
+@dataclass
+class PodSchedulingSpec:
+    """User request carried in the pod-scheduling-spec annotation.
+
+    Reference: types.go:78-88. ``chipType``/``chipNumber`` are the TPU-native
+    keys; ``leafCellType``/``leafCellNumber`` and the legacy
+    ``gpuType``/``gpuNumber`` are accepted on input (internal/utils.go:189-197)
+    so HiveD specs work unchanged."""
+
+    virtual_cluster: VirtualClusterName = ""
+    priority: int = 0
+    pinned_cell_id: PinnedCellId = ""
+    leaf_cell_type: str = ""
+    leaf_cell_number: int = 0
+    gang_release_enable: bool = False
+    lazy_preemption_enable: bool = False
+    ignore_k8s_suggested_nodes: bool = True
+    affinity_group: Optional[AffinityGroupSpec] = None
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PodSchedulingSpec":
+        leaf_type = d.get("chipType", d.get("leafCellType", d.get("gpuType", "")))
+        leaf_num = d.get("chipNumber", d.get("leafCellNumber", d.get("gpuNumber", 0)))
+        return PodSchedulingSpec(
+            virtual_cluster=d.get("virtualCluster", ""),
+            priority=int(d.get("priority", 0)),
+            pinned_cell_id=d.get("pinnedCellId", ""),
+            leaf_cell_type=leaf_type or "",
+            leaf_cell_number=int(leaf_num or 0),
+            gang_release_enable=bool(d.get("gangReleaseEnable", False)),
+            lazy_preemption_enable=bool(d.get("lazyPreemptionEnable", False)),
+            ignore_k8s_suggested_nodes=bool(d.get("ignoreK8sSuggestedNodes", True)),
+            affinity_group=(
+                AffinityGroupSpec.from_dict(d["affinityGroup"]) if d.get("affinityGroup") else None
+            ),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "virtualCluster": self.virtual_cluster,
+            "priority": self.priority,
+            "leafCellType": self.leaf_cell_type,
+            "leafCellNumber": self.leaf_cell_number,
+            "gangReleaseEnable": self.gang_release_enable,
+            "lazyPreemptionEnable": self.lazy_preemption_enable,
+            "ignoreK8sSuggestedNodes": self.ignore_k8s_suggested_nodes,
+        }
+        if self.pinned_cell_id:
+            out["pinnedCellId"] = self.pinned_cell_id
+        if self.affinity_group is not None:
+            out["affinityGroup"] = self.affinity_group.to_dict()
+        return out
+
+
+@dataclass
+class PodPlacementInfo:
+    """Reference: types.go:110-118."""
+
+    physical_node: str
+    physical_leaf_cell_indices: List[int] = field(default_factory=list)
+    preassigned_cell_types: List[CellType] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PodPlacementInfo":
+        return PodPlacementInfo(
+            physical_node=d.get("physicalNode", ""),
+            physical_leaf_cell_indices=[int(i) for i in d.get("physicalLeafCellIndices", [])],
+            preassigned_cell_types=list(d.get("preassignedCellTypes") or []),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "physicalNode": self.physical_node,
+            "physicalLeafCellIndices": self.physical_leaf_cell_indices,
+            "preassignedCellTypes": self.preassigned_cell_types,
+        }
+
+
+@dataclass
+class AffinityGroupMemberBindInfo:
+    """Reference: types.go:106-108."""
+
+    pod_placements: List[PodPlacementInfo] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "AffinityGroupMemberBindInfo":
+        return AffinityGroupMemberBindInfo(
+            pod_placements=[PodPlacementInfo.from_dict(p) for p in d.get("podPlacements", [])]
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"podPlacements": [p.to_dict() for p in self.pod_placements]}
+
+
+@dataclass
+class PodBindInfo:
+    """Durable placement record written into the pod-bind-info annotation at
+    bind time and replayed at startup (reference: types.go:100-104,
+    scheduler.go:306-337)."""
+
+    node: str
+    leaf_cell_isolation: List[int] = field(default_factory=list)
+    cell_chain: str = ""
+    affinity_group_bind_info: List[AffinityGroupMemberBindInfo] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PodBindInfo":
+        return PodBindInfo(
+            node=d.get("node", ""),
+            leaf_cell_isolation=[int(i) for i in d.get("leafCellIsolation", [])],
+            cell_chain=d.get("cellChain", ""),
+            affinity_group_bind_info=[
+                AffinityGroupMemberBindInfo.from_dict(m)
+                for m in d.get("affinityGroupBindInfo", [])
+            ],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "node": self.node,
+            "leafCellIsolation": self.leaf_cell_isolation,
+            "cellChain": self.cell_chain,
+            "affinityGroupBindInfo": [m.to_dict() for m in self.affinity_group_bind_info],
+        }
+
+
+# ---------------------------------------------------------------------------
+# Inspect DTOs (reference: types.go:140-273)
+# ---------------------------------------------------------------------------
+
+CELL_HEALTHY = "Healthy"
+CELL_BAD = "Bad"
+
+
+@dataclass
+class LazyPreemptionStatus:
+    preemptor: str
+    preemption_time: str
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"preemptor": self.preemptor, "preemptionTime": self.preemption_time}
+
+
+@dataclass
+class AffinityGroupStatus:
+    vc: VirtualClusterName = ""
+    priority: int = 0
+    state: str = ""
+    physical_placement: Dict[str, List[int]] = field(default_factory=dict)
+    virtual_placement: Dict[CellAddress, List[CellAddress]] = field(default_factory=dict)
+    allocated_pods: List[str] = field(default_factory=list)
+    preempting_pods: List[str] = field(default_factory=list)
+    lazy_preemption_status: Optional[LazyPreemptionStatus] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"vc": self.vc, "priority": self.priority, "state": self.state}
+        if self.physical_placement:
+            out["physicalPlacement"] = self.physical_placement
+        if self.virtual_placement:
+            out["virtualPlacement"] = self.virtual_placement
+        if self.allocated_pods:
+            out["allocatedPods"] = self.allocated_pods
+        if self.preempting_pods:
+            out["preemptingPods"] = self.preempting_pods
+        if self.lazy_preemption_status is not None:
+            out["lazyPreemptionStatus"] = self.lazy_preemption_status.to_dict()
+        return out
+
+
+@dataclass
+class AffinityGroup:
+    name: str
+    status: AffinityGroupStatus
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"metadata": {"name": self.name}, "status": self.status.to_dict()}
+
+
+@dataclass
+class CellStatus:
+    """Reference: types.go:184-205. ``mesh_origin``/``mesh_shape`` are TPU
+    extensions exposing the cell's sub-mesh geometry."""
+
+    cell_type: CellType = ""
+    cell_address: CellAddress = ""
+    cell_state: str = ""
+    cell_healthiness: str = CELL_HEALTHY
+    cell_priority: int = 0
+    leaf_cell_type: str = ""
+    is_node_level: bool = False
+    mesh_origin: Optional[Tuple[int, ...]] = None
+    mesh_shape: Optional[Tuple[int, ...]] = None
+
+    def base_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "cellType": self.cell_type,
+            "cellAddress": self.cell_address,
+            "cellState": self.cell_state,
+            "cellHealthiness": self.cell_healthiness,
+            "cellPriority": self.cell_priority,
+        }
+        if self.leaf_cell_type:
+            out["leafCellType"] = self.leaf_cell_type
+        if self.is_node_level:
+            out["isNodeLevel"] = True
+        if self.mesh_origin is not None:
+            out["meshOrigin"] = list(self.mesh_origin)
+        if self.mesh_shape is not None:
+            out["meshShape"] = list(self.mesh_shape)
+        return out
+
+
+@dataclass
+class PhysicalCellStatus(CellStatus):
+    cell_children: List["PhysicalCellStatus"] = field(default_factory=list)
+    vc: VirtualClusterName = ""
+    virtual_cell: Optional["VirtualCellStatus"] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = self.base_dict()
+        if self.cell_children:
+            out["cellChildren"] = [c.to_dict() for c in self.cell_children]
+        if self.vc:
+            out["vc"] = self.vc
+        if self.virtual_cell is not None:
+            out["virtualCell"] = self.virtual_cell.to_dict()
+        return out
+
+    def deep_copy(self) -> "PhysicalCellStatus":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class VirtualCellStatus(CellStatus):
+    cell_children: List["VirtualCellStatus"] = field(default_factory=list)
+    physical_cell: Optional[PhysicalCellStatus] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = self.base_dict()
+        if self.cell_children:
+            out["cellChildren"] = [c.to_dict() for c in self.cell_children]
+        if self.physical_cell is not None:
+            out["physicalCell"] = self.physical_cell.to_dict()
+        return out
+
+    def deep_copy(self) -> "VirtualCellStatus":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ClusterStatus:
+    physical_cluster: List[PhysicalCellStatus] = field(default_factory=list)
+    virtual_clusters: Dict[VirtualClusterName, List[VirtualCellStatus]] = field(
+        default_factory=dict
+    )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "physicalCluster": [c.to_dict() for c in self.physical_cluster],
+            "virtualClusters": {
+                vc: [c.to_dict() for c in cells] for vc, cells in self.virtual_clusters.items()
+            },
+        }

@@ -1,0 +1,7 @@
+from hivedscheduler_tpu.common.utils import (  # noqa: F401
+    from_json,
+    from_yaml,
+    init_logger,
+    to_json,
+    to_yaml,
+)

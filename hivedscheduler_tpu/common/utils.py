@@ -1,0 +1,75 @@
+"""Common utilities: codecs, logging, signal handling.
+
+TPU-native analogue of the reference's ``pkg/common`` (``common/utils.go:119-169``,
+``common/types.go:33-95``): YAML/JSON marshal-or-raise codecs, logger init
+(stderr only, mirroring the klog rationale at ``common/utils.go:124-149``), and
+a stop-event wired to SIGINT/SIGTERM. The reference's ``Set`` type is the
+builtin ``set``/``frozenset`` here.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import sys
+import threading
+from typing import Any
+
+import yaml
+
+log = logging.getLogger("tpu-hive")
+
+
+def init_logger(level: int = logging.INFO) -> None:
+    """Log to stderr only: the container runtime collects stderr, and mixing
+    stdout/stderr reorders lines (reference rationale: common/utils.go:124-149)."""
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter(
+            fmt="%(levelname).1s%(asctime)s.%(msecs)03d %(name)s %(filename)s:%(lineno)d] %(message)s",
+            datefmt="%m%d %H:%M:%S",
+        )
+    )
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(level)
+
+
+def init_all(level: int = logging.INFO) -> None:
+    """Process-wide init (reference: common.InitAll, common/utils.go:119)."""
+    init_logger(level)
+
+
+def to_yaml(obj: Any) -> str:
+    return yaml.safe_dump(obj, default_flow_style=False, sort_keys=False)
+
+
+def from_yaml(text: str) -> Any:
+    return yaml.safe_load(text)
+
+
+def to_json(obj: Any) -> str:
+    import json
+
+    return json.dumps(obj, separators=(",", ":"), sort_keys=False)
+
+
+def from_json(text: str) -> Any:
+    import json
+
+    return json.loads(text)
+
+
+def new_stop_event() -> threading.Event:
+    """Event set on SIGINT/SIGTERM (reference: NewStopChannel,
+    common/utils.go:155-169). Only callable from the main thread; callers on
+    other threads should construct their own Event."""
+    stop = threading.Event()
+
+    def _handler(signum: int, _frame: Any) -> None:
+        log.info("Received signal %s, stopping", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, _handler)
+    signal.signal(signal.SIGTERM, _handler)
+    return stop

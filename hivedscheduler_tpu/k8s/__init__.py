@@ -1,0 +1,8 @@
+"""Minimal Kubernetes object model + clients.
+
+The reference vendors k8s.io/client-go; this build uses a self-contained
+object model (``k8s/types.py``), a pluggable client interface
+(``k8s/client.py``), and an in-memory fake ApiServer with watch support
+(``k8s/fake.py``) used for tests and e2e — exceeding the reference's test
+strategy, which has no automated integration harness (SURVEY.md §4).
+"""

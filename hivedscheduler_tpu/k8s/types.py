@@ -1,0 +1,66 @@
+"""Kubernetes-shaped object model (pods, nodes, bindings).
+
+Only the fields the scheduler reads/writes are modeled, matching the shapes
+used by the reference through client-go: pod metadata + annotations + container
+resource limits + spec.nodeName + status.phase; node schedulability +
+conditions.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class Container:
+    name: str = ""
+    resource_limits: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Pod:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    annotations: Dict[str, str] = field(default_factory=dict)
+    containers: List[Container] = field(default_factory=list)
+    node_name: str = ""  # spec.nodeName: non-empty iff bound
+    phase: str = "Pending"  # status.phase
+    deletion_timestamp: Optional[str] = None
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def deep_copy(self) -> "Pod":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class NodeCondition:
+    type: str = "Ready"
+    status: str = "True"
+
+
+@dataclass
+class Node:
+    name: str = ""
+    unschedulable: bool = False
+    conditions: List[NodeCondition] = field(default_factory=lambda: [NodeCondition()])
+
+    def deep_copy(self) -> "Node":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class Binding:
+    """Bind subresource payload: target node + annotations to merge
+    (reference: internal/utils.go:291-314)."""
+
+    pod_name: str
+    pod_namespace: str
+    pod_uid: str
+    node: str
+    annotations: Dict[str, str] = field(default_factory=dict)

@@ -1,0 +1,18 @@
+"""Test session config.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip TPU hardware is not
+available in CI): the env vars MUST be set before jax is first imported, so
+this conftest sets them at collection time and never imports jax itself.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

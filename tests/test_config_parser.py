@@ -1,0 +1,123 @@
+"""Cell-tree constructor tests (mirroring the reference's config-as-fake-cluster
+strategy, SURVEY.md §4): golden topologies for generic and mesh chains."""
+
+import os
+
+import pytest
+
+from hivedscheduler_tpu.api.config import load_config
+from hivedscheduler_tpu.algorithm.config_parser import parse_config
+from hivedscheduler_tpu.algorithm.mesh import MeshChain
+from hivedscheduler_tpu.api.types import MeshSpec
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "example", "config", "design", "tpu-hive.yaml",
+)
+
+
+@pytest.fixture(scope="module")
+def parsed():
+    return parse_config(load_config(FIXTURE))
+
+
+def test_chains_present(parsed):
+    assert set(parsed.physical_full_list) == {"v4-node-pool", "v5p-64", "v5e-8"}
+
+
+def test_generic_chain_structure(parsed):
+    levels = parsed.chain_levels["v4-node-pool"]
+    assert [lv.cell_type for lv in levels] == ["v4-chip", "v4-tray", "v4-node", "v4-node-pool"]
+    assert [lv.leaf_cell_number for lv in levels] == [1, 4, 8, 24]
+    assert levels[2].is_node_level and not levels[2].is_multi_nodes
+    assert levels[3].is_multi_nodes
+    full = parsed.physical_full_list["v4-node-pool"]
+    assert len(full[1]) == 24 and len(full[2]) == 6 and len(full[3]) == 3 and len(full[4]) == 1
+    top = full[4][0]
+    assert top.nodes == ["0", "1", "2"]
+    assert top.leaf_cell_indices == [-1]
+    node = full[3][0]
+    assert node.nodes == ["0"]
+    assert sorted(node.leaf_cell_indices) == list(range(8))
+
+
+def test_mesh_chain_structure(parsed):
+    levels = parsed.chain_levels["v5p-64"]
+    assert [lv.cell_type for lv in levels] == [
+        "v5p-chip", "v5p-64-host", "v5p-2x2x2", "v5p-4x4x2", "v5p-64",
+    ]
+    assert [lv.leaf_cell_number for lv in levels] == [1, 4, 8, 32, 64]
+    assert [lv.child_number for lv in levels] == [0, 4, 2, 4, 2]
+    full = parsed.physical_full_list["v5p-64"]
+    assert len(full[1]) == 64 and len(full[2]) == 16 and len(full[3]) == 8
+    assert len(full[4]) == 2 and len(full[5]) == 1
+    # host cells map to nodes with 4-chip TPU_VISIBLE_CHIPS index ranges
+    host = full[2][0]
+    assert host.nodes == [host.address]
+    assert sorted(host.leaf_cell_indices) == [0, 1, 2, 3]
+    # contiguity: every cell is a contiguous sub-mesh with exact tiling
+    for level in range(1, 6):
+        for cell in full[level]:
+            assert cell.mesh_origin is not None and cell.mesh_shape is not None
+    top = full[5][0]
+    assert top.mesh_shape == (4, 4, 4)
+    assert len(top.nodes) == 16
+
+
+def test_mesh_pinned_cell(parsed):
+    pins = {pid: c for vc_pins in parsed.physical_pinned_cells.values() for pid, c in vc_pins.items()}
+    assert "pin1" in pins
+    pin = pins["pin1"]
+    assert pin.chain == "v5p-64"
+    assert pin.level == 3 and pin.mesh_origin == (0, 0, 0) and pin.mesh_shape == (2, 2, 2)
+    assert pin.pinned
+
+
+def test_single_host_mesh_chain(parsed):
+    levels = parsed.chain_levels["v5e-8"]
+    assert [lv.cell_type for lv in levels] == ["v5e-chip", "v5e-8"]
+    assert levels[1].is_node_level and not levels[1].is_multi_nodes
+    full = parsed.physical_full_list["v5e-8"]
+    assert len(full[1]) == 8 and len(full[2]) == 1
+    top = full[2][0]
+    assert top.nodes == ["v5e-host0/0-0"]
+    assert sorted(top.leaf_cell_indices) == list(range(8))
+
+
+def test_virtual_cells(parsed):
+    assert parsed.vc_free_cell_num["vc1"]["v5p-64"] == {4: 1, 3: 1}  # incl. pinned
+    assert parsed.vc_free_cell_num["vc1"]["v4-node-pool"] == {3: 2}
+    assert parsed.vc_free_cell_num["vc2"]["v5p-64"] == {3: 2}
+    assert parsed.vc_free_cell_num["vc2"]["v5e-8"] == {2: 1}
+    # vc1's non-pinned free list has one v5p-4x4x2 root whose tree reaches chips
+    free = parsed.virtual_non_pinned_free["vc1"]["v5p-64"]
+    (root,) = free[4]
+    assert root.total_leaf_cell_num == 32
+    assert root.preassigned_cell is root
+    leaves = root.children[0].children[0].children
+    assert all(lv.level == 1 for lv in leaves)
+    # pinned virtual tree exists for vc1
+    assert "pin1" in parsed.virtual_pinned_cells["vc1"]
+    assert len(parsed.virtual_pinned_cells["vc1"]["pin1"][1]) == 8
+
+
+def test_leaf_type_maps(parsed):
+    assert parsed.leaf_cell_type_to_chain["v5p-chip"] == ["v5p-64"]
+    assert parsed.leaf_cell_type_to_chain["v4-chip"] == ["v4-node-pool"]
+    assert parsed.cell_level_to_leaf_cell_num["v5p-64"][4] == 32
+    assert parsed.cell_level_to_type["v5p-64"][3] == "v5p-2x2x2"
+
+
+def test_mesh_validation_errors():
+    with pytest.raises(ValueError):
+        MeshChain("bad", MeshSpec(topology=(4, 4), chip_type="c", host_shape=(3, 3)))
+    with pytest.raises(ValueError):
+        MeshChain(
+            "bad2",
+            MeshSpec(
+                topology=(4, 4),
+                chip_type="c",
+                host_shape=(2, 2),
+                levels=[type("L", (), {"name": "x", "shape": (3, 2)})()],
+            ),
+        )
