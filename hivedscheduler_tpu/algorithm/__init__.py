@@ -2,3 +2,5 @@
 
 TPU-native analogue of the reference's ``pkg/algorithm``.
 """
+
+from hivedscheduler_tpu.algorithm.hived import HivedAlgorithm  # noqa: F401

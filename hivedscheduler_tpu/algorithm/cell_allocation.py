@@ -1,0 +1,388 @@
+"""Buddy allocation, safe-relaxed splitting, virtual<->physical cell mapping,
+and cell binding primitives.
+
+TPU-native analogue of the reference's ``pkg/algorithm/cell_allocation.go``.
+On a mesh chain, a buddy split is a mesh tiling (children of a cell are the
+sub-mesh tiles of the next-lower level), so every allocation these routines
+hand out is a contiguous ICI sub-mesh by construction; the backtracking exists
+only because cells can be bad or outside K8s suggested nodes
+(reference comment: ``cell_allocation.go:36-41``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Set, Tuple
+
+from hivedscheduler_tpu.algorithm.cell import (
+    Cell,
+    CellLevel,
+    CellPriority,
+    PhysicalCell,
+    VirtualCell,
+)
+from hivedscheduler_tpu.algorithm.constants import (
+    FREE_PRIORITY,
+    LOWEST_LEVEL,
+    MAX_GUARANTEED_PRIORITY,
+    OPPORTUNISTIC_PRIORITY,
+)
+from hivedscheduler_tpu.algorithm.types import CellBindingPathVertex, CellList, ChainCellList
+
+log = logging.getLogger(__name__)
+
+
+class VCSafetyBroken(AssertionError):
+    """Raised when an operation would violate the VC-safety invariant."""
+
+
+def _top_level(ccl: ChainCellList) -> CellLevel:
+    return max(ccl) if ccl else LOWEST_LEVEL
+
+
+def buddy_alloc(
+    cell: CellBindingPathVertex,
+    free_list: ChainCellList,
+    current_level: CellLevel,
+    suggested_nodes: Set[str],
+    ignore_suggested_nodes: bool,
+    bindings: Dict[str, PhysicalCell],
+) -> bool:
+    """Backtracking buddy allocation of a free physical cell for a preassigned
+    virtual cell; splits a higher-level cell when the current level is empty
+    (reference: buddyAlloc, cell_allocation.go:42-80). On mesh chains a split
+    is a mesh bisection/tiling, keeping every free cell contiguous."""
+    if current_level == cell.cell.level:
+        ok, picked = map_virtual_cells_to_physical(
+            [cell], free_list[current_level], suggested_nodes, ignore_suggested_nodes,
+            bindings, return_picked=True,
+        )
+        if ok:
+            for c in picked:
+                free_list.remove(c, current_level)
+            return True
+        return False
+    free_cells = get_usable_physical_cells(
+        free_list[current_level], 1, suggested_nodes, ignore_suggested_nodes
+    )
+    if free_cells is None:
+        return False
+    for c in free_cells:
+        free_list[current_level - 1] = free_list[current_level - 1] + list(c.children)
+        if buddy_alloc(
+            cell, free_list, current_level - 1, suggested_nodes, ignore_suggested_nodes, bindings
+        ):
+            free_list.remove(c, current_level)
+            return True
+        free_list[current_level - 1] = []
+    return False
+
+
+def safe_relaxed_buddy_alloc(
+    cell: CellBindingPathVertex,
+    free_list: ChainCellList,
+    free_cell_num: Dict[CellLevel, int],
+    current_level: CellLevel,
+    suggested_nodes: Set[str],
+    ignore_suggested_nodes: bool,
+    bindings: Dict[str, PhysicalCell],
+) -> bool:
+    """When buddy alloc fails (bad cells / non-suggested nodes), split
+    higher-level cells *without* violating VC safety: a level may only donate
+    ``len(freeList[l]) - freeCellNum[l]`` cells, where ``freeCellNum`` is the
+    number other VCs may still claim at that level (reference:
+    safeRelaxedBuddyAlloc, cell_allocation.go:84-150)."""
+    top = _top_level(free_list)
+    splittable_cell: Optional[Cell] = None
+    splittable_num: Dict[CellLevel, int] = {}
+    for i in range(top, current_level, -1):
+        splittable_num[i] = len(free_list[i]) - free_cell_num.get(i, 0)
+        if i < top and splittable_cell is not None:
+            splittable_num[i] += splittable_num[i + 1] * len(splittable_cell.children)
+        if splittable_cell is None and len(free_list[i]) > 0:
+            splittable_cell = free_list[i][0]
+        elif splittable_cell is not None:
+            splittable_cell = splittable_cell.children[0]
+        if splittable_num[i] < 0:
+            raise VCSafetyBroken(
+                f"VC Safety Broken: level {i} cell with free list {free_list[i]} is "
+                f"unsplittable, splittableNum={splittable_num[i]}"
+            )
+
+    for l in range(current_level + 1, top + 1):
+        cell_num = min(len(free_list[l]), splittable_num[l])
+        if cell_num > 0:
+            split_list: CellList = []
+            for _ in range(cell_num):
+                split_list.append(free_list[l][0])
+                free_list.remove(free_list[l][0], l)
+            splittable_num[l] -= cell_num
+            for _ in range(l, current_level, -1):
+                split_list = [child for sc in split_list for child in sc.children]
+            free_list[current_level] = split_list + free_list[current_level]
+            ok, picked = map_virtual_cells_to_physical(
+                [cell], free_list[current_level], suggested_nodes, ignore_suggested_nodes,
+                bindings, return_picked=True,
+            )
+            if ok:
+                for c in picked:
+                    free_list.remove(c, current_level)
+                return True
+    return False
+
+
+def get_lowest_free_cell_level(free_list: ChainCellList, level: CellLevel) -> CellLevel:
+    """Reference: getLowestFreeCellLevel, cell_allocation.go:153-161."""
+    top = _top_level(free_list)
+    for l in range(level, top + 1):
+        if len(free_list[l]) != 0:
+            return l
+    raise VCSafetyBroken(
+        f"VC Safety Broken: free cell not found even split to the highest level {top}"
+    )
+
+
+def map_virtual_placement_to_physical(
+    preassigned_cells: List[CellBindingPathVertex],
+    non_preassigned_cells: List[List[CellBindingPathVertex]],
+    free_list: ChainCellList,
+    free_cell_num: Dict[CellLevel, int],
+    suggested_nodes: Set[str],
+    ignore_suggested_nodes: bool,
+    bindings: Dict[str, PhysicalCell],
+) -> bool:
+    """Map a VC placement to the physical cluster: preassigned cells via buddy
+    alloc, non-preassigned cells following the preassigned cell's physical
+    topology (reference: mapVirtualPlacementToPhysical,
+    cell_allocation.go:166-197)."""
+    for c in preassigned_cells:
+        if not buddy_alloc(
+            c, free_list, get_lowest_free_cell_level(free_list, c.cell.level),
+            suggested_nodes, ignore_suggested_nodes, bindings,
+        ):
+            log.info("Buddy allocation failed due to bad cells, trying to split higher-level cells")
+            if not safe_relaxed_buddy_alloc(
+                c, free_list, free_cell_num, c.cell.level,
+                suggested_nodes, ignore_suggested_nodes, bindings,
+            ):
+                log.info("Cannot split higher level cells")
+                return False
+        else:
+            free_cell_num[c.cell.level] = free_cell_num.get(c.cell.level, 0) - 1
+    for cells in non_preassigned_cells:
+        parent = cells[0].cell.parent
+        assert isinstance(parent, VirtualCell) and parent.physical_cell is not None
+        ok, _ = map_virtual_cells_to_physical(
+            cells, parent.physical_cell.children, suggested_nodes, ignore_suggested_nodes,
+            bindings, return_picked=False,
+        )
+        if not ok:
+            return False
+    return True
+
+
+def get_usable_physical_cells(
+    candidates: CellList,
+    num_needed: int,
+    suggested_nodes: Set[str],
+    ignore_suggested_nodes: bool,
+) -> Optional[CellList]:
+    """Filter out bound cells, bad single-node cells, and cells entirely
+    outside suggested nodes; sort by fewest opportunistic pods to reduce
+    preemption (reference: getUsablePhysicalCells, cell_allocation.go:200-243)."""
+    usable: List[PhysicalCell] = []
+    for cand in candidates:
+        assert isinstance(cand, PhysicalCell)
+        if cand.virtual_cell is not None:
+            continue
+        nodes, _ = cand.get_physical_placement()
+        if len(nodes) == 1 and not cand.healthy:
+            continue
+        if not ignore_suggested_nodes:
+            if all(n not in suggested_nodes for n in nodes):
+                continue
+        usable.append(cand)
+    if len(usable) < num_needed:
+        return None
+    usable.sort(
+        key=lambda c: c.used_leaf_cell_num_at_priorities.get(OPPORTUNISTIC_PRIORITY, 0)
+    )
+    return usable
+
+
+def map_virtual_cells_to_physical(
+    cells: List[CellBindingPathVertex],
+    candidates: CellList,
+    suggested_nodes: Set[str],
+    ignore_suggested_nodes: bool,
+    bindings: Dict[str, PhysicalCell],
+    return_picked: bool,
+) -> Tuple[bool, Optional[CellList]]:
+    """Backtracking assignment of virtual cells to physical candidates, level
+    by level; children candidates are the picked cell's children, preserving
+    topology equivalence inside the preassigned cell (reference:
+    mapVirtualCellsToPhysical, cell_allocation.go:252-315)."""
+    usable = get_usable_physical_cells(
+        candidates, len(cells), suggested_nodes, ignore_suggested_nodes
+    )
+    if usable is None:
+        return False, None
+    cell_index = 0
+    picked_candidate_indices = [0] * len(cells)
+    picked_index_set: Set[int] = set()
+    while cell_index >= 0:
+        candidate_index = picked_candidate_indices[cell_index]
+        while candidate_index < len(usable):
+            if candidate_index in picked_index_set:
+                candidate_index += 1
+                continue
+            candidate = usable[candidate_index]
+            assert isinstance(candidate, PhysicalCell)
+            if candidate.level == LOWEST_LEVEL:
+                picked = True
+                bindings[cells[cell_index].cell.address] = candidate
+            else:
+                picked, _ = map_virtual_cells_to_physical(
+                    cells[cell_index].children_to_bind,
+                    candidate.children,
+                    suggested_nodes,
+                    ignore_suggested_nodes,
+                    bindings,
+                    return_picked=False,
+                )
+            if picked:
+                picked_candidate_indices[cell_index] = candidate_index
+                picked_index_set.add(candidate_index)
+                if cell_index == len(cells) - 1:
+                    if not return_picked:
+                        return True, None
+                    return True, [usable[i] for i in picked_candidate_indices]
+                break
+            candidate_index += 1
+        if candidate_index == len(usable):
+            cell_index -= 1
+            if cell_index >= 0:
+                picked_index_set.discard(picked_candidate_indices[cell_index])
+                picked_candidate_indices[cell_index] += 1
+        else:
+            cell_index += 1
+    return False, None
+
+
+def map_physical_cell_to_virtual(
+    c: PhysicalCell,
+    vccl: ChainCellList,
+    preassigned_level: CellLevel,
+    p: CellPriority,
+) -> Tuple[Optional[VirtualCell], str]:
+    """Inverse mapping used during recovery of allocated pods (reference:
+    mapPhysicalCellToVirtual, cell_allocation.go:320-346)."""
+    if c.virtual_cell is not None:
+        return c.virtual_cell, ""
+    if c.level == preassigned_level:
+        pre = get_lowest_priority_virtual_cell(vccl[preassigned_level], p)
+        if pre is None:
+            return None, (
+                f"insufficient free cell in the VC at the preassigned level ({preassigned_level})"
+            )
+        return pre, ""
+    if c.parent is None:
+        return None, (
+            f"physical and virtual cell hierarchies not match "
+            f"(cannot reach the preassigned level {preassigned_level} in physical)"
+        )
+    assert isinstance(c.parent, PhysicalCell)
+    parent_virtual, message = map_physical_cell_to_virtual(
+        c.parent, vccl, preassigned_level, p
+    )
+    if parent_virtual is None:
+        return None, message
+    return get_lowest_priority_virtual_cell(parent_virtual.children, p), ""
+
+
+def get_lowest_priority_virtual_cell(cl: CellList, p: CellPriority) -> Optional[VirtualCell]:
+    """Lowest-priority virtual cell among those with priority < p. A free cell
+    with a binding is skipped — such a binding (e.g., for a doomed bad cell)
+    cannot be preempted (reference: getLowestPriorityVirtualCell,
+    cell_allocation.go:352-372)."""
+    lowest_priority = MAX_GUARANTEED_PRIORITY
+    lowest_cell: Optional[VirtualCell] = None
+    for c in cl:
+        assert isinstance(c, VirtualCell)
+        priority = c.priority
+        if priority == FREE_PRIORITY:
+            if c.physical_cell is None:
+                return c
+            continue
+        if priority < p and priority < lowest_priority:
+            lowest_priority = priority
+            lowest_cell = c
+    return lowest_cell
+
+
+def get_unbound_virtual_cell(cl: CellList) -> Optional[VirtualCell]:
+    """Reference: getUnboundVirtualCell, cell_allocation.go:375-382."""
+    for c in cl:
+        assert isinstance(c, VirtualCell)
+        if c.physical_cell is None:
+            return c
+    return None
+
+
+def bind_cell(pc: PhysicalCell, vc: VirtualCell) -> None:
+    """Bind a virtual cell chainward up-tree, starting from leaf level
+    (reference: bindCell, cell_allocation.go:386-398)."""
+    while vc.physical_cell is None:
+        pc.set_virtual_cell(vc)
+        vc.set_physical_cell(pc)
+        log.info("Virtual cell %s is bound to physical cell %s", vc.address, pc.address)
+        if vc.parent is None:
+            break
+        vc = vc.parent  # type: ignore[assignment]
+        pc = pc.parent  # type: ignore[assignment]
+
+
+def unbind_cell(c: PhysicalCell) -> None:
+    """Unbind up-tree until an ancestor is pinned or still has bound children
+    (reference: unbindCell, cell_allocation.go:402-420)."""
+    bound_virtual = c.virtual_cell
+    while not bound_virtual.physical_cell.pinned:
+        bound_physical = bound_virtual.physical_cell
+        log.info(
+            "Virtual cell %s is unbound from physical cell %s",
+            bound_virtual.address, bound_physical.address,
+        )
+        bound_virtual.set_physical_cell(None)
+        bound_physical.set_virtual_cell(None)
+        if bound_virtual.parent is None:
+            return
+        for cc in bound_virtual.parent.children:
+            assert isinstance(cc, VirtualCell)
+            if cc.physical_cell is not None:
+                return
+        bound_virtual = bound_virtual.parent  # type: ignore[assignment]
+
+
+def set_cell_priority(c: Cell, p: CellPriority) -> None:
+    """Set priority keeping the invariant parent = max(children) (reference:
+    setCellPriority, cell_allocation.go:425-441)."""
+    original_priority = c.priority
+    c.set_priority(p)
+    parent = c.parent
+    if parent is not None:
+        if p > parent.priority:
+            set_cell_priority(parent, p)
+        elif original_priority == parent.priority and p < original_priority:
+            max_buddy_priority = FREE_PRIORITY
+            for buddy in parent.children:
+                if buddy.priority > max_buddy_priority:
+                    max_buddy_priority = buddy.priority
+            set_cell_priority(parent, max_buddy_priority)
+
+
+def update_used_leaf_cell_num_at_priority(c: Optional[Cell], p: CellPriority, increase: bool) -> None:
+    """Reference: updateUsedLeafCellNumAtPriority, cell_allocation.go:445-454."""
+    delta = 1 if increase else -1
+    while c is not None:
+        c.increase_used_leaf_cell_num_at_priority(p, delta)
+        c = c.parent

@@ -17,6 +17,10 @@ FREE_PRIORITY = OPPORTUNISTIC_PRIORITY - 1
 LOWEST_LEVEL = 1
 HIGHEST_LEVEL = 2**31 - 1
 
+# --- cell healthiness (re-exported api wire values) -------------------------
+from hivedscheduler_tpu.api.types import CELL_BAD as CELL_BAD_H  # noqa: E402
+from hivedscheduler_tpu.api.types import CELL_HEALTHY as CELL_HEALTHY_H  # noqa: E402
+
 # --- cell states ------------------------------------------------------------
 # No group is using, reserving, or has reserved the cell. A Free cell's
 # priority must be FREE_PRIORITY. (A Free cell may still be *bound* when it is

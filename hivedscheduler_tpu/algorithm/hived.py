@@ -1,0 +1,1389 @@
+"""HivedAlgorithm: the top-level scheduling algorithm.
+
+TPU-native analogue of the reference's ``pkg/algorithm/hived_algorithm.go``:
+VC-safety accounting (``totalLeftCellNum >= allVCFreeCellNum`` at every chain
+level), gang scheduling of affinity groups, guaranteed/opportunistic
+priorities, intra/inter-VC preemption with Reserving/Reserved cell states,
+lazy preemption, bad-hardware awareness with doomed-bad-cell binding, and
+annotation-driven crash recovery.
+
+Concurrency: all mutating entry points take the algorithm lock; the runtime
+additionally serializes scheduling via its own lock (reference contract:
+``internal/types.go:59-75``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from datetime import datetime, timezone
+from typing import Dict, List, Optional, Set, Tuple
+
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.api.config import Config
+from hivedscheduler_tpu.algorithm import utils as algo_utils
+from hivedscheduler_tpu.algorithm.cell import (
+    CellChain,
+    CellLevel,
+    CellPriority,
+    PhysicalCell,
+    VirtualCell,
+)
+from hivedscheduler_tpu.algorithm.cell_allocation import (
+    bind_cell,
+    get_unbound_virtual_cell,
+    map_physical_cell_to_virtual,
+    map_virtual_placement_to_physical,
+    set_cell_priority,
+    unbind_cell,
+    update_used_leaf_cell_num_at_priority,
+)
+from hivedscheduler_tpu.algorithm.config_parser import parse_config
+from hivedscheduler_tpu.algorithm.constants import (
+    CELL_BAD_H,
+    CELL_FREE,
+    CELL_HEALTHY_H,
+    CELL_RESERVED,
+    CELL_RESERVING,
+    CELL_USED,
+    FREE_PRIORITY,
+    GROUP_ALLOCATED,
+    GROUP_BEING_PREEMPTED,
+    GROUP_PREEMPTING,
+    LOWEST_LEVEL,
+    MIN_GUARANTEED_PRIORITY,
+    OPPORTUNISTIC_PRIORITY,
+)
+from hivedscheduler_tpu.algorithm.intra_vc import IntraVCScheduler
+from hivedscheduler_tpu.algorithm.topology_aware import TopologyAwareScheduler
+from hivedscheduler_tpu.algorithm.types import (
+    AlgoAffinityGroup,
+    ChainCellList,
+    GroupPhysicalPlacement,
+    GroupVirtualPlacement,
+    SchedulingRequest,
+    to_binding_paths,
+    virtual_to_physical_placement,
+)
+from hivedscheduler_tpu.algorithm.utils import (
+    all_pods_released,
+    collect_bad_or_non_suggested_nodes,
+    collect_preemption_victims,
+    delete_ot_virtual_cell,
+    find_physical_leaf_cell,
+    generate_ot_virtual_cell,
+    generate_pod_schedule_result,
+    get_allocated_pod_index,
+    get_new_pod_index,
+    in_free_cell_list,
+    retrieve_virtual_cell,
+    set_cell_state,
+)
+from hivedscheduler_tpu.k8s.types import Node, Pod
+from hivedscheduler_tpu.runtime import types as internal
+from hivedscheduler_tpu.runtime import utils as internal_utils
+from hivedscheduler_tpu.runtime.types import PodScheduleResult, SchedulerAlgorithm
+
+log = logging.getLogger(__name__)
+
+
+class HivedAlgorithm(SchedulerAlgorithm):
+    """Reference: HivedAlgorithm, hived_algorithm.go:40-105."""
+
+    def __init__(self, config: Config):
+        parsed = parse_config(config)
+        self.vc_schedulers: Dict[str, IntraVCScheduler] = {}
+        self.opportunistic_schedulers: Dict[CellChain, TopologyAwareScheduler] = {}
+        self.full_cell_list = parsed.physical_full_list
+        self.free_cell_list = parsed.physical_free_list
+        self.affinity_groups: Dict[str, AlgoAffinityGroup] = {}
+        self.vc_free_cell_num = parsed.vc_free_cell_num
+        self.all_vc_free_cell_num: Dict[CellChain, Dict[CellLevel, int]] = {}
+        self.total_left_cell_num: Dict[CellChain, Dict[CellLevel, int]] = {}
+        self.bad_free_cells: Dict[CellChain, ChainCellList] = {}
+        self.vc_doomed_bad_cells: Dict[str, Dict[CellChain, ChainCellList]] = {}
+        self.all_vc_doomed_bad_cell_num: Dict[CellChain, Dict[CellLevel, int]] = {}
+        self.bad_nodes: Set[str] = set()
+        self.cell_chains = parsed.leaf_cell_type_to_chain
+        self.cell_types = parsed.cell_level_to_type
+        self.mesh_chains = parsed.mesh_chains
+        self.api_cluster_status = api.ClusterStatus()
+        self.algorithm_lock = threading.RLock()
+
+        for vc_name in parsed.virtual_non_pinned_full:
+            self.vc_schedulers[vc_name] = IntraVCScheduler(
+                parsed.virtual_non_pinned_full[vc_name],
+                parsed.virtual_non_pinned_free[vc_name],
+                parsed.virtual_pinned_cells[vc_name],
+                parsed.cell_level_to_leaf_cell_num,
+            )
+        for chain, ccl in self.full_cell_list.items():
+            self.opportunistic_schedulers[chain] = TopologyAwareScheduler(
+                ccl, parsed.cell_level_to_leaf_cell_num[chain], cross_priority_pack=False
+            )
+        self._init_cell_nums()
+        self._init_api_cluster_status()
+        self._init_pinned_cells(parsed.physical_pinned_cells)
+        self._init_bad_nodes()
+
+    # ------------------------------------------------------------------
+    # init
+    # ------------------------------------------------------------------
+
+    def _init_cell_nums(self) -> None:
+        """Validates VC assignment fits the physical cluster and initializes
+        usage/badness tracking (reference: initCellNums,
+        hived_algorithm.go:369-409)."""
+        for vc, vc_free in self.vc_free_cell_num.items():
+            self.vc_doomed_bad_cells[vc] = {}
+            for chain, chain_free in vc_free.items():
+                self.vc_doomed_bad_cells[vc][chain] = ChainCellList()
+                self.all_vc_free_cell_num.setdefault(chain, {})
+                for level, num in chain_free.items():
+                    self.all_vc_free_cell_num[chain][level] = (
+                        self.all_vc_free_cell_num[chain].get(level, 0) + num
+                    )
+        for chain, chain_free in self.all_vc_free_cell_num.items():
+            ccl = self.full_cell_list.get(chain)
+            if ccl is None:
+                raise AssertionError(
+                    f"Illegal initial VC assignment: Chain {chain} does not exist "
+                    f"in physical cluster"
+                )
+            top = max(ccl)
+            available = len(ccl[top])
+            self.total_left_cell_num[chain] = {top: available}
+            self.bad_free_cells[chain] = ChainCellList()
+            self.all_vc_doomed_bad_cell_num[chain] = {}
+            for l in range(top, LOWEST_LEVEL - 1, -1):
+                left = available - chain_free.get(l, 0)
+                if left < 0:
+                    raise AssertionError(
+                        f"Illegal initial VC assignment: Insufficient physical cells "
+                        f"at chain {chain} level {l}: {chain_free.get(l, 0)} needed, "
+                        f"{available} available"
+                    )
+                if l > LOWEST_LEVEL:
+                    child_num = len(ccl[l][0].children)
+                    available = left * child_num
+                    self.total_left_cell_num[chain][l - 1] = (
+                        self.total_left_cell_num[chain][l] * child_num
+                    )
+
+    def _init_api_cluster_status(self) -> None:
+        """Reference: initAPIClusterStatus, hived_algorithm.go:412-436."""
+        for ccl in self.full_cell_list.values():
+            for c in ccl[max(ccl)]:
+                assert isinstance(c, PhysicalCell)
+                self.api_cluster_status.physical_cluster.append(c.api_status)
+        for vc, vcs in self.vc_schedulers.items():
+            status_list: List[api.VirtualCellStatus] = []
+            for ccl in vcs.non_pinned_preassigned_cells.values():
+                for cl in ccl.values():
+                    for c in cl:
+                        assert isinstance(c, VirtualCell)
+                        status_list.append(c.api_status)
+            for ccl in vcs.pinned_cells.values():
+                for c in ccl[max(ccl)]:
+                    assert isinstance(c, VirtualCell)
+                    status_list.append(c.api_status)
+            self.api_cluster_status.virtual_clusters[vc] = status_list
+
+    def _init_pinned_cells(
+        self, pinned: Dict[str, Dict[str, PhysicalCell]]
+    ) -> None:
+        """Static bindings for pinned cells; removes them from the free list
+        (reference: initPinnedCells, hived_algorithm.go:439-450)."""
+        for vcn, vc_pinned in pinned.items():
+            for pid, pinned_physical in vc_pinned.items():
+                self._allocate_preassigned_cell(pinned_physical, vcn, doomed_bad=False)
+                virtual_list = self.vc_schedulers[vcn].pinned_cells[pid]
+                pinned_virtual = virtual_list[max(virtual_list)][0]
+                assert isinstance(pinned_virtual, VirtualCell)
+                bind_cell(pinned_physical, pinned_virtual)
+
+    def _init_bad_nodes(self) -> None:
+        """All nodes start bad until K8s informs otherwise (reference:
+        initBadNodes, hived_algorithm.go:453-464)."""
+        log.info("Init all nodes defined in the config to bad first, and wait for "
+                 "node informs (add_node) to mark the healthy ones")
+        for ccl in self.full_cell_list.values():
+            for c in ccl[max(ccl)]:
+                assert isinstance(c, PhysicalCell)
+                nodes, _ = c.get_physical_placement()
+                for n in nodes:
+                    self._set_bad_node(n)
+
+    # ------------------------------------------------------------------
+    # node events
+    # ------------------------------------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        with self.algorithm_lock:
+            if not internal_utils.is_node_healthy(node):
+                self._set_bad_node(node.name)
+            else:
+                self._set_healthy_node(node.name)
+
+    def update_node(self, old_node: Node, new_node: Node) -> None:
+        with self.algorithm_lock:
+            old_healthy = internal_utils.is_node_healthy(old_node)
+            if old_healthy != internal_utils.is_node_healthy(new_node):
+                if old_healthy:
+                    self._set_bad_node(new_node.name)
+                else:
+                    self._set_healthy_node(new_node.name)
+
+    def delete_node(self, node: Node) -> None:
+        with self.algorithm_lock:
+            self._set_bad_node(node.name)
+
+    def _set_bad_node(self, node_name: str) -> None:
+        """Reference: setBadNode, hived_algorithm.go:467-481."""
+        if node_name in self.bad_nodes:
+            return
+        self.bad_nodes.add(node_name)
+        for ccl in self.full_cell_list.values():
+            for leaf_cell in ccl[1]:
+                assert isinstance(leaf_cell, PhysicalCell)
+                if leaf_cell.nodes[0] == node_name:
+                    self._set_bad_cell(leaf_cell)
+
+    def _set_healthy_node(self, node_name: str) -> None:
+        """Reference: setHealthyNode, hived_algorithm.go:484-498."""
+        if node_name not in self.bad_nodes:
+            return
+        self.bad_nodes.discard(node_name)
+        for ccl in self.full_cell_list.values():
+            for leaf_cell in ccl[1]:
+                assert isinstance(leaf_cell, PhysicalCell)
+                if leaf_cell.nodes[0] == node_name:
+                    self._set_healthy_cell(leaf_cell)
+
+    def _set_bad_cell(self, c: PhysicalCell) -> None:
+        """Mark bad up-tree; bind to a virtual cell if an ancestor is bound so
+        the VC scheduler sees the failure (reference: setBadCell,
+        hived_algorithm.go:503-521)."""
+        if not c.healthy:
+            return
+        c.set_healthiness(CELL_BAD_H)
+        if c.parent is not None:
+            self._set_bad_cell(c.parent)  # type: ignore[arg-type]
+        if in_free_cell_list(c):
+            self._add_bad_free_cell(c)
+        elif c.virtual_cell is None and not c.split:
+            parent = c.parent
+            assert isinstance(parent, PhysicalCell) and parent.virtual_cell is not None
+            vc = get_unbound_virtual_cell(parent.virtual_cell.children)
+            c.set_virtual_cell(vc)
+            vc.set_physical_cell(c)
+            log.info("Virtual cell %s is bound to physical cell %s", vc.address, c.address)
+
+    def _set_healthy_cell(self, c: PhysicalCell) -> None:
+        """Reference: setHealthyCell, hived_algorithm.go:526-560."""
+        if c.healthy:
+            return
+        c.set_healthiness(CELL_HEALTHY_H)
+        if in_free_cell_list(c):
+            self._remove_bad_free_cell(c)
+        elif c.virtual_cell is not None:
+            vc = c.virtual_cell
+            if not c.pinned and c.priority < MIN_GUARANTEED_PRIORITY:
+                # binding existed only because the cell was bad; drop it
+                c.set_virtual_cell(None)
+                vc.set_physical_cell(None)
+                log.info("Virtual cell %s is unbound from physical cell %s",
+                         vc.address, c.address)
+                if vc.parent is None:
+                    # a preassigned cell: must be a doomed bad cell
+                    self.vc_doomed_bad_cells[vc.vc][c.chain].remove(c, c.level)
+                    self.all_vc_doomed_bad_cell_num[c.chain][c.level] -= 1
+                    self._release_preassigned_cell(c, vc.vc, doomed_bad=True)
+        if c.parent is None:
+            return
+        for buddy in c.parent.children:
+            assert isinstance(buddy, PhysicalCell)
+            if not buddy.healthy:
+                return
+        self._set_healthy_cell(c.parent)  # type: ignore[arg-type]
+
+    def _add_bad_free_cell(self, c: PhysicalCell) -> None:
+        """Reference: addBadFreeCell, hived_algorithm.go:564-581."""
+        chain, level = c.chain, c.level
+        self.bad_free_cells[chain][level].append(c)
+        if self.all_vc_free_cell_num.get(chain, {}).get(level, 0) > (
+            self.total_left_cell_num[chain][level] - len(self.bad_free_cells[chain][level])
+        ):
+            log.warning(
+                "Cell type %s (chain %s level %s) now has fewer healthy cells (%s) than "
+                "the total free cells of all the VCs (%s). Certain VCs' cells may be "
+                "doomed to be bad.",
+                self.cell_types[chain][level], chain, level,
+                self.total_left_cell_num[chain][level] - len(self.bad_free_cells[chain][level]),
+                self.all_vc_free_cell_num[chain][level]
+                + self.all_vc_doomed_bad_cell_num[chain].get(level, 0),
+            )
+            self._try_bind_doomed_bad_cell(chain, level)
+
+    def _remove_bad_free_cell(self, c: PhysicalCell) -> None:
+        """Reference: removeBadFreeCell, hived_algorithm.go:584-600."""
+        chain, level = c.chain, c.level
+        self.bad_free_cells[chain].remove(c, level)
+        self._try_unbind_doomed_bad_cell(chain, level)
+
+    def _try_bind_doomed_bad_cell(self, chain: CellChain, level: CellLevel) -> None:
+        """If a VC's free cells exceed the healthy free physical cells, some of
+        its cells are doomed bad: bind them so the VC scheduler avoids them
+        (reference: tryBindDoomedBadCell, hived_algorithm.go:604-628)."""
+        for vc_name, vc_free in self.vc_free_cell_num.items():
+            if chain not in vc_free:
+                continue
+            while vc_free[chain].get(level, 0) > (
+                self.total_left_cell_num[chain][level] - len(self.bad_free_cells[chain][level])
+            ):
+                pc = self.bad_free_cells[chain][level][0]
+                assert isinstance(pc, PhysicalCell)
+                vc = get_unbound_virtual_cell(
+                    self.vc_schedulers[vc_name].non_pinned_preassigned_cells[chain][level]
+                )
+                pc.set_virtual_cell(vc)
+                vc.set_physical_cell(pc)
+                log.warning(
+                    "Cell %s is doomed to be bad and bound to %s (VC %s)",
+                    vc.address, pc.address, vc_name,
+                )
+                self.vc_doomed_bad_cells[vc_name][chain][level].append(pc)
+                self.all_vc_doomed_bad_cell_num[chain][level] = (
+                    self.all_vc_doomed_bad_cell_num[chain].get(level, 0) + 1
+                )
+                self._allocate_preassigned_cell(pc, vc_name, doomed_bad=True)
+
+    def _try_unbind_doomed_bad_cell(self, chain: CellChain, level: CellLevel) -> None:
+        """Reference: tryUnbindDoomedBadCell, hived_algorithm.go:632-653."""
+        for vc_name, vc_free in self.vc_free_cell_num.items():
+            if chain not in vc_free:
+                continue
+            while len(self.vc_doomed_bad_cells[vc_name][chain][level]) != 0 and vc_free[
+                chain
+            ].get(level, 0) < (
+                self.total_left_cell_num[chain][level] - len(self.bad_free_cells[chain][level])
+            ):
+                pc = self.vc_doomed_bad_cells[vc_name][chain][level][0]
+                assert isinstance(pc, PhysicalCell)
+                log.info(
+                    "Cell %s is no longer doomed to be bad and is unbound from %s",
+                    pc.virtual_cell.address, pc.address,
+                )
+                pc.virtual_cell.set_physical_cell(None)
+                pc.set_virtual_cell(None)
+                self.vc_doomed_bad_cells[vc_name][chain].remove(pc, level)
+                self.all_vc_doomed_bad_cell_num[chain][level] -= 1
+                self._release_preassigned_cell(pc, vc_name, doomed_bad=True)
+
+    # ------------------------------------------------------------------
+    # scheduling entry
+    # ------------------------------------------------------------------
+
+    def schedule(
+        self, pod: Pod, suggested_nodes: List[str], phase: str
+    ) -> PodScheduleResult:
+        """Reference: Schedule, hived_algorithm.go:180-224."""
+        with self.algorithm_lock:
+            log.info("[%s]: Scheduling pod in %s phase...", internal_utils.key(pod), phase)
+            s = internal_utils.extract_pod_scheduling_spec(pod)
+            suggested_node_set = set(suggested_nodes)
+            group_physical: Optional[GroupPhysicalPlacement] = None
+            group_virtual: Optional[GroupVirtualPlacement] = None
+            preemption_victims: Dict[str, Dict[str, Pod]] = {}
+            wait_reason = ""
+            pod_index = 0
+
+            g = self.affinity_groups.get(s.affinity_group.name)
+            if g is not None:
+                (group_physical, group_virtual, preemption_victims, pod_index) = (
+                    self._schedule_pod_from_existing_group(
+                        g, s, suggested_node_set, phase, pod
+                    )
+                )
+            # the group may have been a preempting group deleted just above
+            if self.affinity_groups.get(s.affinity_group.name) is None:
+                (group_physical, group_virtual, preemption_victims, wait_reason) = (
+                    self._schedule_pod_from_new_group(s, suggested_node_set, phase, pod)
+                )
+            return generate_pod_schedule_result(
+                group_physical,
+                group_virtual,
+                preemption_victims,
+                wait_reason,
+                self.cell_types,
+                s.leaf_cell_number,
+                pod_index,
+                self.affinity_groups.get(s.affinity_group.name),
+                s.affinity_group.name,
+                suggested_node_set,
+                pod,
+            )
+
+    def add_unallocated_pod(self, pod: Pod) -> None:
+        pass
+
+    def delete_unallocated_pod(self, pod: Pod) -> None:
+        """Cancels a preemption when its last preempting pod dies (reference:
+        DeleteUnallocatedPod, hived_algorithm.go:229-245)."""
+        with self.algorithm_lock:
+            s = internal_utils.extract_pod_scheduling_spec(pod)
+            g = self.affinity_groups.get(s.affinity_group.name)
+            if g is not None and g.state == GROUP_PREEMPTING:
+                if g.preempting_pods and pod.uid in g.preempting_pods:
+                    log.info("[%s]: Deleting preempting pod from affinity group %s...",
+                             internal_utils.key(pod), g.name)
+                    del g.preempting_pods[pod.uid]
+                if not g.preempting_pods:
+                    log.info(
+                        "[%s]: Canceling affinity group %s's preemption because its pods "
+                        "are all deleted", internal_utils.key(pod), g.name,
+                    )
+                    self._delete_preempting_affinity_group(g, pod)
+
+    def add_allocated_pod(self, pod: Pod) -> None:
+        """Reference: AddAllocatedPod, hived_algorithm.go:247-269."""
+        with self.algorithm_lock:
+            s = internal_utils.extract_pod_scheduling_spec(pod)
+            info = internal_utils.extract_pod_bind_info(pod)
+            log.info("[%s]: Adding allocated pod to affinity group %s (node %s, leaf cells %s)",
+                     internal_utils.key(pod), s.affinity_group.name, info.node,
+                     info.leaf_cell_isolation)
+            pod_index = 0
+            g = self.affinity_groups.get(s.affinity_group.name)
+            if g is not None:
+                if g.state == GROUP_PREEMPTING:
+                    self._allocate_preempting_affinity_group(g, pod)
+                pod_index = get_allocated_pod_index(info, s.leaf_cell_number)
+                if pod_index == -1:
+                    log.error(
+                        "[%s]: Pod placement not found in group %s: node %s, leaf cells %s",
+                        internal_utils.key(pod), s.affinity_group.name, info.node,
+                        info.leaf_cell_isolation,
+                    )
+                    return
+            else:
+                self._create_allocated_affinity_group(s, info, pod)
+            self.affinity_groups[s.affinity_group.name].allocated_pods[s.leaf_cell_number][
+                pod_index
+            ] = pod
+
+    def delete_allocated_pod(self, pod: Pod) -> None:
+        """Reference: DeleteAllocatedPod, hived_algorithm.go:272-296."""
+        with self.algorithm_lock:
+            s = internal_utils.extract_pod_scheduling_spec(pod)
+            info = internal_utils.extract_pod_bind_info(pod)
+            log.info(
+                "[%s]: Deleting allocated pod from affinity group %s (node %s, leaf cells %s)",
+                internal_utils.key(pod), s.affinity_group.name, info.node,
+                info.leaf_cell_isolation,
+            )
+            g = self.affinity_groups.get(s.affinity_group.name)
+            if g is None:
+                log.error("[%s]: Group %s not found when deleting pod",
+                          internal_utils.key(pod), s.affinity_group.name)
+                return
+            pod_index = get_allocated_pod_index(info, s.leaf_cell_number)
+            if pod_index == -1 or s.leaf_cell_number not in g.allocated_pods:
+                log.error(
+                    "[%s]: Pod placement not found in group %s: node %s, leaf cells %s",
+                    internal_utils.key(pod), s.affinity_group.name, info.node,
+                    info.leaf_cell_isolation,
+                )
+                return
+            g.allocated_pods[s.leaf_cell_number][pod_index] = None
+            if all_pods_released(g.allocated_pods):
+                self._delete_allocated_affinity_group(g, pod)
+
+    # ------------------------------------------------------------------
+    # inspect
+    # ------------------------------------------------------------------
+
+    def get_all_affinity_groups(self) -> List[api.AffinityGroup]:
+        with self.algorithm_lock:
+            return [g.to_affinity_group() for g in self.affinity_groups.values()]
+
+    def get_affinity_group(self, name: str) -> api.AffinityGroup:
+        with self.algorithm_lock:
+            g = self.affinity_groups.get(name)
+            if g is not None:
+                return g.to_affinity_group()
+            raise api.WebServerError(
+                404,
+                f"Affinity group {name} does not exist since it is not allocated or preempting",
+            )
+
+    def get_cluster_status(self) -> api.ClusterStatus:
+        with self.algorithm_lock:
+            return api.ClusterStatus(
+                physical_cluster=[s.deep_copy() for s in self.api_cluster_status.physical_cluster],
+                virtual_clusters={
+                    vcn: [s.deep_copy() for s in vcs]
+                    for vcn, vcs in self.api_cluster_status.virtual_clusters.items()
+                },
+            )
+
+    def get_physical_cluster_status(self) -> List[api.PhysicalCellStatus]:
+        with self.algorithm_lock:
+            return [s.deep_copy() for s in self.api_cluster_status.physical_cluster]
+
+    def get_all_virtual_clusters_status(self) -> Dict[str, List[api.VirtualCellStatus]]:
+        with self.algorithm_lock:
+            return {
+                vcn: [s.deep_copy() for s in vcs]
+                for vcn, vcs in self.api_cluster_status.virtual_clusters.items()
+            }
+
+    def get_virtual_cluster_status(self, vcn: str) -> List[api.VirtualCellStatus]:
+        with self.algorithm_lock:
+            if vcn in self.api_cluster_status.virtual_clusters:
+                return [s.deep_copy() for s in self.api_cluster_status.virtual_clusters[vcn]]
+            raise api.WebServerError(404, f"VC {vcn} not found")
+
+    # ------------------------------------------------------------------
+    # scheduling internals
+    # ------------------------------------------------------------------
+
+    def _schedule_pod_from_existing_group(
+        self,
+        g: AlgoAffinityGroup,
+        s: api.PodSchedulingSpec,
+        suggested_nodes: Set[str],
+        phase: str,
+        pod: Pod,
+    ) -> Tuple[
+        Optional[GroupPhysicalPlacement],
+        Optional[GroupVirtualPlacement],
+        Dict[str, Dict[str, Pod]],
+        int,
+    ]:
+        """Reference: schedulePodFromExistingGroup, hived_algorithm.go:658-712."""
+        group_physical: Optional[GroupPhysicalPlacement] = None
+        group_virtual: Optional[GroupVirtualPlacement] = None
+        preemption_victims: Dict[str, Dict[str, Pod]] = {}
+        pod_index = 0
+        bad_or_non_suggested = collect_bad_or_non_suggested_nodes(
+            g.physical_leaf_cell_placement, suggested_nodes, g.ignore_k8s_suggested_nodes
+        )
+        if g.state == GROUP_ALLOCATED:
+            log.info("[%s]: Pod is from an affinity group that is already allocated: %s",
+                     internal_utils.key(pod), s.affinity_group.name)
+            group_physical = g.physical_leaf_cell_placement
+            group_virtual = g.virtual_leaf_cell_placement
+            if bad_or_non_suggested:
+                # insist the previous decision even if some nodes went bad
+                log.warning(
+                    "[%s]: Some nodes allocated to affinity group %s are no longer "
+                    "healthy and within K8s suggested nodes: %s",
+                    internal_utils.key(pod), g.name, bad_or_non_suggested,
+                )
+            pod_index = get_new_pod_index(g.allocated_pods.get(s.leaf_cell_number, []))
+            if pod_index == -1:
+                raise api.as_bad_request(
+                    f"Requesting more pods than the configured number for "
+                    f"{s.leaf_cell_number} leaf cells "
+                    f"({g.total_pod_nums.get(s.leaf_cell_number)} pods) in affinity group "
+                    f"{s.affinity_group.name}"
+                )
+        else:  # GROUP_PREEMPTING
+            log.info("[%s]: Pod is from an affinity group that is preempting others: %s",
+                     internal_utils.key(pod), s.affinity_group.name)
+            if phase == internal.PREEMPTING_PHASE and bad_or_non_suggested:
+                # cancel the preemption so the group can reschedule elsewhere;
+                # only Preempting-phase suggested nodes consider preemption
+                log.info(
+                    "[%s]: Canceling affinity group %s's preemption because its placement "
+                    "is no longer fully healthy and within Preempting-phase suggested "
+                    "nodes: %s", internal_utils.key(pod), g.name, bad_or_non_suggested,
+                )
+                self._delete_preempting_affinity_group(g, pod)
+            else:
+                group_physical = g.physical_leaf_cell_placement
+                group_virtual = g.virtual_leaf_cell_placement
+                preemption_victims, _ = collect_preemption_victims(group_physical)
+                if not preemption_victims:
+                    log.info(
+                        "Preemption victims have been cleaned up for the preemptor "
+                        "affinity group %s", g.name,
+                    )
+                g.preempting_pods[pod.uid] = pod
+        return group_physical, group_virtual, preemption_victims, pod_index
+
+    def _schedule_pod_from_new_group(
+        self,
+        s: api.PodSchedulingSpec,
+        suggested_nodes: Set[str],
+        phase: str,
+        pod: Pod,
+    ) -> Tuple[
+        Optional[GroupPhysicalPlacement],
+        Optional[GroupVirtualPlacement],
+        Dict[str, Dict[str, Pod]],
+        str,
+    ]:
+        """Reference: schedulePodFromNewGroup, hived_algorithm.go:716-752."""
+        group_physical, group_virtual, wait_reason = self._schedule_new_affinity_group(
+            pod, s, suggested_nodes
+        )
+        if group_physical is None:
+            return None, None, {}, wait_reason
+        preemption_victims, overlapping_preemptors = collect_preemption_victims(group_physical)
+        if phase == internal.PREEMPTING_PHASE:
+            # cancel preemptions of lower-priority groups we further preempt
+            for preemptor in overlapping_preemptors:
+                log.info(
+                    "[%s]: Canceling affinity group %s's preemption because it is further "
+                    "preempted by a higher-priority affinity group %s",
+                    internal_utils.key(pod), preemptor.name, s.affinity_group.name,
+                )
+                self._delete_preempting_affinity_group(preemptor, pod)
+            if preemption_victims:
+                # reserve now to avoid contention among multiple preemptors
+                self._create_preempting_affinity_group(
+                    s, group_physical, group_virtual, pod
+                )
+        elif preemption_victims:
+            log.info(
+                "[%s]: Found preemption victims in non-Preempting phase, skipping",
+                internal_utils.key(pod),
+            )
+        return group_physical, group_virtual, preemption_victims, wait_reason
+
+    def _schedule_new_affinity_group(
+        self,
+        pod: Pod,
+        s: api.PodSchedulingSpec,
+        suggested_nodes: Set[str],
+    ) -> Tuple[
+        Optional[GroupPhysicalPlacement], Optional[GroupVirtualPlacement], str
+    ]:
+        """Reference: scheduleNewAffinityGroup, hived_algorithm.go:756-796."""
+        log.info("[%s]: Scheduling new affinity group %s",
+                 internal_utils.key(pod), s.affinity_group.name)
+        sr = SchedulingRequest(
+            vc=s.virtual_cluster,
+            pinned_cell_id=s.pinned_cell_id,
+            priority=s.priority,
+            affinity_group_name=s.affinity_group.name,
+            suggested_nodes=suggested_nodes,
+            ignore_suggested_nodes=s.ignore_k8s_suggested_nodes,
+        )
+        for m in s.affinity_group.members:
+            sr.affinity_group_pod_nums[m.leaf_cell_number] = (
+                sr.affinity_group_pod_nums.get(m.leaf_cell_number, 0) + m.pod_number
+            )
+        self._validate_scheduling_request(sr, pod)
+        if sr.pinned_cell_id:
+            log.info("Using pinned cell %s", sr.pinned_cell_id)
+            return self._handle_scheduling_request(sr)
+        if s.leaf_cell_type:
+            if s.leaf_cell_type not in self.cell_chains:
+                raise api.as_bad_request(
+                    f"[{internal_utils.key(pod)}]: Pod requesting leaf cell type "
+                    f"{s.leaf_cell_type} which the whole cluster does not have"
+                )
+            log.info("Using specified leaf cell type %s", s.leaf_cell_type)
+            return self._schedule_affinity_group_for_leaf_cell_type(
+                sr, s.leaf_cell_type, pod, type_specified=True
+            )
+        return self._schedule_affinity_group_for_any_leaf_cell_type(sr, pod)
+
+    def _schedule_affinity_group_for_leaf_cell_type(
+        self,
+        sr: SchedulingRequest,
+        leaf_cell_type: str,
+        pod: Pod,
+        type_specified: bool,
+    ) -> Tuple[
+        Optional[GroupPhysicalPlacement], Optional[GroupVirtualPlacement], str
+    ]:
+        """Reference: scheduleAffinityGroupForLeafCellType,
+        hived_algorithm.go:800-829."""
+        vc_has_type = False
+        failed_reason = ""
+        for chain in self.cell_chains[leaf_cell_type]:
+            if (
+                sr.priority < MIN_GUARANTEED_PRIORITY
+                or chain in self.vc_schedulers[sr.vc].non_pinned_preassigned_cells
+            ):
+                vc_has_type = True
+                log.info("Searching chain %s", chain)
+                sr.chain = chain
+                physical, virtual, failed_reason = self._handle_scheduling_request(sr)
+                if physical is not None:
+                    return physical, virtual, ""
+        if type_specified and sr.priority >= MIN_GUARANTEED_PRIORITY and not vc_has_type:
+            raise api.as_bad_request(
+                f"[{internal_utils.key(pod)}]: Pod requesting leaf cell type "
+                f"{leaf_cell_type} which VC {sr.vc} does not have"
+            )
+        return None, None, failed_reason
+
+    def _schedule_affinity_group_for_any_leaf_cell_type(
+        self, sr: SchedulingRequest, pod: Pod
+    ) -> Tuple[
+        Optional[GroupPhysicalPlacement], Optional[GroupVirtualPlacement], str
+    ]:
+        """Reference: scheduleAffinityGroupForAnyLeafCellType,
+        hived_algorithm.go:833-853."""
+        failed_reason = ""
+        for leaf_cell_type in self.cell_chains:
+            log.info("Searching leaf cell type %s", leaf_cell_type)
+            physical, virtual, type_failed_reason = (
+                self._schedule_affinity_group_for_leaf_cell_type(
+                    sr, leaf_cell_type, pod, type_specified=False
+                )
+            )
+            if physical is not None:
+                return physical, virtual, ""
+            if type_failed_reason:
+                failed_reason = type_failed_reason
+        return None, None, failed_reason
+
+    def _validate_scheduling_request(self, sr: SchedulingRequest, pod: Pod) -> None:
+        """Reference: validateSchedulingRequest, hived_algorithm.go:857-871."""
+        message = ""
+        if sr.vc not in self.vc_schedulers:
+            message = f"VC {sr.vc} does not exist!"
+        elif sr.pinned_cell_id:
+            if sr.pinned_cell_id not in self.vc_schedulers[sr.vc].pinned_cells:
+                message = f"VC {sr.vc} does not have pinned cell {sr.pinned_cell_id}"
+            elif sr.priority == OPPORTUNISTIC_PRIORITY:
+                message = (
+                    f"opportunistic pod not supported to use pinned cell {sr.pinned_cell_id}"
+                )
+        if message:
+            raise api.as_bad_request(f"[{internal_utils.key(pod)}]: {message}")
+
+    def _handle_scheduling_request(
+        self, sr: SchedulingRequest
+    ) -> Tuple[
+        Optional[GroupPhysicalPlacement], Optional[GroupVirtualPlacement], str
+    ]:
+        """Reference: handleSchedulingRequest, hived_algorithm.go:873-896."""
+        where = f"pinned cell {sr.pinned_cell_id}" if sr.pinned_cell_id else f"chain {sr.chain}"
+        log.info("Processing scheduling request: %s, leaf cell numbers %s, priority %s",
+                 where, sr.affinity_group_pod_nums, sr.priority)
+        if sr.priority >= MIN_GUARANTEED_PRIORITY:
+            physical, virtual, failed_reason = self._schedule_guaranteed_affinity_group(sr)
+        else:
+            physical, failed_reason = self._schedule_opportunistic_affinity_group(sr)
+            virtual = None
+        if physical is None:
+            log.info("Cannot find placement in %s: %s", where, failed_reason)
+            return None, None, failed_reason
+        log.info("Found placement in %s", where)
+        return physical, virtual, ""
+
+    def _schedule_guaranteed_affinity_group(
+        self, sr: SchedulingRequest
+    ) -> Tuple[
+        Optional[GroupPhysicalPlacement], Optional[GroupVirtualPlacement], str
+    ]:
+        """VC placement → binding paths → lazy preempt → map to physical
+        (reference: scheduleGuaranteedAffinityGroup, hived_algorithm.go:900-942)."""
+        virtual_placement, failed_reason = self.vc_schedulers[sr.vc].schedule(sr)
+        if virtual_placement is None:
+            return None, None, failed_reason
+        if sr.pinned_cell_id and not sr.chain:
+            # infer the chain from the pinned placement for the physical mapping
+            any_leaf = next(iter(virtual_placement.values()))[0][0]
+            sr.chain = any_leaf.chain
+        bindings: Dict[str, PhysicalCell] = {}
+        leaf_cell_nums = sorted(sr.affinity_group_pod_nums)
+        lazy_preempted_groups = self._try_lazy_preempt(
+            virtual_placement, leaf_cell_nums, sr.affinity_group_name
+        )
+        preassigned, non_preassigned = to_binding_paths(
+            virtual_placement, leaf_cell_nums, bindings
+        )
+        free_cell_num_copy = dict(self.all_vc_free_cell_num[sr.chain])
+        if map_virtual_placement_to_physical(
+            preassigned,
+            non_preassigned,
+            self.free_cell_list[sr.chain].shallow_copy(),
+            free_cell_num_copy,
+            sr.suggested_nodes,
+            sr.ignore_suggested_nodes,
+            bindings,
+        ):
+            return (
+                virtual_to_physical_placement(virtual_placement, bindings, leaf_cell_nums),
+                virtual_placement,
+                "",
+            )
+        for group_name, placement in lazy_preempted_groups.items():
+            g = self.affinity_groups.get(group_name)
+            if g is not None:
+                self._revert_lazy_preempt(g, placement)
+        failed_node_type = "bad" if sr.ignore_suggested_nodes else "bad or non-suggested"
+        return None, None, (
+            f"Mapping the virtual placement would need to use at least one "
+            f"{failed_node_type} node"
+        )
+
+    def _try_lazy_preempt(
+        self,
+        p: GroupVirtualPlacement,
+        leaf_cell_nums: List[int],
+        group_name: str,
+    ) -> Dict[str, GroupVirtualPlacement]:
+        """Reference: tryLazyPreempt, hived_algorithm.go:945-963."""
+        preempted: Dict[str, GroupVirtualPlacement] = {}
+        for pod_leaf_cell_num in leaf_cell_nums:
+            for pod_placement in p[pod_leaf_cell_num]:
+                for leaf_cell in pod_placement:
+                    assert isinstance(leaf_cell, VirtualCell)
+                    p_leaf_cell = leaf_cell.physical_cell
+                    if p_leaf_cell is not None and p_leaf_cell.state == CELL_USED:
+                        using = p_leaf_cell.using_group
+                        if using is not None and using.lazy_preemption_enable:
+                            preempted[using.name] = self._lazy_preempt_affinity_group(
+                                using, group_name
+                            )
+        return preempted
+
+    def _schedule_opportunistic_affinity_group(
+        self, sr: SchedulingRequest
+    ) -> Tuple[Optional[GroupPhysicalPlacement], str]:
+        """Reference: scheduleOpportunisticAffinityGroup,
+        hived_algorithm.go:966-977."""
+        placement, failed_reason = self.opportunistic_schedulers[sr.chain].schedule(
+            sr.affinity_group_pod_nums,
+            OPPORTUNISTIC_PRIORITY,
+            sr.suggested_nodes,
+            sr.ignore_suggested_nodes,
+        )
+        if placement is None:
+            return None, f"{failed_reason} when scheduling in physical cluster"
+        return placement, ""
+
+    # ------------------------------------------------------------------
+    # group lifecycle
+    # ------------------------------------------------------------------
+
+    def _create_allocated_affinity_group(
+        self, s: api.PodSchedulingSpec, info: api.PodBindInfo, pod: Pod
+    ) -> None:
+        """Recovery path with the tolerance ladder: missing cells ignored;
+        missing virtual placement or safety violation → lazy preempt
+        (reference: createAllocatedAffinityGroup, hived_algorithm.go:982-1041)."""
+        log.info("[%s]: Creating new allocated affinity group: %s",
+                 internal_utils.key(pod), s.affinity_group.name)
+        new_group = AlgoAffinityGroup(
+            s.affinity_group, s.virtual_cluster, s.lazy_preemption_enable,
+            s.ignore_k8s_suggested_nodes, s.priority, GROUP_ALLOCATED,
+        )
+        should_lazy_preempt = False
+        for gms in info.affinity_group_bind_info:
+            leaf_cell_number = len(gms.pod_placements[0].physical_leaf_cell_indices)
+            for pod_index in range(len(gms.pod_placements)):
+                node = gms.pod_placements[pod_index].physical_node
+                for leaf_cell_index in range(
+                    len(gms.pod_placements[pod_index].physical_leaf_cell_indices)
+                ):
+                    p_leaf_cell, v_leaf_cell, lazy_preempt = self._find_allocated_leaf_cell(
+                        leaf_cell_index,
+                        gms.pod_placements[pod_index].physical_leaf_cell_indices,
+                        gms.pod_placements[pod_index].preassigned_cell_types,
+                        info.cell_chain,
+                        node,
+                        should_lazy_preempt,
+                        s,
+                        new_group,
+                        pod,
+                    )
+                    if p_leaf_cell is None:
+                        # leaf cell not in the spec: ignore it, let the pod run
+                        continue
+                    new_group.physical_leaf_cell_placement[leaf_cell_number][pod_index][
+                        leaf_cell_index
+                    ] = p_leaf_cell
+                    if lazy_preempt is None:
+                        new_group.virtual_leaf_cell_placement = None
+                    elif v_leaf_cell is not None:
+                        new_group.virtual_leaf_cell_placement[leaf_cell_number][pod_index][
+                            leaf_cell_index
+                        ] = v_leaf_cell
+                        if (
+                            in_free_cell_list(p_leaf_cell)
+                            and v_leaf_cell.preassigned_cell.priority > FREE_PRIORITY
+                        ):
+                            # binding the cell to a virtual cell whose preassigned
+                            # cell is already bound (e.g., shrunk VC after
+                            # reconfiguration): destroy the old binding by lazy
+                            # preempting the groups in the preassigned cell
+                            self._lazy_preempt_cell(
+                                v_leaf_cell.preassigned_cell, new_group.name
+                            )
+                    else:
+                        should_lazy_preempt = should_lazy_preempt or lazy_preempt
+                    safety_ok, reason = self._allocate_leaf_cell(
+                        p_leaf_cell, v_leaf_cell, s.priority, new_group.vc
+                    )
+                    p_leaf_cell.add_using_group(new_group)
+                    set_cell_state(p_leaf_cell, CELL_USED)
+                    if not safety_ok:
+                        should_lazy_preempt = True
+                        log.warning("[%s]: %s", internal_utils.key(pod), reason)
+        if should_lazy_preempt:
+            self._lazy_preempt_affinity_group(new_group, new_group.name)
+        self.affinity_groups[s.affinity_group.name] = new_group
+        log.info("[%s]: New allocated affinity group created: %s",
+                 internal_utils.key(pod), s.affinity_group.name)
+
+    def _delete_allocated_affinity_group(self, g: AlgoAffinityGroup, pod: Pod) -> None:
+        """Reference: deleteAllocatedAffinityGroup, hived_algorithm.go:1045-1070."""
+        log.info("[%s]: All pods complete, deleting allocated affinity group: %s",
+                 internal_utils.key(pod), g.name)
+        for pod_placements in g.physical_leaf_cell_placement.values():
+            for pod_placement in pod_placements:
+                for leaf_cell in pod_placement:
+                    if leaf_cell is None:
+                        continue
+                    assert isinstance(leaf_cell, PhysicalCell)
+                    leaf_cell.delete_using_group(g)
+                    if leaf_cell.state == CELL_USED:
+                        self._release_leaf_cell(leaf_cell, g.vc)
+                        set_cell_state(leaf_cell, CELL_FREE)
+                    else:  # Reserving: already allocated to the reserving group
+                        set_cell_state(leaf_cell, CELL_RESERVED)
+        del self.affinity_groups[g.name]
+        log.info("[%s]: Allocated affinity group deleted: %s",
+                 internal_utils.key(pod), g.name)
+
+    def _create_preempting_affinity_group(
+        self,
+        s: api.PodSchedulingSpec,
+        physical_placement: GroupPhysicalPlacement,
+        virtual_placement: GroupVirtualPlacement,
+        pod: Pod,
+    ) -> None:
+        """Resources are reserved immediately, before the victims die, to
+        avoid preemptor deadlock (reference: createPreemptingAffinityGroup,
+        hived_algorithm.go:1076-1112)."""
+        log.info("[%s]: Creating new preempting affinity group: %s",
+                 internal_utils.key(pod), s.affinity_group.name)
+        new_group = AlgoAffinityGroup(
+            s.affinity_group, s.virtual_cluster, s.lazy_preemption_enable,
+            s.ignore_k8s_suggested_nodes, s.priority, GROUP_PREEMPTING,
+        )
+        new_group.physical_leaf_cell_placement = physical_placement
+        new_group.virtual_leaf_cell_placement = virtual_placement
+        for leaf_cell_num, pod_placements in physical_placement.items():
+            for pod_index, pod_placement in enumerate(pod_placements):
+                for leaf_cell_index, leaf_cell in enumerate(pod_placement):
+                    assert isinstance(leaf_cell, PhysicalCell)
+                    v_leaf_cell = virtual_placement[leaf_cell_num][pod_index][leaf_cell_index]
+                    assert isinstance(v_leaf_cell, VirtualCell)
+                    if leaf_cell.state == CELL_USED:
+                        using_group = leaf_cell.using_group
+                        self._release_leaf_cell(leaf_cell, using_group.vc)
+                        using_group.state = GROUP_BEING_PREEMPTED
+                    self._allocate_leaf_cell(leaf_cell, v_leaf_cell, s.priority, new_group.vc)
+                    leaf_cell.add_reserving_or_reserved_group(new_group)
+                    # cell is Used or Free here (Reserving/Reserved preemptors
+                    # were canceled before in schedule())
+                    if leaf_cell.state == CELL_USED:
+                        set_cell_state(leaf_cell, CELL_RESERVING)
+                    else:
+                        set_cell_state(leaf_cell, CELL_RESERVED)
+        new_group.preempting_pods[pod.uid] = pod
+        self.affinity_groups[s.affinity_group.name] = new_group
+        log.info("[%s]: New preempting affinity group created: %s",
+                 internal_utils.key(pod), new_group.name)
+
+    def _delete_preempting_affinity_group(self, g: AlgoAffinityGroup, pod: Pod) -> None:
+        """Revoke a preemption; Reserving cells return to the being-preempted
+        group (reference: deletePreemptingAffinityGroup,
+        hived_algorithm.go:1116-1144)."""
+        for pod_placements in g.physical_leaf_cell_placement.values():
+            for pod_placement in pod_placements:
+                for leaf_cell in pod_placement:
+                    assert isinstance(leaf_cell, PhysicalCell)
+                    self._release_leaf_cell(leaf_cell, g.vc)
+                    leaf_cell.delete_reserving_or_reserved_group(
+                        leaf_cell.reserving_or_reserved_group
+                    )
+                    if leaf_cell.state == CELL_RESERVING:
+                        set_cell_state(leaf_cell, CELL_USED)
+                        being_preempted = leaf_cell.using_group
+                        being_preempted_v: Optional[VirtualCell] = None
+                        if being_preempted.virtual_leaf_cell_placement is not None:
+                            being_preempted_v = retrieve_virtual_cell(
+                                being_preempted.physical_leaf_cell_placement,
+                                being_preempted.virtual_leaf_cell_placement,
+                                leaf_cell,
+                            )
+                        self._allocate_leaf_cell(
+                            leaf_cell, being_preempted_v, being_preempted.priority,
+                            being_preempted.vc,
+                        )
+                    else:  # Reserved
+                        set_cell_state(leaf_cell, CELL_FREE)
+        del self.affinity_groups[g.name]
+        log.info("[%s]: Preempting affinity group %s deleted",
+                 internal_utils.key(pod), g.name)
+
+    def _allocate_preempting_affinity_group(self, g: AlgoAffinityGroup, pod: Pod) -> None:
+        """Reference: allocatePreemptingAffinityGroup, hived_algorithm.go:1148-1162."""
+        for pod_placements in g.physical_leaf_cell_placement.values():
+            for pod_placement in pod_placements:
+                for leaf_cell in pod_placement:
+                    assert isinstance(leaf_cell, PhysicalCell)
+                    leaf_cell.delete_reserving_or_reserved_group(g)
+                    leaf_cell.add_using_group(g)
+                    set_cell_state(leaf_cell, CELL_USED)
+        g.state = GROUP_ALLOCATED
+        g.preempting_pods = None
+        log.info("[%s]: Preempting affinity group %s transitioned to allocated",
+                 internal_utils.key(pod), g.name)
+
+    def _lazy_preempt_affinity_group(
+        self, victim: AlgoAffinityGroup, preemptor: str
+    ) -> Optional[GroupVirtualPlacement]:
+        """Demote a group to opportunistic (reference:
+        lazyPreemptAffinityGroup, hived_algorithm.go:1166-1189)."""
+        for pod_virtual_placements in (victim.virtual_leaf_cell_placement or {}).values():
+            for pod_virtual_placement in pod_virtual_placements:
+                for leaf_cell in pod_virtual_placement:
+                    if leaf_cell is not None:
+                        assert isinstance(leaf_cell, VirtualCell)
+                        p_leaf_cell = leaf_cell.physical_cell
+                        self._release_leaf_cell(p_leaf_cell, victim.vc)
+                        self._allocate_leaf_cell(
+                            p_leaf_cell, None, OPPORTUNISTIC_PRIORITY, victim.vc
+                        )
+        original = victim.virtual_leaf_cell_placement
+        victim.virtual_leaf_cell_placement = None
+        victim.lazy_preemption_status = api.LazyPreemptionStatus(
+            preemptor=preemptor,
+            preemption_time=datetime.now(timezone.utc).isoformat(),
+        )
+        log.info("Affinity group %s is lazy preempted from VC by %s", victim.name, preemptor)
+        return original
+
+    def _lazy_preempt_cell(self, c: VirtualCell, preemptor: str) -> None:
+        """Reference: lazyPreemptCell, hived_algorithm.go:1192-1199."""
+        if c.level == LOWEST_LEVEL and c.state == CELL_USED:
+            self._lazy_preempt_affinity_group(c.physical_cell.using_group, preemptor)
+        for child in c.children:
+            assert isinstance(child, VirtualCell)
+            self._lazy_preempt_cell(child, preemptor)
+
+    def _revert_lazy_preempt(
+        self, g: AlgoAffinityGroup, virtual_placement: GroupVirtualPlacement
+    ) -> None:
+        """Reference: revertLazyPreempt, hived_algorithm.go:1202-1219."""
+        for leaf_cell_num, pod_placements in g.physical_leaf_cell_placement.items():
+            for pod_index, pod_placement in enumerate(pod_placements):
+                for leaf_cell_index, leaf_cell in enumerate(pod_placement):
+                    if leaf_cell is None:
+                        continue
+                    assert isinstance(leaf_cell, PhysicalCell)
+                    v_leaf_cell = virtual_placement[leaf_cell_num][pod_index][leaf_cell_index]
+                    assert isinstance(v_leaf_cell, VirtualCell)
+                    self._release_leaf_cell(leaf_cell, g.vc)
+                    self._allocate_leaf_cell(leaf_cell, v_leaf_cell, g.priority, g.vc)
+        g.virtual_leaf_cell_placement = virtual_placement
+        g.lazy_preemption_status = None
+        log.info("Lazy preemption of affinity group %s is reverted", g.name)
+
+    def _find_allocated_leaf_cell(
+        self,
+        index: int,
+        physical_leaf_cell_indices: List[int],
+        preassigned_cell_types: List[str],
+        chain: CellChain,
+        node: str,
+        lazy_preempted: bool,
+        s: api.PodSchedulingSpec,
+        group: AlgoAffinityGroup,
+        pod: Pod,
+    ) -> Tuple[Optional[PhysicalCell], Optional[VirtualCell], Optional[bool]]:
+        """Reference: findAllocatedLeafCell, hived_algorithm.go:1224-1290.
+        Returns (physical, virtual, lazy_preempt) where lazy_preempt=None means
+        the group is opportunistic (no virtual placement)."""
+        priority = s.priority
+        physical_leaf_cell_index = physical_leaf_cell_indices[index]
+        p_leaf_cell = find_physical_leaf_cell(
+            self.full_cell_list, chain, node, physical_leaf_cell_index
+        )
+        if p_leaf_cell is None:
+            log.warning(
+                "[%s]: Cannot find leaf cell %s on node %s: not found in the spec. "
+                "Pod ignored", internal_utils.key(pod), physical_leaf_cell_index, node,
+            )
+            return None, None, False
+        if not preassigned_cell_types:
+            log.warning("[%s]: Cannot find virtual cell: preassigned cell not found in "
+                        "pod bind info", internal_utils.key(pod))
+            return p_leaf_cell, None, True
+        if group.virtual_leaf_cell_placement is not None and not lazy_preempted:
+            preassigned_type = preassigned_cell_types[index]
+            if preassigned_type:
+                preassigned_level: Optional[CellLevel] = None
+                for l, t in self.cell_types.get(p_leaf_cell.chain, {}).items():
+                    if t == preassigned_type:
+                        preassigned_level = l
+                message = ""
+                v_leaf_cell: Optional[VirtualCell] = None
+                if preassigned_level is None:
+                    message = (
+                        f"Preassigned cell type {preassigned_type} not found in chain "
+                        f"{p_leaf_cell.chain}"
+                    )
+                elif s.virtual_cluster not in self.vc_schedulers:
+                    message = f"VC {s.virtual_cluster} not found"
+                else:
+                    vcs = self.vc_schedulers[s.virtual_cluster]
+                    if s.pinned_cell_id:
+                        vccl = vcs.pinned_cells.get(s.pinned_cell_id)
+                        where = s.pinned_cell_id
+                    else:
+                        vccl = vcs.non_pinned_preassigned_cells.get(p_leaf_cell.chain)
+                        where = str(p_leaf_cell.chain)
+                    if vccl is None:
+                        message = f"VC {s.virtual_cluster} has no cell for {where}"
+                    else:
+                        v_leaf_cell, message = map_physical_cell_to_virtual(
+                            p_leaf_cell, vccl, preassigned_level, priority
+                        )
+                if v_leaf_cell is None:
+                    log.warning("[%s]: Cannot find virtual cell: %s",
+                                internal_utils.key(pod), message)
+                    return p_leaf_cell, None, True
+                return p_leaf_cell, v_leaf_cell, False
+            return p_leaf_cell, None, None
+        return p_leaf_cell, None, False
+
+    # ------------------------------------------------------------------
+    # leaf cell allocation / release with safety accounting
+    # ------------------------------------------------------------------
+
+    def _allocate_leaf_cell(
+        self,
+        p_leaf_cell: PhysicalCell,
+        v_leaf_cell: Optional[VirtualCell],
+        p: CellPriority,
+        vcn: str,
+    ) -> Tuple[bool, str]:
+        """Reference: allocateLeafCell, hived_algorithm.go:1294-1323."""
+        safety_ok, reason = True, ""
+        if v_leaf_cell is not None:
+            set_cell_priority(v_leaf_cell, p)
+            update_used_leaf_cell_num_at_priority(v_leaf_cell, p, True)
+            set_cell_priority(p_leaf_cell, p)
+            update_used_leaf_cell_num_at_priority(p_leaf_cell, p, True)
+            pac = v_leaf_cell.preassigned_cell
+            preassigned_newly_bound = pac.physical_cell is None
+            if p_leaf_cell.virtual_cell is None:
+                # the binding may exist already (when the cell is bad)
+                bind_cell(p_leaf_cell, v_leaf_cell)
+            if preassigned_newly_bound:
+                safety_ok, reason = self._allocate_preassigned_cell(
+                    pac.physical_cell, vcn, doomed_bad=False
+                )
+        else:
+            set_cell_priority(p_leaf_cell, OPPORTUNISTIC_PRIORITY)
+            update_used_leaf_cell_num_at_priority(
+                p_leaf_cell, OPPORTUNISTIC_PRIORITY, True
+            )
+            p_leaf_cell.api_status.vc = vcn
+            self.api_cluster_status.virtual_clusters[vcn].append(
+                generate_ot_virtual_cell(p_leaf_cell.api_status)
+            )
+        return safety_ok, reason
+
+    def _release_leaf_cell(self, p_leaf_cell: PhysicalCell, vcn: str) -> None:
+        """Reference: releaseLeafCell, hived_algorithm.go:1327-1352."""
+        v_leaf_cell = p_leaf_cell.virtual_cell
+        if v_leaf_cell is not None:
+            update_used_leaf_cell_num_at_priority(v_leaf_cell, v_leaf_cell.priority, False)
+            set_cell_priority(v_leaf_cell, FREE_PRIORITY)
+            preassigned_physical = v_leaf_cell.preassigned_cell.physical_cell
+            if p_leaf_cell.healthy:
+                # keep the binding if the cell is bad
+                unbind_cell(p_leaf_cell)
+            if (
+                not preassigned_physical.pinned
+                and v_leaf_cell.preassigned_cell.priority < MIN_GUARANTEED_PRIORITY
+                and not self.vc_doomed_bad_cells[vcn][preassigned_physical.chain].contains(
+                    preassigned_physical, preassigned_physical.level
+                )
+            ):
+                self._release_preassigned_cell(preassigned_physical, vcn, doomed_bad=False)
+        else:
+            p_leaf_cell.api_status.vc = ""
+            self.api_cluster_status.virtual_clusters[vcn] = delete_ot_virtual_cell(
+                self.api_cluster_status.virtual_clusters[vcn], p_leaf_cell.address
+            )
+        update_used_leaf_cell_num_at_priority(p_leaf_cell, p_leaf_cell.priority, False)
+        set_cell_priority(p_leaf_cell, FREE_PRIORITY)
+
+    def _allocate_preassigned_cell(
+        self, c: PhysicalCell, vcn: str, doomed_bad: bool
+    ) -> Tuple[bool, str]:
+        """Remove from free list + full safety/doomed-bad accounting at every
+        level (reference: allocatePreassignedCell, hived_algorithm.go:1356-1427)."""
+        safety_ok, reason = True, ""
+        chain, level = c.chain, c.level
+        self.vc_free_cell_num[vcn][chain][level] -= 1
+        self.all_vc_free_cell_num[chain][level] -= 1
+        self.total_left_cell_num[chain][level] -= 1
+        split_level_up_to = self._remove_cell_from_free_list(c)
+
+        parent = c.parent
+        for l in range(level + 1, split_level_up_to + 1):
+            self.total_left_cell_num[chain][l] -= 1
+            if self.total_left_cell_num[chain][l] < self.all_vc_free_cell_num[chain].get(l, 0):
+                safety_ok = False
+                reason = (
+                    f"Adding pod would lead to broken safety: cell type "
+                    f"{self.cell_types[chain][l]}, {self.total_left_cell_num[chain][l]} "
+                    f"left, {self.all_vc_free_cell_num[chain].get(l, 0)} free cells in all VCs"
+                )
+            assert isinstance(parent, PhysicalCell)
+            if not parent.healthy:
+                # parent bad: neither vcFreeCellNum nor healthy-free count
+                # changes; just remove it from bad free cells
+                self.bad_free_cells[chain].remove(parent, l)
+            else:
+                # healthy-free count decreased: try binding doomed bad cells
+                self._try_bind_doomed_bad_cell(chain, l)
+            parent = parent.parent
+        if not c.healthy:
+            self._allocate_bad_cell(c)
+            if not doomed_bad:
+                self._try_unbind_doomed_bad_cell(chain, level)
+        else:
+            self._try_bind_doomed_bad_cell(chain, level)
+        num_to_reduce = len(c.children)
+        for l in range(level - 1, LOWEST_LEVEL - 1, -1):
+            self.total_left_cell_num[chain][l] -= num_to_reduce
+            if self.total_left_cell_num[chain][l] < self.all_vc_free_cell_num[chain].get(l, 0):
+                safety_ok = False
+                reason = (
+                    f"Adding pod would lead to broken safety: cell type "
+                    f"{self.cell_types[chain][l]}, {self.total_left_cell_num[chain][l]} "
+                    f"left, {self.all_vc_free_cell_num[chain].get(l, 0)} free cells in all VCs"
+                )
+            if not doomed_bad:
+                self._try_bind_doomed_bad_cell(chain, l)
+            num_to_reduce *= len(self.full_cell_list[chain][l][0].children) if l > 1 else 1
+        return safety_ok, reason
+
+    def _allocate_bad_cell(self, c: PhysicalCell) -> None:
+        """Reference: allocateBadCell, hived_algorithm.go:1431-1447."""
+        if self.bad_free_cells[c.chain].contains(c, c.level):
+            self.bad_free_cells[c.chain].remove(c, c.level)
+        if c.virtual_cell is None:
+            parent = c.parent
+            assert isinstance(parent, PhysicalCell) and parent.virtual_cell is not None
+            vc = get_unbound_virtual_cell(parent.virtual_cell.children)
+            c.set_virtual_cell(vc)
+            vc.set_physical_cell(c)
+            log.info("Virtual cell %s is bound to physical cell %s", vc.address, c.address)
+        for child in c.children:
+            assert isinstance(child, PhysicalCell)
+            if not child.healthy:
+                self._allocate_bad_cell(child)
+
+    def _release_preassigned_cell(self, c: PhysicalCell, vcn: str, doomed_bad: bool) -> None:
+        """Reference: releasePreassignedCell, hived_algorithm.go:1451-1485."""
+        chain, level = c.chain, c.level
+        self.vc_free_cell_num[vcn][chain][level] += 1
+        self.all_vc_free_cell_num[chain][level] += 1
+        self.total_left_cell_num[chain][level] += 1
+        merge_level_up_to = self._add_cell_to_free_list(c)
+
+        parent = c.parent
+        for l in range(level + 1, merge_level_up_to + 1):
+            self.total_left_cell_num[chain][l] += 1
+            assert isinstance(parent, PhysicalCell)
+            if not parent.healthy:
+                self.bad_free_cells[chain][l].append(parent)
+            else:
+                self._try_unbind_doomed_bad_cell(chain, l)
+            parent = parent.parent
+        if not c.healthy:
+            self._release_bad_cell(c)
+            if not doomed_bad:
+                self._try_bind_doomed_bad_cell(chain, level)
+        else:
+            self._try_unbind_doomed_bad_cell(chain, level)
+        num_to_add = len(c.children)
+        for l in range(level - 1, LOWEST_LEVEL - 1, -1):
+            self.total_left_cell_num[chain][l] += num_to_add
+            if not doomed_bad:
+                self._try_unbind_doomed_bad_cell(chain, l)
+            num_to_add *= len(self.full_cell_list[chain][l][0].children) if l > 1 else 1
+
+    def _release_bad_cell(self, c: PhysicalCell) -> None:
+        """Reference: releaseBadCell, hived_algorithm.go:1488-1500."""
+        self.bad_free_cells[c.chain][c.level].append(c)
+        vc = c.virtual_cell
+        if vc is not None:
+            c.set_virtual_cell(None)
+            vc.set_physical_cell(None)
+            log.info("Virtual cell %s is unbound from physical cell %s", vc.address, c.address)
+        for child in c.children:
+            assert isinstance(child, PhysicalCell)
+            if not child.healthy:
+                self._release_bad_cell(child)
+
+    def _remove_cell_from_free_list(self, c: PhysicalCell) -> CellLevel:
+        """Split ancestors as needed (reference: removeCellFromFreeList,
+        hived_algorithm.go:1503-1527)."""
+        chain = c.chain
+        while True:
+            l = c.level
+            parent = c.parent
+            terminate = False
+            if parent is not None:
+                assert isinstance(parent, PhysicalCell)
+                if parent.split:
+                    terminate = True
+                else:
+                    self.free_cell_list[chain][l] = self.free_cell_list[chain][l] + list(
+                        parent.children
+                    )
+                    parent.split = True
+            else:
+                terminate = True
+            self.free_cell_list[chain].remove(c, l)
+            if terminate:
+                return l
+            c = parent  # type: ignore[assignment]
+
+    def _add_cell_to_free_list(self, c: PhysicalCell) -> CellLevel:
+        """Merge buddies as possible (reference: addCellToFreeList,
+        hived_algorithm.go:1530-1565)."""
+        chain = c.chain
+        while True:
+            l = c.level
+            parent = c.parent
+            terminate = False
+            if parent is not None:
+                assert isinstance(parent, PhysicalCell)
+                all_buddy_free = all(
+                    buddy is c or self.free_cell_list[chain].contains(buddy, l)
+                    for buddy in parent.children
+                )
+                if not all_buddy_free:
+                    terminate = True
+                else:
+                    for buddy in parent.children:
+                        if buddy is not c:
+                            self.free_cell_list[chain].remove(buddy, l)
+                    parent.split = False
+            else:
+                terminate = True
+            if terminate:
+                self.free_cell_list[chain][l].append(c)
+                return l
+            c = parent  # type: ignore[assignment]

@@ -1,0 +1,75 @@
+"""Per-VC scheduler: dispatches to a topology-aware scheduler per chain or
+per pinned cell.
+
+TPU-native analogue of the reference's ``pkg/algorithm/intra_vc_scheduler.go``.
+All intra-VC schedulers use ``cross_priority_pack=True`` (see rationale in
+``algorithm/topology_aware.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from hivedscheduler_tpu.algorithm.cell import CellChain, CellLevel
+from hivedscheduler_tpu.algorithm.topology_aware import TopologyAwareScheduler
+from hivedscheduler_tpu.algorithm.types import CellList, ChainCellList, SchedulingRequest
+
+log = logging.getLogger(__name__)
+
+GroupVirtualPlacement = Dict[int, List[CellList]]
+
+
+class IntraVCScheduler:
+    """Reference: defaultIntraVCScheduler, intra_vc_scheduler.go:45-117."""
+
+    def __init__(
+        self,
+        non_pinned_full_list: Dict[CellChain, ChainCellList],
+        non_pinned_free_list: Dict[CellChain, ChainCellList],
+        pinned_list: Dict[str, ChainCellList],
+        leaf_cell_nums: Dict[CellChain, Dict[CellLevel, int]],
+    ):
+        self.non_pinned_full_cell_list = non_pinned_full_list
+        self.non_pinned_preassigned_cells = non_pinned_free_list
+        self.pinned_cells = pinned_list
+        # chains absent from the physical cluster have no leaf-cell-num table;
+        # HivedAlgorithm._init_cell_nums rejects such configs right after
+        self.non_pinned_cell_schedulers: Dict[CellChain, TopologyAwareScheduler] = {
+            chain: TopologyAwareScheduler(
+                ccl, leaf_cell_nums.get(chain, {}), cross_priority_pack=True
+            )
+            for chain, ccl in non_pinned_full_list.items()
+        }
+        self.pinned_cell_schedulers: Dict[str, TopologyAwareScheduler] = {
+            pid: TopologyAwareScheduler(
+                ccl, leaf_cell_nums[ccl[1][0].chain], cross_priority_pack=True
+            )
+            for pid, ccl in pinned_list.items()
+        }
+
+    def schedule(self, sr: SchedulingRequest) -> Tuple[Optional[GroupVirtualPlacement], str]:
+        """Reference: intra_vc_scheduler.go:92-117."""
+        if sr.pinned_cell_id:
+            scheduler = self.pinned_cell_schedulers.get(sr.pinned_cell_id)
+            where = f"pinned cell {sr.pinned_cell_id}"
+        else:
+            scheduler = self.non_pinned_cell_schedulers.get(sr.chain)
+            where = f"chain {sr.chain}"
+        log.info(
+            "Processing scheduling request in VC %s: %s, leaf cell numbers %s, priority %s",
+            sr.vc, where, sr.affinity_group_pod_nums, sr.priority,
+        )
+        placement: Optional[GroupVirtualPlacement] = None
+        failed_reason = ""
+        if scheduler is not None:
+            placement, failed_reason = scheduler.schedule(
+                sr.affinity_group_pod_nums,
+                sr.priority,
+                sr.suggested_nodes,
+                sr.ignore_suggested_nodes,
+            )
+        if placement is None:
+            return None, f"{failed_reason} when scheduling in VC {sr.vc}"
+        log.info("Found placement in VC %s", sr.vc)
+        return placement, ""

@@ -1,0 +1,344 @@
+"""Topology-aware placement search inside one cluster view.
+
+TPU-native analogue of the reference's ``pkg/algorithm/topology_aware_scheduler.go``.
+Places a gang's pods onto "nodes" (node-level cells, or top-level cells below
+node level), packing onto busier nodes first, then picks chips inside each
+node minimizing the level of their lowest common ancestor (LCA) — on a mesh
+chain that LCA level is exactly the smallest enclosing sub-mesh, so best
+affinity = tightest contiguous ICI slice.
+
+Two packing modes (reference rationale at ``topology_aware_scheduler.go:42-48``):
+- ``cross_priority_pack=True`` (intra-VC): pack across priorities, since a
+  high-priority group avoids preemption across the whole view;
+- ``cross_priority_pack=False`` (opportunistic): pack within the same priority
+  and stay away from higher priorities, since guaranteed pods can avoid
+  preempting opportunistic pods only among buddy cells.
+
+The in-node chip selection (``find_leaf_cells_in_node``) can be delegated to
+the C++ accelerator in ``hivedscheduler_tpu/native`` when available; the pure
+Python path is the semantic reference.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Set, Tuple
+
+from hivedscheduler_tpu.algorithm.cell import Cell, CellLevel, CellPriority, PhysicalCell, VirtualCell, cell_equal
+from hivedscheduler_tpu.algorithm.constants import (
+    FREE_PRIORITY,
+    HIGHEST_LEVEL,
+    LOWEST_LEVEL,
+    OPPORTUNISTIC_PRIORITY,
+)
+from hivedscheduler_tpu.algorithm.types import CellList, ChainCellList
+
+log = logging.getLogger(__name__)
+
+
+class _Node:
+    """One schedulable unit of the cluster view (reference: node struct,
+    topology_aware_scheduler.go:118-154)."""
+
+    __slots__ = (
+        "cell",
+        "free_leaf_cell_num_at_priority",
+        "used_leaf_cell_num_same_priority",
+        "used_leaf_cell_num_higher_priority",
+        "healthy",
+        "suggested",
+        "node_address",
+    )
+
+    def __init__(self, cell: Cell):
+        self.cell = cell
+        self.free_leaf_cell_num_at_priority = 0
+        self.used_leaf_cell_num_same_priority = 0
+        self.used_leaf_cell_num_higher_priority = 0
+        self.healthy = True
+        self.suggested = True
+        self.node_address = ""
+
+    def update_used_leaf_cell_num_for_priority(
+        self, p: CellPriority, cross_priority_pack: bool
+    ) -> None:
+        used = self.cell.used_leaf_cell_num_at_priorities
+        self.used_leaf_cell_num_same_priority = used.get(p, 0)
+        self.used_leaf_cell_num_higher_priority = 0
+        self.free_leaf_cell_num_at_priority = self.cell.total_leaf_cell_num
+        for priority, num in used.items():
+            if cross_priority_pack:
+                if priority != p:
+                    self.used_leaf_cell_num_same_priority += num
+            elif priority > p:
+                self.used_leaf_cell_num_higher_priority += num
+            if priority >= p:
+                self.free_leaf_cell_num_at_priority -= num
+
+
+def _ancestor_no_higher_than_node(c: Cell) -> Cell:
+    """Reference: ancestorNoHigherThanNode, topology_aware_scheduler.go:183-189."""
+    while not c.at_or_higher_than_node and c.parent is not None:
+        c = c.parent
+    return c
+
+
+def _new_cluster_view(ccl: ChainCellList) -> List[_Node]:
+    """Extract node-level cells (or lower-level cells with no node-level
+    ancestor in the list) from a cell list (reference: newClusterView,
+    topology_aware_scheduler.go:158-179)."""
+    levels = sorted(lv for lv in ccl if ccl.get(lv))
+    start: Optional[CellLevel] = None
+    for lv in levels:
+        if ccl[lv][0].at_or_higher_than_node:
+            start = lv
+            break
+    if start is None:
+        start = levels[-1] if levels else LOWEST_LEVEL
+    cv: List[_Node] = []
+    addresses: Set[str] = set()
+    for lv in range(start, LOWEST_LEVEL - 1, -1):
+        for c in ccl.get(lv, []):
+            anc = _ancestor_no_higher_than_node(c)
+            if anc.address not in addresses:
+                addresses.add(anc.address)
+                cv.append(_Node(c))
+    return cv
+
+
+def _node_healthy_and_in_suggested(
+    n: _Node, suggested_nodes: Set[str], ignore_suggested_nodes: bool
+) -> Tuple[bool, bool, str]:
+    """Reference: nodeHealthyAndInSuggested, topology_aware_scheduler.go:242-265."""
+    c = n.cell
+    if isinstance(c, PhysicalCell):
+        return (
+            c.healthy,
+            ignore_suggested_nodes or c.nodes[0] in suggested_nodes,
+            c.address,
+        )
+    if isinstance(c, VirtualCell) and c.physical_cell is not None:
+        pn = c.physical_cell
+        return (
+            pn.healthy,
+            ignore_suggested_nodes or pn.nodes[0] in suggested_nodes,
+            pn.address,
+        )
+    return True, True, ""
+
+
+def _find_nodes_for_pods(
+    cv: List[_Node], leaf_cell_nums: List[int]
+) -> Tuple[Optional[List[int]], str]:
+    """Greedy bin-packing over the sorted view (reference: findNodesForPods,
+    topology_aware_scheduler.go:268-306). Nodes sorted by: healthy first,
+    suggested first, more same-priority-used, fewer higher-priority-used."""
+    cv.sort(
+        key=lambda n: (
+            not n.healthy,
+            not n.suggested,
+            -n.used_leaf_cell_num_same_priority,
+            n.used_leaf_cell_num_higher_priority,
+        )
+    )
+    picked = [0] * len(leaf_cell_nums)
+    pod_index = 0
+    picked_leaf_cell_num = 0
+    node_index = 0
+    while node_index < len(cv):
+        n = cv[node_index]
+        if n.free_leaf_cell_num_at_priority - picked_leaf_cell_num >= leaf_cell_nums[pod_index]:
+            # fail when forced onto a bad or non-suggested node
+            if not n.healthy:
+                return None, f"have to use at least one bad node {n.node_address}"
+            if not n.suggested:
+                return None, f"have to use at least one non-suggested node {n.node_address}"
+            picked[pod_index] = node_index
+            picked_leaf_cell_num += leaf_cell_nums[pod_index]
+            pod_index += 1
+            if pod_index == len(leaf_cell_nums):
+                return picked, ""
+        else:
+            picked_leaf_cell_num = 0
+            node_index += 1
+    return None, "insufficient capacity"
+
+
+def _get_optimal_affinity(leaf_cell_num: int, level_leaf_cell_num: Dict[CellLevel, int]) -> CellLevel:
+    """Lowest level whose cells can hold the pod (reference:
+    getOptimalAffinity, topology_aware_scheduler.go:389-399)."""
+    for lv in range(1, len(level_leaf_cell_num) + 1):
+        if level_leaf_cell_num.get(lv, 0) >= leaf_cell_num:
+            return lv
+    raise AssertionError(
+        "Assert Failure: pod allocated a node but exceeds the capacity of the current chain"
+    )
+
+
+def _find_lca(lower: Cell, higher: Cell) -> Optional[Cell]:
+    """Reference: findLCA, topology_aware_scheduler.go:444-462."""
+    while lower.level < higher.level:
+        if lower.parent is None:
+            return None
+        lower = lower.parent
+    if cell_equal(lower, higher):
+        return lower
+    while not cell_equal(lower.parent, higher.parent):
+        if lower.parent is None or higher.parent is None:
+            return None
+        lower = lower.parent
+        higher = higher.parent
+    return lower.parent
+
+
+def _get_leaf_cells_from_node(
+    c: Cell, p: CellPriority, free: CellList, preemptible: CellList
+) -> None:
+    """Reference: getLeafCellsFromNode, topology_aware_scheduler.go:465-476."""
+    if c.level > 1:
+        for cc in c.children:
+            _get_leaf_cells_from_node(cc, p, free, preemptible)
+    elif c.priority == FREE_PRIORITY:
+        free.append(c)
+    elif c.priority < p:
+        preemptible.append(c)
+
+
+def find_leaf_cells_in_node(
+    n: Cell,
+    leaf_cell_num: int,
+    p: CellPriority,
+    available_leaf_cells: Optional[CellList],
+    level_leaf_cell_num: Dict[CellLevel, int],
+) -> Tuple[CellList, CellList]:
+    """Backtracking search for the `leaf_cell_num` chips with the lowest LCA in
+    a node (reference: findLeafCellsInNode, topology_aware_scheduler.go:309-387).
+
+    Free chips come before preemptible ones in the candidate list, so free
+    chips are preferred. Prunes branches whose running LCA already exceeds the
+    best seen; early-stops on an optimal (all-buddy / tightest sub-mesh)
+    solution. Returns (picked cells, remaining available cells).
+    """
+    if available_leaf_cells is None:
+        free: CellList = []
+        preemptible: CellList = []
+        _get_leaf_cells_from_node(n, p, free, preemptible)
+        available_leaf_cells = free + preemptible
+
+    current_indices = [0] * leaf_cell_num
+    current_affinity: List[Optional[Cell]] = [None] * leaf_cell_num
+    best_cells: CellList = [None] * leaf_cell_num  # type: ignore[list-item]
+    best_indices = [0] * leaf_cell_num
+    best_affinity = HIGHEST_LEVEL
+    optimal_affinity = _get_optimal_affinity(leaf_cell_num, level_leaf_cell_num)
+
+    avail_index = 0
+    search_index = 0
+    while True:
+        while avail_index < len(available_leaf_cells):
+            leaf_cell = available_leaf_cells[avail_index]
+            current_indices[search_index] = avail_index
+            if search_index == 0:
+                current_affinity[0] = leaf_cell
+            else:
+                lca = _find_lca(leaf_cell, current_affinity[search_index - 1])
+                current_affinity[search_index] = lca
+                # prune: running LCA already worse than the best seen
+                if (lca is None and best_affinity < HIGHEST_LEVEL) or (
+                    lca is not None and lca.level > best_affinity
+                ):
+                    avail_index += 1
+                    continue
+            if search_index == leaf_cell_num - 1:
+                affinity = current_affinity[-1].level
+                if affinity < best_affinity:
+                    best_affinity = affinity
+                    best_indices[:] = current_indices
+                    for i, idx in enumerate(current_indices):
+                        best_cells[i] = available_leaf_cells[idx]
+                    if affinity == optimal_affinity:
+                        # early stop: all-buddy solution
+                        _remove_picked(available_leaf_cells, best_indices)
+                        return best_cells, available_leaf_cells
+            else:
+                search_index += 1
+            avail_index += 1
+        search_index -= 1
+        if search_index < 0:
+            if best_affinity == HIGHEST_LEVEL:
+                raise AssertionError(
+                    f"Assert Failure: failed to allocate {leaf_cell_num} leaf cells "
+                    f"in picked node {n.address}"
+                )
+            _remove_picked(available_leaf_cells, best_indices)
+            return best_cells, available_leaf_cells
+        avail_index = current_indices[search_index] + 1
+
+
+def _remove_picked(leaf_cells: CellList, indices: List[int]) -> None:
+    """Remove the picked cells (ascending indices) in place."""
+    for offset, index in enumerate(indices):
+        del leaf_cells[index - offset]
+
+
+class TopologyAwareScheduler:
+    """Reference: topologyAwareScheduler, topology_aware_scheduler.go:36-116."""
+
+    def __init__(
+        self,
+        ccl: ChainCellList,
+        level_leaf_cell_num: Dict[CellLevel, int],
+        cross_priority_pack: bool,
+    ):
+        self.cv = _new_cluster_view(ccl)
+        self.level_leaf_cell_num = level_leaf_cell_num
+        self.cross_priority_pack = cross_priority_pack
+
+    def schedule(
+        self,
+        pod_leaf_cell_numbers: Dict[int, int],
+        p: CellPriority,
+        suggested_nodes: Set[str],
+        ignore_suggested_nodes: bool,
+    ) -> Tuple[Optional[Dict[int, List[CellList]]], str]:
+        """Two-phase placement: first with preemption disabled (schedule at
+        opportunistic priority), then retry with the real priority
+        (reference: Schedule, topology_aware_scheduler.go:65-116)."""
+        sorted_pod_nums: List[int] = []
+        for leaf_cell_num, pod_num in pod_leaf_cell_numbers.items():
+            sorted_pod_nums.extend([leaf_cell_num] * pod_num)
+        sorted_pod_nums.sort()
+
+        priority = OPPORTUNISTIC_PRIORITY
+        self._update_cluster_view(priority, suggested_nodes, ignore_suggested_nodes)
+        picked_indices, failed_reason = _find_nodes_for_pods(self.cv, sorted_pod_nums)
+        if picked_indices is None and p > OPPORTUNISTIC_PRIORITY:
+            priority = p
+            self._update_cluster_view(priority, suggested_nodes, ignore_suggested_nodes)
+            picked_indices, failed_reason = _find_nodes_for_pods(self.cv, sorted_pod_nums)
+        if picked_indices is None:
+            return None, failed_reason
+
+        selected_nodes = [self.cv[i].cell for i in picked_indices]
+        node_available: Dict[str, CellList] = {}
+        pod_placements: Dict[int, List[CellList]] = {}
+        for pod_index, leaf_cell_num in enumerate(sorted_pod_nums):
+            node_cell = selected_nodes[pod_index]
+            picked_cells, node_available[node_cell.address] = find_leaf_cells_in_node(
+                node_cell,
+                leaf_cell_num,
+                priority,
+                node_available.get(node_cell.address),
+                self.level_leaf_cell_num,
+            )
+            pod_placements.setdefault(leaf_cell_num, []).append(picked_cells)
+        return pod_placements, ""
+
+    def _update_cluster_view(
+        self, p: CellPriority, suggested_nodes: Set[str], ignore_suggested_nodes: bool
+    ) -> None:
+        for n in self.cv:
+            n.update_used_leaf_cell_num_for_priority(p, self.cross_priority_pack)
+            n.healthy, n.suggested, n.node_address = _node_healthy_and_in_suggested(
+                n, suggested_nodes, ignore_suggested_nodes
+            )

@@ -1,0 +1,369 @@
+"""Result generation, victim collection, recovery helpers.
+
+TPU-native analogue of the reference's ``pkg/algorithm/utils.go``.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from typing import Dict, List, Optional, Set, Tuple
+
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.algorithm.cell import CellChain, CellLevel, PhysicalCell, VirtualCell, cell_equal
+from hivedscheduler_tpu.algorithm.constants import (
+    CELL_RESERVED,
+    CELL_RESERVING,
+    CELL_USED,
+    GROUP_PREEMPTING,
+)
+from hivedscheduler_tpu.algorithm.types import (
+    AlgoAffinityGroup,
+    ChainCellList,
+    GroupPhysicalPlacement,
+    GroupVirtualPlacement,
+)
+from hivedscheduler_tpu.k8s.types import Pod
+from hivedscheduler_tpu.runtime import utils as internal
+from hivedscheduler_tpu.runtime.types import (
+    PodPreemptInfo,
+    PodScheduleResult,
+    PodWaitInfo,
+)
+
+log = logging.getLogger(__name__)
+
+
+def generate_pod_schedule_result(
+    group_physical_placement: Optional[GroupPhysicalPlacement],
+    group_virtual_placement: Optional[GroupVirtualPlacement],
+    preemption_victims: Dict[str, Dict[str, Pod]],
+    wait_reason: str,
+    cell_level_to_type: Dict[CellChain, Dict[CellLevel, str]],
+    current_leaf_cell_num: int,
+    current_pod_index: int,
+    group: Optional[AlgoAffinityGroup],
+    group_name: str,
+    suggested_nodes: Set[str],
+    pod: Pod,
+) -> PodScheduleResult:
+    """wait | preempt | bind (reference: generatePodScheduleResult,
+    utils.go:38-79)."""
+    if group_physical_placement is None:
+        log.info("[%s]: Pod needs to wait, reason: %s", internal.key(pod), wait_reason)
+        return PodScheduleResult(pod_wait_info=PodWaitInfo(reason=wait_reason))
+    if preemption_victims:
+        return PodScheduleResult(
+            pod_preempt_info=generate_pod_preempt_info(preemption_victims, pod)
+        )
+    # find the selected node only after preemption is done — victims may cause
+    # the selected node to be excluded from the suggested nodes
+    bind_info, selected_node, selected_indices, cell_chain = generate_affinity_group_bind_info(
+        group_physical_placement, group_virtual_placement, cell_level_to_type,
+        current_leaf_cell_num, current_pod_index, group, group_name,
+    )
+    log.info(
+        "[%s]: pod is decided to be scheduled to node %s, leaf cells %s",
+        internal.key(pod), selected_node, selected_indices,
+    )
+    return PodScheduleResult(
+        pod_bind_info=api.PodBindInfo(
+            node=selected_node,
+            leaf_cell_isolation=selected_indices,
+            cell_chain=cell_chain,
+            affinity_group_bind_info=bind_info,
+        )
+    )
+
+
+def generate_pod_preempt_info(
+    preemption_victims: Dict[str, Dict[str, Pod]], pod: Pod
+) -> PodPreemptInfo:
+    """Victims on ONE random node per call — K8s preempts one node at a time;
+    randomness spreads different preemptors over different nodes (reference:
+    generatePodPreemptInfo, utils.go:82-103)."""
+    nodes_having_victims = sorted(preemption_victims)
+    node_to_preempt = nodes_having_victims[random.randrange(len(nodes_having_victims))]
+    victim_pods = list(preemption_victims[node_to_preempt].values())
+    log.info("[%s]: need to preempt pods %s", internal.key(pod),
+             [internal.key(v) for v in victim_pods])
+    return PodPreemptInfo(victim_pods=victim_pods)
+
+
+def generate_affinity_group_bind_info(
+    group_physical_placement: GroupPhysicalPlacement,
+    group_virtual_placement: Optional[GroupVirtualPlacement],
+    cell_level_to_type: Dict[CellChain, Dict[CellLevel, str]],
+    current_leaf_cell_num: int,
+    current_pod_index: int,
+    group: Optional[AlgoAffinityGroup],
+    group_name: str,
+) -> Tuple[List[api.AffinityGroupMemberBindInfo], str, List[int], str]:
+    """Placement → wire format, incl. PreassignedCellTypes needed for recovery
+    (reference: generateAffinityGroupBindInfo, utils.go:108-171)."""
+    bind_info: List[api.AffinityGroupMemberBindInfo] = []
+    selected_node = ""
+    selected_indices: List[int] = []
+    chain = ""
+    for pod_leaf_cell_num, pod_physical_placements in group_physical_placement.items():
+        mbi = api.AffinityGroupMemberBindInfo(
+            pod_placements=[
+                api.PodPlacementInfo(
+                    physical_node="",
+                    physical_leaf_cell_indices=[0] * pod_leaf_cell_num,
+                    preassigned_cell_types=[""] * pod_leaf_cell_num,
+                )
+                for _ in pod_physical_placements
+            ]
+        )
+        for pod_index in range(len(pod_physical_placements)):
+            for leaf_cell_index in range(pod_leaf_cell_num):
+                p_leaf_cell = pod_physical_placements[pod_index][leaf_cell_index]
+                if p_leaf_cell is None:
+                    if group is None or group.state == GROUP_PREEMPTING:
+                        raise AssertionError(
+                            f"The first pod in group {group_name} was allocated invalid resource"
+                        )
+                    # placement invalid (e.g., removed by reconfiguration):
+                    # insist the decision by retrieving it from peer pods
+                    mbi.pod_placements[pod_index], chain = retrieve_missing_pod_placement(
+                        group, pod_leaf_cell_num, pod_index
+                    )
+                    log.warning(
+                        "pod placement has been invalid and is retrieved from annotation "
+                        "of other pods: node %s, leaf cells %s",
+                        mbi.pod_placements[pod_index].physical_node,
+                        mbi.pod_placements[pod_index].physical_leaf_cell_indices,
+                    )
+                else:
+                    assert isinstance(p_leaf_cell, PhysicalCell)
+                    nodes, leaf_cell_indices = p_leaf_cell.get_physical_placement()
+                    if mbi.pod_placements[pod_index].physical_node == "":
+                        mbi.pod_placements[pod_index].physical_node = nodes[0]
+                    mbi.pod_placements[pod_index].physical_leaf_cell_indices[leaf_cell_index] = (
+                        leaf_cell_indices[0]
+                    )
+                    if group_virtual_placement is not None:
+                        v_leaf_cell = group_virtual_placement[pod_leaf_cell_num][pod_index][
+                            leaf_cell_index
+                        ]
+                        assert isinstance(v_leaf_cell, VirtualCell)
+                        mbi.pod_placements[pod_index].preassigned_cell_types[leaf_cell_index] = (
+                            cell_level_to_type[v_leaf_cell.chain][
+                                v_leaf_cell.preassigned_cell.level
+                            ]
+                        )
+                    else:
+                        mbi.pod_placements[pod_index].preassigned_cell_types[leaf_cell_index] = ""
+        if pod_leaf_cell_num == current_leaf_cell_num:
+            selected_node = mbi.pod_placements[current_pod_index].physical_node
+            selected_indices = mbi.pod_placements[current_pod_index].physical_leaf_cell_indices
+            p_leaf_cell = group_physical_placement[current_leaf_cell_num][current_pod_index][0]
+            if p_leaf_cell is not None:
+                chain = p_leaf_cell.chain
+        bind_info.append(mbi)
+    return bind_info, selected_node, selected_indices, chain
+
+
+def collect_bad_or_non_suggested_nodes(
+    placement: GroupPhysicalPlacement,
+    suggested_nodes: Set[str],
+    ignore_suggested_nodes: bool,
+) -> Set[str]:
+    """Reference: collectBadOrNonSuggestedNodes, utils.go:175-197."""
+    bad_or_non_suggested: Set[str] = set()
+    for pod_placements in placement.values():
+        for pod_placement in pod_placements:
+            for leaf_cell in pod_placement:
+                if leaf_cell is None:
+                    continue
+                assert isinstance(leaf_cell, PhysicalCell)
+                nodes, _ = leaf_cell.get_physical_placement()
+                if not leaf_cell.healthy or (
+                    not ignore_suggested_nodes and nodes[0] not in suggested_nodes
+                ):
+                    bad_or_non_suggested.add(nodes[0])
+    return bad_or_non_suggested
+
+
+def collect_preemption_victims(
+    placement: GroupPhysicalPlacement,
+) -> Tuple[Dict[str, Dict[str, Pod]], List[AlgoAffinityGroup]]:
+    """Gang preemption: any Used/Reserving cell pulls in ALL pods of the using
+    group; also returns overlapping preemptor groups whose preemption must be
+    canceled (reference: collectPreemptionVictims, utils.go:202-235).
+
+    Victims are keyed node -> {pod uid -> pod}."""
+    victim_pods: Dict[str, Dict[str, Pod]] = {}
+    overlapping_preemptors: List[AlgoAffinityGroup] = []
+    for pod_placements in placement.values():
+        for pod_placement in pod_placements:
+            for leaf_cell in pod_placement:
+                if leaf_cell is None:
+                    continue
+                assert isinstance(leaf_cell, PhysicalCell)
+                state = leaf_cell.state
+                if state in (CELL_USED, CELL_RESERVING):
+                    for pods in leaf_cell.using_group.allocated_pods.values():
+                        for v in pods:
+                            if v is not None:
+                                victim_pods.setdefault(v.node_name, {})[v.uid] = v
+                if state in (CELL_RESERVING, CELL_RESERVED):
+                    g = leaf_cell.reserving_or_reserved_group
+                    if g is not None and all(o is not g for o in overlapping_preemptors):
+                        overlapping_preemptors.append(g)
+    return victim_pods, overlapping_preemptors
+
+
+def retrieve_missing_pod_placement(
+    g: AlgoAffinityGroup, leaf_cell_num: int, pod_index: int
+) -> Tuple[api.PodPlacementInfo, str]:
+    """Reference: retrieveMissingPodPlacement, utils.go:250-265."""
+    for pods in g.allocated_pods.values():
+        for p in pods:
+            if p is not None:
+                info = internal.extract_pod_bind_info(p)
+                for mbi in info.affinity_group_bind_info:
+                    if leaf_cell_num == len(mbi.pod_placements[0].physical_leaf_cell_indices):
+                        return mbi.pod_placements[pod_index], info.cell_chain
+    raise AssertionError(
+        f"No allocated pod found in an allocated group {g.name} when retrieving placement "
+        f"for pod {pod_index} with leaf cell number {leaf_cell_num}"
+    )
+
+
+def retrieve_virtual_cell(
+    physical_placement: GroupPhysicalPlacement,
+    virtual_placement: GroupVirtualPlacement,
+    p_leaf_cell: PhysicalCell,
+) -> Optional[VirtualCell]:
+    """Reference: retrieveVirtualCell, utils.go:269-283."""
+    for leaf_cell_num, pod_placements in physical_placement.items():
+        for pod_index, pod_placement in enumerate(pod_placements):
+            for leaf_cell_index, leaf_cell in enumerate(pod_placement):
+                if leaf_cell is not None and cell_equal(leaf_cell, p_leaf_cell):
+                    return virtual_placement[leaf_cell_num][pod_index][leaf_cell_index]
+    return None
+
+
+def get_new_pod_index(pods: List[Optional[Pod]]) -> int:
+    """Reference: getNewPodIndex, utils.go:286-295."""
+    for i, p in enumerate(pods):
+        if p is None:
+            return i
+    return -1
+
+
+def get_allocated_pod_index(info: api.PodBindInfo, leaf_cell_num: int) -> int:
+    """Reference: getAllocatedPodIndex, utils.go:298-310."""
+    for gms in info.affinity_group_bind_info:
+        if len(gms.pod_placements[0].physical_leaf_cell_indices) == leaf_cell_num:
+            for pod_index, placement in enumerate(gms.pod_placements):
+                if (
+                    placement.physical_node == info.node
+                    and info.leaf_cell_isolation
+                    and info.leaf_cell_isolation[0] in placement.physical_leaf_cell_indices
+                ):
+                    return pod_index
+    return -1
+
+
+def all_pods_released(allocated_pods: Dict[int, List[Optional[Pod]]]) -> bool:
+    """Reference: allPodsReleased, utils.go:313-321."""
+    return all(p is None for pods in allocated_pods.values() for p in pods)
+
+
+def find_physical_leaf_cell(
+    full_cell_list: Dict[CellChain, ChainCellList],
+    chain: CellChain,
+    node: str,
+    leaf_cell_index: int,
+) -> Optional[PhysicalCell]:
+    """Find a leaf cell by (node, index); falls back to other chains on
+    reconfiguration (reference: findPhysicalLeafCell, utils.go:326-345)."""
+    found = _find_physical_leaf_cell_in_chain(full_cell_list, chain, node, leaf_cell_index)
+    if found is None:
+        for c in full_cell_list:
+            if c != chain:
+                found = _find_physical_leaf_cell_in_chain(full_cell_list, c, node, leaf_cell_index)
+                if found is not None:
+                    log.warning(
+                        "Leaf cell %s on node %s has been moved to chain %s",
+                        leaf_cell_index, node, c,
+                    )
+                    return found
+        return None
+    return found
+
+
+def _find_physical_leaf_cell_in_chain(
+    full_cell_list: Dict[CellChain, ChainCellList],
+    chain: CellChain,
+    node: str,
+    leaf_cell_index: int,
+) -> Optional[PhysicalCell]:
+    """Reference: findPhysicalLeafCellInChain, utils.go:350-378."""
+    for c in full_cell_list.get(chain, {}).get(1, []):
+        assert isinstance(c, PhysicalCell)
+        nodes, leaf_cell_indices = c.get_physical_placement()
+        if node in nodes:
+            if leaf_cell_index < 0 or leaf_cell_index in leaf_cell_indices:
+                return c
+    return None
+
+
+def in_free_cell_list(c: PhysicalCell) -> bool:
+    """True iff the cell or an ancestor is in the global free list (reference:
+    inFreeCellList, utils.go:381-391)."""
+    while True:
+        if c.virtual_cell is not None or c.split:
+            return False
+        if c.parent is None or c.parent.split:  # type: ignore[union-attr]
+            return True
+        c = c.parent  # type: ignore[assignment]
+
+
+def set_cell_state(c: PhysicalCell, s: str) -> None:
+    """Set state up-tree: a parent is Used if ANY child is Used; it takes the
+    other states only when ALL children share them (reference: setCellState,
+    utils.go:397-405)."""
+    c.set_state(s)
+    if c.parent is not None:
+        parent = c.parent
+        assert isinstance(parent, PhysicalCell)
+        if s == CELL_USED or all_children_same_state(parent, s):
+            set_cell_state(parent, s)
+
+
+def all_children_same_state(c: PhysicalCell, s: str) -> bool:
+    return all(child.state == s for child in c.children)
+
+
+def generate_ot_virtual_cell(pc: api.PhysicalCellStatus) -> api.VirtualCellStatus:
+    """Fake '-opp' virtual cell exposing opportunistic usage in the VC status
+    (reference: generateOTVirtualCell, utils.go:419-432)."""
+    return api.VirtualCellStatus(
+        leaf_cell_type=pc.leaf_cell_type,
+        cell_type=pc.cell_type,
+        cell_address=pc.cell_address + "-opp",
+        cell_state=CELL_USED,
+        cell_healthiness=pc.cell_healthiness,
+        cell_priority=-1,
+        physical_cell=pc,
+    )
+
+
+def delete_ot_virtual_cell(
+    status_list: List[api.VirtualCellStatus], addr: str
+) -> List[api.VirtualCellStatus]:
+    """Reference: deleteOTVirtualCell, utils.go:436-452."""
+    for i, ovc in enumerate(status_list):
+        if ovc.physical_cell is not None and ovc.physical_cell.cell_address == addr:
+            status_list[i] = status_list[-1]
+            status_list.pop()
+            return status_list
+    log.error(
+        "trying to delete an opportunistic virtual cell that does not exist, "
+        "physical cell address: %s", addr,
+    )
+    return status_list

@@ -1,0 +1,4 @@
+"""Scheduler runtime: pod state machine, routines, contracts.
+
+TPU-native analogue of the reference's ``pkg/internal`` + ``pkg/scheduler``.
+"""

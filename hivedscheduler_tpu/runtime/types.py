@@ -1,0 +1,115 @@
+"""Runtime contracts: SchedulerAlgorithm interface, scheduling phases, pod
+schedule results, pod states.
+
+TPU-native analogue of the reference's ``pkg/internal/types.go``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from hivedscheduler_tpu.api.types import PodBindInfo
+from hivedscheduler_tpu.k8s.types import Node, Pod
+
+# --- scheduling phases (reference: internal/types.go:102-114) ---------------
+FILTERING_PHASE = "Filtering"
+PREEMPTING_PHASE = "Preempting"
+
+# --- pod states (reference: internal/types.go:154-198) ----------------------
+# The Pod is unknown to the scheduler: it may not exist or its state has not
+# been recovered yet.
+POD_UNKNOWN = "Unknown"
+# Waiting for free resources.
+POD_WAITING = "Waiting"
+# Waiting for preemption to complete.
+POD_PREEMPTING = "Preempting"
+# The scheduler has decided the placement and is delivering the bind.
+POD_BINDING = "Binding"
+# The bind has been committed to the ApiServer.
+POD_BOUND = "Bound"
+
+
+def is_allocated(state: str) -> bool:
+    """Binding|Bound hold resources (reference: internal/types.go:190-198)."""
+    return state in (POD_BINDING, POD_BOUND)
+
+
+@dataclass
+class PodWaitInfo:
+    reason: str = ""
+
+
+@dataclass
+class PodPreemptInfo:
+    victim_pods: List[Pod] = field(default_factory=list)
+
+
+@dataclass
+class PodScheduleResult:
+    """Exactly one of the three is set: wait | preempt | bind (reference:
+    internal/types.go:116-136)."""
+
+    pod_wait_info: Optional[PodWaitInfo] = None
+    pod_preempt_info: Optional[PodPreemptInfo] = None
+    pod_bind_info: Optional[PodBindInfo] = None
+
+
+@dataclass
+class PodScheduleStatus:
+    """In-flight pod record (reference: internal/types.go:138-152)."""
+
+    pod: Optional[Pod] = None
+    pod_state: str = POD_UNKNOWN
+    pod_schedule_result: Optional[PodScheduleResult] = None
+    # number of bind attempts; beyond ForcePodBindThreshold we force-bind
+    pod_bind_attempts: int = 0
+
+
+class SchedulerAlgorithm:
+    """Interface + concurrency contract (reference: internal/types.go:57-100):
+    the caller serializes all mutating calls (one global scheduler lock);
+    implementations need not be thread-safe beyond their own inspect reads."""
+
+    def add_node(self, node: Node) -> None:
+        raise NotImplementedError
+
+    def update_node(self, old_node: Node, new_node: Node) -> None:
+        raise NotImplementedError
+
+    def delete_node(self, node: Node) -> None:
+        raise NotImplementedError
+
+    def add_unallocated_pod(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def delete_unallocated_pod(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def add_allocated_pod(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def delete_allocated_pod(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def schedule(self, pod: Pod, suggested_nodes: List[str], phase: str) -> PodScheduleResult:
+        raise NotImplementedError
+
+    # inspect getters
+    def get_all_affinity_groups(self):
+        raise NotImplementedError
+
+    def get_affinity_group(self, name: str):
+        raise NotImplementedError
+
+    def get_cluster_status(self):
+        raise NotImplementedError
+
+    def get_physical_cluster_status(self):
+        raise NotImplementedError
+
+    def get_all_virtual_clusters_status(self):
+        raise NotImplementedError
+
+    def get_virtual_cluster_status(self, vcn: str):
+        raise NotImplementedError

@@ -1,0 +1,182 @@
+"""Runtime helpers: pod predicates, annotation (de)serialization with
+defaulting + validation, binding construction.
+
+TPU-native analogue of the reference's ``pkg/internal/utils.go``. The
+``gpuType``/``gpuNumber``/``gpuIsolation`` and ``chipType``/``chipNumber``
+annotation keys are rewritten to the canonical leaf-cell keys for backward and
+TPU-idiomatic compatibility (reference: convertOldAnnotation,
+``internal/utils.go:189-197``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from hivedscheduler_tpu.api import constants as api_constants
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.common import utils as common
+from hivedscheduler_tpu.k8s.types import Node, Pod
+
+
+def key(pod: Pod) -> str:
+    return f"{pod.uid}({pod.namespace}/{pod.name})"
+
+
+def is_completed(pod: Pod) -> bool:
+    return pod.phase in ("Succeeded", "Failed")
+
+
+def is_live(pod: Pod) -> bool:
+    return not is_completed(pod)
+
+
+def is_hived_enabled(pod: Pod) -> bool:
+    """A pod opts in via the pod-scheduling-enable resource limit on any
+    container (reference: internal/utils.go:116-139)."""
+    for container in pod.containers:
+        quantity = container.resource_limits.get(
+            api_constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE
+        )
+        if quantity is not None and float(quantity) > 0:
+            return True
+    return False
+
+
+def is_interested(pod: Pod) -> bool:
+    return is_live(pod) and is_hived_enabled(pod)
+
+
+def is_bound(pod: Pod) -> bool:
+    return pod.node_name != "" and is_live(pod)
+
+
+def is_unbound(pod: Pod) -> bool:
+    return pod.node_name == "" and is_live(pod)
+
+
+def is_node_healthy(node: Node) -> bool:
+    """Schedulable + Ready (reference: internal/utils.go:160-170)."""
+    if node.unschedulable:
+        return False
+    return any(c.type == "Ready" and c.status == "True" for c in node.conditions)
+
+
+def to_indices_string(indices: List[int]) -> str:
+    """The TPU_VISIBLE_CHIPS-style comma-joined chip index list."""
+    return ",".join(str(i) for i in indices)
+
+
+def new_binding_pod(pod: Pod, pod_bind_info: api.PodBindInfo) -> Pod:
+    """Stamp node + chip-isolation + bind-info annotations onto a copy of the
+    pod (reference: NewBindingPod, internal/utils.go:172-186)."""
+    binding_pod = pod.deep_copy()
+    binding_pod.node_name = pod_bind_info.node
+    binding_pod.annotations[api_constants.ANNOTATION_POD_CHIP_ISOLATION] = to_indices_string(
+        pod_bind_info.leaf_cell_isolation
+    )
+    binding_pod.annotations[api_constants.ANNOTATION_POD_BIND_INFO] = common.to_yaml(
+        pod_bind_info.to_dict()
+    )
+    return binding_pod
+
+
+_OLD_KEY_REWRITES = [
+    ("gpuType", "leafCellType"),
+    ("gpuNumber", "leafCellNumber"),
+    ("gpuIsolation", "leafCellIsolation"),
+    ("physicalGpuIndices", "physicalLeafCellIndices"),
+    ("chipType", "leafCellType"),
+    ("chipNumber", "leafCellNumber"),
+    ("chipIsolation", "leafCellIsolation"),
+    ("physicalChipIndices", "physicalLeafCellIndices"),
+]
+
+
+def convert_old_annotation(annotation: str) -> str:
+    for old, new in _OLD_KEY_REWRITES:
+        annotation = annotation.replace(old, new)
+    return annotation
+
+
+def extract_pod_bind_info(allocated_pod: Pod) -> api.PodBindInfo:
+    """Bind info comes from us, so deserialization just asserts (reference:
+    internal/utils.go:200-214)."""
+    annotation = convert_old_annotation(
+        allocated_pod.annotations.get(api_constants.ANNOTATION_POD_BIND_INFO, "")
+    )
+    if not annotation:
+        raise AssertionError(
+            f"Pod does not contain or contains empty annotation: "
+            f"{api_constants.ANNOTATION_POD_BIND_INFO}"
+        )
+    return api.PodBindInfo.from_dict(common.from_yaml(annotation))
+
+
+def extract_pod_bind_annotations(allocated_pod: Pod) -> Dict[str, str]:
+    return {
+        api_constants.ANNOTATION_POD_CHIP_ISOLATION: allocated_pod.annotations.get(
+            api_constants.ANNOTATION_POD_CHIP_ISOLATION, ""
+        ),
+        api_constants.ANNOTATION_POD_BIND_INFO: allocated_pod.annotations.get(
+            api_constants.ANNOTATION_POD_BIND_INFO, ""
+        ),
+    }
+
+
+def extract_pod_scheduling_spec(pod: Pod) -> api.PodSchedulingSpec:
+    """User-facing spec: parse + default + validate; all errors are
+    bad-request (HTTP 400) class (reference: ExtractPodSchedulingSpec,
+    internal/utils.go:230-289)."""
+    err_pfx = f"Pod annotation {api_constants.ANNOTATION_POD_SCHEDULING_SPEC}: "
+    annotation = convert_old_annotation(
+        pod.annotations.get(api_constants.ANNOTATION_POD_SCHEDULING_SPEC, "")
+    )
+    if not annotation:
+        raise api.as_bad_request(err_pfx + "Annotation does not exist or is empty")
+    try:
+        raw = common.from_yaml(annotation)
+        spec = api.PodSchedulingSpec.from_dict(raw or {})
+    except api.WebServerError:
+        raise
+    except Exception as e:
+        raise api.as_bad_request(err_pfx + f"Failed to parse: {e}")
+
+    # Defaulting: a pod with no affinity group is its own gang of one.
+    if spec.affinity_group is None:
+        spec.affinity_group = api.AffinityGroupSpec(
+            name=f"{pod.namespace}/{pod.name}",
+            members=[
+                api.AffinityGroupMemberSpec(
+                    pod_number=1, leaf_cell_number=spec.leaf_cell_number
+                )
+            ],
+        )
+
+    # Validation
+    if not spec.virtual_cluster:
+        raise api.as_bad_request(err_pfx + "VirtualCluster is empty")
+    if spec.priority < api_constants.OPPORTUNISTIC_PRIORITY:
+        raise api.as_bad_request(
+            err_pfx + f"Priority is less than {api_constants.OPPORTUNISTIC_PRIORITY}"
+        )
+    if spec.priority > api_constants.MAX_GUARANTEED_PRIORITY:
+        raise api.as_bad_request(
+            err_pfx + f"Priority is greater than {api_constants.MAX_GUARANTEED_PRIORITY}"
+        )
+    if spec.leaf_cell_number <= 0:
+        raise api.as_bad_request(err_pfx + "LeafCellNumber is non-positive")
+    if not spec.affinity_group.name:
+        raise api.as_bad_request(err_pfx + "AffinityGroup.Name is empty")
+    is_pod_in_group = False
+    for member in spec.affinity_group.members:
+        if member.pod_number <= 0:
+            raise api.as_bad_request(err_pfx + "AffinityGroup.Members has non-positive PodNumber")
+        if member.leaf_cell_number <= 0:
+            raise api.as_bad_request(
+                err_pfx + "AffinityGroup.Members has non-positive LeafCellNumber"
+            )
+        if member.leaf_cell_number == spec.leaf_cell_number:
+            is_pod_in_group = True
+    if not is_pod_in_group:
+        raise api.as_bad_request(err_pfx + "AffinityGroup.Members does not contain current Pod")
+    return spec

@@ -1,0 +1,524 @@
+"""HivedAlgorithm behavioral tests.
+
+Ports the reference's test strategy (``pkg/algorithm/hived_algorithm_test.go``,
+SURVEY.md §4): a fake multi-node cluster defined purely by config YAML, driven
+through the algorithm layer with pod specs, suggested-node lists, and node
+health events — no real K8s anywhere. Covers: normal operations with
+placement goldens, gang scheduling, user-error panics (HTTP 4xx class),
+stateful preemption chains, lazy preemption, bad nodes with doomed-bad-cell
+binding, safe-relaxed buddy allocation, reconfiguration replay, and invalid
+initial VC assignments.
+"""
+
+import logging
+import random
+
+import pytest
+
+from hivedscheduler_tpu.api import constants as C
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.api.config import load_config
+from hivedscheduler_tpu.algorithm import HivedAlgorithm
+from hivedscheduler_tpu.algorithm.constants import (
+    CELL_FREE,
+    CELL_RESERVED,
+    CELL_RESERVING,
+    CELL_USED,
+    GROUP_ALLOCATED,
+    GROUP_BEING_PREEMPTED,
+    GROUP_PREEMPTING,
+)
+from hivedscheduler_tpu.common.utils import to_yaml
+from hivedscheduler_tpu.k8s.types import Container, Node, Pod
+from hivedscheduler_tpu.runtime.types import FILTERING_PHASE, PREEMPTING_PHASE
+from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+logging.getLogger().setLevel(logging.ERROR)
+
+import os
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "example", "config", "design", "tpu-hive.yaml",
+)
+
+
+def make_pod(name, spec_dict, uid=None):
+    return Pod(
+        name=name,
+        uid=uid or name,
+        annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: to_yaml(spec_dict)},
+        containers=[Container(resource_limits={C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})],
+    )
+
+
+def all_node_names(h):
+    nodes = set()
+    for ccl in h.full_cell_list.values():
+        for c in ccl[max(ccl)]:
+            nodes.update(c.nodes)
+    return sorted(nodes)
+
+
+def set_healthy_nodes(h):
+    for n in all_node_names(h):
+        h.add_node(Node(name=n))
+
+
+@pytest.fixture
+def algo():
+    random.seed(0)
+    h = HivedAlgorithm(load_config(FIXTURE))
+    set_healthy_nodes(h)
+    return h
+
+
+def schedule_and_allocate(h, pod, suggested=None, phase=FILTERING_PHASE):
+    sn = suggested if suggested is not None else all_node_names(h)
+    r = h.schedule(pod, sn, phase)
+    assert r.pod_bind_info is not None, f"expected bind, got {r.pod_wait_info or r.pod_preempt_info}"
+    bp = new_binding_pod(pod, r.pod_bind_info)
+    h.add_allocated_pod(bp)
+    return bp, r.pod_bind_info
+
+
+# ---------------------------------------------------------------------------
+# normal operations
+# ---------------------------------------------------------------------------
+
+
+class TestNormalOperations:
+    def test_single_chip_pod(self, algo):
+        pod = make_pod("p1", {"virtualCluster": "vc2", "priority": 0,
+                              "chipType": "v5e-chip", "chipNumber": 1})
+        bp, info = schedule_and_allocate(algo, pod)
+        assert info.node == "v5e-host0/0-0"
+        assert len(info.leaf_cell_isolation) == 1
+        assert info.cell_chain == "v5e-8"
+        # isolation annotation is the TPU_VISIBLE_CHIPS handoff
+        assert bp.annotations[C.ANNOTATION_POD_CHIP_ISOLATION] == str(
+            info.leaf_cell_isolation[0]
+        )
+
+    def test_full_host_gang(self, algo):
+        pod = make_pod("p8", {"virtualCluster": "vc2", "priority": 0,
+                              "chipType": "v5e-chip", "chipNumber": 8})
+        _, info = schedule_and_allocate(algo, pod)
+        assert sorted(info.leaf_cell_isolation) == list(range(8))
+
+    def test_multi_host_gang_is_contiguous_submesh(self, algo):
+        spec = {"virtualCluster": "vc1", "priority": 5, "chipType": "v5p-chip",
+                "chipNumber": 4,
+                "affinityGroup": {"name": "g32",
+                                  "members": [{"podNumber": 8, "chipNumber": 4}]}}
+        origins = []
+        for i in range(8):
+            _, info = schedule_and_allocate(algo, make_pod(f"g32-{i}", spec))
+            origins.append(tuple(int(x) for x in info.node.split("/")[-1].split("-")))
+        # the 8 hosts must tile one contiguous 4x4x2 sub-mesh (VC1's cell type)
+        xs = sorted({o[0] for o in origins})
+        ys = sorted({o[1] for o in origins})
+        zs = sorted({o[2] for o in origins})
+        assert xs == [0, 2] and ys == [0, 2]
+        assert zs in ([0, 1], [2, 3])
+        assert len(set(origins)) == 8
+
+    def test_pinned_cell_scheduling(self, algo):
+        spec = {"virtualCluster": "vc1", "priority": 2, "pinnedCellId": "pin1",
+                "chipNumber": 4,
+                "affinityGroup": {"name": "gp",
+                                  "members": [{"podNumber": 2, "chipNumber": 4}]}}
+        origins = []
+        for i in range(2):
+            _, info = schedule_and_allocate(algo, make_pod(f"gp-{i}", spec))
+            origins.append(tuple(int(x) for x in info.node.split("/")[-1].split("-")))
+        # pin1 is the 2x2x2 cube at origin (0,0,0): hosts (0,0,0) and (0,0,1)
+        assert sorted(origins) == [(0, 0, 0), (0, 0, 1)]
+
+    def test_generic_chain_scheduling(self, algo):
+        pod = make_pod("pv4", {"virtualCluster": "vc1", "priority": 0,
+                               "chipType": "v4-chip", "chipNumber": 8})
+        _, info = schedule_and_allocate(algo, pod)
+        assert info.cell_chain == "v4-node-pool"
+        assert sorted(info.leaf_cell_isolation) == list(range(8))
+
+    def test_any_leaf_cell_type(self, algo):
+        pod = make_pod("pany", {"virtualCluster": "vc2", "priority": 0, "chipNumber": 8})
+        _, info = schedule_and_allocate(algo, pod)
+        assert info.cell_chain == "v5e-8"  # only chain with 8-chip nodes in vc2
+
+    def test_opportunistic_pod(self, algo):
+        pod = make_pod("opp", {"virtualCluster": "vc1", "priority": -1,
+                               "chipType": "v5p-chip", "chipNumber": 4})
+        _, info = schedule_and_allocate(algo, pod)
+        assert info.cell_chain == "v5p-64"
+        g = algo.get_affinity_group("default/opp")  # default group name: ns/pod
+        assert g.status.state == GROUP_ALLOCATED
+        # OT usage shows up as a fake -opp virtual cell in the VC status
+        vc_status = algo.get_virtual_cluster_status("vc1")
+        assert any(s.cell_address.endswith("-opp") for s in vc_status)
+
+    def test_delete_pod_frees_cells(self, algo):
+        pod = make_pod("p1", {"virtualCluster": "vc2", "priority": 0,
+                              "chipType": "v5e-chip", "chipNumber": 8})
+        bp, _ = schedule_and_allocate(algo, pod)
+        algo.delete_allocated_pod(bp)
+        with pytest.raises(api.WebServerError):
+            algo.get_affinity_group("p1")
+        # all cells free again: scheduling works again
+        pod2 = make_pod("p2", {"virtualCluster": "vc2", "priority": 0,
+                               "chipType": "v5e-chip", "chipNumber": 8})
+        schedule_and_allocate(algo, pod2)
+
+    def test_vc_safety_capacity(self, algo):
+        # vc2 owns 2x 2x2x2 (16 chips) of v5p-64; requesting a third 2x2x2's
+        # worth beyond its share must wait, not steal vc1's cells
+        spec = {"virtualCluster": "vc2", "priority": 0, "chipType": "v5p-chip",
+                "chipNumber": 4}
+        for i in range(4):  # 16 chips = vc2's full share
+            schedule_and_allocate(algo, make_pod(f"s-{i}", {
+                **spec, "affinityGroup": {"name": f"s-{i}",
+                                          "members": [{"podNumber": 1, "chipNumber": 4}]}}))
+        r = algo.schedule(make_pod("overflow", spec), all_node_names(algo), FILTERING_PHASE)
+        assert r.pod_wait_info is not None
+
+
+class TestUserErrors:
+    def test_unknown_vc(self, algo):
+        pod = make_pod("bad", {"virtualCluster": "ghost", "priority": 0, "chipNumber": 1})
+        with pytest.raises(api.WebServerError) as e:
+            algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+        assert e.value.code == 400
+
+    def test_unknown_leaf_cell_type(self, algo):
+        pod = make_pod("bad", {"virtualCluster": "vc1", "priority": 0,
+                               "chipType": "h100", "chipNumber": 1})
+        with pytest.raises(api.WebServerError) as e:
+            algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+        assert e.value.code == 400
+
+    def test_type_not_in_vc(self, algo):
+        pod = make_pod("bad", {"virtualCluster": "vc1", "priority": 0,
+                               "chipType": "v5e-chip", "chipNumber": 1})
+        with pytest.raises(api.WebServerError):
+            algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+
+    def test_opportunistic_on_pinned_cell(self, algo):
+        pod = make_pod("bad", {"virtualCluster": "vc1", "priority": -1,
+                               "pinnedCellId": "pin1", "chipNumber": 1})
+        with pytest.raises(api.WebServerError):
+            algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+
+    def test_missing_annotation(self, algo):
+        pod = Pod(name="na", uid="na")
+        with pytest.raises(api.WebServerError):
+            algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+
+    def test_invalid_priority(self, algo):
+        pod = make_pod("bad", {"virtualCluster": "vc1", "priority": 1001, "chipNumber": 1})
+        with pytest.raises(api.WebServerError):
+            algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+
+    def test_too_many_pods_in_group(self, algo):
+        spec = {"virtualCluster": "vc2", "priority": 0, "chipType": "v5e-chip",
+                "chipNumber": 4,
+                "affinityGroup": {"name": "g1",
+                                  "members": [{"podNumber": 1, "chipNumber": 4}]}}
+        schedule_and_allocate(algo, make_pod("g1-0", spec))
+        with pytest.raises(api.WebServerError):
+            algo.schedule(make_pod("g1-1", spec), all_node_names(algo), FILTERING_PHASE)
+
+
+# ---------------------------------------------------------------------------
+# preemption
+# ---------------------------------------------------------------------------
+
+
+class TestStatefulPreemption:
+    def _fill_vc2_v5p(self, algo, priority=1):
+        """Fill vc2's entire v5p share (2x 2x2x2) with low-priority pods."""
+        pods = []
+        for i in range(4):
+            spec = {"virtualCluster": "vc2", "priority": priority,
+                    "chipType": "v5p-chip", "chipNumber": 4,
+                    "affinityGroup": {"name": f"low-{i}",
+                                      "members": [{"podNumber": 1, "chipNumber": 4}]}}
+            bp, info = schedule_and_allocate(algo, make_pod(f"low-{i}", spec))
+            pods.append(bp)
+        return pods
+
+    def test_intra_vc_preemption_lifecycle(self, algo):
+        victims = self._fill_vc2_v5p(algo, priority=1)
+        spec_hi = {"virtualCluster": "vc2", "priority": 100, "chipType": "v5p-chip",
+                   "chipNumber": 4,
+                   "affinityGroup": {"name": "hi",
+                                     "members": [{"podNumber": 4, "chipNumber": 4}]}}
+        hi_pod = make_pod("hi-0", spec_hi)
+        # Filtering phase: victims found but no preemption state created
+        r = algo.schedule(hi_pod, all_node_names(algo), FILTERING_PHASE)
+        assert r.pod_preempt_info is not None
+        assert "hi" not in {g.name for g in algo.get_all_affinity_groups()}
+        # Preempting phase: preemptor reserves cells
+        r = algo.schedule(hi_pod, all_node_names(algo), PREEMPTING_PHASE)
+        assert r.pod_preempt_info is not None
+        g = algo.get_affinity_group("hi")
+        assert g.status.state == GROUP_PREEMPTING
+        # some victim group must be BeingPreempted now
+        states = {x.name: x.status.state for x in algo.get_all_affinity_groups()}
+        assert GROUP_BEING_PREEMPTED in states.values()
+        # victims die -> cells Reserving -> Reserved
+        for v in victims:
+            algo.delete_allocated_pod(v)
+        # preemptor pods get scheduled now: no victims left
+        for i in range(4):
+            p = make_pod(f"hi-{i}", spec_hi, uid=f"hi-{i}")
+            r = algo.schedule(p, all_node_names(algo), FILTERING_PHASE)
+            assert r.pod_bind_info is not None
+            algo.add_allocated_pod(new_binding_pod(p, r.pod_bind_info))
+        g = algo.get_affinity_group("hi")
+        assert g.status.state == GROUP_ALLOCATED
+
+    def test_preemption_canceled_when_preemptor_deleted(self, algo):
+        self._fill_vc2_v5p(algo, priority=1)
+        spec_hi = {"virtualCluster": "vc2", "priority": 100, "chipType": "v5p-chip",
+                   "chipNumber": 4,
+                   "affinityGroup": {"name": "hi",
+                                     "members": [{"podNumber": 4, "chipNumber": 4}]}}
+        hi_pod = make_pod("hi-0", spec_hi)
+        algo.schedule(hi_pod, all_node_names(algo), PREEMPTING_PHASE)
+        assert algo.get_affinity_group("hi").status.state == GROUP_PREEMPTING
+        # preemptor pod deleted before victims die: preemption canceled,
+        # cells return to the victims
+        algo.delete_unallocated_pod(hi_pod)
+        assert "hi" not in {g.name for g in algo.get_all_affinity_groups()}
+        states = {x.name: x.status.state for x in algo.get_all_affinity_groups()}
+        # no group is still Preempting; victims keep their cells (the reference
+        # leaves them in BeingPreempted state after a canceled preemption)
+        assert GROUP_PREEMPTING not in states.values()
+        for ccl in algo.full_cell_list["v5p-64"].values():
+            for c in ccl:
+                assert c.state in (CELL_USED, CELL_FREE)
+
+    def test_opportunistic_preempted_by_guaranteed(self, algo):
+        # fill vc1's v5p share with an opportunistic gang (uses free cells)
+        spec_opp = {"virtualCluster": "vc1", "priority": -1, "chipType": "v5p-chip",
+                    "chipNumber": 4,
+                    "affinityGroup": {"name": "opp",
+                                      "members": [{"podNumber": 16, "chipNumber": 4}]}}
+        for i in range(16):  # fill the whole v5p-64 cube
+            schedule_and_allocate(algo, make_pod(f"opp-{i}", spec_opp))
+        # guaranteed gang in vc1 wants its 4x4x2: must preempt the OT pods
+        spec_g = {"virtualCluster": "vc1", "priority": 0, "chipType": "v5p-chip",
+                  "chipNumber": 4,
+                  "affinityGroup": {"name": "guar",
+                                    "members": [{"podNumber": 8, "chipNumber": 4}]}}
+        r = algo.schedule(make_pod("guar-0", spec_g), all_node_names(algo),
+                          PREEMPTING_PHASE)
+        assert r.pod_preempt_info is not None
+        assert len(r.pod_preempt_info.victim_pods) > 0
+
+
+class TestLazyPreemption:
+    def test_lazy_preemption(self, algo):
+        # g1 in vc2 with lazy preemption enabled takes one 2x2x2
+        spec1 = {"virtualCluster": "vc2", "priority": 1, "chipType": "v5p-chip",
+                 "chipNumber": 4, "lazyPreemptionEnable": True,
+                 "affinityGroup": {"name": "lazy1",
+                                   "members": [{"podNumber": 2, "chipNumber": 4}]}}
+        for i in range(2):
+            schedule_and_allocate(algo, make_pod(f"lazy1-{i}", spec1))
+        # fill rest of vc2's v5p share
+        spec2 = {"virtualCluster": "vc2", "priority": 1, "chipType": "v5p-chip",
+                 "chipNumber": 4, "lazyPreemptionEnable": True,
+                 "affinityGroup": {"name": "lazy2",
+                                   "members": [{"podNumber": 2, "chipNumber": 4}]}}
+        for i in range(2):
+            schedule_and_allocate(algo, make_pod(f"lazy2-{i}", spec2))
+        # higher-priority group in vc2: lazy-preempts instead of killing
+        spec_hi = {"virtualCluster": "vc2", "priority": 50, "chipType": "v5p-chip",
+                   "chipNumber": 4,
+                   "affinityGroup": {"name": "hi",
+                                     "members": [{"podNumber": 2, "chipNumber": 4}]}}
+        r = algo.schedule(make_pod("hi-0", spec_hi), all_node_names(algo),
+                          FILTERING_PHASE)
+        # lazy preemption: the high-priority group gets a placement WITHOUT
+        # binding victims (they are demoted to opportunistic instead)
+        assert r.pod_bind_info is not None
+        lazy_preempted = [g for g in algo.get_all_affinity_groups()
+                          if g.status.lazy_preemption_status is not None]
+        assert len(lazy_preempted) >= 1
+        assert lazy_preempted[0].status.lazy_preemption_status.preemptor == "hi"
+
+
+# ---------------------------------------------------------------------------
+# suggested nodes
+# ---------------------------------------------------------------------------
+
+
+class TestSuggestedNodes:
+    def test_ignore_suggested_default(self, algo):
+        # default ignoreK8sSuggestedNodes=True: schedules outside suggestions
+        pod = make_pod("p", {"virtualCluster": "vc2", "priority": 0,
+                             "chipType": "v5e-chip", "chipNumber": 1})
+        r = algo.schedule(pod, [], FILTERING_PHASE)
+        assert r.pod_bind_info is not None
+
+    def test_respect_suggested_nodes(self, algo):
+        pod = make_pod("p", {"virtualCluster": "vc2", "priority": 0,
+                             "chipType": "v5e-chip", "chipNumber": 1,
+                             "ignoreK8sSuggestedNodes": False})
+        r = algo.schedule(pod, [], FILTERING_PHASE)
+        assert r.pod_wait_info is not None
+        r = algo.schedule(pod, ["v5e-host0/0-0"], FILTERING_PHASE)
+        assert r.pod_bind_info is not None
+
+
+# ---------------------------------------------------------------------------
+# bad nodes / doomed bad cells
+# ---------------------------------------------------------------------------
+
+
+class TestBadNodes:
+    def test_bad_node_avoided(self, algo):
+        algo.delete_node(Node(name="v5e-host0/0-0"))
+        pod = make_pod("p", {"virtualCluster": "vc2", "priority": 0,
+                             "chipType": "v5e-chip", "chipNumber": 1})
+        r = algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+        assert r.pod_wait_info is not None
+        assert "bad node" in r.pod_wait_info.reason
+        # node comes back
+        algo.add_node(Node(name="v5e-host0/0-0"))
+        r = algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+        assert r.pod_bind_info is not None
+
+    def test_doomed_bad_cell_binding(self, algo):
+        # kill the v5e host: vc2's v5e-8 cell is doomed to be bad
+        algo.delete_node(Node(name="v5e-host0/0-0"))
+        vc2 = algo.get_virtual_cluster_status("vc2")
+        doomed = [s for s in vc2 if s.cell_type == "v5e-8" and s.cell_healthiness == api.CELL_BAD]
+        assert len(doomed) == 1
+        assert doomed[0].physical_cell is not None
+        # healthy again: doomed binding released
+        algo.add_node(Node(name="v5e-host0/0-0"))
+        vc2 = algo.get_virtual_cluster_status("vc2")
+        assert all(s.cell_healthiness == api.CELL_HEALTHY for s in vc2 if s.cell_type == "v5e-8")
+
+    def test_allocated_group_insists_on_bad_node(self, algo):
+        pod = make_pod("p", {"virtualCluster": "vc2", "priority": 0,
+                             "chipType": "v5e-chip", "chipNumber": 8})
+        bp, info = schedule_and_allocate(algo, pod)
+        algo.delete_node(Node(name=info.node))
+        # a new pod of the (full) allocated group is a user error; the group
+        # itself insists its placement despite the now-bad node
+        with pytest.raises(api.WebServerError):
+            algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+        assert algo.get_affinity_group("default/p").status.state == GROUP_ALLOCATED
+        # after the group is gone, the bad node blocks new scheduling
+        algo.delete_allocated_pod(bp)
+        r = algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+        assert r.pod_wait_info is not None  # node is bad now
+
+
+class TestSafeRelaxedBuddyAlloc:
+    def test_split_higher_level_on_bad_cells(self, algo):
+        # make both hosts of vc2's natural first 2x2x2 allocation target bad
+        # at z in {0,1} side; the allocator must split a higher-level cell
+        # while respecting vc1's guarantees
+        algo.delete_node(Node(name="v5p-pod0/0-0-0"))
+        spec = {"virtualCluster": "vc2", "priority": 1, "chipType": "v5p-chip",
+                "chipNumber": 4,
+                "affinityGroup": {"name": "g", "members": [{"podNumber": 2, "chipNumber": 4}]}}
+        origins = []
+        for i in range(2):
+            _, info = schedule_and_allocate(algo, make_pod(f"g-{i}", spec))
+            origins.append(info.node)
+        assert "v5p-pod0/0-0-0" not in origins
+
+
+# ---------------------------------------------------------------------------
+# recovery / reconfiguration
+# ---------------------------------------------------------------------------
+
+
+class TestRecovery:
+    def test_crash_recovery_replay(self, algo):
+        spec = {"virtualCluster": "vc1", "priority": 5, "chipType": "v5p-chip",
+                "chipNumber": 4,
+                "affinityGroup": {"name": "g32",
+                                  "members": [{"podNumber": 8, "chipNumber": 4}]}}
+        bound = []
+        for i in range(8):
+            bp, _ = schedule_and_allocate(algo, make_pod(f"g32-{i}", spec))
+            bound.append(bp)
+        placement_before = algo.get_affinity_group("g32").status.physical_placement
+
+        # "restart": a fresh algorithm instance, replay bound pods
+        h2 = HivedAlgorithm(load_config(FIXTURE))
+        set_healthy_nodes(h2)
+        for bp in bound:
+            h2.add_allocated_pod(bp)
+        g = h2.get_affinity_group("g32")
+        assert g.status.state == GROUP_ALLOCATED
+        assert g.status.physical_placement == placement_before
+        assert g.status.lazy_preemption_status is None
+        # the recovered group occupies real cells: vc1 cannot double-book
+        r = h2.schedule(make_pod("extra", {
+            "virtualCluster": "vc1", "priority": 5, "chipType": "v5p-chip",
+            "chipNumber": 4,
+            "affinityGroup": {"name": "extra",
+                              "members": [{"podNumber": 8, "chipNumber": 4}]}}),
+            all_node_names(h2), FILTERING_PHASE)
+        assert r.pod_wait_info is not None
+
+    def test_reconfiguration_shrunk_vc_lazy_preempts(self, algo, tmp_path):
+        spec = {"virtualCluster": "vc1", "priority": 5, "chipType": "v5p-chip",
+                "chipNumber": 4,
+                "affinityGroup": {"name": "g32",
+                                  "members": [{"podNumber": 8, "chipNumber": 4}]}}
+        bound = [schedule_and_allocate(algo, make_pod(f"g32-{i}", spec))[0]
+                 for i in range(8)]
+
+        # reconfigure: vc1 loses its v5p-4x4x2 (moved to vc2)
+        import yaml
+        with open(FIXTURE) as f:
+            cfg = yaml.safe_load(f)
+        cfg["virtualClusters"]["vc1"]["virtualCells"] = [
+            {"cellType": "v4-node-pool.v4-node", "cellNumber": 2}]
+        cfg["virtualClusters"]["vc2"]["virtualCells"].append(
+            {"cellType": "v5p-64.v5p-4x4x2", "cellNumber": 1})
+        new_path = tmp_path / "reconf.yaml"
+        new_path.write_text(yaml.safe_dump(cfg))
+
+        h2 = HivedAlgorithm(load_config(str(new_path)))
+        set_healthy_nodes(h2)
+        for bp in bound:
+            h2.add_allocated_pod(bp)
+        g = h2.get_affinity_group("g32")
+        # group still running (work-preserving) but lazy-preempted out of VC
+        assert g.status.state == GROUP_ALLOCATED
+        assert g.status.lazy_preemption_status is not None
+
+
+class TestInvalidInitialAssignment:
+    def test_vc_overcommit_panics(self, tmp_path):
+        import yaml
+        with open(FIXTURE) as f:
+            cfg = yaml.safe_load(f)
+        cfg["virtualClusters"]["vc2"]["virtualCells"] = [
+            {"cellType": "v5p-64.v5p-4x4x2", "cellNumber": 2}]  # + vc1's 1 + pin = overcommit
+        path = tmp_path / "bad.yaml"
+        path.write_text(yaml.safe_dump(cfg))
+        with pytest.raises(AssertionError, match="Illegal initial VC assignment"):
+            HivedAlgorithm(load_config(str(path)))
+
+    def test_vc_chain_missing_panics(self, tmp_path):
+        import yaml
+        with open(FIXTURE) as f:
+            cfg = yaml.safe_load(f)
+        cfg["physicalCluster"]["physicalCells"] = [
+            c for c in cfg["physicalCluster"]["physicalCells"]
+            if c.get("cellType") != "v5e-8"]
+        path = tmp_path / "bad2.yaml"
+        path.write_text(yaml.safe_dump(cfg))
+        with pytest.raises(AssertionError):
+            HivedAlgorithm(load_config(str(path)))
