@@ -13,11 +13,13 @@ from typing import Dict, List, Optional, Tuple
 
 from hivedscheduler_tpu.algorithm.cell import CellChain, CellLevel
 from hivedscheduler_tpu.algorithm.topology_aware import TopologyAwareScheduler
-from hivedscheduler_tpu.algorithm.types import CellList, ChainCellList, SchedulingRequest
+from hivedscheduler_tpu.algorithm.types import (
+    ChainCellList,
+    GroupVirtualPlacement,
+    SchedulingRequest,
+)
 
 log = logging.getLogger(__name__)
-
-GroupVirtualPlacement = Dict[int, List[CellList]]
 
 
 class IntraVCScheduler:

@@ -16,6 +16,7 @@ from hivedscheduler_tpu.algorithm.constants import (
     CELL_RESERVING,
     CELL_USED,
     GROUP_PREEMPTING,
+    OPPORTUNISTIC_PRIORITY,
 )
 from hivedscheduler_tpu.algorithm.types import (
     AlgoAffinityGroup,
@@ -348,7 +349,7 @@ def generate_ot_virtual_cell(pc: api.PhysicalCellStatus) -> api.VirtualCellStatu
         cell_address=pc.cell_address + "-opp",
         cell_state=CELL_USED,
         cell_healthiness=pc.cell_healthiness,
-        cell_priority=-1,
+        cell_priority=OPPORTUNISTIC_PRIORITY,
         physical_cell=pc,
     )
 

@@ -1,0 +1,85 @@
+"""tpu-hive scheduler entry point.
+
+Analogue of the reference's ``cmd/hivedscheduler/main.go``: init, load config,
+watch it (exit-on-change -> restart-based work-preserving reconfiguration),
+run the scheduler runtime + webserver until signaled.
+
+Run with a fake in-memory cluster (demo mode) via ``--fake-cluster``; a real
+deployment plugs a REST KubeClient implementation against
+``kubeApiServerAddress`` (insecure ApiServer or kubectl proxy).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from hivedscheduler_tpu.api import config as api_config
+from hivedscheduler_tpu.api import constants as C
+from hivedscheduler_tpu.common import utils as common
+from hivedscheduler_tpu.k8s.fake import FakeKubeClient
+from hivedscheduler_tpu.k8s.types import Node
+from hivedscheduler_tpu.runtime.scheduler import HivedScheduler
+from hivedscheduler_tpu.webserver import WebServer
+
+log = logging.getLogger(__name__)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tpu-hive")
+    parser.add_argument(
+        "--config",
+        default=os.environ.get(C.ENV_CONFIG_FILE, C.DEFAULT_CONFIG_FILE_PATH),
+        help="scheduler config YAML path",
+    )
+    parser.add_argument(
+        "--fake-cluster",
+        action="store_true",
+        help="serve against an in-memory cluster with all config nodes healthy "
+        "(demo / development mode)",
+    )
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    common.init_all(logging.DEBUG if args.verbose else logging.INFO)
+    config = api_config.load_config(args.config)
+    api_config.watch_config(args.config, config)
+
+    if args.fake_cluster:
+        kube_client = FakeKubeClient()
+    else:
+        log.error(
+            "No real Kubernetes client configured in this build; "
+            "run with --fake-cluster, or embed HivedScheduler with your own "
+            "KubeClient implementation (hivedscheduler_tpu.k8s.client.KubeClient)."
+        )
+        return 1
+
+    scheduler = HivedScheduler(config, kube_client)
+    if args.fake_cluster:
+        # demo: all nodes in the config exist and are healthy
+        algo = scheduler.scheduler_algorithm
+        nodes = sorted(
+            {
+                n
+                for ccl in algo.full_cell_list.values()
+                for c in ccl[max(ccl)]
+                for n in c.nodes
+            }
+        )
+        for n in nodes:
+            kube_client.create_node(Node(name=n))
+    scheduler.start()
+    server = WebServer(scheduler)
+    host, port = server.async_run()
+    log.info("tpu-hive ready on %s:%s", host, port)
+    stop = common.new_stop_event()
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

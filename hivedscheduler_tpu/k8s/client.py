@@ -1,0 +1,65 @@
+"""Kubernetes client interface.
+
+The reference talks to K8s through client-go informers + the Bind subresource
+(``scheduler.go:132-137``, ``internal/utils.go:291-314``). This build defines a
+minimal client interface with informer-style callbacks; ``k8s/fake.py``
+implements it in memory (tests/e2e), and a real REST implementation can be
+plugged in for cluster deployments.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from hivedscheduler_tpu.k8s.types import Binding, Node, Pod
+
+NodeHandler = Callable[[Node], None]
+NodeUpdateHandler = Callable[[Node, Node], None]
+PodHandler = Callable[[Pod], None]
+PodUpdateHandler = Callable[[Pod, Pod], None]
+
+
+class KubeClient:
+    """Informer + write interface the scheduler runtime consumes."""
+
+    # --- informer registration ------------------------------------------
+    def on_node_event(
+        self,
+        add: NodeHandler,
+        update: NodeUpdateHandler,
+        delete: NodeHandler,
+    ) -> None:
+        raise NotImplementedError
+
+    def on_pod_event(
+        self,
+        add: PodHandler,
+        update: PodUpdateHandler,
+        delete: PodHandler,
+    ) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        """Replay current state through the registered handlers and block
+        until done — the crash-recovery barrier (reference: WaitForCacheSync,
+        scheduler.go:202-209)."""
+        raise NotImplementedError
+
+    # --- reads ------------------------------------------------------------
+    def get_node(self, name: str) -> Optional[Node]:
+        raise NotImplementedError
+
+    def list_nodes(self) -> List[Node]:
+        raise NotImplementedError
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        raise NotImplementedError
+
+    def list_pods(self) -> List[Pod]:
+        raise NotImplementedError
+
+    # --- writes -----------------------------------------------------------
+    def bind_pod(self, binding: Binding) -> None:
+        """Commit a binding: set spec.nodeName and merge annotations
+        (reference: BindPod, internal/utils.go:291-314)."""
+        raise NotImplementedError

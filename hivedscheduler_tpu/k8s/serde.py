@@ -1,0 +1,78 @@
+"""K8s-wire JSON <-> object model conversion.
+
+Only the fields the scheduler-extender protocol touches are mapped, matching
+the subset of core/v1 the reference consumes through client-go.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from hivedscheduler_tpu.k8s.types import Container, Node, NodeCondition, Pod
+
+
+def pod_from_k8s(d: Dict[str, Any]) -> Pod:
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    containers = []
+    for c in (spec.get("containers") or []) + (spec.get("initContainers") or []):
+        limits = ((c.get("resources") or {}).get("limits")) or {}
+        containers.append(Container(name=c.get("name", ""), resource_limits=dict(limits)))
+    return Pod(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid", ""),
+        annotations=dict(meta.get("annotations") or {}),
+        containers=containers,
+        node_name=spec.get("nodeName", "") or "",
+        phase=status.get("phase", "Pending") or "Pending",
+        deletion_timestamp=meta.get("deletionTimestamp"),
+    )
+
+
+def pod_to_k8s(p: Pod) -> Dict[str, Any]:
+    return {
+        "metadata": {
+            "name": p.name,
+            "namespace": p.namespace,
+            "uid": p.uid,
+            "annotations": dict(p.annotations),
+            **({"deletionTimestamp": p.deletion_timestamp} if p.deletion_timestamp else {}),
+        },
+        "spec": {
+            "nodeName": p.node_name or None,
+            "containers": [
+                {"name": c.name, "resources": {"limits": dict(c.resource_limits)}}
+                for c in p.containers
+            ],
+        },
+        "status": {"phase": p.phase},
+    }
+
+
+def node_from_k8s(d: Dict[str, Any]) -> Node:
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    # no conditions reported => NOT ready (the reference requires an explicit
+    # Ready=True condition, internal/utils.go:160-170)
+    conditions = [
+        NodeCondition(type=c.get("type", ""), status=c.get("status", ""))
+        for c in status.get("conditions") or []
+    ]
+    return Node(
+        name=meta.get("name", ""),
+        unschedulable=bool(spec.get("unschedulable", False)),
+        conditions=conditions,
+    )
+
+
+def node_to_k8s(n: Node) -> Dict[str, Any]:
+    return {
+        "metadata": {"name": n.name},
+        "spec": {"unschedulable": n.unschedulable},
+        "status": {
+            "conditions": [{"type": c.type, "status": c.status} for c in n.conditions]
+        },
+    }

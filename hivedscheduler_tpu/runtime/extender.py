@@ -1,0 +1,125 @@
+"""K8s scheduler-extender wire types (v1 extender protocol JSON).
+
+Field names match k8s.io/kubernetes scheduler api ExtenderArgs /
+ExtenderFilterResult / ExtenderBindingArgs / ExtenderPreemptionArgs so a stock
+kube-scheduler extender policy (reference: example/run/deploy.yaml:25-47)
+works against this server unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from hivedscheduler_tpu.k8s import serde
+from hivedscheduler_tpu.k8s.types import Pod
+
+
+@dataclass
+class ExtenderArgs:
+    pod: Pod
+    node_names: List[str] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ExtenderArgs":
+        if not d.get("Pod"):
+            raise ValueError("ExtenderArgs.Pod is missing")
+        return ExtenderArgs(
+            pod=serde.pod_from_k8s(d["Pod"]),
+            node_names=list(d.get("NodeNames") or []),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"Pod": serde.pod_to_k8s(self.pod), "NodeNames": self.node_names}
+
+
+@dataclass
+class ExtenderFilterResult:
+    node_names: Optional[List[str]] = None
+    failed_nodes: Dict[str, str] = field(default_factory=dict)
+    error: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.node_names is not None:
+            out["NodeNames"] = self.node_names
+        if self.failed_nodes:
+            out["FailedNodes"] = self.failed_nodes
+        if self.error:
+            out["Error"] = self.error
+        return out
+
+
+@dataclass
+class ExtenderBindingArgs:
+    pod_name: str
+    pod_namespace: str
+    pod_uid: str
+    node: str
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ExtenderBindingArgs":
+        for f in ("PodName", "PodNamespace", "PodUID", "Node"):
+            if not d.get(f):
+                raise ValueError(f"ExtenderBindingArgs.{f} is missing")
+        return ExtenderBindingArgs(
+            pod_name=d["PodName"],
+            pod_namespace=d["PodNamespace"],
+            pod_uid=d["PodUID"],
+            node=d["Node"],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "PodName": self.pod_name,
+            "PodNamespace": self.pod_namespace,
+            "PodUID": self.pod_uid,
+            "Node": self.node,
+        }
+
+
+@dataclass
+class ExtenderBindingResult:
+    error: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"Error": self.error} if self.error else {}
+
+
+@dataclass
+class ExtenderPreemptionArgs:
+    pod: Pod
+    node_name_to_meta_victims: Dict[str, List[str]] = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ExtenderPreemptionArgs":
+        if not d.get("Pod"):
+            raise ValueError("ExtenderPreemptionArgs.Pod is missing")
+        victims: Dict[str, List[str]] = {}
+        for node, mv in (d.get("NodeNameToMetaVictims") or {}).items():
+            victims[node] = [p.get("UID", "") for p in (mv or {}).get("Pods") or []]
+        # non-nodeCacheCapable fallback: Pods are full v1.Pod objects
+        for node, mv in (d.get("NodeNameToVictims") or {}).items():
+            victims.setdefault(node, []).extend(
+                ((p.get("metadata") or {}).get("uid", ""))
+                for p in (mv or {}).get("Pods") or []
+            )
+        return ExtenderPreemptionArgs(
+            pod=serde.pod_from_k8s(d["Pod"]),
+            node_name_to_meta_victims=victims,
+        )
+
+
+@dataclass
+class ExtenderPreemptionResult:
+    node_name_to_meta_victims: Dict[str, List[str]] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        if not self.node_name_to_meta_victims:
+            return {}
+        return {
+            "NodeNameToMetaVictims": {
+                node: {"Pods": [{"UID": uid} for uid in uids]}
+                for node, uids in self.node_name_to_meta_victims.items()
+            }
+        }

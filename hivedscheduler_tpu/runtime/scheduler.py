@@ -1,0 +1,388 @@
+"""HivedScheduler runtime: the bridge between K8s and the algorithm.
+
+TPU-native analogue of the reference's ``pkg/scheduler/scheduler.go``: informer
+event handlers, the pod state machine ground truth (``pod_schedule_statuses``),
+filter/bind/preempt routines behind one global scheduler lock, force-bind
+escalation, and the recovery barrier (all bound pods replayed via
+``add_allocated_pod`` before the webserver starts).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from hivedscheduler_tpu.api import config as api_config
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.algorithm.hived import HivedAlgorithm
+from hivedscheduler_tpu.k8s.client import KubeClient
+from hivedscheduler_tpu.k8s.types import Binding, Node, Pod
+from hivedscheduler_tpu.runtime import extender as ei
+from hivedscheduler_tpu.runtime import types as internal
+from hivedscheduler_tpu.runtime import utils as internal_utils
+from hivedscheduler_tpu.runtime.types import (
+    PodScheduleStatus,
+    SchedulerAlgorithm,
+)
+
+log = logging.getLogger(__name__)
+
+
+class HivedScheduler:
+    """Reference: HivedScheduler, scheduler.go:53-120."""
+
+    def __init__(
+        self,
+        config: api_config.Config,
+        kube_client: KubeClient,
+        algorithm: Optional[SchedulerAlgorithm] = None,
+    ):
+        self.config = config
+        self.kube_client = kube_client
+        # One coarse lock serializes scheduling (reference: schedulerLock,
+        # scheduler.go:104-108); bind reads take it shared.
+        self.scheduler_lock = threading.RLock()
+        # uid -> PodScheduleStatus: ground truth of in-flight pods
+        self.pod_schedule_statuses: Dict[str, PodScheduleStatus] = {}
+        self.scheduler_algorithm: SchedulerAlgorithm = algorithm or HivedAlgorithm(config)
+        self._started = False
+
+        kube_client.on_node_event(self._add_node, self._update_node, self._delete_node)
+        kube_client.on_pod_event(self._add_pod, self._update_pod, self._delete_pod)
+
+    def start(self) -> None:
+        """Sync current cluster state through the handlers — the crash-recovery
+        barrier: every bound pod is replayed into add_allocated_pod before any
+        scheduling request is served (reference: Run, scheduler.go:196-216)."""
+        log.info("Recovering tpu-hive scheduler")
+        self.kube_client.sync()
+        self._started = True
+        log.info("Running tpu-hive scheduler")
+
+    # ------------------------------------------------------------------
+    # informer callbacks
+    # ------------------------------------------------------------------
+
+    def _add_node(self, node: Node) -> None:
+        self.scheduler_algorithm.add_node(node)
+
+    def _update_node(self, old_node: Node, new_node: Node) -> None:
+        self.scheduler_algorithm.update_node(old_node, new_node)
+
+    def _delete_node(self, node: Node) -> None:
+        self.scheduler_algorithm.delete_node(node)
+
+    def _add_pod(self, pod: Pod) -> None:
+        """Reference: addPod, scheduler.go:253-260."""
+        if not internal_utils.is_interested(pod):
+            return
+        if internal_utils.is_bound(pod):
+            self._add_bound_pod(pod)
+        else:
+            self._add_unbound_pod(pod)
+
+    def _update_pod(self, old_pod: Pod, new_pod: Pod) -> None:
+        """Reference: updatePod, scheduler.go:262-284."""
+        if old_pod.uid != new_pod.uid:
+            self._delete_pod(old_pod)
+            self._add_pod(new_pod)
+            return
+        if not internal_utils.is_interested(new_pod):
+            if internal_utils.is_interested(old_pod):
+                self._delete_pod(old_pod)
+            return
+        old_bound = internal_utils.is_bound(old_pod)
+        new_bound = internal_utils.is_bound(new_pod)
+        if not old_bound and new_bound:
+            self._add_bound_pod(new_pod)
+        elif old_bound and not new_bound:
+            raise AssertionError(
+                f"[{internal_utils.key(new_pod)}]: Pod updated from bound to unbound: "
+                f"previous bound node: {old_pod.node_name}"
+            )
+
+    def _delete_pod(self, pod: Pod) -> None:
+        """Reference: deletePod, scheduler.go:285-304."""
+        if not internal_utils.is_hived_enabled(pod):
+            return
+        with self.scheduler_lock:
+            pod_status = self.pod_schedule_statuses.get(pod.uid)
+            if pod_status is not None:
+                if internal.is_allocated(pod_status.pod_state):
+                    self.scheduler_algorithm.delete_allocated_pod(pod_status.pod)
+                else:
+                    self.scheduler_algorithm.delete_unallocated_pod(pod_status.pod)
+                del self.pod_schedule_statuses[pod.uid]
+
+    def _add_bound_pod(self, pod: Pod) -> None:
+        """Reference: addBoundPod, scheduler.go:306-337."""
+        with self.scheduler_lock:
+            pod_status = self.pod_schedule_statuses.get(pod.uid)
+            if pod_status is not None and internal.is_allocated(pod_status.pod_state):
+                # already allocated: the placement never changes again
+                if pod_status.pod_state != internal.POD_BOUND:
+                    self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                        pod=pod_status.pod, pod_state=internal.POD_BOUND
+                    )
+                return
+            # recover the bound pod
+            self.scheduler_algorithm.add_allocated_pod(pod)
+            self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                pod=pod, pod_state=internal.POD_BOUND
+            )
+
+    def _add_unbound_pod(self, pod: Pod) -> None:
+        """Reference: addUnboundPod, scheduler.go:339-359."""
+        with self.scheduler_lock:
+            if pod.uid in self.pod_schedule_statuses:
+                return
+            self.scheduler_algorithm.add_unallocated_pod(pod)
+            self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                pod=pod, pod_state=internal.POD_WAITING
+            )
+
+    # ------------------------------------------------------------------
+    # admission / force bind
+    # ------------------------------------------------------------------
+
+    def _general_schedule_admission_check(
+        self, pod_status: Optional[PodScheduleStatus]
+    ) -> PodScheduleStatus:
+        """Reference: generalScheduleAdmissionCheck, scheduler.go:364-383."""
+        if pod_status is None:
+            raise api.as_bad_request(
+                "Pod does not exist, completed or has not been informed to the scheduler"
+            )
+        if pod_status.pod_state == internal.POD_BOUND:
+            raise api.as_bad_request(
+                f"Pod has already been bound to node {pod_status.pod.node_name}"
+            )
+        return pod_status
+
+    def _validate_pod_bind_info(
+        self, pod_bind_info: api.PodBindInfo, suggested_nodes: List[str]
+    ) -> Optional[str]:
+        """Reference: validatePodBindInfo, scheduler.go:385-421."""
+        node = pod_bind_info.node
+        if self.kube_client.get_node(node) is None:
+            return (
+                f"The SchedulerAlgorithm decided to bind on node {node}, but the node "
+                f"does not exist or has not been informed to the scheduler"
+            )
+        if node not in suggested_nodes:
+            return (
+                f"The SchedulerAlgorithm decided to bind on node {node} but the node "
+                f"is not within the selected nodes from the default scheduler"
+            )
+        return None
+
+    def _should_force_bind(
+        self, pod_status: PodScheduleStatus, suggested_nodes: List[str]
+    ) -> bool:
+        """Keep binding regardless of potentially stale decisions; failed pods
+        are retried/GC'd by K8s (reference: shouldForceBind,
+        scheduler.go:423-466)."""
+        pod = pod_status.pod
+        if pod_status.pod_bind_attempts >= self.config.force_pod_bind_threshold:
+            log.warning(
+                "[%s]: Will force bind Pod: binding tried %s times, reaching the "
+                "ForcePodBindThreshold %s",
+                internal_utils.key(pod), pod_status.pod_bind_attempts,
+                self.config.force_pod_bind_threshold,
+            )
+            return True
+        err = self._validate_pod_bind_info(
+            pod_status.pod_schedule_result.pod_bind_info, suggested_nodes
+        )
+        if err is not None:
+            log.warning("[%s]: Will force bind Pod: %s", internal_utils.key(pod), err)
+            return True
+        return False
+
+    def _force_bind_executor(self, binding_pod: Pod) -> None:
+        """Bypass the default scheduler and trigger bindRoutine directly
+        (reference: forceBindExecutor, scheduler.go:471-483)."""
+        log.info("[%s]: forceBindExecutor: Started", internal_utils.key(binding_pod))
+        try:
+            self.bind_routine(
+                ei.ExtenderBindingArgs(
+                    pod_name=binding_pod.name,
+                    pod_namespace=binding_pod.namespace,
+                    pod_uid=binding_pod.uid,
+                    node=binding_pod.node_name,
+                )
+            )
+        except Exception as e:  # async shadow of bindRoutine; log-and-drop
+            log.warning("[%s]: forceBindExecutor failed: %s",
+                        internal_utils.key(binding_pod), e)
+
+    # ------------------------------------------------------------------
+    # extender routines
+    # ------------------------------------------------------------------
+
+    def filter_routine(self, args: ei.ExtenderArgs) -> ei.ExtenderFilterResult:
+        """Reference: filterRoutine, scheduler.go:485-587."""
+        with self.scheduler_lock:
+            pod = args.pod
+            suggested_nodes = args.node_names
+            log.info("[%s]: filterRoutine: Started", internal_utils.key(pod))
+
+            pod_status = self._general_schedule_admission_check(
+                self.pod_schedule_statuses.get(pod.uid)
+            )
+            if pod_status.pod_state == internal.POD_BINDING:
+                # insist the previous bind: binding must be idempotent and the
+                # algorithm has already assumed the pod allocated
+                binding_pod = pod_status.pod
+                pod_status.pod_bind_attempts += 1
+                if self._should_force_bind(pod_status, suggested_nodes):
+                    threading.Thread(
+                        target=self._force_bind_executor, args=(binding_pod,), daemon=True
+                    ).start()
+                return ei.ExtenderFilterResult(node_names=[binding_pod.node_name])
+
+            # pod state is Waiting or Preempting: run a new scheduling
+            result = self.scheduler_algorithm.schedule(
+                pod, suggested_nodes, internal.FILTERING_PHASE
+            )
+            if result.pod_bind_info is not None:
+                binding_pod = internal_utils.new_binding_pod(pod, result.pod_bind_info)
+                # assume allocated so the next scheduling needn't wait for the bind
+                self.scheduler_algorithm.add_allocated_pod(binding_pod)
+                self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                    pod=binding_pod,
+                    pod_state=internal.POD_BINDING,
+                    pod_schedule_result=result,
+                )
+                if self._should_force_bind(
+                    self.pod_schedule_statuses[pod.uid], suggested_nodes
+                ):
+                    threading.Thread(
+                        target=self._force_bind_executor, args=(binding_pod,), daemon=True
+                    ).start()
+                log.info("[%s]: Pod is binding to %s",
+                         internal_utils.key(pod), binding_pod.node_name)
+                return ei.ExtenderFilterResult(node_names=[binding_pod.node_name])
+            if result.pod_preempt_info is not None:
+                # FailedNodes tell the default scheduler preemption may help
+                failed_nodes: Dict[str, str] = {}
+                for victim in result.pod_preempt_info.victim_pods:
+                    node = victim.node_name
+                    if node not in failed_nodes:
+                        failed_nodes[node] = (
+                            f"node({node}) has preemptible Pods: {internal_utils.key(victim)}"
+                        )
+                    else:
+                        failed_nodes[node] += ", " + internal_utils.key(victim)
+                log.info("[%s]: Pod is waiting for preemptRoutine", internal_utils.key(pod))
+                return ei.ExtenderFilterResult(failed_nodes=failed_nodes)
+
+            self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                pod=pod, pod_state=internal.POD_WAITING, pod_schedule_result=result
+            )
+            # block to achieve stronger FIFO (reference: scheduler.go:566-570)
+            if self.config.waiting_pod_scheduling_block_milli_sec > 0:
+                time.sleep(self.config.waiting_pod_scheduling_block_milli_sec / 1000.0)
+            wait_reason = "Pod is waiting for preemptible or free resource to appear"
+            if result.pod_wait_info is not None:
+                wait_reason += ": " + result.pod_wait_info.reason
+            log.info("[%s]: %s", internal_utils.key(pod), wait_reason)
+            from hivedscheduler_tpu.api.constants import COMPONENT_NAME
+
+            return ei.ExtenderFilterResult(failed_nodes={COMPONENT_NAME: wait_reason})
+
+    def bind_routine(self, args: ei.ExtenderBindingArgs) -> ei.ExtenderBindingResult:
+        """Idempotent bind executor (reference: bindRoutine, scheduler.go:594-627)."""
+        with self.scheduler_lock:
+            pod_key = f"{args.pod_namespace}/{args.pod_name}"
+            log.info("[%s(%s)]: bindRoutine: Started", args.pod_uid, pod_key)
+            pod_status = self._general_schedule_admission_check(
+                self.pod_schedule_statuses.get(args.pod_uid)
+            )
+            if pod_status.pod_state == internal.POD_BINDING:
+                binding_pod = pod_status.pod
+                if binding_pod.node_name != args.node:
+                    raise api.as_bad_request(
+                        f"Pod binding node mismatch: expected {binding_pod.node_name}, "
+                        f"received {args.node}"
+                    )
+                self.kube_client.bind_pod(
+                    Binding(
+                        pod_name=binding_pod.name,
+                        pod_namespace=binding_pod.namespace,
+                        pod_uid=binding_pod.uid,
+                        node=binding_pod.node_name,
+                        annotations=internal_utils.extract_pod_bind_annotations(binding_pod),
+                    )
+                )
+                return ei.ExtenderBindingResult()
+            raise api.as_bad_request(
+                f"Pod cannot be bound without a scheduling placement: Pod current "
+                f"scheduling state {pod_status.pod_state}, received node {args.node}"
+            )
+
+    def preempt_routine(self, args: ei.ExtenderPreemptionArgs) -> ei.ExtenderPreemptionResult:
+        """Reference: preemptRoutine, scheduler.go:629-721."""
+        with self.scheduler_lock:
+            pod = args.pod
+            suggested_nodes = list(args.node_name_to_meta_victims)
+            log.info("[%s]: preemptRoutine: Started", internal_utils.key(pod))
+            pod_status = self._general_schedule_admission_check(
+                self.pod_schedule_statuses.get(pod.uid)
+            )
+            if pod_status.pod_state == internal.POD_BINDING:
+                raise api.as_bad_request(
+                    f"Pod has already been binding to node {pod_status.pod.node_name}"
+                )
+            # re-schedule with the victims' nodes as suggested nodes; do not
+            # insist a previous (possibly stale) preemption result
+            result = self.scheduler_algorithm.schedule(
+                pod, suggested_nodes, internal.PREEMPTING_PHASE
+            )
+            if result.pod_bind_info is not None:
+                log.info("[%s]: Pod is waiting for filterRoutine as free resource appeared",
+                         internal_utils.key(pod))
+                return ei.ExtenderPreemptionResult()
+            if result.pod_preempt_info is not None:
+                self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                    pod=pod,
+                    pod_state=internal.POD_PREEMPTING,
+                    pod_schedule_result=result,
+                )
+                nodes_victims: Dict[str, List[str]] = {}
+                for victim in result.pod_preempt_info.victim_pods:
+                    nodes_victims.setdefault(victim.node_name, []).append(victim.uid)
+                log.info("[%s]: Pod is preempting: %s", internal_utils.key(pod), nodes_victims)
+                return ei.ExtenderPreemptionResult(node_name_to_meta_victims=nodes_victims)
+            self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                pod=pod, pod_state=internal.POD_WAITING, pod_schedule_result=result
+            )
+            wait_reason = "Pod is waiting for preemptible or free resource to appear"
+            if result.pod_wait_info is not None:
+                wait_reason += ": " + result.pod_wait_info.reason
+            log.info("[%s]: %s", internal_utils.key(pod), wait_reason)
+            return ei.ExtenderPreemptionResult()
+
+    # ------------------------------------------------------------------
+    # inspect delegates (reference: scheduler.go:723-745)
+    # ------------------------------------------------------------------
+
+    def get_all_affinity_groups(self):
+        return self.scheduler_algorithm.get_all_affinity_groups()
+
+    def get_affinity_group(self, name: str):
+        return self.scheduler_algorithm.get_affinity_group(name)
+
+    def get_cluster_status(self):
+        return self.scheduler_algorithm.get_cluster_status()
+
+    def get_physical_cluster_status(self):
+        return self.scheduler_algorithm.get_physical_cluster_status()
+
+    def get_all_virtual_clusters_status(self):
+        return self.scheduler_algorithm.get_all_virtual_clusters_status()
+
+    def get_virtual_cluster_status(self, vcn: str):
+        return self.scheduler_algorithm.get_virtual_cluster_status(vcn)

@@ -1,0 +1,1 @@
+from hivedscheduler_tpu.webserver.server import WebServer  # noqa: F401

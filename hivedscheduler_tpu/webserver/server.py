@@ -1,0 +1,150 @@
+"""HTTP server exposing the scheduler-extender and inspect APIs.
+
+TPU-native analogue of the reference's ``pkg/webserver/webserver.go``: routes
+``/v1/extender/{filter,bind,preempt}`` (POST) and ``/v1/inspect/...`` (GET)
+with per-request panic->HTTP-error recovery (``webserver.go:142-155``).
+Implemented on the stdlib ThreadingHTTPServer — requests are serialized by the
+scheduler lock anyway (the algorithm is single-threaded by design).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional, Tuple
+
+from hivedscheduler_tpu.api import constants as C
+from hivedscheduler_tpu.api.types import WebServerError
+from hivedscheduler_tpu.runtime import extender as ei
+from hivedscheduler_tpu.runtime.scheduler import HivedScheduler
+
+log = logging.getLogger(__name__)
+
+
+class WebServer:
+    """Reference: webserver.go:62-137."""
+
+    def __init__(self, scheduler: HivedScheduler, address: str = ""):
+        self.scheduler = scheduler
+        address = address or scheduler.config.web_server_address
+        host, _, port = address.rpartition(":")
+        self.host = host or "0.0.0.0"
+        self.port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def async_run(self) -> Tuple[str, int]:
+        """Start serving in a background thread; returns (host, port) with the
+        actually-bound port (reference: AsyncRun, webserver.go:93-137)."""
+        handler = _make_handler(self.scheduler)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="webserver", daemon=True
+        )
+        self._thread.start()
+        host, port = self._httpd.server_address[:2]
+        log.info("WebServer serving on %s:%s", host, port)
+        return str(host), int(port)
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+def _make_handler(scheduler: HivedScheduler):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt: str, *args: Any) -> None:  # route to logging
+            log.debug("%s - %s", self.address_string(), fmt % args)
+
+        def _reply(self, code: int, obj: Any) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_error(self, e: Exception) -> None:
+            """Panic -> HTTP error (reference: webserver.go:142-155):
+            WebServerError keeps its code; anything else is a 500."""
+            if isinstance(e, WebServerError):
+                self._reply(e.code, e.to_dict())
+            else:
+                log.exception("Internal error serving %s", self.path)
+                self._reply(500, {"code": 500, "message": str(e)})
+
+        def _read_json(self) -> Any:
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            if not raw:
+                raise WebServerError(400, "Request body is empty")
+            try:
+                return json.loads(raw)
+            except json.JSONDecodeError as je:
+                raise WebServerError(400, f"Request body is not valid JSON: {je}")
+
+        # ---------------- POST: extender ----------------
+        def do_POST(self) -> None:
+            try:
+                path = self.path.rstrip("/")
+                if path == C.FILTER_PATH:
+                    args = ei.ExtenderArgs.from_dict(self._read_json())
+                    self._reply(200, scheduler.filter_routine(args).to_dict())
+                elif path == C.BIND_PATH:
+                    args = ei.ExtenderBindingArgs.from_dict(self._read_json())
+                    self._reply(200, scheduler.bind_routine(args).to_dict())
+                elif path == C.PREEMPT_PATH:
+                    args = ei.ExtenderPreemptionArgs.from_dict(self._read_json())
+                    self._reply(200, scheduler.preempt_routine(args).to_dict())
+                else:
+                    self._reply(404, {"code": 404, "message": f"Unknown path {self.path}"})
+            except ValueError as ve:
+                self._reply_error(WebServerError(400, str(ve)))
+            except Exception as e:
+                self._reply_error(e)
+
+        # ---------------- GET: inspect ----------------
+        def do_GET(self) -> None:
+            try:
+                path = self.path.rstrip("/")
+                if path == C.VERSION_PREFIX or path == "":
+                    self._reply(200, {"paths": [
+                        C.FILTER_PATH, C.BIND_PATH, C.PREEMPT_PATH,
+                        C.AFFINITY_GROUPS_PATH, C.CLUSTER_STATUS_PATH,
+                        C.PHYSICAL_CLUSTER_PATH, C.VIRTUAL_CLUSTERS_PATH,
+                    ]})
+                elif path == C.AFFINITY_GROUPS_PATH.rstrip("/"):
+                    groups = scheduler.get_all_affinity_groups()
+                    self._reply(200, {"items": [g.to_dict() for g in groups]})
+                elif self.path.startswith(C.AFFINITY_GROUPS_PATH):
+                    name = self.path[len(C.AFFINITY_GROUPS_PATH):].rstrip("/")
+                    self._reply(200, scheduler.get_affinity_group(name).to_dict())
+                elif path == C.CLUSTER_STATUS_PATH:
+                    self._reply(200, scheduler.get_cluster_status().to_dict())
+                elif path == C.PHYSICAL_CLUSTER_PATH:
+                    self._reply(
+                        200, [s.to_dict() for s in scheduler.get_physical_cluster_status()]
+                    )
+                elif path == C.VIRTUAL_CLUSTERS_PATH.rstrip("/"):
+                    vcs = scheduler.get_all_virtual_clusters_status()
+                    self._reply(
+                        200,
+                        {vc: [s.to_dict() for s in lst] for vc, lst in vcs.items()},
+                    )
+                elif self.path.startswith(C.VIRTUAL_CLUSTERS_PATH):
+                    vcn = self.path[len(C.VIRTUAL_CLUSTERS_PATH):].rstrip("/")
+                    self._reply(
+                        200,
+                        [s.to_dict() for s in scheduler.get_virtual_cluster_status(vcn)],
+                    )
+                else:
+                    self._reply(404, {"code": 404, "message": f"Unknown path {self.path}"})
+            except Exception as e:
+                self._reply_error(e)
+
+    return Handler
