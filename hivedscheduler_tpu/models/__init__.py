@@ -1,0 +1,5 @@
+from hivedscheduler_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    forward,
+    init_params,
+)

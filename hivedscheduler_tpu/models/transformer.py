@@ -1,0 +1,175 @@
+"""Flagship workload: a decoder-only transformer LM, TPU-first.
+
+Pure-JAX pytree params (no framework dependency), bf16 matmuls on the MXU,
+RoPE, RMSNorm, SwiGLU. Layers are stacked and scanned with ``lax.scan`` so
+compile time is O(1) in depth and XLA fuses per-layer elementwise work into
+the matmuls. Attention implementation is selectable: plain XLA einsum, the
+Pallas flash kernel (``ops/attention.py``), or ring/Ulysses sequence
+parallelism over a mesh axis (``parallel/ring_attention.py``).
+
+Sharding is annotation-driven (``models.sharding_specs``): tp shards heads
+and the MLP hidden dim, fsdp shards the other param axis, dp/sp shard batch
+and sequence of activations — XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 1408
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    # "xla" | "flash" | "ring" | "ulysses"
+    attn_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
+    """Stacked-layer params: arrays carry a leading [n_layers] axis so the
+    forward pass can lax.scan over them."""
+    k_emb, k_attn, k_mlp, k_out = jax.random.split(key, 4)
+    d, h, hd, f, L = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers
+
+    def norm_init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)).astype(
+            jnp.float32
+        )
+
+    ks = jax.random.split(k_attn, 4)
+    km = jax.random.split(k_mlp, 3)
+    return {
+        "embed": norm_init(k_emb, (cfg.vocab_size, d), d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), jnp.float32),
+            "wq": norm_init(ks[0], (L, d, h, hd), d),
+            "wk": norm_init(ks[1], (L, d, h, hd), d),
+            "wv": norm_init(ks[2], (L, d, h, hd), d),
+            "wo": norm_init(ks[3], (L, h, hd, d), d),
+            "mlp_norm": jnp.ones((L, d), jnp.float32),
+            "w_gate": norm_init(km[0], (L, d, f), d),
+            "w_up": norm_init(km[1], (L, d, f), d),
+            "w_down": norm_init(km[2], (L, f, d), f),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": norm_init(k_out, (d, cfg.vocab_size), d),
+    }
+
+
+def sharding_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpecs per param: tp shards heads / ff; fsdp shards the
+    complementary axis. Mirror of init_params' tree."""
+    return {
+        "embed": P(None, "fsdp"),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tp", None),
+            "wk": P(None, "fsdp", "tp", None),
+            "wv": P(None, "fsdp", "tp", None),
+            "wo": P(None, "tp", None, "fsdp"),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "tp"),
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+def activation_spec() -> P:
+    """[batch, seq, ...]: batch over dp(+fsdp), sequence over sp."""
+    return P(("dp", "fsdp"), "sp")
+
+
+def _rms_norm(x: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    norm = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (norm * w).astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [B, T, H, D]; rotate pairs (even, odd) by position-dependent angles."""
+    d = x.shape[-1]
+    freqs = jnp.exp(
+        -math.log(theta) * (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    )  # [D/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B?, T, D/2]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads: [.., T, 1, D/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh=None,
+) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab] (f32).
+
+    ``mesh`` is required for the ring/ulysses attention implementations (the
+    sequence axis lives on the mesh); the sharded T seen here is global.
+    """
+    dtype = cfg.dtype
+    b, t = tokens.shape
+    x = params["embed"].astype(dtype)[tokens]  # [B, T, D]
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+
+    if cfg.attn_impl == "flash":
+        from hivedscheduler_tpu.ops.attention import flash_attention as attn_fn
+    elif cfg.attn_impl in ("ring", "ulysses"):
+        from hivedscheduler_tpu.parallel import ring_attention as ra
+
+        assert mesh is not None, "ring/ulysses attention requires a mesh"
+        attn_fn = (
+            ra.ring_attention if cfg.attn_impl == "ring" else ra.ulysses_attention
+        )
+    else:
+        from hivedscheduler_tpu.ops.attention import xla_attention as attn_fn
+
+    def layer(x, lp):
+        h = _rms_norm(x, lp["attn_norm"])
+        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        if cfg.attn_impl in ("ring", "ulysses"):
+            attn = attn_fn(q, k, v, mesh, causal=True)
+        else:
+            attn = attn_fn(q, k, v, causal=True)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
+        h = _rms_norm(x, lp["mlp_norm"])
+        gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))
+        up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
+        x = x + jnp.einsum(
+            "btf,fd->btd", jax.nn.silu(gate) * up, lp["w_down"].astype(dtype)
+        )
+        return x, None
+
+    x, _ = lax.scan(layer, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
+    return logits.astype(jnp.float32)

@@ -1,0 +1,3 @@
+"""TPU kernels (Pallas) with XLA fallbacks."""
+
+from hivedscheduler_tpu.ops.attention import flash_attention, xla_attention  # noqa: F401

@@ -1,0 +1,124 @@
+"""Fused causal attention.
+
+``flash_attention`` is a Pallas TPU kernel (online-softmax over key/value
+blocks, never materializing the [T, T] score matrix in HBM); on non-TPU
+backends it runs the same kernel through the Pallas interpreter, and
+``xla_attention`` is the plain einsum reference used for correctness checks
+and as a safe fallback. Blocks are sized to the MXU/VPU tiling constraints
+(multiples of 128 lanes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True) -> jax.Array:
+    """Reference attention: q/k/v [B, T, H, D] -> [B, T, H, D]."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        mask = lax.iota(jnp.int32, t_q)[:, None] >= lax.iota(jnp.int32, t_k)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, scale: float):
+    """One grid step handles one (batch*head, q-block); loops over k blocks
+    with online softmax. Refs are [block_q, D] / [T, D] slices."""
+    block_q, d = q_ref.shape
+    t_k = k_ref.shape[0]
+    q_blk_idx = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+    q_offset = q_blk_idx * block_q
+
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    o = jnp.zeros((block_q, d), jnp.float32)
+
+    num_k_blocks = t_k // block_k
+    if causal:
+        # only blocks up to (and including) the diagonal contribute
+        last_block = lax.div(q_offset + block_q - 1, block_k) + 1
+    else:
+        last_block = num_k_blocks
+
+    def body(j, carry):
+        m, l, o = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            q_pos = q_offset + lax.iota(jnp.int32, block_q)
+            k_pos = j * block_k + lax.iota(jnp.int32, block_k)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.maximum(m_new, -0.5 * abs(NEG_INF))
+        p = jnp.exp(s - m_safe[:, None])
+        corr = jnp.exp(jnp.maximum(m, -0.5 * abs(NEG_INF)) - m_safe)
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[:, None] + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l, o
+
+    m, l, o = lax.fori_loop(0, last_block, body, (m, l, o))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[:] = (o / l_safe[:, None]).astype(o_ref.dtype)
+
+
+try:  # pallas is TPU/GPU-oriented; import lazily-tolerant for exotic builds
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover
+    pl = None
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused attention: q/k/v [B, T, H, D] -> [B, T, H, D].
+
+    Falls back to :func:`xla_attention` when Pallas is unavailable or shapes
+    don't tile (T must divide by the block sizes, D a multiple of 8)."""
+    b, t, h, d = q.shape
+    if pl is None or t % block_q or t % block_k or d % 8:
+        return xla_attention(q, k, v, causal=causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = 1.0 / (d**0.5)
+
+    # fold batch and heads into the grid; blocks are [block_q, D] per program
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,15 @@
+"""SPMD runtime for workloads scheduled by tpu-hive.
+
+This is the compute-side counterpart of the scheduler: a gang's pods receive
+contiguous ICI sub-meshes (via ``TPU_VISIBLE_CHIPS``), and this package turns
+them into ``jax.sharding.Mesh`` axes (dp/fsdp/tp/sp) with sharded training
+steps, ring attention for sequence parallelism, and XLA collectives over ICI.
+The reference has no training runtime (SURVEY.md §2.15) — this exceeds parity
+and makes the framework end-to-end usable on TPU.
+"""
+
+from hivedscheduler_tpu.parallel.topology import (  # noqa: F401
+    MeshAxes,
+    make_mesh,
+    mesh_from_slice,
+)

@@ -1,0 +1,184 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+Long-context support (first-class in this framework): queries stay put while
+key/value blocks rotate around the ``sp`` ring one ICI hop per step
+(``lax.ppermute``), with online-softmax accumulation so the result is exactly
+standard attention. Communication overlaps compute under XLA's async
+collectives, and per-chip memory is O(T/sp).
+
+Also provides Ulysses-style all-to-all sequence parallelism
+(:func:`ulysses_attention`): all_to_all swaps the sharded axis from sequence
+to heads, runs local attention, and swaps back — cheaper for moderate
+contexts when heads >= sp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, q_offset, k_offset, causal, scale):
+    """Online-softmax attention of a local q block against one k/v block.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]. Returns (o, m, l) partials with
+    o: [B, H, Tq, D], m/l: [B, H, Tq] in f32.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + lax.iota(jnp.int32, q.shape[1])
+        k_pos = k_offset + lax.iota(jnp.int32, k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1, so clamp
+    m_safe = jnp.maximum(m, -0.5 * abs(NEG_INF))
+    p = jnp.exp(s - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return o, m_safe, l
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, mesh_axes=()):
+    """Per-shard body (runs under shard_map): rotate k/v around the ring."""
+    axis_size = lax.psum(1, axis_name)
+    my_index = lax.axis_index(axis_name)
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    scale = 1.0 / (d**0.5)
+    qf = q.astype(jnp.float32)
+
+    # accumulators must be device-varying over the mesh axes to sit in a
+    # fori_loop carry with the ppermuted k/v (shard_map vma rules)
+    def varying(x):
+        if not mesh_axes:
+            return x
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, tuple(mesh_axes), to="varying")
+        return lax.pvary(x, tuple(mesh_axes))
+
+    o_acc = varying(jnp.zeros((b, h, t_q, d), jnp.float32))
+    m_acc = varying(jnp.full((b, h, t_q), NEG_INF, jnp.float32))
+    l_acc = varying(jnp.zeros((b, h, t_q), jnp.float32))
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def body(step, carry):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        # after `step` rotations we hold the block originally on shard my-step
+        src = (my_index - step) % axis_size
+
+        def attend(args):
+            o_acc, m_acc, l_acc, k_cur, v_cur = args
+            o_blk, m_blk, l_blk = _block_attention(
+                qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+                q_offset=my_index * t_q, k_offset=src * t_k,
+                causal=causal, scale=scale,
+            )
+            m_new = jnp.maximum(m_acc, m_blk)
+            corr_acc = jnp.exp(m_acc - m_new)
+            corr_blk = jnp.exp(m_blk - m_new)
+            o_acc = o_acc * corr_acc[..., None] + o_blk * corr_blk[..., None]
+            l_acc = l_acc * corr_acc + l_blk * corr_blk
+            return o_acc, m_new, l_acc
+
+        if causal:
+            # blocks entirely in my future are fully masked: skip the compute
+            # (the k/v rotation below still runs, keeping the ring uniform)
+            o_acc, m_acc, l_acc = lax.cond(
+                src <= my_index,
+                attend,
+                lambda args: (args[0], args[1], args[2]),
+                (o_acc, m_acc, l_acc, k_cur, v_cur),
+            )
+        else:
+            o_acc, m_acc, l_acc = attend((o_acc, m_acc, l_acc, k_cur, v_cur))
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return o_acc, m_acc, l_acc, k_nxt, v_nxt
+
+    o_acc, m_acc, l_acc, _, _ = lax.fori_loop(
+        0, axis_size, body, (o_acc, m_acc, l_acc, k, v)
+    )
+    l_safe = jnp.where(l_acc == 0.0, 1.0, l_acc)
+    out = (o_acc / l_safe[..., None]).astype(q.dtype)
+    return jnp.einsum("bhqd->bqhd", out)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    seq_axis: str = "sp",
+    batch_axes=("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention with q/k/v sharded on ``seq_axis`` over `mesh`.
+
+    Inputs are [B, T, H, D] logically; physically T is split over ``seq_axis``,
+    B over ``batch_axes``, H over ``head_axis``.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    # accumulators inside must be varying exactly over the sharded axes
+    vma_axes = tuple(batch_axes) + (seq_axis,) + ((head_axis,) if head_axis else ())
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_local,
+            axis_name=seq_axis,
+            causal=causal,
+            mesh_axes=vma_axes,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def _ulysses_local(q, k, v, axis_name: str, causal: bool):
+    """All-to-all swap: [B, T/sp, H, D] -> [B, T, H/sp, D], local attention,
+    swap back. Requires H % sp == 0."""
+    from hivedscheduler_tpu.ops.attention import xla_attention
+
+    # concat_axis=T (1), split_axis=H (2): gather full sequence, split heads
+    q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    out = xla_attention(q, k, v, causal=causal)
+    # swap back: split sequence, gather heads
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    seq_axis: str = "sp",
+    batch_axes=("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+    causal: bool = True,
+) -> jax.Array:
+    """DeepSpeed-Ulysses-style sequence parallelism via all_to_all."""
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    fn = shard_map(
+        functools.partial(_ulysses_local, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
