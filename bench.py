@@ -1,0 +1,240 @@
+"""Headline benchmark: gang-schedule latency for a 256-chip slice on a
+simulated v5p-1024 cluster under multi-VC load, plus ICI-mesh fragmentation.
+
+Matches the driver metric in BASELINE.json ("p50 gang-schedule latency for
+256-chip slice; ICI-mesh fragmentation %" on v5p-1024). The reference
+publishes no benchmark numbers (BASELINE.md); the only latency figure in its
+artifacts is the 50 ms ``waitingPodSchedulingBlockMilliSec`` knob its sample
+deployment spends *per waiting pod* to get FIFO (example/run/deploy.yaml:50),
+so ``vs_baseline`` reports 50 ms / our p50 — how many times faster one full
+256-chip gang decision is than the reference's single FIFO-blocking tick.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Scenario:
+- physical: one v5p-1024 pod (8x8x16 ICI mesh, 4-chip hosts), levels
+  4/8/16/32/64/128/256/512 chips;
+- VCs: vc-a guarantees 2x 256-chip cells, vc-b 1x 256, vc-c 4x 64;
+- load: vc-b and vc-c churn guaranteed + opportunistic gangs at random sizes;
+- measured: end-to-end Schedule()+AddAllocatedPod for a 64-pod x 4-chip
+  (=256-chip) gang in vc-a, repeated with interleaved churn;
+- fragmentation: fraction of attempts where the 256-chip slice could NOT be
+  placed contiguously although vc-a's guarantee was free (buddy allocation
+  over mesh tilings should make this 0%).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import statistics
+import time
+
+logging.disable(logging.CRITICAL)
+
+from hivedscheduler_tpu.api import constants as C
+from hivedscheduler_tpu.api.config import Config, new_config
+from hivedscheduler_tpu.api.types import (
+    CellTypeSpec,
+    MeshLevelSpec,
+    MeshSpec,
+    PhysicalCellSpec,
+    PhysicalClusterSpec,
+    VirtualCellSpec,
+    VirtualClusterSpec,
+)
+from hivedscheduler_tpu.algorithm.hived import HivedAlgorithm
+from hivedscheduler_tpu.common.utils import to_yaml
+from hivedscheduler_tpu.k8s.types import Container, Node, Pod
+from hivedscheduler_tpu.runtime.types import FILTERING_PHASE, PREEMPTING_PHASE
+from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+LEVELS = [
+    ("v5p-2x2x2", (2, 2, 2)),
+    ("v5p-4x2x2", (4, 2, 2)),
+    ("v5p-4x4x2", (4, 4, 2)),
+    ("v5p-4x4x4", (4, 4, 4)),
+    ("v5p-8x4x4", (8, 4, 4)),
+    ("v5p-8x8x4", (8, 8, 4)),  # 256 chips: the measured slice
+    ("v5p-8x8x8", (8, 8, 8)),
+]
+
+
+def build_config() -> Config:
+    mesh = MeshSpec(
+        topology=(8, 8, 16),
+        chip_type="v5p-chip",
+        host_shape=(2, 2, 1),
+        levels=[MeshLevelSpec(name=n, shape=s) for n, s in LEVELS],
+    )
+    return new_config(
+        Config(
+            physical_cluster=PhysicalClusterSpec(
+                cell_types={"v5p-1024": CellTypeSpec(mesh=mesh)},
+                physical_cells=[PhysicalCellSpec(cell_type="v5p-1024", cell_address="pod0")],
+            ),
+            virtual_clusters={
+                "vc-a": VirtualClusterSpec(
+                    virtual_cells=[VirtualCellSpec(cell_number=2, cell_type="v5p-1024.v5p-8x8x4")]
+                ),
+                "vc-b": VirtualClusterSpec(
+                    virtual_cells=[VirtualCellSpec(cell_number=1, cell_type="v5p-1024.v5p-8x8x4")]
+                ),
+                "vc-c": VirtualClusterSpec(
+                    virtual_cells=[VirtualCellSpec(cell_number=4, cell_type="v5p-1024.v5p-4x4x4")]
+                ),
+            },
+        )
+    )
+
+
+def make_pod(name: str, vc: str, priority: int, group: str, pods: int, chips: int) -> Pod:
+    spec = {
+        "virtualCluster": vc,
+        "priority": priority,
+        "leafCellType": "v5p-chip",
+        "leafCellNumber": chips,
+        "affinityGroup": {
+            "name": group,
+            "members": [{"podNumber": pods, "leafCellNumber": chips}],
+        },
+    }
+    return Pod(
+        name=name,
+        uid=name,
+        annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: to_yaml(spec)},
+        containers=[Container(resource_limits={C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})],
+    )
+
+
+class Cluster:
+    def __init__(self):
+        self.algo = HivedAlgorithm(build_config())
+        self.nodes = sorted(
+            {
+                n
+                for ccl in self.algo.full_cell_list.values()
+                for c in ccl[max(ccl)]
+                for n in c.nodes
+            }
+        )
+        for n in self.nodes:
+            self.algo.add_node(Node(name=n))
+        self.groups = {}  # name -> list of bound pods
+
+    def schedule_gang(self, vc, priority, group, pods, chips, allow_preempt=False):
+        """Schedule + allocate a whole gang; returns (ok, seconds, preempted).
+
+        With ``allow_preempt``, opportunistic victims advertised by the
+        scheduler are deleted instantly (simulated kill) and the pod retried —
+        preempting OT pods off a VC's guarantee is by-design, not a
+        fragmentation failure."""
+        bound = []
+        preempted = False
+        t0 = time.perf_counter()
+        for i in range(pods):
+            pod = make_pod(f"{group}-{i}", vc, priority, group, pods, chips)
+            # victims are advertised one node per round (K8s preempts a node
+            # at a time), so a wide gang may need many preempt rounds
+            for _attempt in range(128):
+                r = self.algo.schedule(
+                    pod, self.nodes,
+                    PREEMPTING_PHASE if (allow_preempt and _attempt) else FILTERING_PHASE,
+                )
+                if r.pod_preempt_info is not None and allow_preempt:
+                    preempted = True
+                    for victim in r.pod_preempt_info.victim_pods:
+                        self._kill_pod(victim)
+                    continue
+                break
+            if r.pod_bind_info is None:
+                dt = time.perf_counter() - t0
+                for bp in bound:  # roll back partial gang
+                    self.algo.delete_allocated_pod(bp)
+                return False, dt, preempted
+            bp = new_binding_pod(pod, r.pod_bind_info)
+            self.algo.add_allocated_pod(bp)
+            bound.append(bp)
+        dt = time.perf_counter() - t0
+        self.groups[group] = bound
+        return True, dt, preempted
+
+    def _kill_pod(self, victim):
+        for name, pods in list(self.groups.items()):
+            if any(bp.uid == victim.uid for bp in pods):
+                self.free_gang(name)
+                return
+
+    def free_gang(self, group):
+        for bp in self.groups.pop(group):
+            self.algo.delete_allocated_pod(bp)
+
+
+def run(measure_iters: int = 30, seed: int = 7):
+    rng = random.Random(seed)
+    cluster = Cluster()
+
+    # steady background load on vc-b / vc-c (guaranteed + opportunistic)
+    churn_sizes = [(1, 4), (2, 4), (4, 4), (8, 4), (16, 4)]  # (pods, chips/pod)
+    churn_groups = []
+    gid = 0
+    for _ in range(24):
+        vc = rng.choice(["vc-b", "vc-c"])
+        prio = rng.choice([-1, 0, 5, 10])
+        pods, chips = rng.choice(churn_sizes)
+        name = f"churn-{gid}"
+        gid += 1
+        ok, _, _ = cluster.schedule_gang(vc, prio, name, pods, chips)
+        if ok:
+            churn_groups.append(name)
+
+    latencies = []
+    frag_failures = 0
+    for it in range(measure_iters):
+        # drop groups preempted away by the previous measured gang
+        churn_groups = [g for g in churn_groups if g in cluster.groups]
+        # churn: free a random third of load groups, add new ones
+        rng.shuffle(churn_groups)
+        for name in churn_groups[: len(churn_groups) // 3]:
+            cluster.free_gang(name)
+            churn_groups.remove(name)
+        for _ in range(4):
+            vc = rng.choice(["vc-b", "vc-c"])
+            prio = rng.choice([-1, 0, 5, 10])
+            pods, chips = rng.choice(churn_sizes)
+            name = f"churn-{gid}"
+            gid += 1
+            ok, _, _ = cluster.schedule_gang(vc, prio, name, pods, chips)
+            if ok:
+                churn_groups.append(name)
+
+        # the measured 256-chip gang in vc-a (guarantee is free): 64 pods x 4
+        ok, dt, _ = cluster.schedule_gang("vc-a", 10, f"big-{it}", 64, 4,
+                                          allow_preempt=True)
+        latencies.append(dt)
+        if not ok:
+            frag_failures += 1  # guarantee free but slice not placeable
+        else:
+            cluster.free_gang(f"big-{it}")
+
+    p50 = statistics.median(latencies) * 1000.0
+    p99 = sorted(latencies)[max(0, int(len(latencies) * 0.99) - 1)] * 1000.0
+    frag_pct = 100.0 * frag_failures / measure_iters
+    return p50, p99, frag_pct
+
+
+if __name__ == "__main__":
+    p50, p99, frag_pct = run()
+    baseline_ms = 50.0  # reference deploy's per-pod FIFO blocking tick
+    print(
+        json.dumps(
+            {
+                "metric": "p50_gang_schedule_latency_256chip_slice_v5p1024"
+                + ("" if frag_pct == 0 else f"_frag{frag_pct:.0f}pct"),
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(baseline_ms / p50, 3) if p50 > 0 else None,
+            }
+        )
+    )

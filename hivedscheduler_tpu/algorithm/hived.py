@@ -121,6 +121,9 @@ class HivedAlgorithm(SchedulerAlgorithm):
             self.opportunistic_schedulers[chain] = TopologyAwareScheduler(
                 ccl, parsed.cell_level_to_leaf_cell_num[chain], cross_priority_pack=False
             )
+        from hivedscheduler_tpu.algorithm.utils import build_leaf_cell_index
+
+        self._leaf_cell_index = build_leaf_cell_index(self.full_cell_list)
         self._init_cell_nums()
         self._init_api_cluster_status()
         self._init_pinned_cells(parsed.physical_pinned_cells)
@@ -1060,6 +1063,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
                         )
         original = victim.virtual_leaf_cell_placement
         victim.virtual_leaf_cell_placement = None
+        victim.placement_version += 1
         victim.lazy_preemption_status = api.LazyPreemptionStatus(
             preemptor=preemptor,
             preemption_time=datetime.now(timezone.utc).isoformat(),
@@ -1090,6 +1094,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     self._release_leaf_cell(leaf_cell, g.vc)
                     self._allocate_leaf_cell(leaf_cell, v_leaf_cell, g.priority, g.vc)
         g.virtual_leaf_cell_placement = virtual_placement
+        g.placement_version += 1
         g.lazy_preemption_status = None
         log.info("Lazy preemption of affinity group %s is reverted", g.name)
 
@@ -1111,7 +1116,8 @@ class HivedAlgorithm(SchedulerAlgorithm):
         priority = s.priority
         physical_leaf_cell_index = physical_leaf_cell_indices[index]
         p_leaf_cell = find_physical_leaf_cell(
-            self.full_cell_list, chain, node, physical_leaf_cell_index
+            self.full_cell_list, chain, node, physical_leaf_cell_index,
+            leaf_cell_index_map=self._leaf_cell_index,
         )
         if p_leaf_cell is None:
             log.warning(

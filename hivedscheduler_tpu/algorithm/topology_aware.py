@@ -204,6 +204,72 @@ def _get_leaf_cells_from_node(
         preemptible.append(c)
 
 
+# below this many candidates the Python search beats ctypes marshalling
+_NATIVE_THRESHOLD = 16
+
+
+def _node_ancestor_matrix(n: Cell):
+    """Static per-node ancestor-id matrix for the native search, cached on the
+    node cell (topology never changes after construction)."""
+    import ctypes
+
+    cached = getattr(n, "_native_ancestors", None)
+    if cached is not None:
+        return cached
+    leaves: CellList = []
+
+    def collect(c: Cell) -> None:
+        if c.level == 1:
+            leaves.append(c)
+        else:
+            for cc in c.children:
+                collect(cc)
+
+    collect(n)
+    n_levels = n.level
+    ids: Dict[str, int] = {}
+    flat = (ctypes.c_int32 * (len(leaves) * n_levels))()
+    row_of: Dict[str, int] = {}
+    for r, leaf in enumerate(leaves):
+        row_of[leaf.address] = r
+        c: Optional[Cell] = leaf
+        for lv in range(1, n_levels + 1):
+            while c.level < lv:
+                c = c.parent
+            flat[r * n_levels + (lv - 1)] = ids.setdefault(c.address, len(ids))
+    cached = (row_of, flat, n_levels)
+    n._native_ancestors = cached  # type: ignore[attr-defined]
+    return cached
+
+
+def _find_leaf_cells_native(
+    n: Cell,
+    available_leaf_cells: CellList,
+    leaf_cell_num: int,
+    optimal_affinity: CellLevel,
+) -> Optional[List[int]]:
+    """Gather the available rows and run the C++ search; returns candidate
+    indices or None when the native library is unavailable."""
+    import ctypes
+
+    from hivedscheduler_tpu import native
+
+    if not native.available():
+        return None
+    row_of, full, n_levels = _node_ancestor_matrix(n)
+    n_avail = len(available_leaf_cells)
+    gathered = (ctypes.c_int32 * (n_avail * n_levels))()
+    for i, cell in enumerate(available_leaf_cells):
+        r = row_of.get(cell.address)
+        if r is None:  # cell not under this node (shouldn't happen)
+            return None
+        src = r * n_levels
+        gathered[i * n_levels : (i + 1) * n_levels] = full[src : src + n_levels]
+    return native.find_leaf_cells(
+        gathered, n_avail, n_levels, leaf_cell_num, optimal_affinity
+    )
+
+
 def find_leaf_cells_in_node(
     n: Cell,
     leaf_cell_num: int,
@@ -225,12 +291,22 @@ def find_leaf_cells_in_node(
         _get_leaf_cells_from_node(n, p, free, preemptible)
         available_leaf_cells = free + preemptible
 
+    optimal = _get_optimal_affinity(leaf_cell_num, level_leaf_cell_num)
+    if len(available_leaf_cells) >= _NATIVE_THRESHOLD:
+        picked_idx = _find_leaf_cells_native(
+            n, available_leaf_cells, leaf_cell_num, optimal
+        )
+        if picked_idx is not None:
+            best_cells = [available_leaf_cells[i] for i in picked_idx]
+            _remove_picked(available_leaf_cells, picked_idx)
+            return best_cells, available_leaf_cells
+
     current_indices = [0] * leaf_cell_num
     current_affinity: List[Optional[Cell]] = [None] * leaf_cell_num
     best_cells: CellList = [None] * leaf_cell_num  # type: ignore[list-item]
     best_indices = [0] * leaf_cell_num
     best_affinity = HIGHEST_LEVEL
-    optimal_affinity = _get_optimal_affinity(leaf_cell_num, level_leaf_cell_num)
+    optimal_affinity = optimal
 
     avail_index = 0
     search_index = 0

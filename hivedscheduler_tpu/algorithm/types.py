@@ -233,6 +233,11 @@ class AlgoAffinityGroup:
         self.virtual_leaf_cell_placement: GroupVirtualPlacement = {}
         self.state = state
         self.lazy_preemption_status: Optional[api.LazyPreemptionStatus] = None
+        # bumped whenever either placement mutates; generate_affinity_group_
+        # bind_info caches its (expensive, per-gang-quadratic) result per
+        # version
+        self.placement_version = 0
+        self._bind_info_cache = None  # (version, bind_info_list, chain)
         for leaf_cell_num, pod_num in self.total_pod_nums.items():
             self.physical_leaf_cell_placement[leaf_cell_num] = [
                 [None] * leaf_cell_num for _ in range(pod_num)

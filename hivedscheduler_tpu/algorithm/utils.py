@@ -102,6 +102,17 @@ def generate_affinity_group_bind_info(
 ) -> Tuple[List[api.AffinityGroupMemberBindInfo], str, List[int], str]:
     """Placement → wire format, incl. PreassignedCellTypes needed for recovery
     (reference: generateAffinityGroupBindInfo, utils.go:108-171)."""
+    cached = group._bind_info_cache if group is not None else None
+    if cached is not None and cached[0] == group.placement_version:
+        bind_info, chain = cached[1], cached[2]
+        for mbi_cached in bind_info:
+            if len(mbi_cached.pod_placements[0].physical_leaf_cell_indices) == current_leaf_cell_num:
+                return (
+                    bind_info,
+                    mbi_cached.pod_placements[current_pod_index].physical_node,
+                    mbi_cached.pod_placements[current_pod_index].physical_leaf_cell_indices,
+                    chain,
+                )
     bind_info: List[api.AffinityGroupMemberBindInfo] = []
     selected_node = ""
     selected_indices: List[int] = []
@@ -163,6 +174,8 @@ def generate_affinity_group_bind_info(
             if p_leaf_cell is not None:
                 chain = p_leaf_cell.chain
         bind_info.append(mbi)
+    if group is not None:
+        group._bind_info_cache = (group.placement_version, bind_info, chain)
     return bind_info, selected_node, selected_indices, chain
 
 
@@ -274,14 +287,50 @@ def all_pods_released(allocated_pods: Dict[int, List[Optional[Pod]]]) -> bool:
     return all(p is None for pods in allocated_pods.values() for p in pods)
 
 
+def build_leaf_cell_index(
+    full_cell_list: Dict[CellChain, ChainCellList],
+) -> Dict[CellChain, Dict[Tuple[str, int], PhysicalCell]]:
+    """Static (node, in-node index) -> leaf cell map per chain; the cell
+    topology never changes after construction, so lookups during recovery are
+    O(1) instead of scanning every leaf cell."""
+    index: Dict[CellChain, Dict[Tuple[str, int], PhysicalCell]] = {}
+    for chain, ccl in full_cell_list.items():
+        chain_index: Dict[Tuple[str, int], PhysicalCell] = {}
+        for c in ccl.get(1, []):
+            assert isinstance(c, PhysicalCell)
+            nodes, leaf_cell_indices = c.get_physical_placement()
+            for n in nodes:
+                for i in leaf_cell_indices:
+                    chain_index[(n, i)] = c
+        index[chain] = chain_index
+    return index
+
+
 def find_physical_leaf_cell(
     full_cell_list: Dict[CellChain, ChainCellList],
     chain: CellChain,
     node: str,
     leaf_cell_index: int,
+    leaf_cell_index_map: Optional[Dict[CellChain, Dict[Tuple[str, int], PhysicalCell]]] = None,
 ) -> Optional[PhysicalCell]:
     """Find a leaf cell by (node, index); falls back to other chains on
     reconfiguration (reference: findPhysicalLeafCell, utils.go:326-345)."""
+    if leaf_cell_index_map is not None and leaf_cell_index >= 0:
+        # a negative index is a wildcard "any cell on the node" (legacy
+        # annotations): only the scan path below supports it
+        found = leaf_cell_index_map.get(chain, {}).get((node, leaf_cell_index))
+        if found is not None:
+            return found
+        for c, chain_index in leaf_cell_index_map.items():
+            if c != chain:
+                found = chain_index.get((node, leaf_cell_index))
+                if found is not None:
+                    log.warning("Leaf cell %s on node %s has been moved to chain %s",
+                                leaf_cell_index, node, c)
+                    return found
+        return None
+    if leaf_cell_index_map is not None:
+        leaf_cell_index = -1  # normalize wildcard for the scan path
     found = _find_physical_leaf_cell_in_chain(full_cell_list, chain, node, leaf_cell_index)
     if found is None:
         for c in full_cell_list:

@@ -169,7 +169,10 @@ def forward(
         )
         return x, None
 
-    x, _ = lax.scan(layer, x, params["layers"])
+    # rematerialize per-layer activations in the backward pass: HBM for the
+    # whole stack is O(1) layers instead of O(n_layers), the standard trade
+    # for long-context training
+    x, _ = lax.scan(jax.checkpoint(layer), x, params["layers"])
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
     return logits.astype(jnp.float32)

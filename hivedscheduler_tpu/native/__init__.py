@@ -1,0 +1,82 @@
+"""ctypes loader for the native placement search.
+
+Compiles ``placement.cpp`` with g++ on first use (cached as ``_placement.so``
+next to the source) and exposes :func:`find_leaf_cells`. Import failure or a
+missing toolchain degrades silently to the pure-Python path — set
+``HIVED_NATIVE=0`` to force Python, ``HIVED_NATIVE=1`` to require native.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import List, Optional
+
+log = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "placement.cpp")
+_SO = os.path.join(_HERE, "_placement.so")
+
+_lib = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("HIVED_NATIVE", "") == "0":
+        return None
+    try:
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_SO)
+        lib.hived_find_leaf_cells.restype = ctypes.c_int32
+        lib.hived_find_leaf_cells.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        _lib = lib
+    except Exception as e:  # toolchain missing / compile error
+        if os.environ.get("HIVED_NATIVE") == "1":
+            raise
+        log.info("native placement unavailable, using Python path: %s", e)
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def find_leaf_cells(
+    ancestors: "ctypes.Array",
+    n_avail: int,
+    n_levels: int,
+    leaf_cell_num: int,
+    optimal_affinity: int,
+) -> Optional[List[int]]:
+    """Run the native search; returns picked candidate indices (ascending) or
+    None when no solution exists. ``ancestors`` is a flat int32 ctypes array
+    of shape [n_avail, n_levels]."""
+    lib = _load()
+    assert lib is not None
+    out = (ctypes.c_int32 * leaf_cell_num)()
+    best = lib.hived_find_leaf_cells(
+        ancestors, n_avail, n_levels, leaf_cell_num, optimal_affinity, out
+    )
+    if best < 0:
+        return None
+    return list(out)

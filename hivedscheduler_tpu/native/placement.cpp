@@ -1,0 +1,98 @@
+// Native placement search: best-affinity leaf-cell selection inside a node.
+//
+// C++ implementation of the backtracking LCA-minimizing search the scheduler
+// runs per pod (Python reference: algorithm/topology_aware.py
+// find_leaf_cells_in_node; upstream semantics: topology_aware_scheduler.go:
+// 309-387). Exposed via a C ABI for ctypes; semantics are identical to the
+// Python path and covered by differential tests (tests/test_native.py).
+//
+// Representation: each available leaf cell is a row of `ancestors`
+// ([n_avail x n_levels], row-major), holding an integer id of the cell's
+// ancestor at each level (level 1 = the leaf itself at column 0). The LCA of
+// a candidate leaf and the running affinity (an ancestor of a previously
+// picked leaf at level `aff_level`) is the lowest level >= aff_level at which
+// their ancestor ids agree. Lower LCA level = tighter ICI sub-mesh.
+//
+// Build: g++ -O2 -shared -fPIC -o _placement.so placement.cpp
+
+#include <cstdint>
+#include <vector>
+
+namespace {
+constexpr int32_t kInfLevel = INT32_MAX;
+
+inline int32_t lca_level(const int32_t* ancestors, int32_t n_levels,
+                         int32_t leaf, int32_t ref, int32_t from_level) {
+  const int32_t* a = ancestors + static_cast<int64_t>(leaf) * n_levels;
+  const int32_t* b = ancestors + static_cast<int64_t>(ref) * n_levels;
+  for (int32_t l = from_level; l <= n_levels; ++l) {
+    if (a[l - 1] == b[l - 1]) return l;
+  }
+  return kInfLevel;
+}
+}  // namespace
+
+extern "C" {
+
+// Returns the best affinity level found (and writes the picked candidate
+// indices, ascending, to out_indices), or -1 if no solution exists.
+// Mirrors findLeafCellsInNode: candidates scanned in order (free cells before
+// preemptible ones), prune when the running LCA exceeds the best seen, early
+// stop at optimal_affinity.
+int32_t hived_find_leaf_cells(const int32_t* ancestors, int32_t n_avail,
+                              int32_t n_levels, int32_t leaf_cell_num,
+                              int32_t optimal_affinity, int32_t* out_indices) {
+  if (leaf_cell_num <= 0 || n_avail < leaf_cell_num) return -1;
+  std::vector<int32_t> current_idx(leaf_cell_num, 0);
+  // running affinity per depth: (reference leaf row, LCA level)
+  std::vector<int32_t> aff_ref(leaf_cell_num, 0);
+  std::vector<int32_t> aff_level(leaf_cell_num, 0);
+  std::vector<int32_t> best_idx(leaf_cell_num, 0);
+  int32_t best_affinity = kInfLevel;
+
+  int32_t search = 0;
+  int32_t avail = 0;
+  while (true) {
+    while (avail < n_avail) {
+      current_idx[search] = avail;
+      if (search == 0) {
+        aff_ref[0] = avail;
+        aff_level[0] = 1;  // a single leaf: affinity is the leaf itself
+      } else {
+        int32_t lvl = lca_level(ancestors, n_levels, avail,
+                                aff_ref[search - 1], aff_level[search - 1]);
+        // prune: running LCA already worse than the best seen
+        if ((lvl == kInfLevel && best_affinity < kInfLevel) ||
+            (lvl != kInfLevel && lvl > best_affinity)) {
+          ++avail;
+          continue;
+        }
+        aff_ref[search] = avail;
+        aff_level[search] = lvl;
+      }
+      if (search == leaf_cell_num - 1) {
+        int32_t affinity = aff_level[search];
+        if (affinity < best_affinity) {
+          best_affinity = affinity;
+          for (int32_t i = 0; i < leaf_cell_num; ++i) best_idx[i] = current_idx[i];
+          if (affinity == optimal_affinity) {
+            for (int32_t i = 0; i < leaf_cell_num; ++i) out_indices[i] = best_idx[i];
+            return best_affinity;  // early stop: all-buddy solution
+          }
+        }
+      } else {
+        ++search;
+      }
+      ++avail;
+    }
+    --search;
+    if (search < 0) {
+      if (best_affinity == kInfLevel) return -1;
+      for (int32_t i = 0; i < leaf_cell_num; ++i) out_indices[i] = best_idx[i];
+      return best_affinity;
+    }
+    avail = current_idx[search] + 1;
+  }
+}
+
+}  // extern "C"

@@ -69,9 +69,10 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, mesh_axes=()):
     l_acc = varying(jnp.zeros((b, h, t_q), jnp.float32))
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    def body(step, carry):
-        o_acc, m_acc, l_acc, k_cur, v_cur = carry
-        # after `step` rotations we hold the block originally on shard my-step
+    def merge_block(step, o_acc, m_acc, l_acc, k_cur, v_cur):
+        """Attend the local q against the k/v block currently held (which
+        originated on shard my_index - step), skipping blocks that a causal
+        mask would zero out entirely."""
         src = (my_index - step) % axis_size
 
         def attend(args):
@@ -90,21 +91,28 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, mesh_axes=()):
 
         if causal:
             # blocks entirely in my future are fully masked: skip the compute
-            # (the k/v rotation below still runs, keeping the ring uniform)
-            o_acc, m_acc, l_acc = lax.cond(
+            return lax.cond(
                 src <= my_index,
                 attend,
                 lambda args: (args[0], args[1], args[2]),
                 (o_acc, m_acc, l_acc, k_cur, v_cur),
             )
-        else:
-            o_acc, m_acc, l_acc = attend((o_acc, m_acc, l_acc, k_cur, v_cur))
+        return attend((o_acc, m_acc, l_acc, k_cur, v_cur))
+
+    def body(step, carry):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        o_acc, m_acc, l_acc = merge_block(step, o_acc, m_acc, l_acc, k_cur, v_cur)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return o_acc, m_acc, l_acc, k_nxt, v_nxt
 
-    o_acc, m_acc, l_acc, _, _ = lax.fori_loop(
-        0, axis_size, body, (o_acc, m_acc, l_acc, k, v)
+    # rotate only axis_size-1 times; the final block attends outside the loop
+    # so no wasted ICI transfer trails the ring
+    o_acc, m_acc, l_acc, k_last, v_last = lax.fori_loop(
+        0, axis_size - 1, body, (o_acc, m_acc, l_acc, k, v)
+    )
+    o_acc, m_acc, l_acc = merge_block(
+        axis_size - 1, o_acc, m_acc, l_acc, k_last, v_last
     )
     l_safe = jnp.where(l_acc == 0.0, 1.0, l_acc)
     out = (o_acc / l_safe[..., None]).astype(q.dtype)

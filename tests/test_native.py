@@ -1,0 +1,154 @@
+"""Differential tests: the C++ placement search must pick exactly the same
+cells as the pure-Python backtracking search, including under adversarial
+fragmentation on large single-node cells."""
+
+import random
+
+import pytest
+
+from hivedscheduler_tpu import native
+from hivedscheduler_tpu.api.config import Config, new_config
+from hivedscheduler_tpu.api.types import (
+    CellTypeSpec,
+    MeshLevelSpec,
+    MeshSpec,
+    PhysicalCellSpec,
+    PhysicalClusterSpec,
+    VirtualClusterSpec,
+)
+from hivedscheduler_tpu.algorithm.config_parser import parse_config
+from hivedscheduler_tpu.algorithm.constants import FREE_PRIORITY
+from hivedscheduler_tpu.algorithm import topology_aware as ta
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def big_node():
+    """One 64-chip single-host cell (a 4x4x4 slice exposed as one K8s node),
+    with intermediate levels to make affinity non-trivial."""
+    mesh = MeshSpec(
+        topology=(4, 4, 4),
+        chip_type="chip",
+        host_shape=(4, 4, 4),
+        levels=[
+            MeshLevelSpec(name="m2", shape=(2, 2, 1)),
+            MeshLevelSpec(name="m4", shape=(2, 2, 2)),
+            MeshLevelSpec(name="m16", shape=(4, 2, 2)),
+            MeshLevelSpec(name="m32", shape=(4, 4, 2)),
+        ],
+    )
+    cfg = new_config(
+        Config(
+            physical_cluster=PhysicalClusterSpec(
+                cell_types={"slice64": CellTypeSpec(mesh=mesh)},
+                physical_cells=[PhysicalCellSpec(cell_type="slice64", cell_address="n0")],
+            ),
+            virtual_clusters={"vc": VirtualClusterSpec()},
+        )
+    )
+    parsed = parse_config(cfg)
+    full = parsed.physical_full_list["slice64"]
+    node = full[max(full)][0]
+    levels = {lv.level: lv.leaf_cell_number for lv in parsed.chain_levels["slice64"]}
+    return node, levels
+
+
+def _py(node, avail, num, levels):
+    # force the Python branch by monkey-free call: temporarily drop below the
+    # native threshold is not possible, so call internals directly
+    saved = ta._NATIVE_THRESHOLD
+    ta._NATIVE_THRESHOLD = 10**9
+    try:
+        return ta.find_leaf_cells_in_node(node, num, 0, list(avail), levels)
+    finally:
+        ta._NATIVE_THRESHOLD = saved
+
+
+def native_search(node, avail, num, levels):
+    saved = ta._NATIVE_THRESHOLD
+    ta._NATIVE_THRESHOLD = 0
+    try:
+        return ta.find_leaf_cells_in_node(node, num, 0, list(avail), levels)
+    finally:
+        ta._NATIVE_THRESHOLD = saved
+
+
+@pytest.mark.parametrize("num", [1, 2, 4, 8, 16])
+def test_differential_full_node(num):
+    node, levels = big_node()
+    leaves = []
+
+    def collect(c):
+        if c.level == 1:
+            leaves.append(c)
+        else:
+            for cc in c.children:
+                collect(cc)
+
+    collect(node)
+    py_picked, _ = _py(node, leaves, num, levels)
+    nat_picked, _ = native_search(node, leaves, num, levels)
+    assert [c.address for c in py_picked] == [c.address for c in nat_picked]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_fragmented(seed):
+    """Random subsets of free chips (fragmentation) at random request sizes."""
+    rng = random.Random(seed)
+    node, levels = big_node()
+    leaves = []
+
+    def collect(c):
+        if c.level == 1:
+            leaves.append(c)
+        else:
+            for cc in c.children:
+                collect(cc)
+
+    collect(node)
+    avail = [c for c in leaves if rng.random() < 0.6]
+    num = rng.choice([1, 2, 3, 4, 5, 8])
+    if len(avail) < num:
+        return
+    py_picked, py_rest = _py(node, avail, num, levels)
+    nat_picked, nat_rest = native_search(node, avail, num, levels)
+    assert [c.address for c in py_picked] == [c.address for c in nat_picked]
+    assert [c.address for c in py_rest] == [c.address for c in nat_rest]
+
+
+def test_native_speedup_adversarial_fragmentation():
+    """Worst case for the backtracking search: one chip removed from every
+    8-chip sub-cube, so an 8-chip request can never reach level-3 affinity and
+    the search must prove the best is level 4. The C++ path must win big
+    (typically ~80x) and pick identical cells."""
+    import time
+
+    node, levels = big_node()
+    leaves = []
+
+    def collect(c):
+        if c.level == 1:
+            leaves.append(c)
+        else:
+            for cc in c.children:
+                collect(cc)
+
+    collect(node)
+    blocks = {}
+    for leaf in leaves:
+        key = tuple(o // 2 for o in leaf.mesh_origin)
+        blocks.setdefault(key, []).append(leaf)
+    avail = []
+    for blk in blocks.values():
+        avail.extend(blk[1:])  # drop one chip per 8-block
+
+    t0 = time.perf_counter()
+    py_picked, _ = _py(node, avail, 8, levels)
+    t_py = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    nat_picked, _ = native_search(node, avail, 8, levels)
+    t_nat = time.perf_counter() - t0
+    assert [c.address for c in py_picked] == [c.address for c in nat_picked]
+    assert t_nat < t_py / 5, (t_nat, t_py)
