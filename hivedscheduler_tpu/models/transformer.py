@@ -1,15 +1,20 @@
 """Flagship workload: a decoder-only transformer LM, TPU-first.
 
 Pure-JAX pytree params (no framework dependency), bf16 matmuls on the MXU,
-RoPE, RMSNorm, SwiGLU. Layers are stacked and scanned with ``lax.scan`` so
-compile time is O(1) in depth and XLA fuses per-layer elementwise work into
-the matmuls. Attention implementation is selectable: plain XLA einsum, the
-Pallas flash kernel (``ops/attention.py``), or ring/Ulysses sequence
-parallelism over a mesh axis (``parallel/ring_attention.py``).
+RoPE, RMSNorm, SwiGLU. Layers are stacked and scanned with ``lax.scan``
+(compile time O(1) in depth) and rematerialized with ``jax.checkpoint``.
+Attention implementation is selectable: plain XLA einsum, the Pallas flash
+kernel (``ops/attention.py``), or ring/Ulysses sequence parallelism over the
+``sp`` mesh axis (``parallel/ring_attention.py``).
 
-Sharding is annotation-driven (``models.sharding_specs``): tp shards heads
-and the MLP hidden dim, fsdp shards the other param axis, dp/sp shard batch
-and sequence of activations — XLA inserts the collectives.
+Parallelism:
+- tp shards heads and MLP hidden, fsdp the complementary param axis, dp/sp
+  shard activations (annotation-driven; XLA inserts the collectives);
+- ``n_experts > 0`` turns every MLP into a switch (top-1) MoE layer with the
+  expert dimension sharded over ``ep`` (capacity-based dense dispatch, the
+  standard GSPMD expert-parallel formulation);
+- ``pipeline_microbatches > 0`` runs the layer stack GPipe-pipelined over the
+  ``pp`` mesh axis (``parallel/pipeline.py``), layer params sharded by stage.
 """
 
 from __future__ import annotations
@@ -36,6 +41,14 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     # "xla" | "flash" | "ring" | "ulysses"
     attn_impl: str = "xla"
+    # switch-MoE: 0 = dense MLP; >0 = experts per MoE layer (ep-sharded)
+    n_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    # weight of the Switch load-balancing auxiliary loss (router collapse
+    # prevention); added to the LM loss by parallel/train.py
+    moe_aux_weight: float = 0.01
+    # GPipe microbatches over the pp axis; 0 = no pipelining
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -45,7 +58,7 @@ class TransformerConfig:
 
 def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
     """Stacked-layer params: arrays carry a leading [n_layers] axis so the
-    forward pass can lax.scan over them."""
+    forward pass can lax.scan (or pipeline) over them."""
     k_emb, k_attn, k_mlp, k_out = jax.random.split(key, 4)
     d, h, hd, f, L = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers
 
@@ -55,41 +68,69 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
         )
 
     ks = jax.random.split(k_attn, 4)
-    km = jax.random.split(k_mlp, 3)
+    km = jax.random.split(k_mlp, 4)
+    layers: Dict[str, Any] = {
+        "attn_norm": jnp.ones((L, d), jnp.float32),
+        "wq": norm_init(ks[0], (L, d, h, hd), d),
+        "wk": norm_init(ks[1], (L, d, h, hd), d),
+        "wv": norm_init(ks[2], (L, d, h, hd), d),
+        "wo": norm_init(ks[3], (L, h, hd, d), d),
+        "mlp_norm": jnp.ones((L, d), jnp.float32),
+    }
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        layers.update(
+            router=norm_init(km[3], (L, d, E), d),
+            w_gate=norm_init(km[0], (L, E, d, f), d),
+            w_up=norm_init(km[1], (L, E, d, f), d),
+            w_down=norm_init(km[2], (L, E, f, d), f),
+        )
+    else:
+        layers.update(
+            w_gate=norm_init(km[0], (L, d, f), d),
+            w_up=norm_init(km[1], (L, d, f), d),
+            w_down=norm_init(km[2], (L, f, d), f),
+        )
     return {
         "embed": norm_init(k_emb, (cfg.vocab_size, d), d),
-        "layers": {
-            "attn_norm": jnp.ones((L, d), jnp.float32),
-            "wq": norm_init(ks[0], (L, d, h, hd), d),
-            "wk": norm_init(ks[1], (L, d, h, hd), d),
-            "wv": norm_init(ks[2], (L, d, h, hd), d),
-            "wo": norm_init(ks[3], (L, h, hd, d), d),
-            "mlp_norm": jnp.ones((L, d), jnp.float32),
-            "w_gate": norm_init(km[0], (L, d, f), d),
-            "w_up": norm_init(km[1], (L, d, f), d),
-            "w_down": norm_init(km[2], (L, f, d), f),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((d,), jnp.float32),
         "lm_head": norm_init(k_out, (d, cfg.vocab_size), d),
     }
 
 
 def sharding_specs(cfg: TransformerConfig) -> Dict[str, Any]:
-    """PartitionSpecs per param: tp shards heads / ff; fsdp shards the
-    complementary axis. Mirror of init_params' tree."""
+    """PartitionSpecs per param, mirroring init_params' tree. tp shards heads
+    and ff, fsdp the complementary axis, ep the expert axis. With pipelining,
+    the leading layer axis is sharded over pp (and tp/fsdp must be 1 inside
+    the pipeline; see parallel/pipeline.py)."""
+    pl = "pp" if cfg.pipeline_microbatches > 0 else None
+    fsdp = None if cfg.pipeline_microbatches > 0 else "fsdp"
+    tp = None if cfg.pipeline_microbatches > 0 else "tp"
+    layers: Dict[str, Any] = {
+        "attn_norm": P(pl, None),
+        "wq": P(pl, fsdp, tp, None),
+        "wk": P(pl, fsdp, tp, None),
+        "wv": P(pl, fsdp, tp, None),
+        "wo": P(pl, tp, None, fsdp),
+        "mlp_norm": P(pl, None),
+    }
+    if cfg.n_experts > 0:
+        layers.update(
+            router=P(pl, fsdp, None),
+            w_gate=P(pl, "ep", fsdp, tp),
+            w_up=P(pl, "ep", fsdp, tp),
+            w_down=P(pl, "ep", tp, fsdp),
+        )
+    else:
+        layers.update(
+            w_gate=P(pl, fsdp, tp),
+            w_up=P(pl, fsdp, tp),
+            w_down=P(pl, tp, fsdp),
+        )
     return {
         "embed": P(None, "fsdp"),
-        "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, "fsdp", "tp", None),
-            "wk": P(None, "fsdp", "tp", None),
-            "wv": P(None, "fsdp", "tp", None),
-            "wo": P(None, "tp", None, "fsdp"),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, "fsdp", "tp"),
-            "w_up": P(None, "fsdp", "tp"),
-            "w_down": P(None, "tp", "fsdp"),
-        },
+        "layers": layers,
         "final_norm": P(None),
         "lm_head": P("fsdp", "tp"),
     }
@@ -121,58 +162,169 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.reshape(x.shape).astype(x.dtype)
 
 
+def _moe_mlp(
+    h: jax.Array, lp: Dict[str, Any], cfg: TransformerConfig, dtype, mesh=None
+):
+    """Switch (top-1) MoE with capacity-based dense dispatch; the expert axis
+    is ep-sharded so GSPMD turns the dispatch einsums into all_to_alls.
+    Returns (output, aux) where aux is the Switch load-balancing loss term
+    E * sum_e(frac_tokens_e * mean_prob_e) for this layer."""
+    b, t, d = h.shape
+    E = cfg.n_experts
+    capacity = max(1, int(math.ceil(t / E * cfg.expert_capacity_factor)))
+    logits = jnp.einsum("btd,de->bte", h, lp["router"].astype(dtype)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [B, T]
+    gate = jnp.take_along_axis(probs, expert_idx[..., None], axis=-1)[..., 0]
+    mask = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [B, T, E]
+    # Switch aux loss: pushes routing toward uniform expert load
+    aux = E * jnp.sum(jnp.mean(mask, axis=(0, 1)) * jnp.mean(probs, axis=(0, 1)))
+    # position of each token within its expert (per batch row), 0-based
+    pos = jnp.cumsum(mask, axis=1) * mask - 1.0
+    keep = (pos >= 0) & (pos < capacity)
+    dispatch = jax.nn.one_hot(
+        jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity, dtype=jnp.float32
+    ) * keep.astype(jnp.float32)[..., None]  # [B, T, E, C]
+    expert_in = jnp.einsum("btec,btd->ebcd", dispatch.astype(dtype), h)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        expert_in = lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P("ep", ("dp", "fsdp"), None, None))
+        )
+    g = jnp.einsum("ebcd,edf->ebcf", expert_in, lp["w_gate"].astype(dtype))
+    u = jnp.einsum("ebcd,edf->ebcf", expert_in, lp["w_up"].astype(dtype))
+    expert_out = jnp.einsum(
+        "ebcf,efd->ebcd", jax.nn.silu(g) * u, lp["w_down"].astype(dtype)
+    )
+    combine = dispatch * gate[..., None, None]  # weight by the router prob
+    out = jnp.einsum("btec,ebcd->btd", combine.astype(dtype), expert_out)
+    return out, aux
+
+
+def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh):
+    """One transformer block; lp leaves have no leading layer axis.
+    Returns (x, aux) — aux is the layer's MoE load-balancing loss (0 for
+    dense layers)."""
+    dtype = cfg.dtype
+    h = _rms_norm(x, lp["attn_norm"])
+    q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
+    k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
+    v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if cfg.attn_impl in ("ring", "ulysses"):
+        attn = attn_fn(q, k, v, mesh, causal=True)
+    else:
+        attn = attn_fn(q, k, v, causal=True)
+    x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
+    h = _rms_norm(x, lp["mlp_norm"])
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.n_experts > 0:
+        moe_out, aux = _moe_mlp(h, lp, cfg, dtype, mesh)
+        x = x + moe_out
+    else:
+        gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))
+        up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
+        x = x + jnp.einsum(
+            "btf,fd->btd", jax.nn.silu(gate) * up, lp["w_down"].astype(dtype)
+        )
+    return x, aux
+
+
+def _resolve_attn_fn(cfg: TransformerConfig):
+    if cfg.attn_impl == "flash":
+        from hivedscheduler_tpu.ops.attention import flash_attention as attn_fn
+    elif cfg.attn_impl in ("ring", "ulysses"):
+        from hivedscheduler_tpu.parallel import ring_attention as ra
+
+        attn_fn = ra.ring_attention if cfg.attn_impl == "ring" else ra.ulysses_attention
+    else:
+        from hivedscheduler_tpu.ops.attention import xla_attention as attn_fn
+    return attn_fn
+
+
+def forward_with_aux(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh=None,
+):
+    """tokens [B, T] int32 -> (logits [B, T, vocab] f32, moe_aux_loss f32).
+
+    ``mesh`` is required for ring/ulysses attention and for pipelining."""
+    dtype = cfg.dtype
+    b, t = tokens.shape
+    x = params["embed"].astype(dtype)[tokens]  # [B, T, D]
+    # [1, T] broadcasts against any (micro)batch size, incl. pipeline stages
+    positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+    attn_fn = _resolve_attn_fn(cfg)
+    if cfg.attn_impl in ("ring", "ulysses") or cfg.pipeline_microbatches > 0:
+        assert mesh is not None, f"{cfg.attn_impl}/pipeline requires a mesh"
+
+    def layer(x, lp):
+        return _apply_layer(x, lp, positions, cfg, attn_fn, mesh)
+
+    aux_total = jnp.zeros((), jnp.float32)
+    if cfg.pipeline_microbatches > 0:
+        assert cfg.attn_impl in ("xla", "flash"), (
+            "pipelined stages need local attention (tp/sp collectives inside "
+            "a pipeline stage are not supported yet)"
+        )
+        assert cfg.n_experts == 0, (
+            "MoE inside a pipeline stage is not supported yet (ep dispatch "
+            "needs GSPMD, pipeline stages run in manual shard_map mode)"
+        )
+        if mesh is not None:
+            shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+            if shape.get("tp", 1) > 1 or shape.get("sp", 1) > 1:
+                raise ValueError(
+                    "pipeline_microbatches > 0 requires mesh tp == sp == 1 "
+                    f"(got tp={shape.get('tp')}, sp={shape.get('sp')}); "
+                    "tensor/sequence collectives inside pipeline stages are "
+                    "not supported yet"
+                )
+        from hivedscheduler_tpu.parallel.pipeline import pipeline_apply
+
+        layer_specs = sharding_specs(cfg)["layers"]
+
+        def stage_block(stage_params, h):
+            hh, _ = lax.scan(
+                jax.checkpoint(lambda xx, lp: (layer(xx, lp)[0], None)),
+                h,
+                stage_params,
+            )
+            return hh
+
+        x = pipeline_apply(
+            stage_block,
+            params["layers"],
+            layer_specs,
+            x,
+            mesh,
+            n_micro=cfg.pipeline_microbatches,
+        )
+    else:
+        # rematerialize per-layer activations in the backward pass: HBM is
+        # O(1) layers instead of O(n_layers) — the long-context trade
+        def scan_body(carry, lp):
+            x, aux = carry
+            x, layer_aux = layer(x, lp)
+            return (x, aux + layer_aux), None
+
+        (x, aux_total), _ = lax.scan(
+            jax.checkpoint(scan_body), (x, aux_total), params["layers"]
+        )
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
+    return logits.astype(jnp.float32), aux_total
+
+
 def forward(
     params: Dict[str, Any],
     tokens: jax.Array,
     cfg: TransformerConfig,
     mesh=None,
 ) -> jax.Array:
-    """tokens [B, T] int32 -> logits [B, T, vocab] (f32).
-
-    ``mesh`` is required for the ring/ulysses attention implementations (the
-    sequence axis lives on the mesh); the sharded T seen here is global.
-    """
-    dtype = cfg.dtype
-    b, t = tokens.shape
-    x = params["embed"].astype(dtype)[tokens]  # [B, T, D]
-    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
-
-    if cfg.attn_impl == "flash":
-        from hivedscheduler_tpu.ops.attention import flash_attention as attn_fn
-    elif cfg.attn_impl in ("ring", "ulysses"):
-        from hivedscheduler_tpu.parallel import ring_attention as ra
-
-        assert mesh is not None, "ring/ulysses attention requires a mesh"
-        attn_fn = (
-            ra.ring_attention if cfg.attn_impl == "ring" else ra.ulysses_attention
-        )
-    else:
-        from hivedscheduler_tpu.ops.attention import xla_attention as attn_fn
-
-    def layer(x, lp):
-        h = _rms_norm(x, lp["attn_norm"])
-        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
-        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
-        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-        if cfg.attn_impl in ("ring", "ulysses"):
-            attn = attn_fn(q, k, v, mesh, causal=True)
-        else:
-            attn = attn_fn(q, k, v, causal=True)
-        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
-        h = _rms_norm(x, lp["mlp_norm"])
-        gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))
-        up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
-        x = x + jnp.einsum(
-            "btf,fd->btd", jax.nn.silu(gate) * up, lp["w_down"].astype(dtype)
-        )
-        return x, None
-
-    # rematerialize per-layer activations in the backward pass: HBM for the
-    # whole stack is O(1) layers instead of O(n_layers), the standard trade
-    # for long-context training
-    x, _ = lax.scan(jax.checkpoint(layer), x, params["layers"])
-    x = _rms_norm(x, params["final_norm"])
-    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
-    return logits.astype(jnp.float32)
+    """tokens [B, T] int32 -> logits [B, T, vocab] (f32)."""
+    return forward_with_aux(params, tokens, cfg, mesh)[0]

@@ -21,29 +21,34 @@ from hivedscheduler_tpu.api.constants import ENV_TPU_VISIBLE_CHIPS
 
 @dataclass(frozen=True)
 class MeshAxes:
-    """Logical parallelism axes: data, fully-sharded-data, tensor, sequence.
+    """Logical parallelism axes: data, fully-sharded-data, pipeline, expert,
+    tensor, sequence.
 
     Sizes must multiply to the device count. ``sp`` (sequence/context
     parallelism) is first-class: long-context workloads shard the sequence
-    dimension and run ring attention over this axis.
+    dimension and run ring attention over this axis. ``pp`` shards
+    transformer layers into pipeline stages (``parallel/pipeline.py``);
+    ``ep`` shards MoE experts (``models/transformer.py``).
     """
 
     dp: int = 1
     fsdp: int = 1
+    pp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 
     @property
     def names(self) -> Tuple[str, ...]:
-        return ("dp", "fsdp", "tp", "sp")
+        return ("dp", "fsdp", "pp", "ep", "tp", "sp")
 
     @property
-    def shape(self) -> Tuple[int, int, int, int]:
-        return (self.dp, self.fsdp, self.tp, self.sp)
+    def shape(self) -> Tuple[int, ...]:
+        return (self.dp, self.fsdp, self.pp, self.ep, self.tp, self.sp)
 
     @property
     def size(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp
+        return self.dp * self.fsdp * self.pp * self.ep * self.tp * self.sp
 
 
 def visible_chip_indices() -> Optional[List[int]]:
@@ -121,9 +126,13 @@ def mesh_from_slice(
     return make_mesh(axes, devices)
 
 
-def infer_axes(n_devices: int, tp: int = 1, sp: int = 1, fsdp: int = 1) -> MeshAxes:
+def infer_axes(
+    n_devices: int, tp: int = 1, sp: int = 1, fsdp: int = 1, pp: int = 1, ep: int = 1
+) -> MeshAxes:
     """Fill the dp axis with whatever is left over."""
-    rest = tp * sp * fsdp
+    rest = tp * sp * fsdp * pp * ep
     if n_devices % rest != 0:
-        raise ValueError(f"{n_devices} devices not divisible by tp*sp*fsdp={rest}")
-    return MeshAxes(dp=n_devices // rest, fsdp=fsdp, tp=tp, sp=sp)
+        raise ValueError(
+            f"{n_devices} devices not divisible by tp*sp*fsdp*pp*ep={rest}"
+        )
+    return MeshAxes(dp=n_devices // rest, fsdp=fsdp, pp=pp, ep=ep, tp=tp, sp=sp)

@@ -26,14 +26,18 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01) -> optax.Gradie
 
 
 def loss_fn(params, tokens, cfg: tm.TransformerConfig, mesh=None) -> jax.Array:
-    """Next-token LM loss: predict tokens[:, 1:] from tokens[:, :-1] with a
-    full-length forward (keeps sequence sharding uniform)."""
-    logits = tm.forward(params, tokens, cfg, mesh=mesh)  # [B, T, V] f32
+    """Next-token LM loss (+ Switch load-balancing aux for MoE models):
+    predict tokens[:, 1:] from tokens[:, :-1] with a full-length forward
+    (keeps sequence sharding uniform)."""
+    logits, moe_aux = tm.forward_with_aux(params, tokens, cfg, mesh=mesh)
     targets = jnp.roll(tokens, -1, axis=1)
     per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
     # the rolled-in last position is not a real target
     mask = jnp.ones_like(per_tok).at[:, -1].set(0.0)
-    return jnp.sum(per_tok * mask) / jnp.sum(mask)
+    loss = jnp.sum(per_tok * mask) / jnp.sum(mask)
+    if cfg.n_experts > 0:
+        loss = loss + cfg.moe_aux_weight * moe_aux
+    return loss
 
 
 def train_step(params, opt_state, tokens, cfg: tm.TransformerConfig, optimizer, mesh=None):

@@ -96,8 +96,8 @@ class TestTopology:
         axes = topology.MeshAxes(dp=2, tp=2, sp=2)
         assert axes.size == 8
         mesh = cpu_mesh(axes)
-        assert mesh.axis_names == ("dp", "fsdp", "tp", "sp")
-        assert mesh.devices.shape == (2, 1, 2, 2)
+        assert mesh.axis_names == ("dp", "fsdp", "pp", "ep", "tp", "sp")
+        assert mesh.devices.shape == (2, 1, 1, 1, 2, 2)
 
     def test_mesh_from_slice(self):
         # a scheduler-allocated v5p 4x4x2 cell (32 chips) -> too big for tests,
