@@ -1,14 +1,23 @@
 """In-memory fake Kubernetes ApiServer with watch support.
 
 Used by tests and e2e harnesses; exceeds the reference's test strategy, which
-has no automated integration tests (SURVEY.md §4). Thread-safe; events are
-delivered synchronously on the mutating thread (like a zero-latency informer).
+has no automated integration tests (SURVEY.md §4). Thread-safe with informer
+semantics:
+
+- the object store lock is a LEAF lock, never held while handlers run, so
+  handler code may hold the scheduler lock or read back into the store
+  without lock-order inversions;
+- events for one object are delivered in store-mutation order even when
+  multiple threads mutate the same object (e.g. the force-bind executor
+  racing a pod delete): each mutation enqueues its events under the store
+  lock, and exactly one thread at a time drains a given object's queue.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Callable, Dict, List, Optional
 
 from hivedscheduler_tpu.k8s.client import KubeClient
 from hivedscheduler_tpu.k8s.types import Binding, Node, Pod
@@ -21,6 +30,42 @@ class FakeKubeClient(KubeClient):
         self._pods: Dict[str, Pod] = {}  # key: namespace/name
         self._node_handlers = []
         self._pod_handlers = []
+        # per-object event queues + the set of keys currently being drained
+        self._queues: Dict[str, deque] = {}
+        self._draining: set = set()
+
+    # --- ordered delivery --------------------------------------------------
+    def _emit(self, key: str, handlers: List, slot: int, *objs) -> None:
+        """Must be called with self._lock held: enqueue one event per handler
+        (events of one key keep store-mutation order), then drain outside the
+        lock unless another thread already drains this key."""
+        q = self._queues.setdefault(key, deque())
+        for handler_tuple in handlers:
+            fire = handler_tuple[slot]
+            copies = tuple(o.deep_copy() for o in objs)
+            q.append((fire, copies))
+        if key in self._draining:
+            return  # the current drainer will deliver our events
+        self._draining.add(key)
+        self._lock.release()
+        try:
+            while True:
+                with self._lock:
+                    if not q:
+                        self._draining.discard(key)
+                        return
+                    fire, copies = q.popleft()
+                try:
+                    fire(*copies)
+                except Exception:
+                    # release drainership (remaining events stay queued, in
+                    # order, for the next mutator of this key) and surface
+                    # the handler failure
+                    with self._lock:
+                        self._draining.discard(key)
+                    raise
+        finally:
+            self._lock.acquire()  # restore caller's lock balance
 
     # --- informer registration ------------------------------------------
     def on_node_event(self, add, update, delete) -> None:
@@ -32,11 +77,9 @@ class FakeKubeClient(KubeClient):
     def sync(self) -> None:
         with self._lock:
             for node in list(self._nodes.values()):
-                for add, _, _ in self._node_handlers:
-                    add(node.deep_copy())
+                self._emit(f"node/{node.name}", self._node_handlers, 0, node)
             for pod in list(self._pods.values()):
-                for add, _, _ in self._pod_handlers:
-                    add(pod.deep_copy())
+                self._emit(f"pod/{pod.key}", self._pod_handlers, 0, pod)
 
     # --- reads ------------------------------------------------------------
     def get_node(self, name: str) -> Optional[Node]:
@@ -60,56 +103,45 @@ class FakeKubeClient(KubeClient):
     # --- cluster mutation (the "kubectl" surface) -------------------------
     def create_node(self, node: Node) -> None:
         with self._lock:
-            self._nodes[node.name] = node.deep_copy()
-            for add, _, _ in self._node_handlers:
-                add(node.deep_copy())
-
-    def update_node(self, node: Node) -> None:
-        with self._lock:
             old = self._nodes.get(node.name)
             self._nodes[node.name] = node.deep_copy()
             if old is None:
-                for add, _, _ in self._node_handlers:
-                    add(node.deep_copy())
+                self._emit(f"node/{node.name}", self._node_handlers, 0, node)
             else:
-                for _, update, _ in self._node_handlers:
-                    update(old.deep_copy(), node.deep_copy())
+                self._emit(f"node/{node.name}", self._node_handlers, 1, old, node)
+
+    def update_node(self, node: Node) -> None:
+        self.create_node(node)
 
     def delete_node(self, name: str) -> None:
         with self._lock:
             node = self._nodes.pop(name, None)
             if node is not None:
-                for _, _, delete in self._node_handlers:
-                    delete(node.deep_copy())
+                self._emit(f"node/{name}", self._node_handlers, 2, node)
 
     def create_pod(self, pod: Pod) -> None:
-        with self._lock:
-            self._pods[pod.key] = pod.deep_copy()
-            for add, _, _ in self._pod_handlers:
-                add(pod.deep_copy())
-
-    def update_pod(self, pod: Pod) -> None:
         with self._lock:
             old = self._pods.get(pod.key)
             self._pods[pod.key] = pod.deep_copy()
             if old is None:
-                for add, _, _ in self._pod_handlers:
-                    add(pod.deep_copy())
+                self._emit(f"pod/{pod.key}", self._pod_handlers, 0, pod)
             else:
-                for _, update, _ in self._pod_handlers:
-                    update(old.deep_copy(), pod.deep_copy())
+                self._emit(f"pod/{pod.key}", self._pod_handlers, 1, old, pod)
+
+    def update_pod(self, pod: Pod) -> None:
+        self.create_pod(pod)
 
     def delete_pod(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
         with self._lock:
-            pod = self._pods.pop(f"{namespace}/{name}", None)
+            pod = self._pods.pop(key, None)
             if pod is not None:
-                for _, _, delete in self._pod_handlers:
-                    delete(pod.deep_copy())
+                self._emit(f"pod/{key}", self._pod_handlers, 2, pod)
 
     # --- writes -----------------------------------------------------------
     def bind_pod(self, binding: Binding) -> None:
+        key = f"{binding.pod_namespace}/{binding.pod_name}"
         with self._lock:
-            key = f"{binding.pod_namespace}/{binding.pod_name}"
             pod = self._pods.get(key)
             if pod is None:
                 raise KeyError(f"pod {key} not found")
@@ -118,5 +150,4 @@ class FakeKubeClient(KubeClient):
             old = pod.deep_copy()
             pod.node_name = binding.node
             pod.annotations.update(binding.annotations)
-            for _, update, _ in self._pod_handlers:
-                update(old, pod.deep_copy())
+            self._emit(f"pod/{key}", self._pod_handlers, 1, old, pod)

@@ -72,8 +72,27 @@ def make_sharded_train_step(
             functools.partial(tm.init_params, cfg), out_shardings=param_shardings
         )
         params = init(key)
-        # adam moments mirror param shapes; jit propagates the param shardings
-        opt_state = jax.jit(optimizer.init)(params)
+        # adam moments (mu/nu) are pytrees with exactly the params' structure:
+        # substitute the param shardings for those subtrees, replicate the
+        # rest (step counters). Explicit out_shardings because jit's own
+        # inference can drop to single-device when all specs are effectively
+        # replicated.
+        params_treedef = jax.tree.structure(params)
+        replicated = NamedSharding(mesh, P())
+
+        def is_param_subtree(node):
+            try:
+                return jax.tree.structure(node) == params_treedef
+            except Exception:
+                return False
+
+        opt_shapes = jax.eval_shape(optimizer.init, params)
+        opt_shardings = jax.tree.map(
+            lambda node: param_shardings if is_param_subtree(node) else replicated,
+            opt_shapes,
+            is_leaf=is_param_subtree,
+        )
+        opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(params)
         return params, opt_state
 
     def step(params, opt_state, tokens):
