@@ -49,11 +49,15 @@ def main(argv=None) -> int:
 
     if args.fake_cluster:
         kube_client = FakeKubeClient()
+    elif config.kube_api_server_address:
+        from hivedscheduler_tpu.k8s.rest import RestKubeClient
+
+        kube_client = RestKubeClient(config.kube_api_server_address)
+        log.info("Using Kubernetes ApiServer at %s", config.kube_api_server_address)
     else:
         log.error(
-            "No real Kubernetes client configured in this build; "
-            "run with --fake-cluster, or embed HivedScheduler with your own "
-            "KubeClient implementation (hivedscheduler_tpu.k8s.client.KubeClient)."
+            "No Kubernetes ApiServer configured: set kubeApiServerAddress in the "
+            "config (insecure port or kubectl proxy), or run with --fake-cluster."
         )
         return 1
 

@@ -75,11 +75,22 @@ class FakeKubeClient(KubeClient):
         self._pod_handlers.append((add, update, delete))
 
     def sync(self) -> None:
+        # re-read each object at emission time (one critical section per key):
+        # a concurrent delete between snapshot and emission must not let a
+        # stale add resurrect the object
         with self._lock:
-            for node in list(self._nodes.values()):
-                self._emit(f"node/{node.name}", self._node_handlers, 0, node)
-            for pod in list(self._pods.values()):
-                self._emit(f"pod/{pod.key}", self._pod_handlers, 0, pod)
+            node_names = list(self._nodes)
+            pod_keys = list(self._pods)
+        for name in node_names:
+            with self._lock:
+                node = self._nodes.get(name)
+                if node is not None:
+                    self._emit(f"node/{name}", self._node_handlers, 0, node)
+        for key in pod_keys:
+            with self._lock:
+                pod = self._pods.get(key)
+                if pod is not None:
+                    self._emit(f"pod/{key}", self._pod_handlers, 0, pod)
 
     # --- reads ------------------------------------------------------------
     def get_node(self, name: str) -> Optional[Node]:

@@ -1,0 +1,220 @@
+"""REST Kubernetes client: list/watch + bind over the K8s HTTP API.
+
+Stdlib-only implementation of the ``KubeClient`` interface against an
+ApiServer address (insecure port or ``kubectl proxy``), mirroring what the
+reference gets from client-go (reference: ``pkg/api/config.go:39-60`` for the
+address contract, ``internal/utils.go:291-314`` for Bind):
+
+- ``sync()`` lists nodes+pods (delivering adds) and then starts streaming
+  watches from the returned resourceVersions;
+- watches reconnect on EOF with the last seen resourceVersion; a 410 Gone
+  falls back to a fresh list+watch;
+- ``bind_pod`` POSTs the Bind subresource with the scheduler's annotations in
+  ``binding.metadata.annotations`` — the ApiServer merges them onto the pod,
+  which is exactly how the placement record becomes durable.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+from hivedscheduler_tpu.k8s import serde
+from hivedscheduler_tpu.k8s.client import KubeClient
+from hivedscheduler_tpu.k8s.types import Binding, Node, Pod
+
+log = logging.getLogger(__name__)
+
+
+class RestKubeClient(KubeClient):
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._node_handlers = []
+        self._pod_handlers = []
+        self._stop = threading.Event()
+        self._watch_threads: List[threading.Thread] = []
+
+    # --- HTTP helpers -----------------------------------------------------
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            raw = resp.read()
+            return json.loads(raw) if raw else None
+
+    # --- informer registration --------------------------------------------
+    def on_node_event(self, add, update, delete) -> None:
+        self._node_handlers.append((add, update, delete))
+
+    def on_pod_event(self, add, update, delete) -> None:
+        self._pod_handlers.append((add, update, delete))
+
+    def sync(self) -> None:
+        """List (replay as adds) then watch — the recovery barrier. Like
+        client-go informers, a local object cache per resource supplies the
+        real old objects on MODIFIED events and synthesizes deletes when a
+        410-Gone relist finds objects vanished during a watch gap."""
+        node_cache: dict = {}
+        pod_cache: dict = {}
+        node_rv = self._list_and_diff(
+            "/api/v1/nodes", serde.node_from_k8s, self._node_handlers,
+            lambda n: n.name, node_cache,
+        )
+        pod_rv = self._list_and_diff(
+            "/api/v1/pods", serde.pod_from_k8s, self._pod_handlers,
+            lambda p: p.key, pod_cache,
+        )
+        self._watch_threads = [
+            threading.Thread(
+                target=self._watch_loop,
+                args=("/api/v1/nodes", serde.node_from_k8s, self._node_handlers,
+                      lambda n: n.name, node_cache, node_rv),
+                name="watch-nodes", daemon=True,
+            ),
+            threading.Thread(
+                target=self._watch_loop,
+                args=("/api/v1/pods", serde.pod_from_k8s, self._pod_handlers,
+                      lambda p: p.key, pod_cache, pod_rv),
+                name="watch-pods", daemon=True,
+            ),
+        ]
+        for t in self._watch_threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _list_and_diff(self, path: str, parse, handlers, key_fn, cache: dict) -> str:
+        """List and reconcile against the cache: adds for new objects,
+        updates for known ones, deletes for vanished ones."""
+        body = self._request("GET", path) or {}
+        new = {}
+        for item in body.get("items") or []:
+            obj = parse(item)
+            new[key_fn(obj)] = obj
+        for k in list(cache):
+            if k not in new:
+                old = cache.pop(k)
+                for _, _, delete in handlers:
+                    delete(old)
+        for k, obj in new.items():
+            old = cache.get(k)
+            cache[k] = obj
+            if old is None:
+                for add, _, _ in handlers:
+                    add(obj)
+            else:
+                for _, update, _ in handlers:
+                    update(old, obj)
+        return (body.get("metadata") or {}).get("resourceVersion", "")
+
+    def _watch_loop(
+        self, path: str, parse, handlers, key_fn, cache: dict, resource_version: str
+    ) -> None:
+        rv = resource_version
+        while not self._stop.is_set():
+            url = f"{self.base_url}{path}?watch=true"
+            if rv:
+                url += f"&resourceVersion={rv}"
+            try:
+                req = urllib.request.Request(url)
+                with urllib.request.urlopen(req, timeout=None) as resp:
+                    for line in resp:
+                        if self._stop.is_set():
+                            return
+                        if not line.strip():
+                            continue
+                        event = json.loads(line)
+                        etype = event.get("type")
+                        raw_obj = event.get("object") or {}
+                        rv = (raw_obj.get("metadata") or {}).get("resourceVersion", rv)
+                        if etype == "ERROR":
+                            code = (raw_obj.get("code") or 0)
+                            log.warning("watch %s error event: %s", path, raw_obj)
+                            if code == 410:  # Gone: relist + reconcile
+                                rv = self._list_and_diff(
+                                    path, parse, handlers, key_fn, cache
+                                )
+                            continue
+                        obj = parse(raw_obj)
+                        k = key_fn(obj)
+                        old = cache.get(k)
+                        if etype == "ADDED":
+                            cache[k] = obj
+                            if old is None:
+                                for add, _, _ in handlers:
+                                    add(obj)
+                            else:  # replayed add after resume
+                                for _, update, _ in handlers:
+                                    update(old, obj)
+                        elif etype == "MODIFIED":
+                            cache[k] = obj
+                            for _, update, _ in handlers:
+                                update(old if old is not None else obj, obj)
+                        elif etype == "DELETED":
+                            cache.pop(k, None)
+                            for _, _, delete in handlers:
+                                delete(obj)
+            except Exception as e:
+                if self._stop.is_set():
+                    return
+                log.warning("watch %s disconnected (%s); reconnecting", path, e)
+                self._stop.wait(1.0)
+
+    # --- reads ------------------------------------------------------------
+    def get_node(self, name: str) -> Optional[Node]:
+        try:
+            return serde.node_from_k8s(self._request("GET", f"/api/v1/nodes/{name}"))
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def list_nodes(self) -> List[Node]:
+        body = self._request("GET", "/api/v1/nodes") or {}
+        return [serde.node_from_k8s(i) for i in body.get("items") or []]
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        try:
+            return serde.pod_from_k8s(
+                self._request("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def list_pods(self) -> List[Pod]:
+        body = self._request("GET", "/api/v1/pods") or {}
+        return [serde.pod_from_k8s(i) for i in body.get("items") or []]
+
+    # --- writes -----------------------------------------------------------
+    def bind_pod(self, binding: Binding) -> None:
+        """POST the Bind subresource; annotations ride on binding metadata and
+        are merged onto the pod by the ApiServer (the durable placement
+        record)."""
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{binding.pod_namespace}/pods/{binding.pod_name}/binding",
+            {
+                "apiVersion": "v1",
+                "kind": "Binding",
+                "metadata": {
+                    "name": binding.pod_name,
+                    "namespace": binding.pod_namespace,
+                    "uid": binding.pod_uid,
+                    "annotations": dict(binding.annotations),
+                },
+                "target": {"apiVersion": "v1", "kind": "Node", "name": binding.node},
+            },
+        )

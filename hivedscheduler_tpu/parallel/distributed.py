@@ -1,0 +1,102 @@
+"""Multi-host runtime initialization for gangs scheduled by tpu-hive.
+
+Each pod of a gang runs on one TPU host; ``initialize_from_gang`` wires
+``jax.distributed`` so the hosts form one JAX process group and
+``jax.devices()`` spans the whole slice (collectives then ride ICI within the
+slice). The process topology comes from the scheduler's own bind records:
+the pod's bind-info annotation carries every member's node, so all hosts
+derive the same coordinator and a stable rank without any external
+coordination service.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional, Tuple
+
+from hivedscheduler_tpu.api import constants as api_constants
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.common import utils as common
+
+log = logging.getLogger(__name__)
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+def gang_process_info(
+    bind_info: api.PodBindInfo,
+    my_node: str,
+    my_chip_indices: Optional[List[int]] = None,
+) -> Tuple[str, int, int]:
+    """(coordinator_node, process_id, num_processes) for this pod's gang.
+
+    One rank per gang POD: member identity is (node, sorted chip indices),
+    so multiple pods sharing a host get distinct ranks. Ranks follow the
+    sorted member order; the coordinator is rank 0's node. Every member
+    computes the same answer from its own annotation. ``my_chip_indices``
+    (e.g. from TPU_VISIBLE_CHIPS) is required to disambiguate when several
+    gang pods run on ``my_node``."""
+    members: List[Tuple[str, tuple]] = []
+    for member in bind_info.affinity_group_bind_info:
+        for placement in member.pod_placements:
+            members.append(
+                (placement.physical_node, tuple(sorted(placement.physical_leaf_cell_indices)))
+            )
+    members = sorted(set(members))
+    if my_chip_indices is not None:
+        key = (my_node, tuple(sorted(my_chip_indices)))
+        if key not in members:
+            raise ValueError(f"pod {key} not part of the gang placement {members}")
+        process_id = members.index(key)
+    else:
+        candidates = [i for i, (n, _) in enumerate(members) if n == my_node]
+        if not candidates:
+            raise ValueError(f"node {my_node} not part of the gang placement {members}")
+        if len(candidates) > 1:
+            raise ValueError(
+                f"multiple gang pods on node {my_node}; pass my_chip_indices "
+                f"(TPU_VISIBLE_CHIPS) to disambiguate"
+            )
+        process_id = candidates[0]
+    return members[0][0], process_id, len(members)
+
+
+def initialize_from_gang(
+    bind_info_yaml: Optional[str] = None,
+    my_node: Optional[str] = None,
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT,
+    node_to_address=None,
+) -> Tuple[int, int]:
+    """Initialize jax.distributed from the pod's bind-info annotation.
+
+    Inside a scheduled pod, the annotation is exposed via the downward API as
+    ``POD_BIND_INFO`` (and the node name as ``NODE_NAME``); pass them
+    explicitly otherwise. ``node_to_address`` maps scheduler node names to
+    reachable host addresses (defaults to identity — node names are hostnames
+    on GKE). Returns (process_id, num_processes); single-host gangs skip
+    distributed init entirely."""
+    import jax
+
+    bind_info_yaml = bind_info_yaml or os.environ.get("POD_BIND_INFO", "")
+    my_node = my_node or os.environ.get("NODE_NAME", "")
+    if not bind_info_yaml or not my_node:
+        log.info("no gang bind info/node name: single-process run")
+        return 0, 1
+    bind_info = api.PodBindInfo.from_dict(common.from_yaml(bind_info_yaml))
+    from hivedscheduler_tpu.parallel.topology import visible_chip_indices
+
+    coordinator, process_id, num_processes = gang_process_info(
+        bind_info, my_node, my_chip_indices=visible_chip_indices()
+    )
+    if num_processes == 1:
+        return 0, 1
+    address = (node_to_address or (lambda n: n.split("/")[-1]))(coordinator)
+    jax.distributed.initialize(
+        coordinator_address=f"{address}:{coordinator_port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info("jax.distributed initialized: rank %s/%s, coordinator %s",
+             process_id, num_processes, address)
+    return process_id, num_processes

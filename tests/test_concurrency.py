@@ -96,8 +96,10 @@ def test_concurrent_schedule_bind_delete_and_node_events():
             scheduler.get_cluster_status()
             scheduler.get_all_affinity_groups()
 
-    threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
-    threads.append(threading.Thread(target=chaos))
+    # daemon threads: if the deadlock this test hunts for ever comes back,
+    # pytest must be able to report the failure and exit
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True) for t in range(4)]
+    threads.append(threading.Thread(target=chaos, daemon=True))
     for t in threads:
         t.start()
     for t in threads:

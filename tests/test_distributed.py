@@ -1,0 +1,61 @@
+"""Multi-host gang process-topology derivation (jax.distributed wiring)."""
+
+import pytest
+
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.parallel.distributed import gang_process_info, initialize_from_gang
+
+
+def gang_bind_info(nodes):
+    return api.PodBindInfo(
+        node=nodes[0],
+        cell_chain="v5p-64",
+        affinity_group_bind_info=[
+            api.AffinityGroupMemberBindInfo(pod_placements=[
+                api.PodPlacementInfo(physical_node=n, physical_leaf_cell_indices=[0, 1, 2, 3])
+                for n in nodes
+            ])
+        ],
+    )
+
+
+def test_ranks_are_consistent_across_members():
+    nodes = ["pod0/2-0-0", "pod0/0-0-0", "pod0/0-2-0", "pod0/2-2-0"]
+    bi = gang_bind_info(nodes)
+    infos = {n: gang_process_info(bi, n) for n in nodes}
+    coordinators = {c for c, _, _ in infos.values()}
+    assert coordinators == {"pod0/0-0-0"}  # rank 0 = lexicographically first
+    assert sorted(pid for _, pid, _ in infos.values()) == [0, 1, 2, 3]
+    assert all(num == 4 for _, _, num in infos.values())
+
+
+def test_unknown_node_rejected():
+    bi = gang_bind_info(["pod0/0-0-0"])
+    with pytest.raises(ValueError):
+        gang_process_info(bi, "ghost")
+
+
+def test_multiple_pods_per_node_need_chip_indices():
+    # two gang pods share one host: distinct chip grants, distinct ranks
+    bi = api.PodBindInfo(
+        node="h0", cell_chain="v5e-8",
+        affinity_group_bind_info=[
+            api.AffinityGroupMemberBindInfo(pod_placements=[
+                api.PodPlacementInfo(physical_node="h0",
+                                     physical_leaf_cell_indices=[0, 1]),
+                api.PodPlacementInfo(physical_node="h0",
+                                     physical_leaf_cell_indices=[2, 3]),
+            ])
+        ],
+    )
+    with pytest.raises(ValueError, match="pass my_chip_indices"):
+        gang_process_info(bi, "h0")
+    c0, p0, n0 = gang_process_info(bi, "h0", my_chip_indices=[0, 1])
+    c1, p1, n1 = gang_process_info(bi, "h0", my_chip_indices=[3, 2])
+    assert (n0, n1) == (2, 2) and {p0, p1} == {0, 1} and c0 == c1 == "h0"
+
+
+def test_single_host_skips_distributed(monkeypatch):
+    monkeypatch.delenv("POD_BIND_INFO", raising=False)
+    monkeypatch.delenv("NODE_NAME", raising=False)
+    assert initialize_from_gang() == (0, 1)

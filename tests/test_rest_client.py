@@ -1,0 +1,255 @@
+"""REST KubeClient tests against a miniature in-process ApiServer that speaks
+the K8s list/watch/bind HTTP protocol (chunked watch streams, Bind
+subresource with annotation merge)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from queue import Queue
+
+import pytest
+
+from hivedscheduler_tpu.k8s.rest import RestKubeClient
+from hivedscheduler_tpu.k8s.types import Binding
+
+
+class MiniApiServer:
+    """Just enough of the K8s API: /api/v1/{nodes,pods} list+watch, pod GET,
+    and the pods/{name}/binding subresource."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.pods = {}  # key ns/name -> k8s dict
+        self.rv = 1
+        self.watchers = []  # queues of event dicts
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                watching = "watch=true" in query
+                if path == "/api/v1/nodes" and not watching:
+                    with outer.lock:
+                        items = list(outer.nodes.values())
+                        rv = str(outer.rv)
+                    self._json(200, {"items": items, "metadata": {"resourceVersion": rv}})
+                elif path == "/api/v1/pods" and not watching:
+                    with outer.lock:
+                        items = list(outer.pods.values())
+                        rv = str(outer.rv)
+                    self._json(200, {"items": items, "metadata": {"resourceVersion": rv}})
+                elif watching and path in ("/api/v1/nodes", "/api/v1/pods"):
+                    kind = "nodes" if path.endswith("nodes") else "pods"
+                    q = Queue()
+                    with outer.lock:
+                        outer.watchers.append((kind, q))
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    try:
+                        while True:
+                            event = q.get()
+                            if event is None:
+                                break
+                            line = (json.dumps(event) + "\n").encode()
+                            self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                            self.wfile.flush()
+                    except Exception:
+                        pass
+                elif path.startswith("/api/v1/nodes/"):
+                    with outer.lock:
+                        node = outer.nodes.get(path.split("/")[-1])
+                    if node is None:
+                        self._json(404, {"code": 404})
+                    else:
+                        self._json(200, node)
+                elif path.startswith("/api/v1/namespaces/") and path.count("/") == 6:
+                    ns, name = path.split("/")[4], path.split("/")[6]
+                    with outer.lock:
+                        pod = outer.pods.get(f"{ns}/{name}")
+                    if pod is None:
+                        self._json(404, {"code": 404})
+                    else:
+                        self._json(200, pod)
+                else:
+                    self._json(404, {"code": 404})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length)) if length else {}
+                parts = self.path.split("/")
+                if self.path.endswith("/binding"):
+                    ns, name = parts[4], parts[6]
+                    with outer.lock:
+                        pod = outer.pods.get(f"{ns}/{name}")
+                        if pod is None:
+                            return self._json(404, {"code": 404})
+                        pod.setdefault("spec", {})["nodeName"] = body["target"]["name"]
+                        pod.setdefault("metadata", {}).setdefault("annotations", {}).update(
+                            (body.get("metadata") or {}).get("annotations") or {}
+                        )
+                        outer.rv += 1
+                        pod["metadata"]["resourceVersion"] = str(outer.rv)
+                    outer.emit("pods", {"type": "MODIFIED", "object": pod})
+                    self._json(201, {"kind": "Status", "status": "Success"})
+                else:
+                    self._json(404, {"code": 404})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self.thread.start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def emit(self, kind, event):
+        with self.lock:
+            for k, q in self.watchers:
+                if k == kind:
+                    q.put(event)
+
+    def add_node(self, name):
+        with self.lock:
+            self.rv += 1
+            node = {
+                "metadata": {"name": name, "resourceVersion": str(self.rv)},
+                "spec": {},
+                "status": {"conditions": [{"type": "Ready", "status": "True"}]},
+            }
+            self.nodes[name] = node
+        self.emit("nodes", {"type": "ADDED", "object": node})
+
+    def add_pod(self, ns, name, annotations=None):
+        with self.lock:
+            self.rv += 1
+            pod = {
+                "metadata": {"name": name, "namespace": ns, "uid": name,
+                             "annotations": annotations or {},
+                             "resourceVersion": str(self.rv)},
+                "spec": {"containers": []},
+                "status": {"phase": "Pending"},
+            }
+            self.pods[f"{ns}/{name}"] = pod
+        self.emit("pods", {"type": "ADDED", "object": pod})
+
+    def close(self):
+        with self.lock:
+            for _, q in self.watchers:
+                q.put(None)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def apiserver():
+    s = MiniApiServer()
+    yield s
+    s.close()
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_full_scheduler_stack_over_rest(apiserver):
+    """The deployable configuration: HivedScheduler + webserver wired to a
+    (mini) ApiServer through the REST client — filter decides, bind commits
+    through the Bind subresource, the annotation lands on the pod."""
+    import os
+
+    from hivedscheduler_tpu.api import constants as C
+    from hivedscheduler_tpu.api.config import load_config
+    from hivedscheduler_tpu.common.utils import to_yaml
+    from hivedscheduler_tpu.runtime import extender as ei
+    from hivedscheduler_tpu.runtime.scheduler import HivedScheduler
+
+    fixture = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                           "example", "config", "design", "tpu-hive.yaml")
+    config = load_config(fixture)
+    client = RestKubeClient(apiserver.url)
+    scheduler = HivedScheduler(config, client)
+    for n in sorted({n for ccl in scheduler.scheduler_algorithm.full_cell_list.values()
+                     for c in ccl[max(ccl)] for n in c.nodes}):
+        apiserver.add_node(n)
+    spec = {"virtualCluster": "vc2", "priority": 0,
+            "chipType": "v5e-chip", "chipNumber": 8}
+    apiserver.add_pod("default", "job1", annotations={
+        C.ANNOTATION_POD_SCHEDULING_SPEC: to_yaml(spec)})
+    # make the pod hived-enabled (mini server stores raw dicts)
+    with apiserver.lock:
+        apiserver.pods["default/job1"]["spec"]["containers"] = [
+            {"name": "c", "resources": {"limits": {
+                C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1}}}]
+    scheduler.start()  # recovery barrier: lists nodes + pods over REST
+
+    pod = client.get_pod("default", "job1")
+    result = scheduler.filter_routine(ei.ExtenderArgs(
+        pod=pod, node_names=[n.name for n in client.list_nodes()]))
+    assert result.node_names == ["v5e-host0/0-0"]
+    scheduler.bind_routine(ei.ExtenderBindingArgs(
+        pod_name="job1", pod_namespace="default", pod_uid="job1",
+        node="v5e-host0/0-0"))
+    bound = client.get_pod("default", "job1")
+    assert bound.node_name == "v5e-host0/0-0"
+    assert bound.annotations[C.ANNOTATION_POD_CHIP_ISOLATION] == "0,1,2,3,4,5,6,7"
+    client.stop()
+
+
+def test_list_watch_and_bind(apiserver):
+    apiserver.add_node("n0")
+    apiserver.add_pod("default", "pre-existing")
+
+    client = RestKubeClient(apiserver.url)
+    seen = {"nodes": [], "pods": [], "updates": []}
+    client.on_node_event(
+        lambda n: seen["nodes"].append(n.name), lambda o, n: None, lambda n: None
+    )
+    client.on_pod_event(
+        lambda p: seen["pods"].append(p.key),
+        lambda o, p: seen["updates"].append(p.key),
+        lambda p: None,
+    )
+    client.sync()
+    # list replayed as adds (the recovery barrier)
+    assert seen["nodes"] == ["n0"] and seen["pods"] == ["default/pre-existing"]
+
+    # watch delivers later objects (wait for both watches to connect: the
+    # mini server has no resourceVersion replay, unlike a real ApiServer)
+    assert wait_for(lambda: len(apiserver.watchers) == 2)
+    apiserver.add_pod("default", "late")
+    assert wait_for(lambda: "default/late" in seen["pods"])
+
+    # reads
+    assert client.get_node("n0") is not None
+    assert client.get_node("ghost") is None
+    assert client.get_pod("default", "late").name == "late"
+    assert len(client.list_pods()) == 2
+
+    # bind: node + annotations merged onto the pod, MODIFIED event flows back
+    client.bind_pod(Binding(
+        pod_name="late", pod_namespace="default", pod_uid="late",
+        node="n0", annotations={"k": "v"},
+    ))
+    assert wait_for(lambda: "default/late" in seen["updates"])
+    bound = client.get_pod("default", "late")
+    assert bound.node_name == "n0" and bound.annotations["k"] == "v"
+    client.stop()
