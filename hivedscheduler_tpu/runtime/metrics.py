@@ -1,0 +1,106 @@
+"""Prometheus-format scheduler metrics.
+
+The reference exposes no metrics (klog only, SURVEY.md §5); tpu-hive adds a
+minimal dependency-free registry rendered in the Prometheus text exposition
+format at ``GET /metrics``: request counters and latency histograms per
+extender routine, bind/preemption/wait outcome counters, and a bad-node
+gauge.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+_LATENCY_BUCKETS = [0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0]
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        self._gauges: Dict[str, float] = {}
+        # name -> (bucket counts, sum, count)
+        self._histograms: Dict[str, Tuple[List[int], float, int]] = {}
+        self._help: Dict[str, str] = {}
+
+    def describe(self, name: str, help_text: str) -> None:
+        self._help[name] = help_text
+
+    def inc(self, name: str, amount: float = 1.0, **labels: str) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + amount
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            buckets, total, count = self._histograms.get(
+                name, ([0] * (len(_LATENCY_BUCKETS) + 1), 0.0, 0)
+            )
+            buckets = list(buckets)
+            for i, bound in enumerate(_LATENCY_BUCKETS):
+                if seconds <= bound:
+                    buckets[i] += 1
+                    break
+            else:
+                buckets[-1] += 1
+            self._histograms[name] = (buckets, total + seconds, count + 1)
+
+    @staticmethod
+    def _fmt(value: float) -> str:
+        """Full-precision sample rendering: %g quantizes above ~1e6, which
+        would flatline rate() on long-lived counters."""
+        if float(value).is_integer():
+            return str(int(value))
+        return repr(float(value))
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out: List[str] = []
+        with self._lock:
+            names = sorted({n for n, _ in self._counters})
+            for name in names:
+                if name in self._help:
+                    out.append(f"# HELP {name} {self._help[name]}")
+                out.append(f"# TYPE {name} counter")
+                for (n, labels), value in sorted(self._counters.items()):
+                    if n != name:
+                        continue
+                    label_str = (
+                        "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+                        if labels
+                        else ""
+                    )
+                    out.append(f"{name}{label_str} {self._fmt(value)}")
+            for name, value in sorted(self._gauges.items()):
+                if name in self._help:
+                    out.append(f"# HELP {name} {self._help[name]}")
+                out.append(f"# TYPE {name} gauge")
+                out.append(f"{name} {self._fmt(value)}")
+            for name, (buckets, total, count) in sorted(self._histograms.items()):
+                if name in self._help:
+                    out.append(f"# HELP {name} {self._help[name]}")
+                out.append(f"# TYPE {name} histogram")
+                cumulative = 0
+                for i, bound in enumerate(_LATENCY_BUCKETS):
+                    cumulative += buckets[i]
+                    out.append(f'{name}_bucket{{le="{bound}"}} {cumulative}')
+                cumulative += buckets[-1]
+                out.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
+                out.append(f"{name}_sum {self._fmt(total)}")
+                out.append(f"{name}_count {count}")
+        return "\n".join(out) + "\n"
+
+
+REGISTRY = Registry()
+REGISTRY.describe("tpu_hive_extender_requests_total",
+                  "Extender requests by routine and outcome")
+REGISTRY.describe("tpu_hive_binds_total", "Bind subresource commits")
+REGISTRY.describe("tpu_hive_force_binds_total", "Force-bind escalations")
+REGISTRY.describe("tpu_hive_bad_nodes", "Nodes currently considered bad")
+REGISTRY.describe("tpu_hive_filter_latency_seconds", "filterRoutine latency")
+REGISTRY.describe("tpu_hive_preempt_latency_seconds", "preemptRoutine latency")

@@ -14,6 +14,9 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from hivedscheduler_tpu.api.constants import COMPONENT_NAME as _COMPONENT
+from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
+
 from hivedscheduler_tpu.api import config as api_config
 from hivedscheduler_tpu.api import types as api
 from hivedscheduler_tpu.algorithm.hived import HivedAlgorithm
@@ -51,6 +54,8 @@ class HivedScheduler:
 
         kube_client.on_node_event(self._add_node, self._update_node, self._delete_node)
         kube_client.on_pod_event(self._add_pod, self._update_pod, self._delete_pod)
+        # all nodes start bad until informed: publish that state immediately
+        self._update_bad_node_gauge()
 
     def start(self) -> None:
         """Sync current cluster state through the handlers — the crash-recovery
@@ -67,12 +72,20 @@ class HivedScheduler:
 
     def _add_node(self, node: Node) -> None:
         self.scheduler_algorithm.add_node(node)
+        self._update_bad_node_gauge()
 
     def _update_node(self, old_node: Node, new_node: Node) -> None:
         self.scheduler_algorithm.update_node(old_node, new_node)
+        self._update_bad_node_gauge()
 
     def _delete_node(self, node: Node) -> None:
         self.scheduler_algorithm.delete_node(node)
+        self._update_bad_node_gauge()
+
+    def _update_bad_node_gauge(self) -> None:
+        bad = getattr(self.scheduler_algorithm, "bad_nodes", None)
+        if bad is not None:
+            metrics.set_gauge("tpu_hive_bad_nodes", len(bad))
 
     def _add_pod(self, pod: Pod) -> None:
         """Reference: addPod, scheduler.go:253-260."""
@@ -205,8 +218,9 @@ class HivedScheduler:
         """Bypass the default scheduler and trigger bindRoutine directly
         (reference: forceBindExecutor, scheduler.go:471-483)."""
         log.info("[%s]: forceBindExecutor: Started", internal_utils.key(binding_pod))
+        metrics.inc("tpu_hive_force_binds_total")
         try:
-            self.bind_routine(
+            self._bind_routine(
                 ei.ExtenderBindingArgs(
                     pod_name=binding_pod.name,
                     pod_namespace=binding_pod.namespace,
@@ -224,6 +238,23 @@ class HivedScheduler:
 
     def filter_routine(self, args: ei.ExtenderArgs) -> ei.ExtenderFilterResult:
         """Reference: filterRoutine, scheduler.go:485-587."""
+        t0 = time.perf_counter()
+        try:
+            result, outcome = self._filter_routine(args)
+            metrics.inc("tpu_hive_extender_requests_total",
+                        routine="filter", outcome=outcome)
+            return result
+        except Exception:
+            metrics.inc("tpu_hive_extender_requests_total",
+                        routine="filter", outcome="error")
+            raise
+        finally:
+            metrics.observe("tpu_hive_filter_latency_seconds",
+                            time.perf_counter() - t0)
+
+    def _filter_routine(self, args: ei.ExtenderArgs):
+        """Returns (result, metric outcome); each return site knows its own
+        outcome exactly."""
         with self.scheduler_lock:
             pod = args.pod
             suggested_nodes = args.node_names
@@ -241,7 +272,10 @@ class HivedScheduler:
                     threading.Thread(
                         target=self._force_bind_executor, args=(binding_pod,), daemon=True
                     ).start()
-                return ei.ExtenderFilterResult(node_names=[binding_pod.node_name])
+                return (
+                    ei.ExtenderFilterResult(node_names=[binding_pod.node_name]),
+                    "bind",
+                )
 
             # pod state is Waiting or Preempting: run a new scheduling
             result = self.scheduler_algorithm.schedule(
@@ -264,7 +298,10 @@ class HivedScheduler:
                     ).start()
                 log.info("[%s]: Pod is binding to %s",
                          internal_utils.key(pod), binding_pod.node_name)
-                return ei.ExtenderFilterResult(node_names=[binding_pod.node_name])
+                return (
+                    ei.ExtenderFilterResult(node_names=[binding_pod.node_name]),
+                    "bind",
+                )
             if result.pod_preempt_info is not None:
                 # FailedNodes tell the default scheduler preemption may help
                 failed_nodes: Dict[str, str] = {}
@@ -277,7 +314,10 @@ class HivedScheduler:
                     else:
                         failed_nodes[node] += ", " + internal_utils.key(victim)
                 log.info("[%s]: Pod is waiting for preemptRoutine", internal_utils.key(pod))
-                return ei.ExtenderFilterResult(failed_nodes=failed_nodes)
+                return (
+                    ei.ExtenderFilterResult(failed_nodes=failed_nodes),
+                    "preempt_candidates",
+                )
 
             self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
                 pod=pod, pod_state=internal.POD_WAITING, pod_schedule_result=result
@@ -289,12 +329,24 @@ class HivedScheduler:
             if result.pod_wait_info is not None:
                 wait_reason += ": " + result.pod_wait_info.reason
             log.info("[%s]: %s", internal_utils.key(pod), wait_reason)
-            from hivedscheduler_tpu.api.constants import COMPONENT_NAME
-
-            return ei.ExtenderFilterResult(failed_nodes={COMPONENT_NAME: wait_reason})
+            return (
+                ei.ExtenderFilterResult(failed_nodes={_COMPONENT: wait_reason}),
+                "wait",
+            )
 
     def bind_routine(self, args: ei.ExtenderBindingArgs) -> ei.ExtenderBindingResult:
         """Idempotent bind executor (reference: bindRoutine, scheduler.go:594-627)."""
+        try:
+            result = self._bind_routine(args)
+            metrics.inc("tpu_hive_extender_requests_total",
+                        routine="bind", outcome="ok")
+            return result
+        except Exception:
+            metrics.inc("tpu_hive_extender_requests_total",
+                        routine="bind", outcome="error")
+            raise
+
+    def _bind_routine(self, args: ei.ExtenderBindingArgs) -> ei.ExtenderBindingResult:
         with self.scheduler_lock:
             pod_key = f"{args.pod_namespace}/{args.pod_name}"
             log.info("[%s(%s)]: bindRoutine: Started", args.pod_uid, pod_key)
@@ -317,6 +369,7 @@ class HivedScheduler:
                         annotations=internal_utils.extract_pod_bind_annotations(binding_pod),
                     )
                 )
+                metrics.inc("tpu_hive_binds_total")  # commits from any path
                 return ei.ExtenderBindingResult()
             raise api.as_bad_request(
                 f"Pod cannot be bound without a scheduling placement: Pod current "
@@ -325,6 +378,23 @@ class HivedScheduler:
 
     def preempt_routine(self, args: ei.ExtenderPreemptionArgs) -> ei.ExtenderPreemptionResult:
         """Reference: preemptRoutine, scheduler.go:629-721."""
+        t0 = time.perf_counter()
+        try:
+            result = self._preempt_routine(args)
+            metrics.inc(
+                "tpu_hive_extender_requests_total", routine="preempt",
+                outcome="victims" if result.node_name_to_meta_victims else "none",
+            )
+            return result
+        except Exception:
+            metrics.inc("tpu_hive_extender_requests_total",
+                        routine="preempt", outcome="error")
+            raise
+        finally:
+            metrics.observe("tpu_hive_preempt_latency_seconds",
+                            time.perf_counter() - t0)
+
+    def _preempt_routine(self, args: ei.ExtenderPreemptionArgs) -> ei.ExtenderPreemptionResult:
         with self.scheduler_lock:
             pod = args.pod
             suggested_nodes = list(args.node_name_to_meta_victims)

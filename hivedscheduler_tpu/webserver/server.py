@@ -112,7 +112,16 @@ def _make_handler(scheduler: HivedScheduler):
         def do_GET(self) -> None:
             try:
                 path = self.path.rstrip("/")
-                if path == C.VERSION_PREFIX or path == "":
+                if path == "/metrics":
+                    from hivedscheduler_tpu.runtime.metrics import REGISTRY
+
+                    body = REGISTRY.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == C.VERSION_PREFIX or path == "":
                     self._reply(200, {"paths": [
                         C.FILTER_PATH, C.BIND_PATH, C.PREEMPT_PATH,
                         C.AFFINITY_GROUPS_PATH, C.CLUSTER_STATUS_PATH,

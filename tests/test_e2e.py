@@ -211,3 +211,22 @@ class TestConfigWatch:
         # real change: trigger
         path.write_text(path.read_text().replace("cellNumber: 2", "cellNumber: 1"))
         assert changed.wait(3.0)
+
+
+class TestMetrics:
+    def test_metrics_endpoint(self, stack):
+        kube, scheduler, base = stack
+        pod = make_pod("m1", {"virtualCluster": "vc2", "priority": 0,
+                              "chipType": "v5e-chip", "chipNumber": 8})
+        kube.create_pod(pod)
+        post(base, C.FILTER_PATH, filter_args(kube, pod, all_nodes(kube)))
+        post(base, C.BIND_PATH, {"PodName": "m1", "PodNamespace": "default",
+                                 "PodUID": "m1", "Node": "v5e-host0/0-0"})
+        import urllib.request
+        with urllib.request.urlopen(base + "/metrics") as r:
+            assert r.status == 200
+            text = r.read().decode()
+        assert 'tpu_hive_extender_requests_total{outcome="bind",routine="filter"}' in text
+        assert "tpu_hive_binds_total" in text
+        assert "tpu_hive_filter_latency_seconds_count" in text
+        assert "tpu_hive_bad_nodes 0" in text
