@@ -45,7 +45,7 @@ from hivedscheduler_tpu.api.types import (
     VirtualClusterSpec,
 )
 from hivedscheduler_tpu.algorithm.hived import HivedAlgorithm
-from hivedscheduler_tpu.common.utils import to_yaml
+from hivedscheduler_tpu.common.utils import to_json
 from hivedscheduler_tpu.k8s.types import Container, Node, Pod
 from hivedscheduler_tpu.runtime.types import FILTERING_PHASE, PREEMPTING_PHASE
 from hivedscheduler_tpu.runtime.utils import new_binding_pod
@@ -103,7 +103,7 @@ def make_pod(name: str, vc: str, priority: int, group: str, pods: int, chips: in
     return Pod(
         name=name,
         uid=name,
-        annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: to_yaml(spec)},
+        annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: to_json(spec)},
         containers=[Container(resource_limits={C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})],
     )
 

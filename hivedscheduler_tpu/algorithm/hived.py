@@ -569,9 +569,17 @@ class HivedAlgorithm(SchedulerAlgorithm):
         group_virtual: Optional[GroupVirtualPlacement] = None
         preemption_victims: Dict[str, Dict[str, Pod]] = {}
         pod_index = 0
-        bad_or_non_suggested = collect_bad_or_non_suggested_nodes(
-            g.physical_leaf_cell_placement, suggested_nodes, g.ignore_k8s_suggested_nodes
-        )
+        # hot path: one scan per pod of every existing group. When the group
+        # ignores suggested nodes and no node is bad, every cell is healthy
+        # (leaf healthiness is driven solely by set_bad_node/set_healthy_node
+        # under this lock), so the scan can only return empty — skip it.
+        if g.ignore_k8s_suggested_nodes and not self.bad_nodes:
+            bad_or_non_suggested: Set[str] = set()
+        else:
+            bad_or_non_suggested = collect_bad_or_non_suggested_nodes(
+                g.physical_leaf_cell_placement, suggested_nodes,
+                g.ignore_k8s_suggested_nodes,
+            )
         if g.state == GROUP_ALLOCATED:
             log.info("[%s]: Pod is from an affinity group that is already allocated: %s",
                      internal_utils.key(pod), s.affinity_group.name)

@@ -1,0 +1,183 @@
+"""The five driver scenarios from BASELINE.json, verbatim:
+
+1. 1-leaf-cell AffinityGroup on a single-node physicalCluster
+2. 8-chip gang job on one v5e-8 host cell
+3. Multi-VC guaranteed + opportunistic jobs on v5p-64 with inter-VC preemption
+4. Contiguous 4x4x4 ICI-mesh slice request on v5p-256 (topology-aware buddy alloc)
+5. Mixed v4/v5e SKU-type cells with pinned cells + bad-hardware-aware rescheduling
+"""
+
+import logging
+import os
+
+import pytest
+
+from hivedscheduler_tpu.api import constants as C
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.api.config import Config, load_config, new_config
+from hivedscheduler_tpu.api.types import (
+    CellTypeSpec,
+    MeshLevelSpec,
+    MeshSpec,
+    PhysicalCellSpec,
+    PhysicalClusterSpec,
+    PinnedCellSpec,
+    VirtualCellSpec,
+    VirtualClusterSpec,
+)
+from hivedscheduler_tpu.algorithm import HivedAlgorithm
+from hivedscheduler_tpu.common.utils import to_yaml
+from hivedscheduler_tpu.k8s.types import Container, Node, Pod
+from hivedscheduler_tpu.runtime.types import FILTERING_PHASE, PREEMPTING_PHASE
+from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+logging.getLogger().setLevel(logging.ERROR)
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "example", "config", "design", "tpu-hive.yaml",
+)
+
+
+def make_pod(name, spec):
+    return Pod(name=name, uid=name,
+               annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: to_yaml(spec)},
+               containers=[Container(resource_limits={
+                   C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})])
+
+
+def healthy(h):
+    nodes = sorted({n for ccl in h.full_cell_list.values()
+                    for c in ccl[max(ccl)] for n in c.nodes})
+    for n in nodes:
+        h.add_node(Node(name=n))
+    return nodes
+
+
+def allocate(h, pod, nodes, phase=FILTERING_PHASE):
+    r = h.schedule(pod, nodes, phase)
+    assert r.pod_bind_info is not None, (r.pod_wait_info, r.pod_preempt_info)
+    bp = new_binding_pod(pod, r.pod_bind_info)
+    h.add_allocated_pod(bp)
+    return bp, r.pod_bind_info
+
+
+def test_config1_single_leaf_cell_on_single_node_cluster():
+    cfg = new_config(Config(
+        physical_cluster=PhysicalClusterSpec(
+            cell_types={
+                "node": CellTypeSpec(child_cell_type="chip", child_cell_number=4,
+                                     is_node_level=True),
+            },
+            physical_cells=[PhysicalCellSpec(cell_type="node", cell_address="n0")],
+        ),
+        virtual_clusters={"vc": VirtualClusterSpec(
+            virtual_cells=[VirtualCellSpec(cell_number=1, cell_type="node")])},
+    ))
+    h = HivedAlgorithm(cfg)
+    nodes = healthy(h)
+    _, info = allocate(h, make_pod("p", {
+        "virtualCluster": "vc", "priority": 0, "leafCellNumber": 1}), nodes)
+    assert info.node == "n0" and len(info.leaf_cell_isolation) == 1
+
+
+def test_config2_v5e8_gang_on_one_host():
+    h = HivedAlgorithm(load_config(FIXTURE))
+    nodes = healthy(h)
+    _, info = allocate(h, make_pod("g", {
+        "virtualCluster": "vc2", "priority": 0,
+        "chipType": "v5e-chip", "chipNumber": 8}), nodes)
+    assert info.node == "v5e-host0/0-0"
+    assert sorted(info.leaf_cell_isolation) == list(range(8))
+
+
+def test_config3_multi_vc_inter_vc_preemption_on_v5p64():
+    h = HivedAlgorithm(load_config(FIXTURE))
+    nodes = healthy(h)
+    # opportunistic jobs from vc2 spill across the whole v5p-64
+    opp = []
+    for i in range(16):
+        bp, _ = allocate(h, make_pod(f"opp-{i}", {
+            "virtualCluster": "vc2", "priority": -1,
+            "chipType": "v5p-chip", "chipNumber": 4}), nodes)
+        opp.append(bp)
+    # vc1's guaranteed gang reclaims its share by preempting OT pods
+    spec = {"virtualCluster": "vc1", "priority": 10, "chipType": "v5p-chip",
+            "chipNumber": 4,
+            "affinityGroup": {"name": "g", "members": [{"podNumber": 8,
+                                                        "chipNumber": 4}]}}
+    r = h.schedule(make_pod("g-0", spec), nodes, PREEMPTING_PHASE)
+    assert r.pod_preempt_info is not None
+    victims = {v.uid for v in r.pod_preempt_info.victim_pods}
+    assert victims <= {bp.uid for bp in opp}
+
+
+def test_config4_contiguous_4x4x4_on_v5p256():
+    mesh = MeshSpec(
+        topology=(8, 8, 4), chip_type="v5p-chip", host_shape=(2, 2, 1),
+        levels=[MeshLevelSpec("v5p-2x2x2", (2, 2, 2)),
+                MeshLevelSpec("v5p-4x4x2", (4, 4, 2)),
+                MeshLevelSpec("v5p-4x4x4", (4, 4, 4)),
+                MeshLevelSpec("v5p-8x4x4", (8, 4, 4))],
+    )
+    cfg = new_config(Config(
+        physical_cluster=PhysicalClusterSpec(
+            cell_types={"v5p-256": CellTypeSpec(mesh=mesh)},
+            physical_cells=[PhysicalCellSpec(cell_type="v5p-256",
+                                             cell_address="pod0")],
+        ),
+        virtual_clusters={"vc": VirtualClusterSpec(
+            virtual_cells=[VirtualCellSpec(cell_number=4,
+                                           cell_type="v5p-256.v5p-4x4x4")])},
+    ))
+    h = HivedAlgorithm(cfg)
+    nodes = healthy(h)
+    spec = {"virtualCluster": "vc", "priority": 0, "chipType": "v5p-chip",
+            "chipNumber": 4,
+            "affinityGroup": {"name": "cube",
+                              "members": [{"podNumber": 16, "chipNumber": 4}]}}
+    origins = []
+    for i in range(16):
+        _, info = allocate(h, make_pod(f"cube-{i}", spec), nodes)
+        origins.append(tuple(int(x) for x in info.node.split("/")[-1].split("-")))
+    # the 16 hosts (2x2x1 each) must tile exactly one aligned 4x4x4 sub-mesh
+    xs = {o[0] for o in origins}
+    ys = {o[1] for o in origins}
+    zs = {o[2] for o in origins}
+    assert len(set(origins)) == 16
+    assert len(xs) == 2 and max(xs) - min(xs) == 2 and min(xs) % 4 == 0
+    assert len(ys) == 2 and max(ys) - min(ys) == 2 and min(ys) % 4 == 0
+    assert len(zs) == 4 and min(zs) == 0  # full z extent of the 4-deep mesh
+
+
+def test_config5_mixed_sku_pinned_and_bad_hardware_rescheduling():
+    h = HivedAlgorithm(load_config(FIXTURE))  # v4 + v5p + v5e chains, pin1
+    nodes = healthy(h)
+    # mixed SKU: one pod per chip type without specifying, one with
+    _, info_v4 = allocate(h, make_pod("a", {
+        "virtualCluster": "vc1", "priority": 0,
+        "chipType": "v4-chip", "chipNumber": 8}), nodes)
+    assert info_v4.cell_chain == "v4-node-pool"
+    # pinned cell usage
+    _, info_pin = allocate(h, make_pod("b", {
+        "virtualCluster": "vc1", "priority": 2, "pinnedCellId": "pin1",
+        "chipNumber": 4}), nodes)
+    assert info_pin.node.startswith("v5p-pod0/0-0-")
+    # bad hardware: the first v4 node dies; a new pod reschedules elsewhere
+    h.delete_node(Node(name=info_v4.node))
+    _, info_v4b = allocate(h, make_pod("c", {
+        "virtualCluster": "vc1", "priority": 0,
+        "chipType": "v4-chip", "chipNumber": 8}), nodes)
+    assert info_v4b.node != info_v4.node
+    # and the bad node is visible in the cluster status
+    status = h.get_physical_cluster_status()
+    flat = []
+
+    def walk(s):
+        flat.append(s)
+        for c in s.cell_children:
+            walk(c)
+
+    for s in status:
+        walk(s)
+    assert any(s.cell_healthiness == api.CELL_BAD for s in flat)
