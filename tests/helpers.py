@@ -1,0 +1,46 @@
+"""Shared test helpers: pod construction, cluster bootstrap, status walking."""
+
+from hivedscheduler_tpu.api import constants as C
+from hivedscheduler_tpu.common.utils import to_json, to_yaml
+from hivedscheduler_tpu.k8s.types import Container, Node, Pod
+
+V5E32_CELL_TYPES = {
+    "v5e-32": {"mesh": {
+        "topology": [4, 8], "chipType": "v5e-chip", "hostShape": [2, 4],
+        "levels": [{"name": "v5e-16", "shape": [4, 4]}]}},
+}
+
+
+def make_pod(name, spec_dict, uid=None, yaml_spec=False):
+    """A hived-enabled pod with the scheduling-spec annotation (JSON by
+    default — valid YAML; pass yaml_spec=True to simulate a human-written
+    annotation)."""
+    serialize = to_yaml if yaml_spec else to_json
+    return Pod(
+        name=name,
+        uid=uid or name,
+        annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: serialize(spec_dict)},
+        containers=[Container(resource_limits={C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})],
+    )
+
+
+def all_node_names(algo):
+    return sorted({
+        n for ccl in algo.full_cell_list.values()
+        for c in ccl[max(ccl)] for n in c.nodes
+    })
+
+
+def set_healthy_nodes(algo):
+    """Mark every configured node healthy; returns the node names."""
+    nodes = all_node_names(algo)
+    for n in nodes:
+        algo.add_node(Node(name=n))
+    return nodes
+
+
+def walk_status(statuses):
+    """Depth-first over inspect cell statuses (physical or virtual)."""
+    for s in statuses:
+        yield s
+        yield from walk_status(s.cell_children)

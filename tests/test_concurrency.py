@@ -30,14 +30,12 @@ FIXTURE = os.path.join(
 )
 
 
+from helpers import make_pod as _make_pod
+
+
 def make_pod(name, vc, chips, chip_type, priority=0):
-    spec = {"virtualCluster": vc, "priority": priority,
-            "chipType": chip_type, "chipNumber": chips}
-    return Pod(
-        name=name, uid=name,
-        annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: to_yaml(spec)},
-        containers=[Container(resource_limits={C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})],
-    )
+    return _make_pod(name, {"virtualCluster": vc, "priority": priority,
+                            "chipType": chip_type, "chipNumber": chips})
 
 
 def test_concurrent_schedule_bind_delete_and_node_events():

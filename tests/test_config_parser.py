@@ -121,3 +121,76 @@ def test_mesh_validation_errors():
                 levels=[type("L", (), {"name": "x", "shape": (3, 2)})()],
             ),
         )
+
+
+from helpers import V5E32_CELL_TYPES, make_pod, set_healthy_nodes
+
+
+class TestOddTopologies:
+    @staticmethod
+    def _config(cell_types, physical_cells, vcs=None):
+        from hivedscheduler_tpu.api.config import Config, new_config
+        from hivedscheduler_tpu.api.types import (
+            PhysicalClusterSpec,
+            VirtualClusterSpec,
+        )
+
+        return new_config(Config(
+            physical_cluster=PhysicalClusterSpec.from_dict(
+                {"cellTypes": cell_types, "physicalCells": physical_cells}),
+            virtual_clusters={k: VirtualClusterSpec.from_dict(v)
+                              for k, v in (vcs or {}).items()},
+        ))
+
+    def _parse(self, cell_types, physical_cells, vcs=None):
+        return parse_config(self._config(cell_types, physical_cells, vcs))
+
+    def test_2d_v5e_32(self):
+        # v5e-32: 4x8 2D mesh, 4 hosts of 2x4
+        p = self._parse(
+            V5E32_CELL_TYPES,
+            [{"cellType": "v5e-32", "cellAddress": "s0"}],
+        )
+        full = p.physical_full_list["v5e-32"]
+        assert len(full[1]) == 32 and len(full[2]) == 4  # hosts
+        assert len(full[3]) == 2 and len(full[4]) == 1   # v5e-16s, top
+        # host tiling of the 4x4 level: 2 hosts per v5e-16
+        assert p.chain_levels["v5e-32"][2].child_number == 2
+
+    def test_non_power_of_two_tiling(self):
+        # 6x3 mesh with 2x3 hosts and a 6x3 top: 3 hosts
+        p = self._parse(
+            {"m": {"mesh": {"topology": [6, 3], "chipType": "c",
+                            "hostShape": [2, 3]}}},
+            [{"cellType": "m", "cellAddress": "x"}],
+        )
+        full = p.physical_full_list["m"]
+        assert len(full[1]) == 18 and len(full[2]) == 3 and len(full[3]) == 1
+        assert p.chain_levels["m"][2].child_number == 3
+
+    def test_schedule_on_2d_mesh(self):
+        from hivedscheduler_tpu.algorithm import HivedAlgorithm
+        from hivedscheduler_tpu.runtime.types import FILTERING_PHASE
+        from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+        cfg = self._config(
+            V5E32_CELL_TYPES,
+            [{"cellType": "v5e-32", "cellAddress": "s0"}],
+            vcs={"vc": {"virtualCells": [{"cellType": "v5e-32.v5e-16",
+                                          "cellNumber": 2}]}},
+        )
+        h = HivedAlgorithm(cfg)
+        nodes = set_healthy_nodes(h)
+        spec = {"virtualCluster": "vc", "priority": 0, "chipNumber": 8,
+                "affinityGroup": {"name": "g", "members": [
+                    {"podNumber": 2, "chipNumber": 8}]}}
+        origins = []
+        for i in range(2):
+            pod = make_pod(f"g-{i}", spec)
+            r = h.schedule(pod, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None
+            h.add_allocated_pod(new_binding_pod(pod, r.pod_bind_info))
+            origins.append(tuple(int(x) for x in
+                                 r.pod_bind_info.node.split("/")[-1].split("-")))
+        # the two 8-chip hosts form one contiguous v5e-16 (4x4) tile
+        assert {o[1] for o in origins} in ({0}, {4}) and {o[0] for o in origins} == {0, 2}

@@ -25,9 +25,10 @@ from hivedscheduler_tpu.api.types import (
     VirtualCellSpec,
     VirtualClusterSpec,
 )
+from helpers import make_pod, set_healthy_nodes
+
 from hivedscheduler_tpu.algorithm import HivedAlgorithm
-from hivedscheduler_tpu.common.utils import to_yaml
-from hivedscheduler_tpu.k8s.types import Container, Node, Pod
+from hivedscheduler_tpu.k8s.types import Node
 from hivedscheduler_tpu.runtime.types import FILTERING_PHASE, PREEMPTING_PHASE
 from hivedscheduler_tpu.runtime.utils import new_binding_pod
 
@@ -39,19 +40,6 @@ FIXTURE = os.path.join(
 )
 
 
-def make_pod(name, spec):
-    return Pod(name=name, uid=name,
-               annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: to_yaml(spec)},
-               containers=[Container(resource_limits={
-                   C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})])
-
-
-def healthy(h):
-    nodes = sorted({n for ccl in h.full_cell_list.values()
-                    for c in ccl[max(ccl)] for n in c.nodes})
-    for n in nodes:
-        h.add_node(Node(name=n))
-    return nodes
 
 
 def allocate(h, pod, nodes, phase=FILTERING_PHASE):
@@ -75,7 +63,7 @@ def test_config1_single_leaf_cell_on_single_node_cluster():
             virtual_cells=[VirtualCellSpec(cell_number=1, cell_type="node")])},
     ))
     h = HivedAlgorithm(cfg)
-    nodes = healthy(h)
+    nodes = set_healthy_nodes(h)
     _, info = allocate(h, make_pod("p", {
         "virtualCluster": "vc", "priority": 0, "leafCellNumber": 1}), nodes)
     assert info.node == "n0" and len(info.leaf_cell_isolation) == 1
@@ -83,7 +71,7 @@ def test_config1_single_leaf_cell_on_single_node_cluster():
 
 def test_config2_v5e8_gang_on_one_host():
     h = HivedAlgorithm(load_config(FIXTURE))
-    nodes = healthy(h)
+    nodes = set_healthy_nodes(h)
     _, info = allocate(h, make_pod("g", {
         "virtualCluster": "vc2", "priority": 0,
         "chipType": "v5e-chip", "chipNumber": 8}), nodes)
@@ -93,7 +81,7 @@ def test_config2_v5e8_gang_on_one_host():
 
 def test_config3_multi_vc_inter_vc_preemption_on_v5p64():
     h = HivedAlgorithm(load_config(FIXTURE))
-    nodes = healthy(h)
+    nodes = set_healthy_nodes(h)
     # opportunistic jobs from vc2 spill across the whole v5p-64
     opp = []
     for i in range(16):
@@ -131,7 +119,7 @@ def test_config4_contiguous_4x4x4_on_v5p256():
                                            cell_type="v5p-256.v5p-4x4x4")])},
     ))
     h = HivedAlgorithm(cfg)
-    nodes = healthy(h)
+    nodes = set_healthy_nodes(h)
     spec = {"virtualCluster": "vc", "priority": 0, "chipType": "v5p-chip",
             "chipNumber": 4,
             "affinityGroup": {"name": "cube",
@@ -152,7 +140,7 @@ def test_config4_contiguous_4x4x4_on_v5p256():
 
 def test_config5_mixed_sku_pinned_and_bad_hardware_rescheduling():
     h = HivedAlgorithm(load_config(FIXTURE))  # v4 + v5p + v5e chains, pin1
-    nodes = healthy(h)
+    nodes = set_healthy_nodes(h)
     # mixed SKU: one pod per chip type without specifying, one with
     _, info_v4 = allocate(h, make_pod("a", {
         "virtualCluster": "vc1", "priority": 0,

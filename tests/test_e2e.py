@@ -31,13 +31,7 @@ FIXTURE = os.path.join(
 )
 
 
-def make_pod(name, spec_dict):
-    return Pod(
-        name=name,
-        uid=name,
-        annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: to_yaml(spec_dict)},
-        containers=[Container(resource_limits={C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})],
-    )
+from helpers import make_pod
 
 
 @pytest.fixture

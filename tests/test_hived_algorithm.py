@@ -43,26 +43,7 @@ FIXTURE = os.path.join(
 )
 
 
-def make_pod(name, spec_dict, uid=None):
-    return Pod(
-        name=name,
-        uid=uid or name,
-        annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: to_yaml(spec_dict)},
-        containers=[Container(resource_limits={C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})],
-    )
-
-
-def all_node_names(h):
-    nodes = set()
-    for ccl in h.full_cell_list.values():
-        for c in ccl[max(ccl)]:
-            nodes.update(c.nodes)
-    return sorted(nodes)
-
-
-def set_healthy_nodes(h):
-    for n in all_node_names(h):
-        h.add_node(Node(name=n))
+from helpers import all_node_names, make_pod, set_healthy_nodes
 
 
 @pytest.fixture

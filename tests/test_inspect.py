@@ -1,0 +1,101 @@
+"""Inspect API detail tests: phys<->virt cross-links, pinned cells, mesh
+geometry exposure, and the '-opp' pseudo-cells (reference inspect semantics:
+api/types.go:184-273, utils.go:419-452)."""
+
+import logging
+import os
+
+from helpers import make_pod, set_healthy_nodes, walk_status
+
+from hivedscheduler_tpu.api.config import load_config
+from hivedscheduler_tpu.algorithm import HivedAlgorithm
+from hivedscheduler_tpu.runtime.types import FILTERING_PHASE
+from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+logging.getLogger().setLevel(logging.ERROR)
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "example", "config", "design", "tpu-hive.yaml",
+)
+
+
+def fresh():
+    h = HivedAlgorithm(load_config(FIXTURE))
+    nodes = set_healthy_nodes(h)
+    return h, nodes
+
+
+def test_cross_links_after_allocation():
+    h, nodes = fresh()
+    pod = make_pod("p", {"virtualCluster": "vc2", "priority": 3,
+                         "chipType": "v5e-chip", "chipNumber": 8})
+    r = h.schedule(pod, nodes, FILTERING_PHASE)
+    h.add_allocated_pod(new_binding_pod(pod, r.pod_bind_info))
+
+    vc2 = h.get_virtual_cluster_status("vc2")
+    bound = [s for s in walk_status(vc2) if s.physical_cell is not None]
+    assert bound, "allocated virtual cells must expose their physical peer"
+    top = next(s for s in bound if s.cell_type == "v5e-8")
+    assert top.physical_cell.cell_address == "v5e-host0/0-0"
+    assert top.cell_priority == 3 and top.cell_state == "Used"
+    # physical side mirrors back
+    pc = h.get_physical_cluster_status()
+    phys = [s for s in walk_status(pc) if s.virtual_cell is not None]
+    assert any(s.vc == "vc2" for s in phys)
+
+
+def test_mesh_geometry_exposed():
+    h, _ = fresh()
+    pc = h.get_physical_cluster_status()
+    v5p = next(s for s in pc if s.cell_type == "v5p-64")
+    assert v5p.mesh_shape == (4, 4, 4) and v5p.mesh_origin == (0, 0, 0)
+    d = v5p.to_dict()
+    assert d["meshShape"] == [4, 4, 4]
+    child_shapes = {tuple(c.mesh_shape) for c in v5p.cell_children}
+    assert child_shapes == {(4, 4, 2)}
+
+
+def test_opp_pseudo_cells_lifecycle():
+    h, nodes = fresh()
+    pod = make_pod("o", {"virtualCluster": "vc1", "priority": -1,
+                         "chipType": "v5p-chip", "chipNumber": 4})
+    r = h.schedule(pod, nodes, FILTERING_PHASE)
+    bp = new_binding_pod(pod, r.pod_bind_info)
+    h.add_allocated_pod(bp)
+    vc1 = h.get_virtual_cluster_status("vc1")
+    opp = [s for s in vc1 if s.cell_address.endswith("-opp")]
+    assert len(opp) == 4  # one pseudo-cell per opportunistic chip
+    assert all(s.cell_priority == -1 and s.physical_cell is not None for s in opp)
+    h.delete_allocated_pod(bp)
+    vc1 = h.get_virtual_cluster_status("vc1")
+    assert not [s for s in vc1 if s.cell_address.endswith("-opp")]
+
+
+def test_pinned_cell_statically_bound():
+    h, _ = fresh()
+    vc1 = h.get_virtual_cluster_status("vc1")
+    pinned = [s for s in walk_status(vc1)
+              if s.physical_cell is not None and s.cell_type == "v5p-2x2x2"]
+    assert pinned, "the pinned cell is bound at startup"
+    assert pinned[0].physical_cell.cell_address == "v5p-pod0/s0-0-0"
+
+
+def test_affinity_group_status_fields():
+    h, nodes = fresh()
+    spec = {"virtualCluster": "vc2", "priority": 1, "chipType": "v5p-chip",
+            "chipNumber": 4,
+            "affinityGroup": {"name": "g", "members": [{"podNumber": 2,
+                                                        "chipNumber": 4}]}}
+    for i in range(2):
+        p = make_pod(f"g-{i}", spec)
+        r = h.schedule(p, nodes, FILTERING_PHASE)
+        h.add_allocated_pod(new_binding_pod(p, r.pod_bind_info))
+    g = h.get_affinity_group("g")
+    d = g.to_dict()
+    assert d["metadata"]["name"] == "g"
+    assert d["status"]["state"] == "Allocated"
+    assert len(d["status"]["allocatedPods"]) == 2
+    # physicalPlacement: node -> chip indices; virtualPlacement: preassigned -> leaves
+    assert sum(len(v) for v in d["status"]["physicalPlacement"].values()) == 8
+    assert sum(len(v) for v in d["status"]["virtualPlacement"].values()) == 8
