@@ -104,9 +104,12 @@ def sharding_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     and ff, fsdp the complementary axis, ep the expert axis. With pipelining,
     the leading layer axis is sharded over pp (and tp/fsdp must be 1 inside
     the pipeline; see parallel/pipeline.py)."""
+    # pipelined stages run in manual shard_map mode: tp sharding is kept
+    # (row-parallel psums in _apply_layer), fsdp param sharding is dropped
+    # (no manual fsdp collectives yet; see ROADMAP.md)
     pl = "pp" if cfg.pipeline_microbatches > 0 else None
     fsdp = None if cfg.pipeline_microbatches > 0 else "fsdp"
-    tp = None if cfg.pipeline_microbatches > 0 else "tp"
+    tp = "tp"
     layers: Dict[str, Any] = {
         "attn_norm": P(pl, None),
         "wq": P(pl, fsdp, tp, None),
@@ -202,11 +205,21 @@ def _moe_mlp(
     return out, aux
 
 
-def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh):
+def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
+                 manual_tp_axis=None):
     """One transformer block; lp leaves have no leading layer axis.
     Returns (x, aux) — aux is the layer's MoE load-balancing loss (0 for
-    dense layers)."""
+    dense layers).
+
+    ``manual_tp_axis``: set when running inside a shard_map (pipeline stages)
+    with weights tensor-sharded over that axis — heads and the MLP hidden dim
+    are device-local, and the two row-parallel projections (attention out,
+    MLP down) psum their partial sums Megatron-style."""
     dtype = cfg.dtype
+
+    def row_parallel(out):
+        return lax.psum(out, manual_tp_axis) if manual_tp_axis else out
+
     h = _rms_norm(x, lp["attn_norm"])
     q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
     k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
@@ -217,7 +230,7 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh):
         attn = attn_fn(q, k, v, mesh, causal=True)
     else:
         attn = attn_fn(q, k, v, causal=True)
-    x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
+    x = x + row_parallel(jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype)))
     h = _rms_norm(x, lp["mlp_norm"])
     aux = jnp.zeros((), jnp.float32)
     if cfg.n_experts > 0:
@@ -226,9 +239,9 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh):
     else:
         gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))
         up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
-        x = x + jnp.einsum(
+        x = x + row_parallel(jnp.einsum(
             "btf,fd->btd", jax.nn.silu(gate) * up, lp["w_down"].astype(dtype)
-        )
+        ))
     return x, aux
 
 
@@ -268,32 +281,38 @@ def forward_with_aux(
     aux_total = jnp.zeros((), jnp.float32)
     if cfg.pipeline_microbatches > 0:
         assert cfg.attn_impl in ("xla", "flash"), (
-            "pipelined stages need local attention (tp/sp collectives inside "
+            "pipelined stages need local attention (sp collectives inside "
             "a pipeline stage are not supported yet)"
         )
         assert cfg.n_experts == 0, (
             "MoE inside a pipeline stage is not supported yet (ep dispatch "
             "needs GSPMD, pipeline stages run in manual shard_map mode)"
         )
+        manual_tp = None
         if mesh is not None:
             shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-            if shape.get("tp", 1) > 1 or shape.get("sp", 1) > 1:
+            if shape.get("sp", 1) > 1:
                 raise ValueError(
-                    "pipeline_microbatches > 0 requires mesh tp == sp == 1 "
-                    f"(got tp={shape.get('tp')}, sp={shape.get('sp')}); "
-                    "tensor/sequence collectives inside pipeline stages are "
-                    "not supported yet"
+                    "pipeline_microbatches > 0 requires mesh sp == 1 "
+                    f"(got sp={shape.get('sp')}); sequence collectives inside "
+                    "pipeline stages are not supported yet"
                 )
+            if "tp" in shape:
+                # Megatron-style psums inside the stage; with tp == 1 the
+                # psum is free but still normalizes the shard_map vma of the
+                # tp-sharded (possibly size-1) weights
+                manual_tp = "tp"
         from hivedscheduler_tpu.parallel.pipeline import pipeline_apply
 
         layer_specs = sharding_specs(cfg)["layers"]
 
         def stage_block(stage_params, h):
-            hh, _ = lax.scan(
-                jax.checkpoint(lambda xx, lp: (layer(xx, lp)[0], None)),
-                h,
-                stage_params,
-            )
+            def stage_layer(xx, lp):
+                out, _ = _apply_layer(xx, lp, positions, cfg, attn_fn, mesh,
+                                      manual_tp_axis=manual_tp)
+                return out, None
+
+            hh, _ = lax.scan(jax.checkpoint(stage_layer), h, stage_params)
             return hh
 
         x = pipeline_apply(

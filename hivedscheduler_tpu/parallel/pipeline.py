@@ -8,14 +8,15 @@ stacked layer params are sharded on their leading layer axis with
 the classic GPipe bubble. The whole schedule is a differentiable ``lax.scan``,
 so one jitted train step backpropagates through the pipeline naturally.
 
-Constraints (round-1, validated in ``models.transformer.forward_with_aux``):
-attention inside a stage must be local (``attn_impl in ("xla", "flash")``),
-and the tp/sp mesh axes must be 1 when pp > 1 (tensor-parallel matmuls inside
-a shard_map need manual collectives; planned). Batch parallelism over dp/fsdp
-composes for *activations*; note that layer params are fully replicated
-across fsdp inside pipeline stages (``sharding_specs`` drops their fsdp
-placement when pipelining), so pipelining trades FSDP param sharding for
-stage sharding.
+Constraints (validated in ``models.transformer.forward_with_aux``):
+attention inside a stage must be local (``attn_impl in ("xla", "flash")``)
+and the sp mesh axis must be 1 when pp > 1 (ring attention inside a stage is
+planned). Tensor parallelism composes: stage weights keep their tp sharding
+and ``_apply_layer`` inserts Megatron-style row-parallel psums. Batch
+parallelism over dp/fsdp composes for *activations*; layer params are
+replicated across fsdp inside pipeline stages (``sharding_specs`` drops
+their fsdp placement when pipelining), so pipelining trades FSDP param
+sharding for stage sharding.
 """
 
 from __future__ import annotations

@@ -97,6 +97,8 @@ class Registry:
 
 
 REGISTRY = Registry()
+REGISTRY.describe("tpu_hive_http_requests_total",
+                  "All HTTP responses by method and status code")
 REGISTRY.describe("tpu_hive_extender_requests_total",
                   "Extender requests by routine and outcome")
 REGISTRY.describe("tpu_hive_binds_total", "Bind subresource commits")

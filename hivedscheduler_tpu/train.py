@@ -49,6 +49,12 @@ def main(argv=None) -> int:
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--fsdp", type=int, default=1)
+    parser.add_argument("--pp", type=int, default=1,
+                        help="pipeline stages (requires --microbatches)")
+    parser.add_argument("--microbatches", type=int, default=0,
+                        help="GPipe microbatches; required when --pp > 1")
+    parser.add_argument("--ep", type=int, default=1,
+                        help="expert-parallel size (with --n-experts)")
     parser.add_argument("--n-experts", type=int, default=0)
     parser.add_argument("--attn", default=None,
                         help="xla|flash|ring|ulysses (default: ring when sp>1)")
@@ -57,6 +63,10 @@ def main(argv=None) -> int:
     parser.add_argument("--log-every", type=int, default=10)
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
+    if args.pp > 1 and args.microbatches <= 0:
+        parser.error("--pp > 1 requires --microbatches")
+    if args.microbatches > 0 and args.pp <= 1:
+        parser.error("--microbatches requires --pp > 1")
 
     from hivedscheduler_tpu.common import utils as common
 
@@ -78,7 +88,8 @@ def main(argv=None) -> int:
 
     # 2. mesh over the granted slice
     n_devices = len(jax.devices())
-    axes = topology.infer_axes(n_devices, tp=args.tp, sp=args.sp, fsdp=args.fsdp)
+    axes = topology.infer_axes(n_devices, tp=args.tp, sp=args.sp,
+                               fsdp=args.fsdp, pp=args.pp, ep=args.ep)
     mesh = topology.make_mesh(axes)
     log.info("rank %s/%s: %s devices, mesh %s", rank, world, n_devices, axes)
 
@@ -92,6 +103,7 @@ def main(argv=None) -> int:
         max_seq_len=args.seq_len,
         attn_impl=attn,
         n_experts=args.n_experts,
+        pipeline_microbatches=args.microbatches if args.pp > 1 else 0,
     )
     step_fn, init_fn, token_sharding = make_sharded_train_step(cfg, mesh)
     params, opt_state = init_fn(jax.random.PRNGKey(0))

@@ -62,6 +62,10 @@ def _make_handler(scheduler: HivedScheduler):
             log.debug("%s - %s", self.address_string(), fmt % args)
 
         def _reply(self, code: int, obj: Any) -> None:
+            from hivedscheduler_tpu.runtime.metrics import REGISTRY
+
+            REGISTRY.inc("tpu_hive_http_requests_total",
+                         method=self.command, code=str(code))
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -115,6 +119,8 @@ def _make_handler(scheduler: HivedScheduler):
                 if path == "/metrics":
                     from hivedscheduler_tpu.runtime.metrics import REGISTRY
 
+                    REGISTRY.inc("tpu_hive_http_requests_total",
+                                 method=self.command, code="200")
                     body = REGISTRY.render().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain; version=0.0.4")
