@@ -102,8 +102,9 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
 def sharding_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     """PartitionSpecs per param, mirroring init_params' tree. tp shards heads
     and ff, fsdp the complementary axis, ep the expert axis. With pipelining,
-    the leading layer axis is sharded over pp (and tp/fsdp must be 1 inside
-    the pipeline; see parallel/pipeline.py)."""
+    the leading layer axis is sharded over pp; tp is kept (manual row-parallel
+    psums in the stage body) while fsdp param sharding is dropped
+    (see parallel/pipeline.py for the composition rules)."""
     # pipelined stages run in manual shard_map mode: tp sharding is kept
     # (row-parallel psums in _apply_layer), fsdp param sharding is dropped
     # (no manual fsdp collectives yet; see ROADMAP.md)
@@ -206,19 +207,30 @@ def _moe_mlp(
 
 
 def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
-                 manual_tp_axis=None):
+                 manual_tp_axis=None, manual_sp_axis=None, manual_vma_axes=()):
     """One transformer block; lp leaves have no leading layer axis.
     Returns (x, aux) — aux is the layer's MoE load-balancing loss (0 for
     dense layers).
 
-    ``manual_tp_axis``: set when running inside a shard_map (pipeline stages)
-    with weights tensor-sharded over that axis — heads and the MLP hidden dim
-    are device-local, and the two row-parallel projections (attention out,
-    MLP down) psum their partial sums Megatron-style."""
+    Manual (shard_map / pipeline-stage) mode:
+    - ``manual_tp_axis``: weights tensor-sharded over that axis — heads and
+      the MLP hidden dim are device-local, and the two row-parallel
+      projections (attention out, MLP down) psum Megatron-style;
+    - ``manual_sp_axis``: activations sequence-sharded over that axis — RoPE
+      positions are offset by the shard index and attention runs the local
+      ring body directly (``manual_vma_axes`` seeds its accumulators'
+      device-varying state)."""
     dtype = cfg.dtype
 
     def row_parallel(out):
         return lax.psum(out, manual_tp_axis) if manual_tp_axis else out
+
+    if manual_sp_axis is not None:
+        t_local = x.shape[1]
+        positions = (
+            lax.axis_index(manual_sp_axis) * t_local
+            + lax.iota(jnp.int32, t_local)
+        )[None, :]
 
     h = _rms_norm(x, lp["attn_norm"])
     q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
@@ -226,7 +238,14 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
     v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    if cfg.attn_impl in ("ring", "ulysses"):
+    if manual_sp_axis is not None:
+        from hivedscheduler_tpu.parallel.ring_attention import _ring_attention_local
+
+        attn = _ring_attention_local(
+            q, k, v, axis_name=manual_sp_axis, causal=True,
+            mesh_axes=manual_vma_axes,
+        )
+    elif cfg.attn_impl in ("ring", "ulysses"):
         attn = attn_fn(q, k, v, mesh, causal=True)
     else:
         attn = attn_fn(q, k, v, causal=True)
@@ -280,23 +299,29 @@ def forward_with_aux(
 
     aux_total = jnp.zeros((), jnp.float32)
     if cfg.pipeline_microbatches > 0:
-        assert cfg.attn_impl in ("xla", "flash"), (
-            "pipelined stages need local attention (sp collectives inside "
-            "a pipeline stage are not supported yet)"
+        assert cfg.attn_impl in ("xla", "flash", "ring"), (
+            "pipelined stages support local attention or ring attention "
+            "(ulysses inside a pipeline stage is not supported yet)"
         )
         assert cfg.n_experts == 0, (
             "MoE inside a pipeline stage is not supported yet (ep dispatch "
             "needs GSPMD, pipeline stages run in manual shard_map mode)"
         )
         manual_tp = None
+        manual_sp = None
         if mesh is not None:
             shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-            if shape.get("sp", 1) > 1:
+            if shape.get("sp", 1) > 1 and cfg.attn_impl != "ring":
                 raise ValueError(
-                    "pipeline_microbatches > 0 requires mesh sp == 1 "
-                    f"(got sp={shape.get('sp')}); sequence collectives inside "
-                    "pipeline stages are not supported yet"
+                    "pipeline with mesh sp > 1 requires attn_impl='ring' "
+                    f"(got {cfg.attn_impl}): the sequence axis is sharded "
+                    "inside the stage"
                 )
+            if cfg.attn_impl == "ring" and "sp" in shape:
+                # always run the manual ring body inside the stage (a GSPMD
+                # shard_map cannot open inside the pipeline's manual context;
+                # with sp == 1 the ring is a single local step)
+                manual_sp = "sp"
             if "tp" in shape:
                 # Megatron-style psums inside the stage; with tp == 1 the
                 # psum is free but still normalizes the shard_map vma of the
@@ -305,11 +330,19 @@ def forward_with_aux(
         from hivedscheduler_tpu.parallel.pipeline import pipeline_apply
 
         layer_specs = sharding_specs(cfg)["layers"]
+        # axes the activations/weights vary over inside the stage body (for
+        # the ring accumulators' vma seed): batch + stage + tp-local heads +
+        # the sequence shard itself
+        vma_axes = ("dp", "fsdp", "pp") + (("tp",) if manual_tp else ()) + (
+            ("sp",) if manual_sp else ()
+        )
 
         def stage_block(stage_params, h):
             def stage_layer(xx, lp):
                 out, _ = _apply_layer(xx, lp, positions, cfg, attn_fn, mesh,
-                                      manual_tp_axis=manual_tp)
+                                      manual_tp_axis=manual_tp,
+                                      manual_sp_axis=manual_sp,
+                                      manual_vma_axes=vma_axes)
                 return out, None
 
             hh, _ = lax.scan(jax.checkpoint(stage_layer), h, stage_params)
@@ -322,6 +355,7 @@ def forward_with_aux(
             x,
             mesh,
             n_micro=cfg.pipeline_microbatches,
+            seq_axis=manual_sp,
         )
     else:
         # rematerialize per-layer activations in the backward pass: HBM is

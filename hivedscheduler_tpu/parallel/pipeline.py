@@ -8,15 +8,18 @@ stacked layer params are sharded on their leading layer axis with
 the classic GPipe bubble. The whole schedule is a differentiable ``lax.scan``,
 so one jitted train step backpropagates through the pipeline naturally.
 
-Constraints (validated in ``models.transformer.forward_with_aux``):
-attention inside a stage must be local (``attn_impl in ("xla", "flash")``)
-and the sp mesh axis must be 1 when pp > 1 (ring attention inside a stage is
-planned). Tensor parallelism composes: stage weights keep their tp sharding
-and ``_apply_layer`` inserts Megatron-style row-parallel psums. Batch
-parallelism over dp/fsdp composes for *activations*; layer params are
-replicated across fsdp inside pipeline stages (``sharding_specs`` drops
-their fsdp placement when pipelining), so pipelining trades FSDP param
-sharding for stage sharding.
+Composition (validated in ``models.transformer.forward_with_aux``):
+- tensor parallelism composes — stage weights keep their tp sharding and
+  ``_apply_layer`` inserts Megatron-style row-parallel psums;
+- sequence parallelism composes with ``attn_impl="ring"`` — ``seq_axis``
+  shards T into the stage and the ring's local body runs directly in the
+  manual context (sp > 1 with local attention is rejected; Ulysses inside a
+  stage is not supported yet);
+- dp/fsdp compose for *activations*; layer params are replicated across
+  fsdp inside pipeline stages (``sharding_specs`` drops their fsdp
+  placement when pipelining), so pipelining trades FSDP param sharding for
+  stage sharding;
+- MoE inside a stage is not supported yet.
 """
 
 from __future__ import annotations
@@ -97,20 +100,22 @@ def pipeline_apply(
     n_micro: int,
     axis: str = "pp",
     batch_axes=("dp", "fsdp"),
+    seq_axis=None,
 ) -> jax.Array:
     """Run ``hidden`` [B, T, D] through all layers, pipelined over ``axis``.
 
     ``stacked_params``: pytree whose leaves have the layer count on axis 0
     (divisible by the pp size); ``param_specs``: matching pytree of
     PartitionSpecs whose first entry is ``axis``; ``layer_block_fn(stage_params,
-    h) -> h`` applies one stage's worth of layers.
+    h) -> h`` applies one stage's worth of layers. ``seq_axis`` shards the T
+    dimension into the stage (ring attention runs inside the stage body).
     """
     try:
         from jax import shard_map
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
-    hidden_spec = P(tuple(batch_axes), None, None)
+    hidden_spec = P(tuple(batch_axes), seq_axis, None)
     fn = shard_map(
         functools.partial(
             _pipeline_local,

@@ -64,14 +64,16 @@ def _make_handler(scheduler: HivedScheduler):
         def _reply(self, code: int, obj: Any) -> None:
             from hivedscheduler_tpu.runtime.metrics import REGISTRY
 
-            REGISTRY.inc("tpu_hive_http_requests_total",
-                         method=self.command, code=str(code))
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            # count only after a successful write: a broken-pipe mid-response
+            # must not double-count the request via the 500 fallback
+            REGISTRY.inc("tpu_hive_http_requests_total",
+                         method=self.command, code=str(code))
 
         def _reply_error(self, e: Exception) -> None:
             """Panic -> HTTP error (reference: webserver.go:142-155):
