@@ -116,6 +116,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
                 parsed.virtual_non_pinned_free[vc_name],
                 parsed.virtual_pinned_cells[vc_name],
                 parsed.cell_level_to_leaf_cell_num,
+                policy=config.virtual_clusters[vc_name].scheduling_policy,
             )
         for chain, ccl in self.full_cell_list.items():
             self.opportunistic_schedulers[chain] = TopologyAwareScheduler(

@@ -22,8 +22,13 @@ from hivedscheduler_tpu.algorithm.types import (
 log = logging.getLogger(__name__)
 
 
+SCHEDULING_POLICIES = ("pack", "spread")
+
+
 class IntraVCScheduler:
-    """Reference: defaultIntraVCScheduler, intra_vc_scheduler.go:45-117."""
+    """Reference: defaultIntraVCScheduler, intra_vc_scheduler.go:45-117, plus
+    the per-VC policy hook the reference leaves as a TODO
+    (hived_algorithm.go:133): "pack" (default) or "spread"."""
 
     def __init__(
         self,
@@ -31,7 +36,14 @@ class IntraVCScheduler:
         non_pinned_free_list: Dict[CellChain, ChainCellList],
         pinned_list: Dict[str, ChainCellList],
         leaf_cell_nums: Dict[CellChain, Dict[CellLevel, int]],
+        policy: str = "pack",
     ):
+        if policy not in SCHEDULING_POLICIES:
+            raise ValueError(
+                f"unknown schedulingPolicy {policy!r}; supported: "
+                f"{', '.join(SCHEDULING_POLICIES)}"
+            )
+        pack = policy == "pack"
         self.non_pinned_full_cell_list = non_pinned_full_list
         self.non_pinned_preassigned_cells = non_pinned_free_list
         self.pinned_cells = pinned_list
@@ -39,13 +51,15 @@ class IntraVCScheduler:
         # HivedAlgorithm._init_cell_nums rejects such configs right after
         self.non_pinned_cell_schedulers: Dict[CellChain, TopologyAwareScheduler] = {
             chain: TopologyAwareScheduler(
-                ccl, leaf_cell_nums.get(chain, {}), cross_priority_pack=True
+                ccl, leaf_cell_nums.get(chain, {}), cross_priority_pack=True,
+                pack=pack,
             )
             for chain, ccl in non_pinned_full_list.items()
         }
         self.pinned_cell_schedulers: Dict[str, TopologyAwareScheduler] = {
             pid: TopologyAwareScheduler(
-                ccl, leaf_cell_nums[ccl[1][0].chain], cross_priority_pack=True
+                ccl, leaf_cell_nums[ccl[1][0].chain], cross_priority_pack=True,
+                pack=pack,
             )
             for pid, ccl in pinned_list.items()
         }

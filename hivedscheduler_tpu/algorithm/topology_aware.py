@@ -128,16 +128,18 @@ def _node_healthy_and_in_suggested(
 
 
 def _find_nodes_for_pods(
-    cv: List[_Node], leaf_cell_nums: List[int]
+    cv: List[_Node], leaf_cell_nums: List[int], pack: bool = True
 ) -> Tuple[Optional[List[int]], str]:
     """Greedy bin-packing over the sorted view (reference: findNodesForPods,
     topology_aware_scheduler.go:268-306). Nodes sorted by: healthy first,
-    suggested first, more same-priority-used, fewer higher-priority-used."""
+    suggested first, then busiest-first (``pack``, the reference behavior) or
+    emptiest-first (``spread`` policy), fewer higher-priority-used last."""
+    sign = -1 if pack else 1
     cv.sort(
         key=lambda n: (
             not n.healthy,
             not n.suggested,
-            -n.used_leaf_cell_num_same_priority,
+            sign * n.used_leaf_cell_num_same_priority,
             n.used_leaf_cell_num_higher_priority,
         )
     )
@@ -365,10 +367,13 @@ class TopologyAwareScheduler:
         ccl: ChainCellList,
         level_leaf_cell_num: Dict[CellLevel, int],
         cross_priority_pack: bool,
+        pack: bool = True,
     ):
         self.cv = _new_cluster_view(ccl)
         self.level_leaf_cell_num = level_leaf_cell_num
         self.cross_priority_pack = cross_priority_pack
+        # pack=False = "spread" policy: prefer emptier nodes
+        self.pack = pack
 
     def schedule(
         self,
@@ -387,11 +392,15 @@ class TopologyAwareScheduler:
 
         priority = OPPORTUNISTIC_PRIORITY
         self._update_cluster_view(priority, suggested_nodes, ignore_suggested_nodes)
-        picked_indices, failed_reason = _find_nodes_for_pods(self.cv, sorted_pod_nums)
+        picked_indices, failed_reason = _find_nodes_for_pods(
+            self.cv, sorted_pod_nums, self.pack
+        )
         if picked_indices is None and p > OPPORTUNISTIC_PRIORITY:
             priority = p
             self._update_cluster_view(priority, suggested_nodes, ignore_suggested_nodes)
-            picked_indices, failed_reason = _find_nodes_for_pods(self.cv, sorted_pod_nums)
+            picked_indices, failed_reason = _find_nodes_for_pods(
+                self.cv, sorted_pod_nums, self.pack
+            )
         if picked_indices is None:
             return None, failed_reason
 

@@ -223,22 +223,29 @@ class PinnedCellSpec:
 
 @dataclass
 class VirtualClusterSpec:
-    """Reference: types.go:64-67."""
+    """Reference: types.go:64-67, plus the per-VC ``schedulingPolicy`` hook
+    (the reference leaves this as a TODO, hived_algorithm.go:133):
+    ``pack`` (default — busiest nodes first, tightest affinity) or ``spread``
+    (emptiest nodes first, for failure-domain spreading)."""
 
     virtual_cells: List[VirtualCellSpec] = field(default_factory=list)
     pinned_cells: List[PinnedCellSpec] = field(default_factory=list)
+    scheduling_policy: str = "pack"
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "VirtualClusterSpec":
         return VirtualClusterSpec(
             virtual_cells=[VirtualCellSpec.from_dict(c) for c in d.get("virtualCells", [])],
             pinned_cells=[PinnedCellSpec.from_dict(c) for c in d.get("pinnedCells", [])],
+            scheduling_policy=d.get("schedulingPolicy", "pack"),
         )
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"virtualCells": [c.to_dict() for c in self.virtual_cells]}
         if self.pinned_cells:
             out["pinnedCells"] = [c.to_dict() for c in self.pinned_cells]
+        if self.scheduling_policy != "pack":
+            out["schedulingPolicy"] = self.scheduling_policy
         return out
 
 

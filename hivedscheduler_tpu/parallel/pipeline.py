@@ -33,12 +33,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
-def _varying(x, axes):
-    if not axes:
-        return x
-    if hasattr(lax, "pcast"):
-        return lax.pcast(x, tuple(axes), to="varying")
-    return lax.pvary(x, tuple(axes))
+from hivedscheduler_tpu.parallel.shard_utils import varying as _varying
 
 
 def _pipeline_local(

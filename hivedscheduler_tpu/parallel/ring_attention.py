@@ -46,8 +46,13 @@ def _block_attention(q, k, v, q_offset, k_offset, causal, scale):
     return o, m_safe, l
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool, mesh_axes=()):
-    """Per-shard body (runs under shard_map): rotate k/v around the ring."""
+from hivedscheduler_tpu.parallel.shard_utils import varying as _varying
+
+
+def _ring_forward(q, k, v, axis_name: str, causal: bool, mesh_axes):
+    """Forward ring: rotate k/v, accumulate online softmax. Returns
+    (out [B,Tq,H,D] in q.dtype, m [B,H,Tq] f32 row maxes, l [B,H,Tq] f32
+    denominators)."""
     axis_size = lax.psum(1, axis_name)
     my_index = lax.axis_index(axis_name)
     b, t_q, h, d = q.shape
@@ -55,18 +60,9 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, mesh_axes=()):
     scale = 1.0 / (d**0.5)
     qf = q.astype(jnp.float32)
 
-    # accumulators must be device-varying over the mesh axes to sit in a
-    # fori_loop carry with the ppermuted k/v (shard_map vma rules)
-    def varying(x):
-        if not mesh_axes:
-            return x
-        if hasattr(lax, "pcast"):
-            return lax.pcast(x, tuple(mesh_axes), to="varying")
-        return lax.pvary(x, tuple(mesh_axes))
-
-    o_acc = varying(jnp.zeros((b, h, t_q, d), jnp.float32))
-    m_acc = varying(jnp.full((b, h, t_q), NEG_INF, jnp.float32))
-    l_acc = varying(jnp.zeros((b, h, t_q), jnp.float32))
+    o_acc = _varying(jnp.zeros((b, h, t_q, d), jnp.float32), mesh_axes)
+    m_acc = _varying(jnp.full((b, h, t_q), NEG_INF, jnp.float32), mesh_axes)
+    l_acc = _varying(jnp.zeros((b, h, t_q), jnp.float32), mesh_axes)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def merge_block(step, o_acc, m_acc, l_acc, k_cur, v_cur):
@@ -116,7 +112,125 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, mesh_axes=()):
     )
     l_safe = jnp.where(l_acc == 0.0, 1.0, l_acc)
     out = (o_acc / l_safe[..., None]).astype(q.dtype)
-    return jnp.einsum("bhqd->bqhd", out)
+    return jnp.einsum("bhqd->bqhd", out), m_acc, l_acc
+
+
+def _ring_backward(q, k, v, out, m, l, g, axis_name: str, causal: bool, mesh_axes):
+    """Flash-style backward ring: q/do/delta stay put while k/v travel with
+    their gradient accumulators; after a full rotation dk/dv arrive home.
+    Per-device memory is O(local block), not O(steps x block) — the reason
+    for the custom VJP instead of autodiff through the forward loop."""
+    axis_size = lax.psum(1, axis_name)
+    my_index = lax.axis_index(axis_name)
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    scale = 1.0 / (d**0.5)
+
+    qf = jnp.einsum("bqhd->bhqd", q.astype(jnp.float32))
+    do = jnp.einsum("bqhd->bhqd", g.astype(jnp.float32))
+    of = jnp.einsum("bqhd->bhqd", out.astype(jnp.float32))
+    delta = jnp.sum(do * of, axis=-1)  # [B,H,Tq]
+    m_safe = jnp.maximum(m, -0.5 * abs(NEG_INF))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+
+    dq = _varying(jnp.zeros((b, h, t_q, d), jnp.float32), mesh_axes)
+    dk0 = _varying(jnp.zeros((b, h, t_k, d), jnp.float32), mesh_axes)
+    dv0 = _varying(jnp.zeros((b, h, t_k, d), jnp.float32), mesh_axes)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def merge_grad(step, dq, dk_cur, dv_cur, k_cur, v_cur):
+        """Gradient contributions of the block currently held (originating on
+        shard my_index - step); fully-masked causal blocks are skipped."""
+        src = (my_index - step) % axis_size
+
+        def attend(args):
+            dq, dk_cur, dv_cur, k_cur, v_cur = args
+            kf = jnp.einsum("bkhd->bhkd", k_cur.astype(jnp.float32))
+            vf = jnp.einsum("bkhd->bhkd", v_cur.astype(jnp.float32))
+            s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+            if causal:
+                q_pos = my_index * t_q + lax.iota(jnp.int32, t_q)
+                k_pos = src * t_k + lax.iota(jnp.int32, t_k)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                s = jnp.where(mask[None, None], s, NEG_INF)
+            # exact probabilities from the saved global max and denominator
+            p = jnp.exp(s - m_safe[..., None]) / l_safe[..., None]
+            dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, do)
+            dp = jnp.einsum("bhqd,bhkd->bhqk", do, vf)
+            ds = p * (dp - delta[..., None])
+            dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+            dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+            return dq, dk_cur + dk_blk, dv_cur + dv_blk
+
+        if causal:
+            return lax.cond(
+                src <= my_index,
+                attend,
+                lambda args: (args[0], args[1], args[2]),
+                (dq, dk_cur, dv_cur, k_cur, v_cur),
+            )
+        return attend((dq, dk_cur, dv_cur, k_cur, v_cur))
+
+    def body(step, carry):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        dq, dk_cur, dv_cur = merge_grad(step, dq, dk_cur, dv_cur, k_cur, v_cur)
+        # rotate the block AND its gradient accumulators together
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = lax.ppermute(dv_cur, axis_name, perm)
+        return dq, k_nxt, v_nxt, dk_nxt, dv_nxt
+
+    # n-1 full rotations, then the final block attends without rotating k/v
+    # (they are no longer needed); only dk/dv take the last hop home
+    dq, k_last, v_last, dk_last, dv_last = lax.fori_loop(
+        0, axis_size - 1, body, (dq, k, v, dk0, dv0)
+    )
+    dq, dk_last, dv_last = merge_grad(
+        axis_size - 1, dq, dk_last, dv_last, k_last, v_last
+    )
+    dk = lax.ppermute(dk_last, axis_name, perm)
+    dv = lax.ppermute(dv_last, axis_name, perm)
+    return (
+        jnp.einsum("bhqd->bqhd", dq).astype(q.dtype),
+        jnp.einsum("bhkd->bkhd", dk).astype(k.dtype),
+        jnp.einsum("bhkd->bkhd", dv).astype(v.dtype),
+    )
+
+
+_RING_CORES = {}
+
+
+def _ring_core(axis_name: str, causal: bool, mesh_axes):
+    """custom_vjp-wrapped ring attention core, cached per configuration."""
+    key = (axis_name, causal, tuple(mesh_axes))
+    core = _RING_CORES.get(key)
+    if core is not None:
+        return core
+
+    @jax.custom_vjp
+    def core(q, k, v):
+        out, _, _ = _ring_forward(q, k, v, axis_name, causal, mesh_axes)
+        return out
+
+    def fwd(q, k, v):
+        out, m, l = _ring_forward(q, k, v, axis_name, causal, mesh_axes)
+        return out, (q, k, v, out, m, l)
+
+    def bwd(res, g):
+        q, k, v, out, m, l = res
+        return _ring_backward(q, k, v, out, m, l, g, axis_name, causal, mesh_axes)
+
+    core.defvjp(fwd, bwd)
+    _RING_CORES[key] = core
+    return core
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, mesh_axes=()):
+    """Per-shard body (runs under shard_map): forward ring with a hand-written
+    flash-style backward (memory O(local block) instead of autodiff's
+    O(ring steps) saved carries)."""
+    return _ring_core(axis_name, causal, mesh_axes)(q, k, v)
 
 
 def ring_attention(

@@ -1,0 +1,16 @@
+"""Small helpers shared by the manual-mode (shard_map) modules."""
+
+from __future__ import annotations
+
+from jax import lax
+
+
+def varying(x, mesh_axes):
+    """Seed device-varying state on fresh arrays so they can sit in loop
+    carries with ppermuted data (shard_map vma rules). Handles the
+    pcast/pvary API rename across JAX versions."""
+    if not mesh_axes:
+        return x
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, tuple(mesh_axes), to="varying")
+    return lax.pvary(x, tuple(mesh_axes))

@@ -503,3 +503,50 @@ class TestInvalidInitialAssignment:
         path.write_text(yaml.safe_dump(cfg))
         with pytest.raises(AssertionError):
             HivedAlgorithm(load_config(str(path)))
+
+
+class TestSchedulingPolicy:
+    def test_spread_policy_prefers_empty_nodes(self, tmp_path):
+        import yaml
+
+        with open(FIXTURE) as f:
+            cfg = yaml.safe_load(f)
+        cfg["virtualClusters"]["vc1"]["schedulingPolicy"] = "spread"
+        path = tmp_path / "spread.yaml"
+        path.write_text(yaml.safe_dump(cfg))
+        h = HivedAlgorithm(load_config(str(path)))
+        set_healthy_nodes(h)
+        # two 4-chip v4 pods in vc1: spread lands them on different nodes
+        nodes = set()
+        for i in range(2):
+            _, info = schedule_and_allocate(h, make_pod(f"s-{i}", {
+                "virtualCluster": "vc1", "priority": 0,
+                "chipType": "v4-chip", "chipNumber": 4,
+                "affinityGroup": {"name": f"s-{i}",
+                                  "members": [{"podNumber": 1, "chipNumber": 4}]}}))
+            nodes.add(info.node)
+        assert len(nodes) == 2  # spread across nodes
+
+        # default pack policy packs both onto one node
+        h2 = HivedAlgorithm(load_config(FIXTURE))
+        set_healthy_nodes(h2)
+        nodes2 = set()
+        for i in range(2):
+            _, info = schedule_and_allocate(h2, make_pod(f"p-{i}", {
+                "virtualCluster": "vc1", "priority": 0,
+                "chipType": "v4-chip", "chipNumber": 4,
+                "affinityGroup": {"name": f"p-{i}",
+                                  "members": [{"podNumber": 1, "chipNumber": 4}]}}))
+            nodes2.add(info.node)
+        assert len(nodes2) == 1  # packed
+
+    def test_unknown_policy_rejected(self, tmp_path):
+        import yaml
+
+        with open(FIXTURE) as f:
+            cfg = yaml.safe_load(f)
+        cfg["virtualClusters"]["vc1"]["schedulingPolicy"] = "chaotic"
+        path = tmp_path / "bad.yaml"
+        path.write_text(yaml.safe_dump(cfg))
+        with pytest.raises(ValueError, match="unknown schedulingPolicy"):
+            HivedAlgorithm(load_config(str(path)))
