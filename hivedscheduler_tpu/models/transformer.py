@@ -10,9 +10,10 @@ kernel (``ops/attention.py``), or ring/Ulysses sequence parallelism over the
 Parallelism:
 - tp shards heads and MLP hidden, fsdp the complementary param axis, dp/sp
   shard activations (annotation-driven; XLA inserts the collectives);
-- ``n_experts > 0`` turns every MLP into a switch (top-1) MoE layer with the
-  expert dimension sharded over ``ep`` (capacity-based dense dispatch, the
-  standard GSPMD expert-parallel formulation);
+- ``n_experts > 0`` turns every MLP into a MoE layer (top-1 switch routing
+  by default, ``moe_top_k=2`` for renormalized top-2) with the expert
+  dimension sharded over ``ep`` (capacity-based dense dispatch, the standard
+  GSPMD expert-parallel formulation);
 - ``pipeline_microbatches > 0`` runs the layer stack GPipe-pipelined over the
   ``pp`` mesh axis (``parallel/pipeline.py``), layer params sharded by stage.
 """
@@ -44,6 +45,9 @@ class TransformerConfig:
     # switch-MoE: 0 = dense MLP; >0 = experts per MoE layer (ep-sharded)
     n_experts: int = 0
     expert_capacity_factor: float = 1.25
+    # experts per token: 1 = switch routing (raw top gate), 2 = top-2 with
+    # gates renormalized over the chosen experts
+    moe_top_k: int = 1
     # weight of the Switch load-balancing auxiliary loss (router collapse
     # prevention); added to the LM loss by parallel/train.py
     moe_aux_weight: float = 0.01
@@ -169,26 +173,39 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 def _moe_mlp(
     h: jax.Array, lp: Dict[str, Any], cfg: TransformerConfig, dtype, mesh=None
 ):
-    """Switch (top-1) MoE with capacity-based dense dispatch; the expert axis
-    is ep-sharded so GSPMD turns the dispatch einsums into all_to_alls.
-    Returns (output, aux) where aux is the Switch load-balancing loss term
-    E * sum_e(frac_tokens_e * mean_prob_e) for this layer."""
+    """Top-k MoE with capacity-based dense dispatch; the expert axis is
+    ep-sharded so GSPMD turns the dispatch einsums into all_to_alls. Top-1
+    uses the raw switch gate; top-2 renormalizes the gates over the chosen
+    experts. Returns (output, aux) where aux is the Switch load-balancing
+    loss term E * sum_e(first_choice_frac_e * mean_prob_e) for this layer."""
     b, t, d = h.shape
     E = cfg.n_experts
-    capacity = max(1, int(math.ceil(t / E * cfg.expert_capacity_factor)))
+    top_k = max(1, min(cfg.moe_top_k, E))
+    capacity = max(1, int(math.ceil(t * top_k / E * cfg.expert_capacity_factor)))
     logits = jnp.einsum("btd,de->bte", h, lp["router"].astype(dtype)).astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # [B, T]
-    gate = jnp.take_along_axis(probs, expert_idx[..., None], axis=-1)[..., 0]
-    mask = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [B, T, E]
-    # Switch aux loss: pushes routing toward uniform expert load
-    aux = E * jnp.sum(jnp.mean(mask, axis=(0, 1)) * jnp.mean(probs, axis=(0, 1)))
-    # position of each token within its expert (per batch row), 0-based
-    pos = jnp.cumsum(mask, axis=1) * mask - 1.0
-    keep = (pos >= 0) & (pos < capacity)
-    dispatch = jax.nn.one_hot(
-        jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity, dtype=jnp.float32
-    ) * keep.astype(jnp.float32)[..., None]  # [B, T, E, C]
+    top_gates, top_idx = lax.top_k(probs, top_k)  # [B, T, K]
+    if top_k > 1:
+        top_gates = top_gates / jnp.sum(top_gates, axis=-1, keepdims=True)
+    masks = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)  # [B, T, K, E]
+    # aux loss on the first choice (standard Switch load balancing)
+    aux = E * jnp.sum(
+        jnp.mean(masks[:, :, 0, :], axis=(0, 1)) * jnp.mean(probs, axis=(0, 1))
+    )
+    # per-expert slot assignment: choice 0 tokens queue first, then choice 1
+    combine = jnp.zeros((b, t, E, capacity), jnp.float32)
+    counts = jnp.zeros((b, E), jnp.float32)
+    for i in range(top_k):
+        m = masks[:, :, i, :]  # [B, T, E]
+        pos = jnp.cumsum(m, axis=1) * m - 1.0 + counts[:, None, :] * m
+        keep = m * ((pos >= 0) & (pos < capacity)).astype(jnp.float32)
+        slot = jax.nn.one_hot(
+            jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity,
+            dtype=jnp.float32,
+        ) * keep[..., None]  # [B, T, E, C]
+        combine = combine + slot * top_gates[:, :, i][..., None, None]
+        counts = counts + jnp.sum(m, axis=1)
+    dispatch = (combine > 0.0).astype(jnp.float32)  # [B, T, E, C]
     expert_in = jnp.einsum("btec,btd->ebcd", dispatch.astype(dtype), h)
     if mesh is not None:
         from jax.sharding import NamedSharding
@@ -201,7 +218,7 @@ def _moe_mlp(
     expert_out = jnp.einsum(
         "ebcf,efd->ebcd", jax.nn.silu(g) * u, lp["w_down"].astype(dtype)
     )
-    combine = dispatch * gate[..., None, None]  # weight by the router prob
+    # `combine` already carries the per-token gate weights per slot
     out = jnp.einsum("btec,ebcd->btd", combine.astype(dtype), expert_out)
     return out, aux
 

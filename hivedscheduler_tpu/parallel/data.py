@@ -1,0 +1,90 @@
+"""Host-parallel data loading for gangs.
+
+Each gang pod (one JAX process per host) reads only its shard of the global
+batch and assembles the global array with
+``jax.make_array_from_process_local_data`` — no host ever materializes the
+full batch. Sources: a memory-mapped token file (binary uint16/uint32 stream,
+the standard packed-LM format) or the deterministic synthetic corpus used by
+``train.py`` when no data file is given.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class TokenFileDataset:
+    """A flat binary token stream, memory-mapped (zero-copy reads)."""
+
+    def __init__(self, path: str, dtype: str = "uint16"):
+        self.tokens = np.memmap(path, dtype=np.dtype(dtype), mode="r")
+        if len(self.tokens) == 0:
+            raise ValueError(f"token file {path} is empty")
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    def sample(self, rng: np.random.Generator, batch: int, seq_len: int,
+               row_slice: slice = slice(None)) -> np.ndarray:
+        """Random contiguous windows (with wraparound). ``row_slice`` gathers
+        only those rows of the batch — the start positions are still drawn
+        for the whole batch so every host sees the same global plan while
+        reading only its own shard."""
+        n = len(self.tokens)
+        starts = rng.integers(0, n, size=batch)[row_slice]
+        idx = (starts[:, None] + np.arange(seq_len)[None, :]) % n
+        return np.asarray(self.tokens[idx], dtype=np.int32)
+
+
+def synthetic_dataset(vocab_size: int, size: int = 1 << 20, seed: int = 0):
+    """In-memory stand-in with the TokenFileDataset interface."""
+    rng = np.random.default_rng(seed)
+    dtype = np.uint16 if vocab_size <= (1 << 16) else np.uint32
+    ds = TokenFileDataset.__new__(TokenFileDataset)
+    ds.tokens = rng.integers(0, vocab_size, size=size).astype(dtype)
+    return ds
+
+
+def host_batches(
+    dataset: TokenFileDataset,
+    global_batch: int,
+    seq_len: int,
+    *,
+    process_index: int = 0,
+    process_count: int = 1,
+    seed: int = 0,
+    start_step: int = 0,
+) -> Iterator[np.ndarray]:
+    """Yield this host's [global_batch / process_count, seq_len] shard.
+
+    All hosts derive per-step RNG from (seed, step) and gather only their own
+    rows, so the global batch is consistent without coordination and no host
+    materializes it. ``start_step`` resumes the stream mid-corpus (checkpoint
+    restarts must not replay seen data)."""
+    if global_batch % process_count != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {process_count} hosts"
+        )
+    local = global_batch // process_count
+    rows = slice(process_index * local, (process_index + 1) * local)
+    step = start_step
+    while True:
+        rng = np.random.default_rng((seed, step))
+        yield dataset.sample(rng, global_batch, seq_len, row_slice=rows)
+        step += 1
+
+
+def device_put_global(local_batch: np.ndarray, sharding, global_batch: int):
+    """Assemble the global [global_batch, seq_len] array from this process's
+    local rows, placed per ``sharding``. Single-process: a plain device_put."""
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_put(local_batch, sharding)
+    global_shape = (global_batch,) + tuple(local_batch.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, local_batch, global_shape=global_shape
+    )

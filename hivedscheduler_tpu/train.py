@@ -22,20 +22,6 @@ import time
 log = logging.getLogger(__name__)
 
 
-def synthetic_batches(vocab_size: int, batch: int, seq_len: int, seed: int = 0):
-    """Deterministic synthetic LM data: a repeating pseudo-corpus so loss
-    curves are comparable across runs (stands in for a real data loader)."""
-    import jax
-    import jax.numpy as jnp
-
-    key = jax.random.PRNGKey(seed)
-    step = 0
-    while True:
-        k = jax.random.fold_in(key, step % 64)  # 64-batch repeating corpus
-        yield jax.random.randint(k, (batch, seq_len), 0, vocab_size, jnp.int32)
-        step += 1
-
-
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="tpu-hive-train")
     parser.add_argument("--steps", type=int, default=100)
@@ -56,8 +42,14 @@ def main(argv=None) -> int:
     parser.add_argument("--ep", type=int, default=1,
                         help="expert-parallel size (with --n-experts)")
     parser.add_argument("--n-experts", type=int, default=0)
+    parser.add_argument("--moe-top-k", type=int, default=1)
     parser.add_argument("--attn", default=None,
                         help="xla|flash|ring|ulysses (default: ring when sp>1)")
+    parser.add_argument("--data", default="",
+                        help="packed token file; synthetic corpus when omitted")
+    parser.add_argument("--data-dtype", default="uint16",
+                        choices=["uint16", "uint32"],
+                        help="token dtype of the --data file")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=50)
     parser.add_argument("--log-every", type=int, default=10)
@@ -103,6 +95,7 @@ def main(argv=None) -> int:
         max_seq_len=args.seq_len,
         attn_impl=attn,
         n_experts=args.n_experts,
+        moe_top_k=args.moe_top_k,
         pipeline_microbatches=args.microbatches if args.pp > 1 else 0,
     )
     step_fn, init_fn, token_sharding = make_sharded_train_step(cfg, mesh)
@@ -118,11 +111,29 @@ def main(argv=None) -> int:
             )
             log.info("resumed from checkpoint step %s", start_step)
 
-    batches = synthetic_batches(cfg.vocab_size, args.batch, args.seq_len)
+    from hivedscheduler_tpu.parallel import data as data_lib
+
+    if args.data:
+        dataset = data_lib.TokenFileDataset(args.data, dtype=args.data_dtype)
+        peak = int(dataset.tokens[: 1 << 16].max())
+        if peak >= cfg.vocab_size:
+            raise SystemExit(
+                f"--data contains token id {peak} >= vocab size "
+                f"{cfg.vocab_size}; wrong --data-dtype or --vocab-size?"
+            )
+    else:
+        dataset = data_lib.synthetic_dataset(cfg.vocab_size)
+    batches = data_lib.host_batches(
+        dataset, args.batch, args.seq_len,
+        process_index=jax.process_index(), process_count=jax.process_count(),
+        start_step=start_step,
+    )
     t0 = time.perf_counter()
     tokens_per_step = args.batch * args.seq_len
     for step in range(start_step, args.steps):
-        tokens = jax.device_put(next(batches), token_sharding)
+        tokens = data_lib.device_put_global(
+            next(batches), token_sharding, args.batch
+        )
         params, opt_state, loss = step_fn(params, opt_state, tokens)
         if (step + 1) % args.log_every == 0:
             loss_v = float(loss)

@@ -201,3 +201,62 @@ class TestPipelineSequenceParallel:
             tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
         with pytest.raises(ValueError, match="requires attn_impl='ring'"):
             tm.forward(params, tokens, cfg, mesh=mesh)
+
+
+class TestTop2MoE:
+    def test_top2_forward_and_train(self):
+        from hivedscheduler_tpu.parallel.train import make_sharded_train_step
+
+        cfg = tiny_cfg(n_experts=4, moe_top_k=2)
+        mesh = cpu_mesh(topology.MeshAxes(dp=2, ep=4))
+        step, init_fn, token_sharding = make_sharded_train_step(cfg, mesh)
+        params, opt_state = init_fn(jax.random.PRNGKey(0))
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64), token_sharding
+        )
+        losses = []
+        for _ in range(4):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+    def test_top2_output_equals_dense_mixture(self):
+        """With ample capacity (nothing dropped), the top-2 MoE output must
+        equal the dense mixture sum_k gate_k * FFN_{expert_k}(h) with gates
+        renormalized over the two chosen experts."""
+        cfg = tiny_cfg(n_experts=4, n_layers=1, moe_top_k=2,
+                       expert_capacity_factor=8.0)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            params = tm.init_params(cfg, jax.random.PRNGKey(0))
+            h = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 32), jnp.float32)
+            lp = jax.tree.map(lambda x: x[0], params["layers"])
+            out, aux = tm._moe_mlp(h, lp, cfg, jnp.float32)
+
+            # dense reference: run every expert on every token, mix by the
+            # renormalized top-2 gates
+            logits = jnp.einsum("btd,de->bte", h, lp["router"])
+            probs = jax.nn.softmax(logits, axis=-1)
+            g2, i2 = jax.lax.top_k(probs, 2)
+            g2 = g2 / g2.sum(-1, keepdims=True)
+            every = jnp.einsum(
+                "ebtf,efd->ebtd",
+                jax.nn.silu(jnp.einsum("btd,edf->ebtf", h, lp["w_gate"]))
+                * jnp.einsum("btd,edf->ebtf", h, lp["w_up"]),
+                lp["w_down"],
+            )  # [E, B, T, D]
+            expected = jnp.zeros_like(h)
+            for kk in range(2):
+                sel = jnp.take_along_axis(
+                    jnp.einsum("ebtd->bted", every), i2[:, :, kk][..., None, None],
+                    axis=2,
+                )[:, :, 0]
+                expected = expected + g2[:, :, kk][..., None] * sel
+        assert bool(jnp.isfinite(aux))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_top1_behavior_unchanged(self):
+        """moe_top_k=1 must keep the raw-gate switch semantics (covered by the
+        capacity-drop test); just confirm the config default wiring."""
+        cfg = tiny_cfg(n_experts=2)
+        assert cfg.moe_top_k == 1
