@@ -224,7 +224,63 @@ def run(measure_iters: int = 30, seed: int = 7):
     return p50, p99, frag_pct
 
 
+def run_scale_4096(seed: int = 7):
+    """Reproduces the PARITY.md v5p-4096 scale figure: a 1024-chip gang
+    (256 pods x 4) on a 16x16x16 cluster. Run: python bench.py --scale-4096"""
+    import time as _t
+
+    from hivedscheduler_tpu.runtime.utils import new_binding_pod as _nbp
+
+    levels = [("l1", (2, 2, 2)), ("l2", (4, 2, 2)), ("l3", (4, 4, 2)),
+              ("l4", (4, 4, 4)), ("l5", (8, 4, 4)), ("l6", (8, 8, 4)),
+              ("l7", (8, 8, 8)), ("l8", (16, 8, 8)), ("l9", (16, 16, 8))]
+    mesh = MeshSpec(topology=(16, 16, 16), chip_type="v5p-chip",
+                    host_shape=(2, 2, 1),
+                    levels=[MeshLevelSpec(name=n, shape=sh) for n, sh in levels])
+    cfg = new_config(Config(
+        physical_cluster=PhysicalClusterSpec(
+            cell_types={"v5p-4096": CellTypeSpec(mesh=mesh)},
+            physical_cells=[PhysicalCellSpec(cell_type="v5p-4096",
+                                             cell_address="pod0")]),
+        virtual_clusters={
+            "vc-a": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=2, cell_type="v5p-4096.l8")]),
+            "vc-b": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=16, cell_type="v5p-4096.l4")]),
+        }))
+    algo = HivedAlgorithm(cfg)
+    nodes = sorted({n for ccl in algo.full_cell_list.values()
+                    for c in ccl[max(ccl)] for n in c.nodes})
+    for n in nodes:
+        algo.add_node(Node(name=n))
+    lat = []
+    for trial in range(4):
+        pods = []
+        t0 = _t.perf_counter()
+        for i in range(256):
+            p = make_pod(f"g{trial}-{i}", "vc-a", 10, f"g{trial}", 256, 4)
+            r = algo.schedule(p, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None, r.pod_wait_info
+            bp = _nbp(p, r.pod_bind_info)
+            algo.add_allocated_pod(bp)
+            pods.append(bp)
+        lat.append(_t.perf_counter() - t0)
+        for bp in pods:
+            algo.delete_allocated_pod(bp)
+    return statistics.median(lat) * 1000.0
+
+
 if __name__ == "__main__":
+    import sys
+
+    if "--scale-4096" in sys.argv:
+        p50 = run_scale_4096()
+        print(json.dumps({
+            "metric": "p50_gang_schedule_latency_1024chip_slice_v5p4096",
+            "value": round(p50, 3), "unit": "ms",
+            "vs_baseline": round(50.0 / p50, 3) if p50 > 0 else None,
+        }))
+        sys.exit(0)
     p50, p99, frag_pct = run()
     baseline_ms = 50.0  # reference deploy's per-pod FIFO blocking tick
     print(

@@ -260,3 +260,28 @@ class TestTop2MoE:
         capacity-drop test); just confirm the config default wiring."""
         cfg = tiny_cfg(n_experts=2)
         assert cfg.moe_top_k == 1
+
+
+class TestMeshLayoutInvariance:
+    def test_loss_identical_across_layouts(self):
+        """The same model/seed/batch must produce the same loss under any
+        mesh layout — dp-only, tp+sp GSPMD, and pp+tp manual mode."""
+        from hivedscheduler_tpu.parallel.train import make_sharded_train_step
+
+        layouts = [
+            (tiny_cfg(), topology.MeshAxes(dp=8)),
+            (tiny_cfg(attn_impl="ring"), topology.MeshAxes(dp=2, tp=2, sp=2)),
+            (tiny_cfg(pipeline_microbatches=2), topology.MeshAxes(dp=2, pp=2, tp=2)),
+        ]
+        losses = []
+        for cfg, axes in layouts:
+            mesh = cpu_mesh(axes)
+            step, init_fn, tok_sh = make_sharded_train_step(cfg, mesh)
+            params, opt_state = init_fn(jax.random.PRNGKey(0))
+            tokens = jax.device_put(
+                jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64), tok_sh
+            )
+            _, _, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))
+        for other in losses[1:]:
+            assert abs(other - losses[0]) < 1e-4, losses
