@@ -227,10 +227,6 @@ def run(measure_iters: int = 30, seed: int = 7):
 def run_scale_4096(seed: int = 7):
     """Reproduces the PARITY.md v5p-4096 scale figure: a 1024-chip gang
     (256 pods x 4) on a 16x16x16 cluster. Run: python bench.py --scale-4096"""
-    import time as _t
-
-    from hivedscheduler_tpu.runtime.utils import new_binding_pod as _nbp
-
     levels = [("l1", (2, 2, 2)), ("l2", (4, 2, 2)), ("l3", (4, 4, 2)),
               ("l4", (4, 4, 4)), ("l5", (8, 4, 4)), ("l6", (8, 8, 4)),
               ("l7", (8, 8, 8)), ("l8", (16, 8, 8)), ("l9", (16, 16, 8))]
@@ -256,15 +252,15 @@ def run_scale_4096(seed: int = 7):
     lat = []
     for trial in range(4):
         pods = []
-        t0 = _t.perf_counter()
+        t0 = time.perf_counter()
         for i in range(256):
             p = make_pod(f"g{trial}-{i}", "vc-a", 10, f"g{trial}", 256, 4)
             r = algo.schedule(p, nodes, FILTERING_PHASE)
             assert r.pod_bind_info is not None, r.pod_wait_info
-            bp = _nbp(p, r.pod_bind_info)
+            bp = new_binding_pod(p, r.pod_bind_info)
             algo.add_allocated_pod(bp)
             pods.append(bp)
-        lat.append(_t.perf_counter() - t0)
+        lat.append(time.perf_counter() - t0)
         for bp in pods:
             algo.delete_allocated_pod(bp)
     return statistics.median(lat) * 1000.0

@@ -171,15 +171,22 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def _moe_mlp(
-    h: jax.Array, lp: Dict[str, Any], cfg: TransformerConfig, dtype, mesh=None
+    h: jax.Array, lp: Dict[str, Any], cfg: TransformerConfig, dtype, mesh=None,
+    manual_ep_axis=None, manual_tp_axis=None,
 ):
     """Top-k MoE with capacity-based dense dispatch; the expert axis is
     ep-sharded so GSPMD turns the dispatch einsums into all_to_alls. Top-1
     uses the raw switch gate; top-2 renormalizes the gates over the chosen
     experts. Returns (output, aux) where aux is the Switch load-balancing
-    loss term E * sum_e(first_choice_frac_e * mean_prob_e) for this layer."""
+    loss term E * sum_e(first_choice_frac_e * mean_prob_e) for this layer.
+
+    ``manual_ep_axis`` (shard_map / pipeline-stage mode): expert weights are
+    device-local slices; routing runs on the full expert count (the router is
+    replicated), each device computes only its experts' slots, and the
+    combine partial-sums are psum'd over the axis."""
     b, t, d = h.shape
-    E = cfg.n_experts
+    # the router is always full-width: its E dim is the global expert count
+    E = lp["router"].shape[-1]
     top_k = max(1, min(cfg.moe_top_k, E))
     capacity = max(1, int(math.ceil(t * top_k / E * cfg.expert_capacity_factor)))
     logits = jnp.einsum("btd,de->bte", h, lp["router"].astype(dtype)).astype(jnp.float32)
@@ -206,8 +213,15 @@ def _moe_mlp(
         combine = combine + slot * top_gates[:, :, i][..., None, None]
         counts = counts + jnp.sum(m, axis=1)
     dispatch = (combine > 0.0).astype(jnp.float32)  # [B, T, E, C]
+    if manual_ep_axis is not None:
+        # manual (pipeline-stage) mode: this device holds E_local experts;
+        # compute their slots only and psum the partial combine
+        e_local = lp["w_gate"].shape[0]
+        start = lax.axis_index(manual_ep_axis) * e_local
+        dispatch = lax.dynamic_slice_in_dim(dispatch, start, e_local, axis=2)
+        combine = lax.dynamic_slice_in_dim(combine, start, e_local, axis=2)
     expert_in = jnp.einsum("btec,btd->ebcd", dispatch.astype(dtype), h)
-    if mesh is not None:
+    if manual_ep_axis is None and mesh is not None:
         from jax.sharding import NamedSharding
 
         expert_in = lax.with_sharding_constraint(
@@ -220,11 +234,17 @@ def _moe_mlp(
     )
     # `combine` already carries the per-token gate weights per slot
     out = jnp.einsum("btec,ebcd->btd", combine.astype(dtype), expert_out)
+    # manual mode: the output is partial over local experts (ep) AND over the
+    # tp-local slice of the expert hidden dim — psum both
+    manual_axes = tuple(a for a in (manual_ep_axis, manual_tp_axis) if a)
+    if manual_axes:
+        out = lax.psum(out, manual_axes)
     return out, aux
 
 
 def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
-                 manual_tp_axis=None, manual_sp_axis=None, manual_vma_axes=()):
+                 manual_tp_axis=None, manual_sp_axis=None, manual_ep_axis=None,
+                 manual_vma_axes=()):
     """One transformer block; lp leaves have no leading layer axis.
     Returns (x, aux) — aux is the layer's MoE load-balancing loss (0 for
     dense layers).
@@ -256,12 +276,18 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     if manual_sp_axis is not None:
-        from hivedscheduler_tpu.parallel.ring_attention import _ring_attention_local
-
-        attn = _ring_attention_local(
-            q, k, v, axis_name=manual_sp_axis, causal=True,
-            mesh_axes=manual_vma_axes,
+        from hivedscheduler_tpu.parallel.ring_attention import (
+            _ring_attention_local,
+            _ulysses_local,
         )
+
+        if cfg.attn_impl == "ulysses":
+            attn = _ulysses_local(q, k, v, axis_name=manual_sp_axis, causal=True)
+        else:
+            attn = _ring_attention_local(
+                q, k, v, axis_name=manual_sp_axis, causal=True,
+                mesh_axes=manual_vma_axes,
+            )
     elif cfg.attn_impl in ("ring", "ulysses"):
         attn = attn_fn(q, k, v, mesh, causal=True)
     else:
@@ -270,7 +296,9 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
     h = _rms_norm(x, lp["mlp_norm"])
     aux = jnp.zeros((), jnp.float32)
     if cfg.n_experts > 0:
-        moe_out, aux = _moe_mlp(h, lp, cfg, dtype, mesh)
+        moe_out, aux = _moe_mlp(h, lp, cfg, dtype, mesh,
+                                manual_ep_axis=manual_ep_axis,
+                                manual_tp_axis=manual_tp_axis)
         x = x + moe_out
     else:
         gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))
@@ -316,34 +344,51 @@ def forward_with_aux(
 
     aux_total = jnp.zeros((), jnp.float32)
     if cfg.pipeline_microbatches > 0:
-        assert cfg.attn_impl in ("xla", "flash", "ring"), (
-            "pipelined stages support local attention or ring attention "
-            "(ulysses inside a pipeline stage is not supported yet)"
-        )
-        assert cfg.n_experts == 0, (
-            "MoE inside a pipeline stage is not supported yet (ep dispatch "
-            "needs GSPMD, pipeline stages run in manual shard_map mode)"
-        )
+        assert cfg.attn_impl in ("xla", "flash", "ring", "ulysses")
         manual_tp = None
         manual_sp = None
+        manual_ep = None
         if mesh is not None:
             shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-            if shape.get("sp", 1) > 1 and cfg.attn_impl != "ring":
+            if shape.get("sp", 1) > 1 and cfg.attn_impl not in ("ring", "ulysses"):
                 raise ValueError(
-                    "pipeline with mesh sp > 1 requires attn_impl='ring' "
-                    f"(got {cfg.attn_impl}): the sequence axis is sharded "
-                    "inside the stage"
+                    "pipeline with mesh sp > 1 requires attn_impl='ring' or "
+                    f"'ulysses' (got {cfg.attn_impl}): the sequence axis is "
+                    "sharded inside the stage"
                 )
-            if cfg.attn_impl == "ring" and "sp" in shape:
-                # always run the manual ring body inside the stage (a GSPMD
-                # shard_map cannot open inside the pipeline's manual context;
-                # with sp == 1 the ring is a single local step)
+            if cfg.attn_impl in ("ring", "ulysses") and "sp" in shape:
+                # always run the manual attention body inside the stage (a
+                # GSPMD shard_map cannot open inside the pipeline's manual
+                # context; with sp == 1 it degenerates to local attention)
                 manual_sp = "sp"
             if "tp" in shape:
                 # Megatron-style psums inside the stage; with tp == 1 the
                 # psum is free but still normalizes the shard_map vma of the
                 # tp-sharded (possibly size-1) weights
                 manual_tp = "tp"
+            if cfg.n_experts > 0 and "ep" in shape:
+                if cfg.n_experts % shape["ep"]:
+                    raise ValueError(
+                        f"n_experts {cfg.n_experts} not divisible by mesh "
+                        f"ep={shape['ep']} inside the pipeline"
+                    )
+                if shape.get("sp", 1) > 1:
+                    raise ValueError(
+                        "MoE with a sequence-sharded pipeline stage (sp > 1) "
+                        "is not supported: per-shard routing would change "
+                        "capacity semantics"
+                    )
+                manual_ep = "ep"
+            if (
+                cfg.attn_impl == "ulysses"
+                and shape.get("sp", 1) > 1
+                and (cfg.n_heads // max(1, shape.get("tp", 1))) % shape["sp"]
+            ):
+                raise ValueError(
+                    f"ulysses in pipeline needs local heads divisible by sp: "
+                    f"{cfg.n_heads} heads / tp={shape.get('tp', 1)} not "
+                    f"divisible by sp={shape['sp']}"
+                )
         from hivedscheduler_tpu.parallel.pipeline import pipeline_apply
 
         layer_specs = sharding_specs(cfg)["layers"]
@@ -355,17 +400,24 @@ def forward_with_aux(
         )
 
         def stage_block(stage_params, h):
-            def stage_layer(xx, lp):
-                out, _ = _apply_layer(xx, lp, positions, cfg, attn_fn, mesh,
-                                      manual_tp_axis=manual_tp,
-                                      manual_sp_axis=manual_sp,
-                                      manual_vma_axes=vma_axes)
-                return out, None
+            def stage_layer(carry, lp):
+                xx, aux = carry
+                out, layer_aux = _apply_layer(xx, lp, positions, cfg, attn_fn,
+                                              mesh,
+                                              manual_tp_axis=manual_tp,
+                                              manual_sp_axis=manual_sp,
+                                              manual_ep_axis=manual_ep,
+                                              manual_vma_axes=vma_axes)
+                return (out, aux + layer_aux), None
 
-            hh, _ = lax.scan(jax.checkpoint(stage_layer), h, stage_params)
-            return hh
+            (hh, aux), _ = lax.scan(
+                jax.checkpoint(stage_layer),
+                (h, jnp.zeros((), jnp.float32) + 0.0 * jnp.sum(h[..., 0, 0])),
+                stage_params,
+            )
+            return hh, aux
 
-        x = pipeline_apply(
+        x, aux_total = pipeline_apply(
             stage_block,
             params["layers"],
             layer_specs,

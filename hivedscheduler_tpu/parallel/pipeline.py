@@ -11,15 +11,15 @@ so one jitted train step backpropagates through the pipeline naturally.
 Composition (validated in ``models.transformer.forward_with_aux``):
 - tensor parallelism composes — stage weights keep their tp sharding and
   ``_apply_layer`` inserts Megatron-style row-parallel psums;
-- sequence parallelism composes with ``attn_impl="ring"`` — ``seq_axis``
-  shards T into the stage and the ring's local body runs directly in the
-  manual context (sp > 1 with local attention is rejected; Ulysses inside a
-  stage is not supported yet);
+- sequence parallelism composes with ``attn_impl`` "ring" or "ulysses" —
+  ``seq_axis`` shards T into the stage and the manual attention body runs
+  directly in the stage (sp > 1 with local attention is rejected);
+- MoE composes — expert weights stay ep-sharded, each device computes its
+  experts' slots and the combine psums over ep (and tp);
 - dp/fsdp compose for *activations*; layer params are replicated across
   fsdp inside pipeline stages (``sharding_specs`` drops their fsdp
   placement when pipelining), so pipelining trades FSDP param sharding for
-  stage sharding;
-- MoE inside a stage is not supported yet.
+  stage sharding.
 """
 
 from __future__ import annotations
@@ -43,6 +43,8 @@ def _pipeline_local(
     layer_block_fn: Callable[[Any, jax.Array], jax.Array],
     n_micro: int,
     axis: str,
+    batch_axes,
+    seq_axis=None,
 ):
     """Per-device body under shard_map. ``params_local`` leaves carry this
     stage's layers on axis 0; ``hidden_local`` is this device's [B_loc, T, D]
@@ -59,15 +61,22 @@ def _pipeline_local(
     # only the pp axis (pcast rejects re-casting already-varying axes)
     out_buf = _varying(jnp.zeros_like(micro), (axis,))
     recv0 = _varying(jnp.zeros_like(micro[0]), (axis,))
+    aux0 = _varying(jnp.zeros((), jnp.float32), (axis,)) + 0.0 * jnp.sum(
+        micro[..., 0, 0, 0]
+    )  # inherit batch vma
     # forward perm: stage s -> s+1 (no wraparound; stage 0 receives zeros)
     perm = [(i, i + 1) for i in range(pp - 1)]
 
     def step_fn(carry, step):
-        out_buf, recv = carry
+        out_buf, recv, aux_acc = carry
         inject_idx = jnp.clip(step, 0, n_micro - 1)
         injected = lax.dynamic_index_in_dim(micro, inject_idx, 0, keepdims=False)
         my_in = jnp.where(stage == 0, injected, recv)
-        h = layer_block_fn(params_local, my_in)
+        h, aux_step = layer_block_fn(params_local, my_in)
+        # a stage computes real work for microbatch (step - stage) only; aux
+        # from bubble steps (garbage activations) must not count
+        real = (step - stage >= 0) & (step - stage < n_micro)
+        aux_acc = aux_acc + jnp.where(real, aux_step, 0.0)
         # the last stage banks microbatch `step - (pp-1)` when it's real
         slot = step - (pp - 1)
         valid = (stage == pp - 1) & (slot >= 0) & (slot < n_micro)
@@ -76,13 +85,21 @@ def _pipeline_local(
         )
         out_buf = jnp.where(valid, banked, out_buf)
         send = lax.ppermute(h, axis, perm) if pp > 1 else h
-        return (out_buf, send), None
+        return (out_buf, send, aux_acc), None
 
-    (out_buf, _), _ = lax.scan(step_fn, (out_buf, recv0), jnp.arange(steps))
+    (out_buf, _, aux_acc), _ = lax.scan(
+        step_fn, (out_buf, recv0, aux0), jnp.arange(steps)
+    )
     # only the last stage ever wrote; psum over pp broadcasts it everywhere so
-    # the output can be pp-replicated
+    # the output can be pp-replicated. Aux: sum over stages (each stage's
+    # layers), averaged over microbatches (standard per-microbatch aux).
     out = lax.psum(out_buf, axis)
-    return out.reshape(b_loc, t, d)
+    # aux: sum over stages, average over microbatches, mean over the data
+    # shards so the scalar is fully replicated
+    aux = lax.psum(aux_acc, axis) / n_micro
+    mean_axes = tuple(batch_axes) + ((seq_axis,) if seq_axis else ())
+    aux = lax.pmean(aux, mean_axes)
+    return out.reshape(b_loc, t, d), aux
 
 
 def pipeline_apply(
@@ -99,11 +116,14 @@ def pipeline_apply(
 ) -> jax.Array:
     """Run ``hidden`` [B, T, D] through all layers, pipelined over ``axis``.
 
-    ``stacked_params``: pytree whose leaves have the layer count on axis 0
-    (divisible by the pp size); ``param_specs``: matching pytree of
-    PartitionSpecs whose first entry is ``axis``; ``layer_block_fn(stage_params,
-    h) -> h`` applies one stage's worth of layers. ``seq_axis`` shards the T
-    dimension into the stage (ring attention runs inside the stage body).
+    Returns (hidden, aux): ``layer_block_fn(stage_params, h) -> (h, aux)``
+    applies one stage's worth of layers and reports their (MoE) aux-loss sum
+    for that microbatch; bubble steps are excluded and the total is averaged
+    over microbatches. ``stacked_params``: pytree whose leaves have the layer
+    count on axis 0 (divisible by the pp size); ``param_specs``: matching
+    pytree of PartitionSpecs whose first entry is ``axis``; ``seq_axis``
+    shards the T dimension into the stage (ring/Ulysses attention runs
+    inside the stage body).
     """
     try:
         from jax import shard_map
@@ -117,9 +137,11 @@ def pipeline_apply(
             layer_block_fn=layer_block_fn,
             n_micro=n_micro,
             axis=axis,
+            batch_axes=tuple(batch_axes),
+            seq_axis=seq_axis,
         ),
         mesh=mesh,
         in_specs=(param_specs, hidden_spec),
-        out_specs=hidden_spec,
+        out_specs=(hidden_spec, P()),
     )
     return fn(stacked_params, hidden)
