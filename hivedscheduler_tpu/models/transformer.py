@@ -106,14 +106,15 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
 def sharding_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     """PartitionSpecs per param, mirroring init_params' tree. tp shards heads
     and ff, fsdp the complementary axis, ep the expert axis. With pipelining,
-    the leading layer axis is sharded over pp; tp is kept (manual row-parallel
-    psums in the stage body) while fsdp param sharding is dropped
-    (see parallel/pipeline.py for the composition rules)."""
+    the leading layer axis is sharded over pp; tp is kept (manual
+    row-parallel psums in the stage body) and fsdp is kept too (ZeRO-style
+    per-use all-gather; see parallel/pipeline.py for the composition
+    rules)."""
     # pipelined stages run in manual shard_map mode: tp sharding is kept
-    # (row-parallel psums in _apply_layer), fsdp param sharding is dropped
-    # (no manual fsdp collectives yet; see ROADMAP.md)
+    # (row-parallel psums in _apply_layer) and fsdp param sharding is kept
+    # too (ZeRO-style all-gather per use inside the stage)
     pl = "pp" if cfg.pipeline_microbatches > 0 else None
-    fsdp = None if cfg.pipeline_microbatches > 0 else "fsdp"
+    fsdp = "fsdp"
     tp = "tp"
     layers: Dict[str, Any] = {
         "attn_norm": P(pl, None),
@@ -348,6 +349,7 @@ def forward_with_aux(
         manual_tp = None
         manual_sp = None
         manual_ep = None
+        manual_fsdp = None
         if mesh is not None:
             shape = dict(zip(mesh.axis_names, mesh.devices.shape))
             if shape.get("sp", 1) > 1 and cfg.attn_impl not in ("ring", "ulysses"):
@@ -389,9 +391,29 @@ def forward_with_aux(
                     f"{cfg.n_heads} heads / tp={shape.get('tp', 1)} not "
                     f"divisible by sp={shape['sp']}"
                 )
+            if "fsdp" in shape:
+                manual_fsdp = "fsdp"
         from hivedscheduler_tpu.parallel.pipeline import pipeline_apply
 
         layer_specs = sharding_specs(cfg)["layers"]
+
+        def gather_stage_params(lp):
+            """ZeRO-style: reconstruct each weight from its fsdp shards at
+            use time (autodiff turns this into grad reduce-scatters)."""
+            if manual_fsdp is None:
+                return lp
+
+            def gather(leaf, spec):
+                # spec's first entry is the (scanned-away) layer/pp axis
+                for i, part in enumerate(spec[1:]):
+                    parts = part if isinstance(part, tuple) else (part,)
+                    if "fsdp" in parts:
+                        return lax.all_gather(
+                            leaf, manual_fsdp, axis=i, tiled=True
+                        )
+                return leaf
+
+            return jax.tree.map(gather, lp, layer_specs)
         # axes the activations/weights vary over inside the stage body (for
         # the ring accumulators' vma seed): batch + stage + tp-local heads +
         # the sequence shard itself
@@ -402,6 +424,7 @@ def forward_with_aux(
         def stage_block(stage_params, h):
             def stage_layer(carry, lp):
                 xx, aux = carry
+                lp = gather_stage_params(lp)
                 out, layer_aux = _apply_layer(xx, lp, positions, cfg, attn_fn,
                                               mesh,
                                               manual_tp_axis=manual_tp,

@@ -16,10 +16,9 @@ Composition (validated in ``models.transformer.forward_with_aux``):
   directly in the stage (sp > 1 with local attention is rejected);
 - MoE composes — expert weights stay ep-sharded, each device computes its
   experts' slots and the combine psums over ep (and tp);
-- dp/fsdp compose for *activations*; layer params are replicated across
-  fsdp inside pipeline stages (``sharding_specs`` drops their fsdp
-  placement when pipelining), so pipelining trades FSDP param sharding for
-  stage sharding.
+- dp/fsdp compose for activations AND params: layer weights stay
+  fsdp-sharded inside stages and are all-gathered ZeRO-style at use time
+  (autodiff reduce-scatters the grads back).
 """
 
 from __future__ import annotations
@@ -40,7 +39,7 @@ def _pipeline_local(
     params_local: Any,
     hidden_local: jax.Array,
     *,
-    layer_block_fn: Callable[[Any, jax.Array], jax.Array],
+    layer_block_fn: Callable[[Any, jax.Array], tuple],
     n_micro: int,
     axis: str,
     batch_axes,
@@ -103,7 +102,7 @@ def _pipeline_local(
 
 
 def pipeline_apply(
-    layer_block_fn: Callable[[Any, jax.Array], jax.Array],
+    layer_block_fn: Callable[[Any, jax.Array], tuple],
     stacked_params: Any,
     param_specs: Any,
     hidden: jax.Array,
@@ -113,7 +112,7 @@ def pipeline_apply(
     axis: str = "pp",
     batch_axes=("dp", "fsdp"),
     seq_axis=None,
-) -> jax.Array:
+) -> tuple:
     """Run ``hidden`` [B, T, D] through all layers, pipelined over ``axis``.
 
     Returns (hidden, aux): ``layer_block_fn(stage_params, h) -> (h, aux)``

@@ -345,3 +345,37 @@ class TestUlyssesInPipeline:
             ref = tm.forward(params, tokens, cfg_ref)
         out = jax.jit(lambda p, t: tm.forward(p, t, cfg_pp, mesh=mesh))(params, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+class TestPipelineFSDP:
+    def test_pipelined_fsdp_matches_dense(self):
+        """pp=2 x fsdp=2: layer weights sharded over fsdp inside stages and
+        gathered per use must reproduce dense logits exactly."""
+        cfg_ref = tiny_cfg()
+        cfg_pp = tiny_cfg(pipeline_microbatches=2)
+        mesh = cpu_mesh(topology.MeshAxes(fsdp=2, pp=2, tp=2))
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            params = tm.init_params(cfg_ref, jax.random.PRNGKey(0))
+            tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+            ref = tm.forward(params, tokens, cfg_ref)
+        out = jax.jit(lambda p, t: tm.forward(p, t, cfg_pp, mesh=mesh))(params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+    def test_pipelined_fsdp_train_step(self):
+        from hivedscheduler_tpu.parallel.train import make_sharded_train_step
+
+        cfg = tiny_cfg(pipeline_microbatches=2)
+        mesh = cpu_mesh(topology.MeshAxes(fsdp=2, pp=2, dp=2))
+        step, init_fn, token_sharding = make_sharded_train_step(cfg, mesh)
+        params, opt_state = init_fn(jax.random.PRNGKey(0))
+        # weights genuinely fsdp-sharded under the pipeline
+        spec = str(params["layers"]["wq"].sharding.spec)
+        assert "pp" in spec and "fsdp" in spec
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64), token_sharding
+        )
+        losses = []
+        for _ in range(4):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0]
