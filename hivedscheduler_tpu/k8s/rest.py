@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import json
 import logging
+import os
+import ssl
 import threading
 import urllib.error
 import urllib.request
@@ -30,25 +32,80 @@ from hivedscheduler_tpu.k8s.types import Binding, Node, Pod
 log = logging.getLogger(__name__)
 
 
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
 class RestKubeClient(KubeClient):
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    """``bearer_token``/``ca_cert`` enable authenticated in-cluster access
+    (both default to the mounted service-account credentials when present);
+    plain HTTP against an insecure port / kubectl proxy needs neither."""
+
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 30.0,
+        bearer_token: Optional[str] = None,
+        ca_cert: Optional[str] = None,
+    ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.bearer_token = bearer_token
+        # auto-use the mounted service-account token only over TLS (a bearer
+        # token must never ride plaintext), re-read per request because bound
+        # SA tokens rotate (~1h lifetime)
+        self._sa_token_file: Optional[str] = None
+        token_file = os.path.join(SERVICE_ACCOUNT_DIR, "token")
+        if (
+            bearer_token is None
+            and self.base_url.startswith("https")
+            and os.path.exists(token_file)
+        ):
+            self._sa_token_file = token_file
+        self._ssl_context: Optional[ssl.SSLContext] = None
+        if self.base_url.startswith("https"):
+            if ca_cert is not None and not os.path.exists(ca_cert):
+                raise FileNotFoundError(f"ca_cert not found: {ca_cert}")
+            ca_file = ca_cert or os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
+            self._ssl_context = ssl.create_default_context(
+                cafile=ca_file if os.path.exists(ca_file) else None
+            )
         self._node_handlers = []
         self._pod_handlers = []
         self._stop = threading.Event()
         self._watch_threads: List[threading.Thread] = []
 
     # --- HTTP helpers -----------------------------------------------------
+    def _current_token(self) -> Optional[str]:
+        if self.bearer_token:
+            return self.bearer_token
+        if self._sa_token_file:
+            try:
+                with open(self._sa_token_file) as f:
+                    return f.read().strip()
+            except OSError:
+                return None
+        return None
+
+    def _headers(self, has_body: bool) -> dict:
+        headers = {}
+        if has_body:
+            headers["Content-Type"] = "application/json"
+        token = self._current_token()
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        return headers
+
     def _request(self, method: str, path: str, body: Optional[dict] = None):
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             self.base_url + path,
             data=data,
             method=method,
-            headers={"Content-Type": "application/json"} if data else {},
+            headers=self._headers(data is not None),
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+        with urllib.request.urlopen(
+            req, timeout=self.timeout, context=self._ssl_context
+        ) as resp:
             raw = resp.read()
             return json.loads(raw) if raw else None
 
@@ -127,8 +184,10 @@ class RestKubeClient(KubeClient):
             if rv:
                 url += f"&resourceVersion={rv}"
             try:
-                req = urllib.request.Request(url)
-                with urllib.request.urlopen(req, timeout=None) as resp:
+                req = urllib.request.Request(url, headers=self._headers(False))
+                with urllib.request.urlopen(
+                    req, timeout=None, context=self._ssl_context
+                ) as resp:
                     for line in resp:
                         if self._stop.is_set():
                             return

@@ -253,3 +253,32 @@ def test_list_watch_and_bind(apiserver):
     bound = client.get_pod("default", "late")
     assert bound.node_name == "n0" and bound.annotations["k"] == "v"
     client.stop()
+
+
+def test_bearer_token_sent(apiserver):
+    """An explicit bearer token must ride every request's Authorization
+    header (list, get, and the bind write all share _headers)."""
+    import urllib.request
+
+    seen_auth = []
+
+    class Recorder(urllib.request.BaseHandler):
+        def http_request(self, req):
+            seen_auth.append(req.get_header("Authorization"))
+            return req
+
+    opener = urllib.request.build_opener(Recorder())
+    old_opener = urllib.request._opener
+    urllib.request.install_opener(opener)
+    try:
+        client = RestKubeClient(apiserver.url, bearer_token="sekret")
+        apiserver.add_node("n0")
+        apiserver.add_pod("default", "p1")
+        client.list_nodes()
+        client.get_node("n0")
+        client.bind_pod(Binding(pod_name="p1", pod_namespace="default",
+                                pod_uid="p1", node="n0"))
+    finally:
+        urllib.request.install_opener(old_opener)
+    assert len(seen_auth) >= 3
+    assert all(a == "Bearer sekret" for a in seen_auth)
