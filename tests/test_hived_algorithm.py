@@ -550,3 +550,33 @@ class TestSchedulingPolicy:
         path.write_text(yaml.safe_dump(cfg))
         with pytest.raises(ValueError, match="unknown schedulingPolicy"):
             HivedAlgorithm(load_config(str(path)))
+
+
+class TestSuggestedNodesPreemption:
+    def test_preemption_canceled_when_placement_leaves_suggested_set(self, algo):
+        """Reference behavior (schedulePodFromExistingGroup): a Preempting
+        group whose placement is no longer within the Preempting-phase
+        suggested nodes cancels and reschedules; in the Filtering phase it
+        insists."""
+        # fill vc2's v5e host with a low-priority pod (not ignoring suggestions)
+        lo = make_pod("lo", {"virtualCluster": "vc2", "priority": 1,
+                             "chipType": "v5e-chip", "chipNumber": 8,
+                             "ignoreK8sSuggestedNodes": False})
+        schedule_and_allocate(algo, lo)
+        hi = make_pod("hi", {"virtualCluster": "vc2", "priority": 100,
+                             "chipType": "v5e-chip", "chipNumber": 8,
+                             "ignoreK8sSuggestedNodes": False})
+        r = algo.schedule(hi, all_node_names(algo), PREEMPTING_PHASE)
+        assert r.pod_preempt_info is not None
+        assert algo.get_affinity_group("default/hi").status.state == GROUP_PREEMPTING
+        # Filtering phase with the host absent from suggestions: preemption
+        # is NOT canceled (only Preempting-phase suggestions count)
+        others = [n for n in all_node_names(algo) if n != "v5e-host0/0-0"]
+        algo.schedule(hi, others, FILTERING_PHASE)
+        assert "default/hi" in {g.name for g in algo.get_all_affinity_groups()}
+        # Preempting phase without the host: preemption canceled
+        r = algo.schedule(hi, others, PREEMPTING_PHASE)
+        groups = {g.name for g in algo.get_all_affinity_groups()}
+        assert "default/hi" not in groups or (
+            algo.get_affinity_group("default/hi").status.state != GROUP_PREEMPTING
+        )
