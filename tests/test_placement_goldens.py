@@ -1,0 +1,63 @@
+"""Deterministic placement goldens: exact (node, chips) decisions for a fixed
+pod sequence on the design fixture, pinning scheduler determinism the way the
+reference's table-driven expectedBindInfos do
+(hived_algorithm_test.go:566-592). Any change to placement order, packing, or
+buddy tie-breaking shows up here as a concrete diff."""
+
+import logging
+import os
+
+from helpers import make_pod, set_healthy_nodes
+
+from hivedscheduler_tpu.api.config import load_config
+from hivedscheduler_tpu.algorithm import HivedAlgorithm
+from hivedscheduler_tpu.runtime.types import FILTERING_PHASE
+from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+logging.getLogger().setLevel(logging.ERROR)
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "example", "config", "design", "tpu-hive.yaml",
+)
+
+# (name, spec) -> expected (node, sorted chip indices)
+SEQUENCE = [
+    ("a1", {"virtualCluster": "vc2", "priority": 0, "chipType": "v5e-chip",
+            "chipNumber": 2},
+     ("v5e-host0/0-0", [0, 1])),
+    ("a2", {"virtualCluster": "vc2", "priority": 0, "chipType": "v5e-chip",
+            "chipNumber": 2},
+     ("v5e-host0/0-0", [2, 3])),  # packs onto the same host, next buddy pair
+    ("b1", {"virtualCluster": "vc2", "priority": 5, "chipType": "v5p-chip",
+            "chipNumber": 4},
+     ("v5p-pod0/2-2-0", [0, 1, 2, 3])),  # vc2's 2x2x2 lands in the pin's half
+    ("b2", {"virtualCluster": "vc2", "priority": 5, "chipType": "v5p-chip",
+            "chipNumber": 4},
+     ("v5p-pod0/2-2-1", [0, 1, 2, 3])),  # buddy host of the same 2x2x2
+    ("c1", {"virtualCluster": "vc1", "priority": 5, "chipType": "v5p-chip",
+            "chipNumber": 4},
+     ("v5p-pod0/0-0-2", [0, 1, 2, 3])),  # vc1's 4x4x2 claims the z=2,3 half
+    ("d1", {"virtualCluster": "vc1", "priority": 0, "chipType": "v4-chip",
+            "chipNumber": 8},
+     ("0", [0, 1, 2, 3, 4, 5, 6, 7])),  # whole first v4 node
+    ("e1", {"virtualCluster": "vc1", "priority": 2, "pinnedCellId": "pin1",
+            "chipNumber": 4},
+     ("v5p-pod0/0-0-0", [0, 1, 2, 3])),  # pinned 2x2x2's first host
+]
+
+
+def test_placement_goldens():
+    h = HivedAlgorithm(load_config(FIXTURE))
+    nodes = set_healthy_nodes(h)
+    got = []
+    for name, spec, expected in SEQUENCE:
+        r = h.schedule(make_pod(name, spec), nodes, FILTERING_PHASE)
+        assert r.pod_bind_info is not None, (name, r.pod_wait_info)
+        h.add_allocated_pod(new_binding_pod(make_pod(name, spec), r.pod_bind_info))
+        got.append((name, (r.pod_bind_info.node,
+                           sorted(r.pod_bind_info.leaf_cell_isolation))))
+    expected_all = [(name, exp) for name, _, exp in SEQUENCE]
+    assert got == expected_all, "\n".join(
+        f"{n}: got {g}, want {e}" for (n, g), (_, e) in zip(got, expected_all)
+    )
