@@ -194,3 +194,32 @@ class TestOddTopologies:
                                  r.pod_bind_info.node.split("/")[-1].split("-")))
         # the two 8-chip hosts form one contiguous v5e-16 (4x4) tile
         assert {o[1] for o in origins} in ({0}, {4}) and {o[0] for o in origins} == {0, 2}
+
+
+class TestExampleConfigsValid:
+    """Every shipped example config must construct a working scheduler —
+    including the scheduler config embedded in the deploy manifest."""
+
+    def test_design_fixture(self):
+        from hivedscheduler_tpu.algorithm import HivedAlgorithm
+
+        HivedAlgorithm(load_config(FIXTURE))
+
+    def test_deploy_manifest_embedded_config(self):
+        import yaml
+
+        from hivedscheduler_tpu.api.config import Config, new_config
+        from hivedscheduler_tpu.algorithm import HivedAlgorithm
+
+        path = os.path.join(os.path.dirname(FIXTURE), "..", "..", "run",
+                            "deploy.yaml")
+        docs = list(yaml.safe_load_all(open(path)))
+        cm = next(d for d in docs if d and d.get("kind") == "ConfigMap")
+        cfg = Config.from_dict(yaml.safe_load(cm["data"]["tpu-hive.yaml"]))
+        h = HivedAlgorithm(new_config(cfg))
+        assert "v5p-256" in h.full_cell_list
+        # the extender policy must point at the routes we serve
+        policy = __import__("json").loads(cm["data"]["policy.cfg"])
+        ext = policy["extenders"][0]
+        assert ext["filterVerb"] == "filter" and ext["bindVerb"] == "bind"
+        assert ext["preemptVerb"] == "preempt"
