@@ -21,7 +21,6 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from hivedscheduler_tpu.api import types as api
 from hivedscheduler_tpu.api.config import Config
-from hivedscheduler_tpu.algorithm import utils as algo_utils
 from hivedscheduler_tpu.algorithm.cell import (
     CellChain,
     CellLevel,

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from hivedscheduler_tpu.k8s.client import KubeClient
 from hivedscheduler_tpu.k8s.types import Binding, Node, Pod

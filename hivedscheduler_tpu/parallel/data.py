@@ -10,8 +10,7 @@ the standard packed-LM format) or the deterministic synthetic corpus used by
 
 from __future__ import annotations
 
-import math
-from typing import Iterator, Optional
+from typing import Iterator
 
 import numpy as np
 

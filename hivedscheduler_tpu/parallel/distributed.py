@@ -15,7 +15,6 @@ import logging
 import os
 from typing import List, Optional, Tuple
 
-from hivedscheduler_tpu.api import constants as api_constants
 from hivedscheduler_tpu.api import types as api
 from hivedscheduler_tpu.common import utils as common
 

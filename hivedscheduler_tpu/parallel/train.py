@@ -10,7 +10,7 @@ layer scan trades FLOPs for HBM on long contexts.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

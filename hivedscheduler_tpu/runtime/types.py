@@ -7,7 +7,7 @@ TPU-native analogue of the reference's ``pkg/internal/types.go``.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from hivedscheduler_tpu.api.types import PodBindInfo
 from hivedscheduler_tpu.k8s.types import Node, Pod

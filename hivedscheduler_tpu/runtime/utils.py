@@ -10,7 +10,7 @@ TPU-idiomatic compatibility (reference: convertOldAnnotation,
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from hivedscheduler_tpu.api import constants as api_constants
 from hivedscheduler_tpu.api import types as api

@@ -71,7 +71,6 @@ def main(argv=None) -> int:
     rank, world = initialize_from_gang()
 
     import jax
-    import jax.numpy as jnp
 
     from hivedscheduler_tpu.models import transformer as tm
     from hivedscheduler_tpu.parallel import checkpoint as ckpt
