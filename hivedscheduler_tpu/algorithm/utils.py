@@ -59,7 +59,8 @@ def generate_pod_schedule_result(
         )
     # find the selected node only after preemption is done — victims may cause
     # the selected node to be excluded from the suggested nodes
-    bind_info, selected_node, selected_indices, cell_chain = generate_affinity_group_bind_info(
+    (bind_info, selected_node, selected_indices, cell_chain,
+     encoded_group) = generate_affinity_group_bind_info(
         group_physical_placement, group_virtual_placement, cell_level_to_type,
         current_leaf_cell_num, current_pod_index, group, group_name,
     )
@@ -67,14 +68,15 @@ def generate_pod_schedule_result(
         "[%s]: pod is decided to be scheduled to node %s, leaf cells %s",
         internal.key(pod), selected_node, selected_indices,
     )
-    return PodScheduleResult(
-        pod_bind_info=api.PodBindInfo(
-            node=selected_node,
-            leaf_cell_isolation=selected_indices,
-            cell_chain=cell_chain,
-            affinity_group_bind_info=bind_info,
-        )
+    result_info = api.PodBindInfo(
+        node=selected_node,
+        leaf_cell_isolation=selected_indices,
+        cell_chain=cell_chain,
+        affinity_group_bind_info=bind_info,
     )
+    # version-keyed pre-encoded fragment for new_binding_pod's serializer
+    result_info._encoded_group = encoded_group
+    return PodScheduleResult(pod_bind_info=result_info)
 
 
 def generate_pod_preempt_info(
@@ -99,9 +101,10 @@ def generate_affinity_group_bind_info(
     current_pod_index: int,
     group: Optional[AlgoAffinityGroup],
     group_name: str,
-) -> Tuple[List[api.AffinityGroupMemberBindInfo], str, List[int], str]:
+):
     """Placement → wire format, incl. PreassignedCellTypes needed for recovery
-    (reference: generateAffinityGroupBindInfo, utils.go:108-171)."""
+    (reference: generateAffinityGroupBindInfo, utils.go:108-171). Returns
+    (bind_info, selected_node, selected_indices, chain, encoded_group)."""
     cached = group._bind_info_cache if group is not None else None
     if cached is not None and cached[0] == group.placement_version:
         bind_info, chain = cached[1], cached[2]
@@ -112,6 +115,7 @@ def generate_affinity_group_bind_info(
                     mbi_cached.pod_placements[current_pod_index].physical_node,
                     mbi_cached.pod_placements[current_pod_index].physical_leaf_cell_indices,
                     chain,
+                    cached[3],  # pre-encoded gang fragment
                 )
     bind_info: List[api.AffinityGroupMemberBindInfo] = []
     selected_node = ""
@@ -174,9 +178,14 @@ def generate_affinity_group_bind_info(
             if p_leaf_cell is not None:
                 chain = p_leaf_cell.chain
         bind_info.append(mbi)
+    # pre-encode the gang fragment once per placement version; every pod's
+    # bind annotation splices it instead of re-serializing the whole gang
+    encoded_group = internal.encode_group_fragment(bind_info)
     if group is not None:
-        group._bind_info_cache = (group.placement_version, bind_info, chain)
-    return bind_info, selected_node, selected_indices, chain
+        group._bind_info_cache = (
+            group.placement_version, bind_info, chain, encoded_group
+        )
+    return bind_info, selected_node, selected_indices, chain, encoded_group
 
 
 def collect_bad_or_non_suggested_nodes(

@@ -407,13 +407,17 @@ class PodBindInfo:
             ],
         )
 
-    def to_dict(self) -> Dict[str, Any]:
-        return {
+    def to_dict(self, include_group: bool = True) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
             "node": self.node,
             "leafCellIsolation": self.leaf_cell_isolation,
             "cellChain": self.cell_chain,
-            "affinityGroupBindInfo": [m.to_dict() for m in self.affinity_group_bind_info],
         }
+        if include_group:
+            out["affinityGroupBindInfo"] = [
+                m.to_dict() for m in self.affinity_group_bind_info
+            ]
+        return out
 
 
 # ---------------------------------------------------------------------------

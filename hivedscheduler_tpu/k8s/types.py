@@ -35,7 +35,21 @@ class Pod:
         return f"{self.namespace}/{self.name}"
 
     def deep_copy(self) -> "Pod":
-        return copy.deepcopy(self)
+        # hand-rolled: this runs on every informer delivery and binding;
+        # generic deepcopy is ~5x slower for this flat shape
+        return Pod(
+            name=self.name,
+            namespace=self.namespace,
+            uid=self.uid,
+            annotations=dict(self.annotations),
+            containers=[
+                Container(name=c.name, resource_limits=dict(c.resource_limits))
+                for c in self.containers
+            ],
+            node_name=self.node_name,
+            phase=self.phase,
+            deletion_timestamp=self.deletion_timestamp,
+        )
 
 
 @dataclass

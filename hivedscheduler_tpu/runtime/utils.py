@@ -66,6 +66,24 @@ def to_indices_string(indices: List[int]) -> str:
     return ",".join(str(i) for i in indices)
 
 
+def encode_group_fragment(members) -> str:
+    """Encode the gang-placement fragment (identical for every pod of a gang;
+    the scheduler caches it per placement version)."""
+    return common.to_json([m.to_dict() for m in members])
+
+
+def _encode_bind_info(pod_bind_info: api.PodBindInfo) -> str:
+    """Serialize a bind info, reusing the pre-encoded gang fragment when the
+    scheduler attached one (``_encoded_group``, keyed to the group's
+    placement version). Field names come from to_dict — one source of
+    truth."""
+    frag = getattr(pod_bind_info, "_encoded_group", None)
+    if frag is None:
+        frag = encode_group_fragment(pod_bind_info.affinity_group_bind_info)
+    head = common.to_json(pod_bind_info.to_dict(include_group=False))
+    return head[:-1] + ',"affinityGroupBindInfo":' + frag + "}"
+
+
 def new_binding_pod(pod: Pod, pod_bind_info: api.PodBindInfo) -> Pod:
     """Stamp node + chip-isolation + bind-info annotations onto a copy of the
     pod (reference: NewBindingPod, internal/utils.go:172-186)."""
@@ -75,8 +93,8 @@ def new_binding_pod(pod: Pod, pod_bind_info: api.PodBindInfo) -> Pod:
         pod_bind_info.leaf_cell_isolation
     )
     # JSON is valid YAML: machine-written bind info uses the fast codec
-    binding_pod.annotations[api_constants.ANNOTATION_POD_BIND_INFO] = common.to_json(
-        pod_bind_info.to_dict()
+    binding_pod.annotations[api_constants.ANNOTATION_POD_BIND_INFO] = _encode_bind_info(
+        pod_bind_info
     )
     return binding_pod
 

@@ -224,3 +224,40 @@ class TestMetrics:
         assert "tpu_hive_binds_total" in text
         assert "tpu_hive_filter_latency_seconds_count" in text
         assert "tpu_hive_bad_nodes 0" in text
+
+
+class TestSerializationGuards:
+    def test_pod_deep_copy_covers_all_fields(self):
+        """Pod.deep_copy is hand-rolled for speed; a new Pod field must be
+        added there too — this guard fails if the constructor call drifts."""
+        import dataclasses
+        import inspect
+
+        src = inspect.getsource(Pod.deep_copy)
+        for f in dataclasses.fields(Pod):
+            assert f.name in src, f"Pod.deep_copy misses field {f.name!r}"
+        # and the copy is actually deep for the mutable fields
+        p = make_pod("x", {"virtualCluster": "v", "priority": 0, "chipNumber": 1})
+        c = p.deep_copy()
+        c.annotations["k"] = "v"
+        c.containers[0].resource_limits["r"] = 1
+        assert "k" not in p.annotations
+        assert "r" not in p.containers[0].resource_limits
+
+    def test_bind_info_encoder_matches_to_dict(self):
+        """The spliced-fragment encoder must stay equivalent to a plain
+        to_dict()+json dump (same fields, same values)."""
+        import json
+
+        from hivedscheduler_tpu.api import types as api
+        from hivedscheduler_tpu.common.utils import to_json
+        from hivedscheduler_tpu.runtime.utils import _encode_bind_info
+
+        bi = api.PodBindInfo(
+            node="n", leaf_cell_isolation=[0, 1], cell_chain="c",
+            affinity_group_bind_info=[api.AffinityGroupMemberBindInfo(
+                pod_placements=[api.PodPlacementInfo(
+                    physical_node="n", physical_leaf_cell_indices=[0, 1],
+                    preassigned_cell_types=["t", "t"])])],
+        )
+        assert json.loads(_encode_bind_info(bi)) == json.loads(to_json(bi.to_dict()))
