@@ -167,23 +167,32 @@ class PhysicalCellSpec:
 
 @dataclass
 class PhysicalClusterSpec:
-    """Reference: types.go:41-44."""
+    """Reference: types.go:41-44, plus ``skuTypes`` as a superset of the
+    reference schema: the reference's YAML decoder silently drops the key
+    (external tooling reads it from the raw config instead), while this build
+    carries it through so configs round-trip losslessly. The scheduler never
+    consumes it."""
 
     cell_types: Dict[CellType, CellTypeSpec] = field(default_factory=dict)
     physical_cells: List[PhysicalCellSpec] = field(default_factory=list)
+    sku_types: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "PhysicalClusterSpec":
         return PhysicalClusterSpec(
             cell_types={k: CellTypeSpec.from_dict(v) for k, v in (d.get("cellTypes") or {}).items()},
             physical_cells=[PhysicalCellSpec.from_dict(c) for c in d.get("physicalCells", [])],
+            sku_types=dict(d.get("skuTypes") or {}),
         )
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out: Dict[str, Any] = {
             "cellTypes": {k: v.to_dict() for k, v in self.cell_types.items()},
             "physicalCells": [c.to_dict() for c in self.physical_cells],
         }
+        if self.sku_types:
+            out["skuTypes"] = copy.deepcopy(self.sku_types)  # fresh structure
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -223,3 +223,19 @@ class TestExampleConfigsValid:
         ext = policy["extenders"][0]
         assert ext["filterVerb"] == "filter" and ext["bindVerb"] == "bind"
         assert ext["preemptVerb"] == "preempt"
+
+
+def test_sku_types_round_trip():
+    """HiveD configs carrying skuTypes (external-tooling metadata) must
+    round-trip even though the scheduler ignores them."""
+    from hivedscheduler_tpu.api.types import PhysicalClusterSpec
+
+    d = {
+        "skuTypes": {"v5p": {"cpu": 10, "memory": "160Gi", "tpu": 1}},
+        "cellTypes": {"node": {"childCellType": "chip", "childCellNumber": 4,
+                               "isNodeLevel": True}},
+        "physicalCells": [{"cellType": "node", "cellAddress": "n0"}],
+    }
+    spec = PhysicalClusterSpec.from_dict(d)
+    assert spec.sku_types["v5p"]["memory"] == "160Gi"
+    assert spec.to_dict()["skuTypes"] == d["skuTypes"]
