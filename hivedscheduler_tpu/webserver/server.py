@@ -118,7 +118,14 @@ def _make_handler(scheduler: HivedScheduler):
         def do_GET(self) -> None:
             try:
                 path = self.path.rstrip("/")
-                if path == "/metrics":
+                if path == "/healthz":
+                    body = b"ok"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/metrics":
                     from hivedscheduler_tpu.runtime.metrics import REGISTRY
 
                     REGISTRY.inc("tpu_hive_http_requests_total",

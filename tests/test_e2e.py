@@ -261,3 +261,10 @@ class TestSerializationGuards:
                     preassigned_cell_types=["t", "t"])])],
         )
         assert json.loads(_encode_bind_info(bi)) == json.loads(to_json(bi.to_dict()))
+
+
+class TestHealthz:
+    def test_healthz(self, stack):
+        kube, scheduler, base = stack
+        with urllib.request.urlopen(base + "/healthz") as r:
+            assert r.status == 200 and r.read() == b"ok"
