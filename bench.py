@@ -171,7 +171,7 @@ class Cluster:
             self.algo.delete_allocated_pod(bp)
 
 
-def run(measure_iters: int = 30, seed: int = 7):
+def run(measure_iters: int = 60, seed: int = 7):
     rng = random.Random(seed)
     cluster = Cluster()
 
