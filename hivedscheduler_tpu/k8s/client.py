@@ -45,6 +45,14 @@ class KubeClient:
         scheduler.go:202-209)."""
         raise NotImplementedError
 
+    def watches_alive(self) -> bool:
+        """Whether the post-sync watch/informer streams are still delivering.
+
+        Consumed by the scheduler's /healthz liveness probe; clients without
+        background watch threads (e.g. the fake in-memory ApiServer) are
+        always alive."""
+        return True
+
     # --- reads ------------------------------------------------------------
     def get_node(self, name: str) -> Optional[Node]:
         raise NotImplementedError

@@ -22,6 +22,7 @@ import os
 import ssl
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import List, Optional
 
@@ -49,6 +50,21 @@ class RestKubeClient(KubeClient):
     ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        if bearer_token is not None and not self.base_url.startswith("https"):
+            # the TLS-only rule for the auto-detected SA token applies to
+            # explicit tokens too: a bearer token must never ride plaintext
+            # off-host. Loopback (kubectl proxy, test fakes) is allowed with
+            # a loud warning.
+            host = urllib.parse.urlsplit(self.base_url).hostname or ""
+            if host not in ("localhost", "127.0.0.1", "::1"):
+                raise ValueError(
+                    f"refusing to send a bearer token over plaintext to "
+                    f"non-loopback {self.base_url}; use https:// or a local proxy"
+                )
+            log.warning(
+                "bearer token will ride plaintext HTTP to loopback %s",
+                self.base_url,
+            )
         self.bearer_token = bearer_token
         # auto-use the mounted service-account token only over TLS (a bearer
         # token must never ride plaintext), re-read per request because bound
@@ -150,6 +166,14 @@ class RestKubeClient(KubeClient):
 
     def stop(self) -> None:
         self._stop.set()
+
+    def watches_alive(self) -> bool:
+        """Liveness for the scheduler's /healthz: dead watch threads mean the
+        informer stream silently stopped. A deliberately stopped client (or
+        one that has not synced yet) is not 'wedged'."""
+        if self._stop.is_set():
+            return True
+        return all(t.is_alive() for t in self._watch_threads)
 
     def _list_and_diff(self, path: str, parse, handlers, key_fn, cache: dict) -> str:
         """List and reconcile against the cache: adds for new objects,

@@ -57,6 +57,16 @@ class HivedScheduler:
         # all nodes start bad until informed: publish that state immediately
         self._update_bad_node_gauge()
 
+    def healthy(self, timeout: float = 2.0) -> bool:
+        """Liveness for /healthz: the scheduler lock must be obtainable within
+        ``timeout`` and the kube client's watch threads must be alive. A
+        scheduler wedged on the algorithm lock, or one whose informer threads
+        died, reports unhealthy so the probe can restart it."""
+        if not self.scheduler_lock.acquire(timeout=timeout):
+            return False
+        self.scheduler_lock.release()
+        return self.kube_client.watches_alive()
+
     def start(self) -> None:
         """Sync current cluster state through the handlers — the crash-recovery
         barrier: every bound pod is replayed into add_allocated_pod before any

@@ -119,8 +119,11 @@ def _make_handler(scheduler: HivedScheduler):
             try:
                 path = self.path.rstrip("/")
                 if path == "/healthz":
-                    body = b"ok"
-                    self.send_response(200)
+                    # bounded liveness: a wedged scheduler lock or dead watch
+                    # threads must fail the probe, not just a dead HTTP server
+                    ok = scheduler.healthy()
+                    body = b"ok" if ok else b"unhealthy: scheduler lock wedged or watch threads dead"
+                    self.send_response(200 if ok else 503)
                     self.send_header("Content-Type", "text/plain")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()

@@ -236,6 +236,12 @@ class TestSerializationGuards:
         src = inspect.getsource(Pod.deep_copy)
         for f in dataclasses.fields(Pod):
             assert f.name in src, f"Pod.deep_copy misses field {f.name!r}"
+        # Container is copied inside Pod.deep_copy — its fields must appear in
+        # the same source, else a new Container field is silently dropped
+        from hivedscheduler_tpu.k8s.types import Container
+
+        for f in dataclasses.fields(Container):
+            assert f.name in src, f"Pod.deep_copy misses Container field {f.name!r}"
         # and the copy is actually deep for the mutable fields
         p = make_pod("x", {"virtualCluster": "v", "priority": 0, "chipNumber": 1})
         c = p.deep_copy()
@@ -268,3 +274,30 @@ class TestHealthz:
         kube, scheduler, base = stack
         with urllib.request.urlopen(base + "/healthz") as r:
             assert r.status == 200 and r.read() == b"ok"
+
+    def test_healthz_detects_wedged_scheduler(self, stack):
+        """A scheduler wedged on its lock must fail the liveness probe
+        (ADVICE r1: /healthz previously returned 200 unconditionally)."""
+        import threading
+
+        kube, scheduler, base = stack
+        acquired = threading.Event()
+        release = threading.Event()
+
+        def hold_lock():
+            with scheduler.scheduler_lock:
+                acquired.set()
+                release.wait(timeout=30)
+
+        t = threading.Thread(target=hold_lock, daemon=True)
+        t.start()
+        assert acquired.wait(timeout=5)
+        try:
+            assert scheduler.healthy(timeout=0.1) is False
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + "/healthz")  # ~2s probe bound
+            assert exc.value.code == 503
+        finally:
+            release.set()
+            t.join(timeout=5)
+        assert scheduler.healthy(timeout=2.0) is True

@@ -280,6 +280,82 @@ class TestStatefulPreemption:
             for c in ccl:
                 assert c.state in (CELL_USED, CELL_FREE)
 
+    def test_preemptor_displaced_by_higher_priority(self, algo):
+        """Cell e3/e6: a higher-priority preemptor overwrites a lower-priority
+        preemptor's Reserving cells; the loser goes back to Pending (AG e5)
+        while the victims stay BeingPreempted (reference:
+        hived_algorithm.go:736-741)."""
+        self._fill_vc2_v5p(algo, priority=1)
+        spec_mid = {"virtualCluster": "vc2", "priority": 50, "chipType": "v5p-chip",
+                    "chipNumber": 4,
+                    "affinityGroup": {"name": "mid",
+                                      "members": [{"podNumber": 4, "chipNumber": 4}]}}
+        algo.schedule(make_pod("mid-0", spec_mid), all_node_names(algo),
+                      PREEMPTING_PHASE)
+        assert algo.get_affinity_group("mid").status.state == GROUP_PREEMPTING
+        # a higher-priority preemptor wants the same (only) share of vc2
+        spec_hi = {"virtualCluster": "vc2", "priority": 100, "chipType": "v5p-chip",
+                   "chipNumber": 4,
+                   "affinityGroup": {"name": "hi",
+                                     "members": [{"podNumber": 4, "chipNumber": 4}]}}
+        r = algo.schedule(make_pod("hi-0", spec_hi), all_node_names(algo),
+                          PREEMPTING_PHASE)
+        assert r.pod_preempt_info is not None
+        names = {g.name for g in algo.get_all_affinity_groups()}
+        assert "mid" not in names  # loser preemptor back to Pending
+        assert algo.get_affinity_group("hi").status.state == GROUP_PREEMPTING
+        # victims keep running (BeingPreempted), their cells Reserving for hi
+        states = {x.name: x.status.state for x in algo.get_all_affinity_groups()}
+        assert GROUP_BEING_PREEMPTED in states.values()
+
+    def test_preemption_canceled_when_allocation_wins(self, algo):
+        """Cell e8(i): an Allocated group claims cells Reserved by a
+        lower-priority preemptor — the preemptor is canceled (AG e5) and the
+        winner allocates. Realized, as in the reference, via the
+        Preempting-phase overlap cancellation followed by a bind (no victims
+        remain once the cells are merely Reserved)."""
+        victims = self._fill_vc2_v5p(algo, priority=1)
+        spec_mid = {"virtualCluster": "vc2", "priority": 50, "chipType": "v5p-chip",
+                    "chipNumber": 4,
+                    "affinityGroup": {"name": "mid",
+                                      "members": [{"podNumber": 4, "chipNumber": 4}]}}
+        algo.schedule(make_pod("mid-0", spec_mid), all_node_names(algo),
+                      PREEMPTING_PHASE)
+        # victims die: mid's cells go Reserving -> Reserved
+        for v in victims:
+            algo.delete_allocated_pod(v)
+        reserved = [
+            c
+            for ccl in algo.full_cell_list["v5p-64"].values()
+            for c in ccl
+            if c.state == CELL_RESERVED
+        ]
+        assert reserved, "expected Reserved cells held by the mid preemptor"
+        # higher-priority group takes the Reserved cells: no pods to kill, so
+        # the overlap cancellation leaves a directly bindable placement
+        spec_win = {"virtualCluster": "vc2", "priority": 100, "chipType": "v5p-chip",
+                    "chipNumber": 4,
+                    "affinityGroup": {"name": "win",
+                                      "members": [{"podNumber": 4, "chipNumber": 4}]}}
+        r = algo.schedule(make_pod("win-0", spec_win), all_node_names(algo),
+                          PREEMPTING_PHASE)
+        assert "mid" not in {g.name for g in algo.get_all_affinity_groups()}
+        assert r.pod_bind_info is not None, (
+            "with victims gone the winner should bind, not preempt"
+        )
+        algo.add_allocated_pod(new_binding_pod(make_pod("win-0", spec_win),
+                                               r.pod_bind_info))
+        assert algo.get_affinity_group("win").status.state == GROUP_ALLOCATED
+        used = [
+            c
+            for ccl in algo.full_cell_list["v5p-64"].values()
+            for c in ccl
+            if c.state == CELL_USED
+        ]
+        assert used, "winner's cells must be Used"
+        assert all(c.state != CELL_RESERVED for ccl in
+                   algo.full_cell_list["v5p-64"].values() for c in ccl)
+
     def test_opportunistic_preempted_by_guaranteed(self, algo):
         # fill vc1's v5p share with an opportunistic gang (uses free cells)
         spec_opp = {"virtualCluster": "vc1", "priority": -1, "chipType": "v5p-chip",

@@ -282,3 +282,15 @@ def test_bearer_token_sent(apiserver):
         urllib.request.install_opener(old_opener)
     assert len(seen_auth) >= 3
     assert all(a == "Bearer sekret" for a in seen_auth)
+
+
+def test_bearer_token_refused_over_plaintext_offhost():
+    """ADVICE r1: an explicit bearer token must not ride plaintext HTTP to a
+    non-loopback address — construction refuses (loopback is allowed, with a
+    warning, for kubectl proxy / test fakes)."""
+    import pytest
+
+    with pytest.raises(ValueError, match="plaintext"):
+        RestKubeClient("http://apiserver.example:8080", bearer_token="sekret")
+    # https off-host is fine
+    RestKubeClient("https://apiserver.example:6443", bearer_token="sekret")
