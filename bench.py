@@ -287,6 +287,14 @@ if __name__ == "__main__":
                 "value": round(p50, 3),
                 "unit": "ms",
                 "vs_baseline": round(baseline_ms / p50, 3) if p50 > 0 else None,
+                "p50_ms": round(p50, 3),
+                "p99_ms": round(p99, 3),
+                "frag_pct": round(frag_pct, 3),
+                "vs_baseline_note": (
+                    "baseline is the reference deploy's 50 ms per-pod FIFO "
+                    "blocking knob (example/run/deploy.yaml:50), not a "
+                    "measured latency; the reference publishes no numbers"
+                ),
             }
         )
     )

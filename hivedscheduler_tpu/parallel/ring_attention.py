@@ -249,7 +249,10 @@ def ring_attention(
     Inputs are [B, T, H, D] logically; physically T is split over ``seq_axis``,
     B over ``batch_axes``, H over ``head_axis``.
     """
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
 
     spec = P(batch_axes, seq_axis, head_axis, None)
     # accumulators inside must be varying exactly over the sharded axes
@@ -294,7 +297,10 @@ def ulysses_attention(
     causal: bool = True,
 ) -> jax.Array:
     """DeepSpeed-Ulysses-style sequence parallelism via all_to_all."""
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
 
     spec = P(batch_axes, seq_axis, head_axis, None)
     fn = shard_map(

@@ -1,0 +1,397 @@
+"""Reference-scale adversarial placement goldens.
+
+Drives the 5-chain gnarly fixture (``example/config/design/tpu-hive-gnarly.yaml``
+— asymmetric 8x4x2 mesh with a pinned half, forged sub-host v5e levels,
+a two-multi-node-level generic chain, non-standard addresses/chip indices,
+a scrambled hierarchy and a multi-type node) through a 40+ pod table with
+exact expected bind infos, expected preemption victims, full-delete
+invariants (including free-list restoration), a stateful preemption chain
+with preemptor-cancellation goldens, reconfiguration lazy-preempt
+expectations, and bad-node behavior.
+
+Mirrors the reference's table-driven suite
+(``pkg/algorithm/hived_algorithm_test.go:172-608`` over
+``example/config/design/hivedscheduler.yaml:29-290``). Any change to packing
+order, buddy tie-breaking, or mesh-tiling order diffs here.
+"""
+
+import logging
+import os
+import random
+
+import pytest
+import yaml
+
+from helpers import make_pod, set_healthy_nodes
+
+from hivedscheduler_tpu.api.config import Config, load_config, new_config
+from hivedscheduler_tpu.api.types import WebServerError
+from hivedscheduler_tpu.algorithm import HivedAlgorithm
+from hivedscheduler_tpu.algorithm.constants import (
+    GROUP_ALLOCATED,
+    GROUP_BEING_PREEMPTED,
+    GROUP_PREEMPTING,
+)
+from hivedscheduler_tpu.k8s.types import Node
+from hivedscheduler_tpu.runtime.types import FILTERING_PHASE, PREEMPTING_PHASE
+from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+logging.getLogger().setLevel(logging.ERROR)
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "example", "config", "design", "tpu-hive-gnarly.yaml",
+)
+
+
+def spec(vc, prio, typ, num, group, members, pinned="", lazy=True):
+    s = {"virtualCluster": vc, "priority": prio, "leafCellNumber": num,
+         "lazyPreemptionEnable": lazy,
+         "affinityGroup": {"name": group, "members": [
+             {"podNumber": p, "leafCellNumber": n} for p, n in members]}}
+    if typ:
+        s["leafCellType"] = typ
+    if pinned:
+        s["pinnedCellId"] = pinned
+    return s
+
+
+@pytest.fixture
+def algo():
+    random.seed(0)
+    h = HivedAlgorithm(load_config(FIXTURE))
+    set_healthy_nodes(h)
+    return h
+
+
+def free_list_snapshot(h):
+    """(chain, level) -> sorted cell addresses of the free list."""
+    return {
+        (chain, lv): sorted(c.address for c in ccl[lv])
+        for chain, ccl in h.free_cell_list.items()
+        for lv in sorted(ccl)
+    }
+
+
+# ---------------------------------------------------------------------------
+# The table. BIND entries carry exact (node, chips) goldens; WAIT entries
+# must not bind. Sequence order is load-bearing (placements build on each
+# other), exactly like the reference's pss table.
+# ---------------------------------------------------------------------------
+
+SUCCEED = [
+    # buddy packing on the asymmetric mesh
+    ("p01", spec("vcB", 0, "v5p-chip", 1, "g01", [(1, 1)]),
+     ("gp0/0-0-0", [0])),
+    ("p02", spec("vcB", 1, "v5p-chip", 1, "g02", [(1, 1)]),
+     ("gp0/0-0-0", [1])),  # buddy chip of p01
+    # 8-chip gang: greedy packing splits across buddy cells (parity with the
+    # reference's per-pod bin-packing; contiguity preference is a tracked
+    # improvement — changing it MUST diff this golden)
+    ("p03a", spec("vcB", 2, "v5p-chip", 4, "g03", [(2, 4)]),
+     ("gp0/0-0-1", [0, 1, 2, 3])),
+    ("p03b", spec("vcB", 2, "v5p-chip", 4, "g03", [(2, 4)]),
+     ("gp0/2-0-0", [0, 1, 2, 3])),
+    # opportunistic stays away from guaranteed pods
+    ("p04", spec("vcB", -1, "v5p-chip", 1, "g04", [(1, 1)]),
+     ("gp0/2-0-1", [0])),
+    # pinned-cell gang fills the pinned 4x4x2 half host by host
+    ("p05a", spec("vcA", 1, "v5p-chip", 4, "g05", [(8, 4)], pinned="pin-gp"),
+     ("gp0/4-0-0", [0, 1, 2, 3])),
+    ("p05b", spec("vcA", 1, "v5p-chip", 4, "g05", [(8, 4)], pinned="pin-gp"),
+     ("gp0/4-0-1", [0, 1, 2, 3])),
+    ("p05c", spec("vcA", 1, "v5p-chip", 4, "g05", [(8, 4)], pinned="pin-gp"),
+     ("gp0/6-0-0", [0, 1, 2, 3])),
+    ("p05d", spec("vcA", 1, "v5p-chip", 4, "g05", [(8, 4)], pinned="pin-gp"),
+     ("gp0/6-0-1", [0, 1, 2, 3])),
+    ("p05e", spec("vcA", 1, "v5p-chip", 4, "g05", [(8, 4)], pinned="pin-gp"),
+     ("gp0/4-2-0", [0, 1, 2, 3])),
+    ("p05f", spec("vcA", 1, "v5p-chip", 4, "g05", [(8, 4)], pinned="pin-gp"),
+     ("gp0/4-2-1", [0, 1, 2, 3])),
+    ("p05g", spec("vcA", 1, "v5p-chip", 4, "g05", [(8, 4)], pinned="pin-gp"),
+     ("gp0/6-2-0", [0, 1, 2, 3])),
+    ("p05h", spec("vcA", 1, "v5p-chip", 4, "g05", [(8, 4)], pinned="pin-gp"),
+     ("gp0/6-2-1", [0, 1, 2, 3])),
+    # pinned chip with non-standard index 8 on the multi-type node
+    ("p06", spec("vcA", 1, "ct-chip", 1, "g06", [(1, 1)], pinned="pin-ct"),
+     ("10.0.0.2", [8])),
+    # any-leaf-cell-type heterogeneous group -> generic g-chain node (and it
+    # CONSUMES vcA's g-node, see p17)
+    ("p08", spec("vcA", 1, "", 7, "g08", [(1, 7), (1, 1)]),
+     ("12", [1, 2, 3, 4, 5, 6, 7])),
+    ("p09", spec("vcA", 1, "", 1, "g08", [(1, 7), (1, 1)]),
+     ("12", [0])),
+    # standard-address ct node
+    ("p10", spec("vcB", 0, "ct-chip", 2, "g10", [(1, 2)]),
+     ("10.0.0.3", [0, 1])),
+    # forged sub-host tiles on the single-host v5e: 2x2 tiles then the 4x2
+    ("p11", spec("vcA", 0, "v5e-chip", 4, "g11", [(1, 4)]),
+     ("ve0/0-0", [0, 1, 4, 5])),
+    ("p12", spec("vcA", 0, "v5e-chip", 4, "g12", [(1, 4)]),
+     ("ve0/0-0", [8, 9, 12, 13])),
+    ("p13", spec("vcC", 0, "v5e-chip", 8, "g13", [(1, 8)]),
+     ("ve0/0-0", [2, 3, 6, 7, 10, 11, 14, 15])),
+    # generic chain nodes (default addresses 12..17)
+    ("p14", spec("vcB", 0, "g-chip", 8, "g14", [(1, 8)]),
+     ("14", [0, 1, 2, 3, 4, 5, 6, 7])),
+    ("p15", spec("vcB", 0, "g-chip", 8, "g15", [(1, 8)]),
+     ("13", [0, 1, 2, 3, 4, 5, 6, 7])),
+    # multi-node gang across a whole g-rack
+    ("p16a", spec("vcC", 0, "g-chip", 8, "g16", [(3, 8)]),
+     ("15", [0, 1, 2, 3, 4, 5, 6, 7])),
+    ("p16b", spec("vcC", 0, "g-chip", 8, "g16", [(3, 8)]),
+     ("16", [0, 1, 2, 3, 4, 5, 6, 7])),
+    ("p16c", spec("vcC", 0, "g-chip", 8, "g16", [(3, 8)]),
+     ("17", [0, 1, 2, 3, 4, 5, 6, 7])),
+    # whole mx node with default chip addresses on the multi-type node
+    ("p18", spec("vcC", 0, "mx-chip", 8, "g18", [(1, 8)]),
+     ("10.0.0.2", [0, 1, 2, 3, 4, 5, 6, 7])),
+    # two sockets on the scrambled-address node: the SCRAMBLED chip indices
+    # surface in the isolation handoff
+    ("p19a", spec("vcB", 0, "mx-chip", 4, "g19", [(2, 4)]),
+     ("10.0.0.0", [1, 3, 4, 7])),
+    ("p19b", spec("vcB", 0, "mx-chip", 4, "g19", [(2, 4)]),
+     ("10.0.0.0", [0, 2, 5, 6])),
+    # vcC's guaranteed 4x2x2 share in the free half
+    ("p20a", spec("vcC", 2, "v5p-chip", 4, "g20", [(4, 4)]),
+     ("gp0/0-2-0", [0, 1, 2, 3])),
+    ("p20b", spec("vcC", 2, "v5p-chip", 4, "g20", [(4, 4)]),
+     ("gp0/0-2-1", [0, 1, 2, 3])),
+    ("p20c", spec("vcC", 2, "v5p-chip", 4, "g20", [(4, 4)]),
+     ("gp0/2-2-0", [0, 1, 2, 3])),
+    ("p20d", spec("vcC", 2, "v5p-chip", 4, "g20", [(4, 4)]),
+     ("gp0/2-2-1", [0, 1, 2, 3])),
+]
+
+WAIT = [
+    # vcA's only g-node was consumed by the any-type group g08
+    ("p17", spec("vcA", 0, "g-chip", 8, "g17", [(1, 8)])),
+    # gang larger than vcC's remaining v5p guarantee
+    ("p07", spec("vcC", 1, "v5p-chip", 4, "g07", [(5, 4)])),
+]
+
+USER_ERRORS = [
+    # leaf cell type not in the VC
+    ("f1", spec("vcB", 1, "v5e-chip", 1, "gf1", [(1, 1)])),
+    # pod's leafCellNumber not among the group members
+    ("f2", spec("vcB", 1, "v5p-chip", 3, "gf2", [(1, 4)])),
+    # unknown VC
+    ("f3", spec("surprise!", 1, "v5p-chip", 1, "gf3", [(1, 1)])),
+    # unknown pinned cell
+    ("f4", spec("vcA", 1, "v5p-chip", 1, "gf4", [(1, 1)], pinned="surprise!")),
+    # priority above the guaranteed maximum
+    ("f5", spec("vcB", 1001, "v5p-chip", 1, "gf5", [(1, 1)])),
+    # leaf cell type the whole cluster does not have
+    ("f6", spec("vcB", 1, "surprise-chip", 1, "gf6", [(1, 1)])),
+]
+
+
+class TestGnarlyNormalOperations:
+    def test_table(self, algo):
+        nodes = set_healthy_nodes(algo)
+        initial_free = free_list_snapshot(algo)
+        allocated = []
+        for name, s, expected in SUCCEED:
+            pod = make_pod(name, s)
+            r = algo.schedule(pod, nodes, PREEMPTING_PHASE)
+            assert r.pod_bind_info is not None, (
+                name, r.pod_wait_info, r.pod_preempt_info)
+            got = (r.pod_bind_info.node,
+                   sorted(r.pod_bind_info.leaf_cell_isolation))
+            assert got == expected, f"{name}: got {got}, want {expected}"
+            bp = new_binding_pod(pod, r.pod_bind_info)
+            algo.add_allocated_pod(bp)
+            allocated.append(bp)
+
+        for name, s in WAIT:
+            r = algo.schedule(make_pod(name, s), nodes, PREEMPTING_PHASE)
+            assert r.pod_wait_info is not None, (
+                name, r.pod_bind_info, r.pod_preempt_info)
+
+        for name, s in USER_ERRORS:
+            with pytest.raises(WebServerError) as exc:
+                algo.schedule(make_pod(name, s), nodes, PREEMPTING_PHASE)
+            assert 400 <= exc.value.code < 500, (name, exc.value.code)
+
+        # full-delete invariant: reverse deletion returns the cluster to its
+        # initial state — no groups left, free list exactly restored
+        for bp in reversed(allocated):
+            algo.delete_allocated_pod(bp)
+        assert not list(algo.get_all_affinity_groups())
+        assert free_list_snapshot(algo) == initial_free
+
+
+class TestGnarlyPreemption:
+    def _fill(self, algo, nodes):
+        allocated = []
+        for name, s, _ in SUCCEED:
+            pod = make_pod(name, s)
+            r = algo.schedule(pod, nodes, PREEMPTING_PHASE)
+            assert r.pod_bind_info is not None, name
+            bp = new_binding_pod(pod, r.pod_bind_info)
+            algo.add_allocated_pod(bp)
+            allocated.append(bp)
+        return allocated
+
+    def test_preempt_victim_goldens(self, algo):
+        nodes = set_healthy_nodes(algo)
+        self._fill(algo, nodes)
+        # q1: higher-priority pinned gang preempts g05; victims come one node
+        # at a time, all from g05
+        q1 = make_pod("q1", spec("vcA", 2, "v5p-chip", 4, "gq1", [(8, 4)],
+                                 pinned="pin-gp"))
+        r = algo.schedule(q1, nodes, PREEMPTING_PHASE)
+        assert r.pod_preempt_info is not None
+        victims = {v.name for v in r.pod_preempt_info.victim_pods}
+        assert victims and victims <= {f"p05{c}" for c in "abcdefgh"}
+        assert algo.get_affinity_group("gq1").status.state == GROUP_PREEMPTING
+        assert algo.get_affinity_group("g05").status.state == GROUP_BEING_PREEMPTED
+        # canceling the preemptor returns the cells to g05
+        algo.delete_unallocated_pod(q1)
+        assert algo.get_affinity_group("g05").status.state in (
+            GROUP_ALLOCATED, GROUP_BEING_PREEMPTED)
+        assert "gq1" not in {g.name for g in algo.get_all_affinity_groups()}
+
+        # q2: exact single-group victim golden on the ct chain
+        q2 = make_pod("q2", spec("vcB", 1, "ct-chip", 2, "gq2", [(1, 2)],
+                                 lazy=False))
+        r = algo.schedule(q2, nodes, PREEMPTING_PHASE)
+        assert r.pod_preempt_info is not None
+        assert {v.name for v in r.pod_preempt_info.victim_pods} == {"p10"}
+
+
+STATEFUL = lambda prio, g, lazy=True: spec(
+    "vcA", prio, "v5p-chip", 4, g, [(8, 4)], pinned="pin-gp", lazy=lazy)
+
+
+class TestGnarlyStatefulPreemption:
+    def test_preemptor_chain(self, algo):
+        """Reference pods 28-35: preemptor displacement, waiting behind a
+        victim, cancellation of displaced preemptors, allocation after the
+        victim dies, and cancellation-by-delete."""
+        nodes = set_healthy_nodes(algo)
+        s1_pods = []
+        for i in range(8):
+            p = make_pod(f"s1-{i}", STATEFUL(1, "g-s1"))
+            r = algo.schedule(p, nodes, PREEMPTING_PHASE)
+            assert r.pod_bind_info is not None
+            bp = new_binding_pod(p, r.pod_bind_info)
+            algo.add_allocated_pod(bp)
+            s1_pods.append(bp)
+        s1_names = {f"s1-{i}" for i in range(8)}
+
+        # s2 preempts s1
+        r = algo.schedule(make_pod("s2-0", STATEFUL(2, "g-s2")), nodes,
+                          PREEMPTING_PHASE)
+        assert r.pod_preempt_info is not None
+        assert {v.name for v in r.pod_preempt_info.victim_pods} <= s1_names
+        assert algo.get_affinity_group("g-s2").status.state == GROUP_PREEMPTING
+        assert algo.get_affinity_group("g-s1").status.state == GROUP_BEING_PREEMPTED
+
+        # s3 (same priority as s1) must wait: s1 still holds the cells
+        r = algo.schedule(make_pod("s3-0", STATEFUL(1, "g-s3")), nodes,
+                          PREEMPTING_PHASE)
+        assert r.pod_wait_info is not None
+
+        # s4 (higher) displaces preemptor g-s2 and keeps preempting g-s1
+        r = algo.schedule(make_pod("s4-0", STATEFUL(3, "g-s4")), nodes,
+                          PREEMPTING_PHASE)
+        assert r.pod_preempt_info is not None
+        assert {v.name for v in r.pod_preempt_info.victim_pods} <= s1_names
+        names = {g.name for g in algo.get_all_affinity_groups()}
+        assert "g-s2" not in names, "displaced preemptor must be deleted"
+        assert algo.get_affinity_group("g-s4").status.state == GROUP_PREEMPTING
+
+        # victims die; s4 allocates
+        for bp in s1_pods:
+            algo.delete_allocated_pod(bp)
+        for i in range(8):
+            p = make_pod(f"s4-{i}", STATEFUL(3, "g-s4"), uid=f"s4-{i}")
+            r = algo.schedule(p, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None, (i, r.pod_wait_info)
+            algo.add_allocated_pod(new_binding_pod(p, r.pod_bind_info))
+        assert algo.get_affinity_group("g-s4").status.state == GROUP_ALLOCATED
+
+        # s5 preempts s4, then dies before the victims: preemption canceled,
+        # s4 keeps its placement (BeingPreempted, as in the reference)
+        s5 = make_pod("s5-0", STATEFUL(4, "g-s5", lazy=False))
+        r = algo.schedule(s5, nodes, PREEMPTING_PHASE)
+        assert r.pod_preempt_info is not None
+        assert {v.name for v in r.pod_preempt_info.victim_pods} <= {
+            f"s4-{i}" for i in range(8)}
+        algo.delete_unallocated_pod(s5)
+        names = {g.name: g.status.state for g in algo.get_all_affinity_groups()}
+        assert "g-s5" not in names
+        assert names["g-s4"] in (GROUP_ALLOCATED, GROUP_BEING_PREEMPTED)
+
+
+class TestGnarlyReconfiguration:
+    def test_shrunk_vc_lazy_preempts_only_the_loser(self, algo):
+        """Work-preserving reconfiguration: vcC loses its v5p share to vcB;
+        on replay vcC's group is lazy-preempted, vcB's keeps its placement."""
+        nodes = set_healthy_nodes(algo)
+        allocated = []
+        for i in range(2):
+            p = make_pod(f"r1-{i}", spec("vcB", 2, "v5p-chip", 4, "g-r1",
+                                         [(2, 4)]))
+            r = algo.schedule(p, nodes, PREEMPTING_PHASE)
+            assert r.pod_bind_info is not None
+            bp = new_binding_pod(p, r.pod_bind_info)
+            algo.add_allocated_pod(bp)
+            allocated.append(bp)
+        for i in range(4):
+            p = make_pod(f"r2-{i}", spec("vcC", 2, "v5p-chip", 4, "g-r2",
+                                         [(4, 4)]))
+            r = algo.schedule(p, nodes, PREEMPTING_PHASE)
+            assert r.pod_bind_info is not None
+            bp = new_binding_pod(p, r.pod_bind_info)
+            algo.add_allocated_pod(bp)
+            allocated.append(bp)
+
+        raw = yaml.safe_load(open(FIXTURE))
+        vcs = raw["virtualClusters"]
+        vcs["vcC"]["virtualCells"] = [
+            v for v in vcs["vcC"]["virtualCells"]
+            if v["cellType"] != "v5p-8x4x2.g-4x2x2"
+        ]
+        vcs["vcB"]["virtualCells"].append(
+            {"cellType": "v5p-8x4x2.g-4x2x2", "cellNumber": 1})
+        h2 = HivedAlgorithm(new_config(Config.from_dict(raw)))
+        set_healthy_nodes(h2)
+        for bp in allocated:
+            h2.add_allocated_pod(bp)
+        g1 = h2.get_affinity_group("g-r1")
+        g2 = h2.get_affinity_group("g-r2")
+        assert g1.status.state == GROUP_ALLOCATED
+        assert g1.status.lazy_preemption_status is None
+        assert g2.status.state == GROUP_ALLOCATED
+        assert g2.status.lazy_preemption_status is not None
+
+
+class TestGnarlyBadNodes:
+    def test_bad_host_avoided_and_doomed_bad_binding(self, algo):
+        nodes = set_healthy_nodes(algo)
+        algo.delete_node(Node(name="gp0/0-0-0"))
+        got = []
+        for i in range(2):
+            p = make_pod(f"b1-{i}", spec("vcB", 2, "v5p-chip", 4, "g-b1",
+                                         [(2, 4)]))
+            r = algo.schedule(p, nodes, PREEMPTING_PHASE)
+            assert r.pod_bind_info is not None
+            algo.add_allocated_pod(new_binding_pod(p, r.pod_bind_info))
+            got.append(r.pod_bind_info.node)
+        assert "gp0/0-0-0" not in got
+        assert got == ["gp0/2-0-0", "gp0/2-0-1"]  # golden: healthy 2x2x2
+
+        # enough bad hosts doom a VC cell: badness must surface in vcB's view
+        for nn in ["gp0/0-0-1", "gp0/2-0-0", "gp0/2-0-1"]:
+            algo.delete_node(Node(name=nn))
+
+        def walk(ss):
+            for s in ss:
+                yield s
+                yield from walk(s.cell_children)
+
+        bad = [s for s in walk(algo.get_virtual_cluster_status("vcB"))
+               if getattr(s, "cell_healthiness", "") == "Bad"]
+        assert bad, "doomed bad cells must be visible in the VC status"

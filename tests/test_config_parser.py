@@ -205,6 +205,14 @@ class TestExampleConfigsValid:
 
         HivedAlgorithm(load_config(FIXTURE))
 
+    def test_gnarly_fixture(self):
+        from hivedscheduler_tpu.algorithm import HivedAlgorithm
+
+        path = os.path.join(os.path.dirname(FIXTURE), "tpu-hive-gnarly.yaml")
+        h = HivedAlgorithm(load_config(path))
+        assert set(h.full_cell_list) == {
+            "v5p-8x4x2", "v5e-16f", "g-pool", "ct-node", "3-mx-node"}
+
     def test_deploy_manifest_embedded_config(self):
         import yaml
 

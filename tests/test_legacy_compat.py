@@ -1,0 +1,168 @@
+"""Legacy HiveD annotation compatibility.
+
+The reference rewrites old GPU-era annotation keys before parsing
+(``convertOldAnnotation``, ``pkg/internal/utils.go:189-197``):
+gpuType→leafCellType, gpuNumber→leafCellNumber, gpuIsolation→leafCellIsolation,
+physicalGpuIndices→physicalLeafCellIndices. tpu-hive accepts those plus the
+chipType/chipNumber TPU aliases. These tests pin the full path: a
+reference-format pod spec and bind info round-trip through
+extract → schedule → crash recovery. If any legacy key stops parsing,
+these fail.
+"""
+
+import logging
+import os
+
+import pytest
+
+from hivedscheduler_tpu.api import constants as C
+from hivedscheduler_tpu.api.config import load_config
+from hivedscheduler_tpu.algorithm import HivedAlgorithm
+from hivedscheduler_tpu.algorithm.constants import GROUP_ALLOCATED
+from hivedscheduler_tpu.common.utils import to_yaml
+from hivedscheduler_tpu.k8s.types import Container, Pod
+from hivedscheduler_tpu.runtime.types import FILTERING_PHASE
+from hivedscheduler_tpu.runtime.utils import (
+    convert_old_annotation,
+    extract_pod_bind_info,
+    extract_pod_scheduling_spec,
+    new_binding_pod,
+)
+
+logging.getLogger().setLevel(logging.ERROR)
+
+from helpers import all_node_names, set_healthy_nodes
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "example", "config", "design", "tpu-hive.yaml",
+)
+
+
+def legacy_pod(name, annotation):
+    return Pod(
+        name=name,
+        uid=name,
+        annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: annotation},
+        containers=[Container(
+            resource_limits={C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})],
+    )
+
+
+@pytest.fixture
+def algo():
+    h = HivedAlgorithm(load_config(FIXTURE))
+    set_healthy_nodes(h)
+    return h
+
+
+class TestLegacySchedulingSpec:
+    def test_gpu_era_spec_keys_parse(self):
+        """A HiveD-GPU-format spec annotation (gpuType/gpuNumber) parses into
+        leafCellType/leafCellNumber."""
+        ann = to_yaml({
+            "virtualCluster": "vc2",
+            "priority": 5,
+            "gpuType": "v5e-chip",
+            "gpuNumber": 8,
+            "affinityGroup": {
+                "name": "legacy/grp",
+                "members": [{"podNumber": 1, "gpuNumber": 8}],
+            },
+        })
+        spec = extract_pod_scheduling_spec(legacy_pod("l0", ann))
+        assert spec.leaf_cell_type == "v5e-chip"
+        assert spec.leaf_cell_number == 8
+        assert spec.affinity_group.members[0].leaf_cell_number == 8
+
+    def test_gpu_era_spec_schedules_end_to_end(self, algo):
+        ann = to_yaml({
+            "virtualCluster": "vc2",
+            "priority": 5,
+            "gpuType": "v5e-chip",
+            "gpuNumber": 8,
+        })
+        pod = legacy_pod("l1", ann)
+        r = algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+        assert r.pod_bind_info is not None
+        assert len(r.pod_bind_info.leaf_cell_isolation) == 8
+
+    def test_chip_alias_spec_keys_parse(self):
+        """The TPU-era chipType/chipNumber aliases keep working too."""
+        ann = to_yaml({
+            "virtualCluster": "vc2",
+            "priority": 5,
+            "chipType": "v5e-chip",
+            "chipNumber": 4,
+        })
+        spec = extract_pod_scheduling_spec(legacy_pod("l2", ann))
+        assert spec.leaf_cell_type == "v5e-chip"
+        assert spec.leaf_cell_number == 4
+
+
+class TestLegacyBindInfo:
+    def test_gpu_era_bind_info_recovers_through_crash(self, algo):
+        """Round-trip: schedule → rewrite the bind-info annotation into the
+        old GPU key format → replay into a fresh scheduler (crash recovery).
+        The recovered group must hold the same placement."""
+        ann = to_yaml({
+            "virtualCluster": "vc2",
+            "priority": 5,
+            "gpuType": "v5e-chip",
+            "gpuNumber": 8,
+            "affinityGroup": {
+                "name": "legacy/recover",
+                "members": [{"podNumber": 1, "gpuNumber": 8}],
+            },
+        })
+        pod = legacy_pod("l3", ann)
+        r = algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+        assert r.pod_bind_info is not None
+        bp = new_binding_pod(pod, r.pod_bind_info)
+
+        # downgrade the machine-written annotations to the old key format,
+        # as if written by a pre-rename HiveD
+        new_to_old = [
+            ("leafCellIsolation", "gpuIsolation"),
+            ("physicalLeafCellIndices", "physicalGpuIndices"),
+            ("leafCellType", "gpuType"),
+            ("leafCellNumber", "gpuNumber"),
+        ]
+        old_bind = bp.annotations[C.ANNOTATION_POD_BIND_INFO]
+        for new, old in new_to_old:
+            old_bind = old_bind.replace(new, old)
+        assert "gpuIsolation" in old_bind
+        legacy_bp = bp.deep_copy()
+        legacy_bp.annotations[C.ANNOTATION_POD_BIND_INFO] = old_bind
+        legacy_bp.annotations[C.ANNOTATION_POD_SCHEDULING_SPEC] = ann
+        legacy_bp.node_name = r.pod_bind_info.node
+
+        # the legacy-format bind info parses identically
+        info = extract_pod_bind_info(legacy_bp)
+        assert info.node == r.pod_bind_info.node
+        assert info.leaf_cell_isolation == r.pod_bind_info.leaf_cell_isolation
+
+        # crash recovery: fresh algorithm replays the legacy-format pod
+        fresh = HivedAlgorithm(load_config(FIXTURE))
+        set_healthy_nodes(fresh)
+        fresh.add_allocated_pod(legacy_bp)
+        g = fresh.get_affinity_group("legacy/recover")
+        assert g.status.state == GROUP_ALLOCATED
+        # placement survived: the recovered group holds the same node + chips
+        assert r.pod_bind_info.node in g.status.physical_placement
+        assert sorted(g.status.physical_placement[r.pod_bind_info.node]) == sorted(
+            r.pod_bind_info.leaf_cell_isolation
+        )
+
+    def test_rewrite_table_is_exhaustive(self):
+        """Guard: every key the reference rewrites must be rewritten here."""
+        reference_pairs = {
+            "gpuType": "leafCellType",
+            "gpuNumber": "leafCellNumber",
+            "gpuIsolation": "leafCellIsolation",
+            "physicalGpuIndices": "physicalLeafCellIndices",
+        }
+        for old, new in reference_pairs.items():
+            assert convert_old_annotation(old) == new, (
+                f"legacy key {old!r} no longer rewrites to {new!r}"
+            )
