@@ -381,8 +381,16 @@ def set_cell_priority(c: Cell, p: CellPriority) -> None:
 
 
 def update_used_leaf_cell_num_at_priority(c: Optional[Cell], p: CellPriority, increase: bool) -> None:
-    """Reference: updateUsedLeafCellNumAtPriority, cell_allocation.go:445-454."""
+    """Reference: updateUsedLeafCellNumAtPriority, cell_allocation.go:445-454.
+
+    Inlined dict update: this walk runs once per leaf per alloc/release on
+    both cell trees, making it the hottest loop in gang bookkeeping."""
     delta = 1 if increase else -1
     while c is not None:
-        c.increase_used_leaf_cell_num_at_priority(p, delta)
+        d = c.used_leaf_cell_num_at_priorities
+        n = d.get(p, 0) + delta
+        if n == 0:
+            d.pop(p, None)
+        else:
+            d[p] = n
         c = c.parent

@@ -108,6 +108,17 @@ class HivedAlgorithm(SchedulerAlgorithm):
         self.mesh_chains = parsed.mesh_chains
         self.api_cluster_status = api.ClusterStatus()
         self.algorithm_lock = threading.RLock()
+        # Live-placement handoff: the optimistic AddAllocatedPod that follows
+        # a Schedule under the same scheduler lock re-derives the placement
+        # from the annotation (reference behavior). When NOTHING has happened
+        # in between (consecutive op sequence numbers) and the annotation's
+        # gang fragment is byte-identical to what Schedule encoded, the
+        # re-derivation provably picks the same cells — so Schedule stashes
+        # its placement objects and the create path reuses them. Any other
+        # interleaving (bind retries, recovery, node events) falls back to
+        # the annotation-driven slow path.
+        self._op_seq = 0
+        self._live_stash: Optional[tuple] = None
 
         for vc_name in parsed.virtual_non_pinned_full:
             self.vc_schedulers[vc_name] = IntraVCScheduler(
@@ -124,6 +135,14 @@ class HivedAlgorithm(SchedulerAlgorithm):
         from hivedscheduler_tpu.algorithm.utils import build_leaf_cell_index
 
         self._leaf_cell_index = build_leaf_cell_index(self.full_cell_list)
+        # node name -> leaf cells, in full_cell_list iteration order (same
+        # order the reference's per-event leaf scan visits, setBadNode,
+        # hived_algorithm.go:467-481); health events become O(leaves-per-node)
+        self._leaves_by_node: Dict[str, List[PhysicalCell]] = {}
+        for ccl in self.full_cell_list.values():
+            for leaf_cell in ccl[1]:
+                assert isinstance(leaf_cell, PhysicalCell)
+                self._leaves_by_node.setdefault(leaf_cell.nodes[0], []).append(leaf_cell)
         self._init_cell_nums()
         self._init_api_cluster_status()
         self._init_pinned_cells(parsed.physical_pinned_cells)
@@ -223,6 +242,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
 
     def add_node(self, node: Node) -> None:
         with self.algorithm_lock:
+            self._op_seq += 1
             if not internal_utils.is_node_healthy(node):
                 self._set_bad_node(node.name)
             else:
@@ -230,6 +250,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
 
     def update_node(self, old_node: Node, new_node: Node) -> None:
         with self.algorithm_lock:
+            self._op_seq += 1
             old_healthy = internal_utils.is_node_healthy(old_node)
             if old_healthy != internal_utils.is_node_healthy(new_node):
                 if old_healthy:
@@ -239,6 +260,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
 
     def delete_node(self, node: Node) -> None:
         with self.algorithm_lock:
+            self._op_seq += 1
             self._set_bad_node(node.name)
 
     def _set_bad_node(self, node_name: str) -> None:
@@ -246,22 +268,16 @@ class HivedAlgorithm(SchedulerAlgorithm):
         if node_name in self.bad_nodes:
             return
         self.bad_nodes.add(node_name)
-        for ccl in self.full_cell_list.values():
-            for leaf_cell in ccl[1]:
-                assert isinstance(leaf_cell, PhysicalCell)
-                if leaf_cell.nodes[0] == node_name:
-                    self._set_bad_cell(leaf_cell)
+        for leaf_cell in self._leaves_by_node.get(node_name, []):
+            self._set_bad_cell(leaf_cell)
 
     def _set_healthy_node(self, node_name: str) -> None:
         """Reference: setHealthyNode, hived_algorithm.go:484-498."""
         if node_name not in self.bad_nodes:
             return
         self.bad_nodes.discard(node_name)
-        for ccl in self.full_cell_list.values():
-            for leaf_cell in ccl[1]:
-                assert isinstance(leaf_cell, PhysicalCell)
-                if leaf_cell.nodes[0] == node_name:
-                    self._set_healthy_cell(leaf_cell)
+        for leaf_cell in self._leaves_by_node.get(node_name, []):
+            self._set_healthy_cell(leaf_cell)
 
     def _set_bad_cell(self, c: PhysicalCell) -> None:
         """Mark bad up-tree; bind to a virtual cell if an ancestor is bound so
@@ -392,6 +408,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
     ) -> PodScheduleResult:
         """Reference: Schedule, hived_algorithm.go:180-224."""
         with self.algorithm_lock:
+            self._op_seq += 1
             log.info("[%s]: Scheduling pod in %s phase...", internal_utils.key(pod), phase)
             s = internal_utils.extract_pod_scheduling_spec(pod)
             suggested_node_set = set(suggested_nodes)
@@ -413,7 +430,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
                 (group_physical, group_virtual, preemption_victims, wait_reason) = (
                     self._schedule_pod_from_new_group(s, suggested_node_set, phase, pod)
                 )
-            return generate_pod_schedule_result(
+            result = generate_pod_schedule_result(
                 group_physical,
                 group_virtual,
                 preemption_victims,
@@ -426,6 +443,19 @@ class HivedAlgorithm(SchedulerAlgorithm):
                 suggested_node_set,
                 pod,
             )
+            if (
+                result.pod_bind_info is not None
+                and s.affinity_group.name not in self.affinity_groups
+                and group_physical is not None
+            ):
+                self._live_stash = (
+                    self._op_seq,
+                    s.affinity_group.name,
+                    result.pod_bind_info._encoded_group,
+                    group_physical,
+                    group_virtual,
+                )
+            return result
 
     def add_unallocated_pod(self, pod: Pod) -> None:
         pass
@@ -434,6 +464,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
         """Cancels a preemption when its last preempting pod dies (reference:
         DeleteUnallocatedPod, hived_algorithm.go:229-245)."""
         with self.algorithm_lock:
+            self._op_seq += 1
             s = internal_utils.extract_pod_scheduling_spec(pod)
             g = self.affinity_groups.get(s.affinity_group.name)
             if g is not None and g.state == GROUP_PREEMPTING:
@@ -451,6 +482,8 @@ class HivedAlgorithm(SchedulerAlgorithm):
     def add_allocated_pod(self, pod: Pod) -> None:
         """Reference: AddAllocatedPod, hived_algorithm.go:247-269."""
         with self.algorithm_lock:
+            stash, self._live_stash = self._live_stash, None
+            self._op_seq += 1
             s = internal_utils.extract_pod_scheduling_spec(pod)
             info = internal_utils.extract_pod_bind_info(pod)
             log.info("[%s]: Adding allocated pod to affinity group %s (node %s, leaf cells %s)",
@@ -470,7 +503,15 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     )
                     return
             else:
-                self._create_allocated_affinity_group(s, info, pod)
+                live = None
+                if (
+                    stash is not None
+                    and stash[0] == self._op_seq - 1
+                    and stash[1] == s.affinity_group.name
+                    and stash[2] == getattr(info, "_frag", None)
+                ):
+                    live = (stash[3], stash[4])
+                self._create_allocated_affinity_group(s, info, pod, live=live)
             self.affinity_groups[s.affinity_group.name].allocated_pods[s.leaf_cell_number][
                 pod_index
             ] = pod
@@ -478,6 +519,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
     def delete_allocated_pod(self, pod: Pod) -> None:
         """Reference: DeleteAllocatedPod, hived_algorithm.go:272-296."""
         with self.algorithm_lock:
+            self._op_seq += 1
             s = internal_utils.extract_pod_scheduling_spec(pod)
             info = internal_utils.extract_pod_bind_info(pod)
             log.info(
@@ -878,11 +920,21 @@ class HivedAlgorithm(SchedulerAlgorithm):
     # ------------------------------------------------------------------
 
     def _create_allocated_affinity_group(
-        self, s: api.PodSchedulingSpec, info: api.PodBindInfo, pod: Pod
+        self,
+        s: api.PodSchedulingSpec,
+        info: api.PodBindInfo,
+        pod: Pod,
+        live: Optional[tuple] = None,
     ) -> None:
         """Recovery path with the tolerance ladder: missing cells ignored;
         missing virtual placement or safety violation → lazy preempt
-        (reference: createAllocatedAffinityGroup, hived_algorithm.go:982-1041)."""
+        (reference: createAllocatedAffinityGroup, hived_algorithm.go:982-1041).
+
+        ``live`` carries the (physical, virtual) placement objects Schedule
+        just computed, when add_allocated_pod proved nothing changed in
+        between — the annotation-driven lookup then provably re-derives these
+        exact cells (guard: test_live_placement_equivalence), so the lookup
+        is skipped. Allocation, binding and safety accounting are unchanged."""
         log.info("[%s]: Creating new allocated affinity group: %s",
                  internal_utils.key(pod), s.affinity_group.name)
         new_group = AlgoAffinityGroup(
@@ -897,17 +949,26 @@ class HivedAlgorithm(SchedulerAlgorithm):
                 for leaf_cell_index in range(
                     len(gms.pod_placements[pod_index].physical_leaf_cell_indices)
                 ):
-                    p_leaf_cell, v_leaf_cell, lazy_preempt = self._find_allocated_leaf_cell(
-                        leaf_cell_index,
-                        gms.pod_placements[pod_index].physical_leaf_cell_indices,
-                        gms.pod_placements[pod_index].preassigned_cell_types,
-                        info.cell_chain,
-                        node,
-                        should_lazy_preempt,
-                        s,
-                        new_group,
-                        pod,
-                    )
+                    if live is not None:
+                        live_gp, live_gv = live
+                        p_leaf_cell = live_gp[leaf_cell_number][pod_index][leaf_cell_index]
+                        if live_gv is None:
+                            v_leaf_cell, lazy_preempt = None, None
+                        else:
+                            v_leaf_cell = live_gv[leaf_cell_number][pod_index][leaf_cell_index]
+                            lazy_preempt = False
+                    else:
+                        p_leaf_cell, v_leaf_cell, lazy_preempt = self._find_allocated_leaf_cell(
+                            leaf_cell_index,
+                            gms.pod_placements[pod_index].physical_leaf_cell_indices,
+                            gms.pod_placements[pod_index].preassigned_cell_types,
+                            info.cell_chain,
+                            node,
+                            should_lazy_preempt,
+                            s,
+                            new_group,
+                            pod,
+                        )
                     if p_leaf_cell is None:
                         # leaf cell not in the spec: ignore it, let the pod run
                         continue

@@ -18,17 +18,22 @@ CellList = List[Cell]
 
 
 def cell_list_contains(cl: CellList, c: Cell) -> bool:
-    return any(cell_equal(cc, c) for cc in cl)
+    # identity fast path runs at C speed; the address-equality scan only
+    # matters if a list ever held a distinct object with the same address
+    return c in cl or any(cell_equal(cc, c) for cc in cl)
 
 
 def cell_list_remove(cl: CellList, c: Cell) -> CellList:
     """Swap-remove, mirroring CellList.remove (types.go:78-95)."""
-    for i, cc in enumerate(cl):
-        if cell_equal(cc, c):
-            cl[i] = cl[-1]
-            cl.pop()
-            return cl
-    raise AssertionError(f"Cell not found in list when removing: {c.address}")
+    try:
+        i = cl.index(c)  # identity scan at C speed (cells define no __eq__)
+    except ValueError:
+        i = next((j for j, cc in enumerate(cl) if cell_equal(cc, c)), -1)
+        if i < 0:
+            raise AssertionError(f"Cell not found in list when removing: {c.address}")
+    cl[i] = cl[-1]
+    cl.pop()
+    return cl
 
 
 def cell_list_to_string(cl: CellList) -> str:

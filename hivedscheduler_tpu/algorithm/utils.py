@@ -278,16 +278,30 @@ def get_new_pod_index(pods: List[Optional[Pod]]) -> int:
 
 
 def get_allocated_pod_index(info: api.PodBindInfo, leaf_cell_num: int) -> int:
-    """Reference: getAllocatedPodIndex, utils.go:298-310."""
+    """Reference: getAllocatedPodIndex, utils.go:298-310.
+
+    The (node, chip) -> pod-index map is memoized on the member-bind-info
+    object: a gang replay calls this once per pod against the same shared
+    group list (see extract_pod_bind_info's fragment memo), so the naive scan
+    is O(gang^2) across the gang while the mapped lookup is O(gang)."""
+    if not info.leaf_cell_isolation:
+        return -1
+    first_chip = info.leaf_cell_isolation[0]
     for gms in info.affinity_group_bind_info:
         if len(gms.pod_placements[0].physical_leaf_cell_indices) == leaf_cell_num:
-            for pod_index, placement in enumerate(gms.pod_placements):
-                if (
-                    placement.physical_node == info.node
-                    and info.leaf_cell_isolation
-                    and info.leaf_cell_isolation[0] in placement.physical_leaf_cell_indices
-                ):
-                    return pod_index
+            index_map = getattr(gms, "_pod_index_map", None)
+            if index_map is None:
+                index_map = {}
+                for pod_index, placement in enumerate(gms.pod_placements):
+                    for chip in placement.physical_leaf_cell_indices:
+                        # first writer wins, like the scan's first match
+                        index_map.setdefault(
+                            (placement.physical_node, chip), pod_index
+                        )
+                gms._pod_index_map = index_map
+            pod_index = index_map.get((info.node, first_chip))
+            if pod_index is not None:
+                return pod_index
     return -1
 
 
@@ -385,13 +399,29 @@ def in_free_cell_list(c: PhysicalCell) -> bool:
 def set_cell_state(c: PhysicalCell, s: str) -> None:
     """Set state up-tree: a parent is Used if ANY child is Used; it takes the
     other states only when ALL children share them (reference: setCellState,
-    utils.go:397-405)."""
-    c.set_state(s)
-    if c.parent is not None:
+    utils.go:397-405).
+
+    Used-path early stop: set_state(s) always writes the cell AND its bound
+    virtual cell's mirrors together, so an ancestor whose own state and bound
+    virtual cell's state both already read Used was fully synced by the walk
+    that made it Used — by induction everything above it is consistent too
+    (fresh binds arrive with the virtual cell in Free state, which fails the
+    check and forces the walk to continue). Saves a root walk per chip when
+    allocating many chips under the same host."""
+    while True:
+        c.set_state(s)
         parent = c.parent
+        if parent is None:
+            return
         assert isinstance(parent, PhysicalCell)
-        if s == CELL_USED or all_children_same_state(parent, s):
-            set_cell_state(parent, s)
+        if s == CELL_USED:
+            if parent.state == CELL_USED and (
+                parent.virtual_cell is None or parent.virtual_cell.state == CELL_USED
+            ):
+                return
+        elif not all_children_same_state(parent, s):
+            return
+        c = parent
 
 
 def all_children_same_state(c: PhysicalCell, s: str) -> bool:

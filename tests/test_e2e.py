@@ -268,6 +268,50 @@ class TestSerializationGuards:
         )
         assert json.loads(_encode_bind_info(bi)) == json.loads(to_json(bi.to_dict()))
 
+    def test_bind_info_fast_decoder_matches_from_dict(self):
+        """The spliced-fragment fast parser in extract_pod_bind_info must
+        stay equivalent to the canonical PodBindInfo.from_dict — a new field
+        added to from_dict but not the fast path would be silently dropped
+        (and memoized)."""
+        import json
+
+        from hivedscheduler_tpu.api import types as api
+        from hivedscheduler_tpu.k8s.types import Pod
+        from hivedscheduler_tpu.api import constants as C2
+        from hivedscheduler_tpu.runtime import utils as ru
+
+        bi = api.PodBindInfo(
+            node="n", leaf_cell_isolation=[2, 3], cell_chain="c",
+            affinity_group_bind_info=[api.AffinityGroupMemberBindInfo(
+                pod_placements=[api.PodPlacementInfo(
+                    physical_node="n", physical_leaf_cell_indices=[2, 3],
+                    preassigned_cell_types=["t", "t"])])],
+        )
+        raw = ru._encode_bind_info(bi)
+        pod = Pod(name="g", uid="g",
+                  annotations={C2.ANNOTATION_POD_BIND_INFO: raw})
+        ru._bind_info_memo.clear()
+        ru._group_frag_memo.clear()
+        fast = ru.extract_pod_bind_info(pod)
+        assert getattr(fast, "_frag", None) is not None, (
+            "expected the fast path to handle a machine-written annotation"
+        )
+        canonical = api.PodBindInfo.from_dict(json.loads(raw))
+        assert fast.to_dict() == canonical.to_dict()
+        # structural pin: every top-level key PodBindInfo.from_dict consumes
+        # must be handled by the fast path too ("affinityGroupBindInfo" is
+        # referenced there via the _GROUP_SPLICE_MARKER constant)
+        import inspect as _inspect
+        import re
+
+        fast_src = _inspect.getsource(ru.extract_pod_bind_info)
+        from_dict_src = _inspect.getsource(api.PodBindInfo.from_dict)
+        for key in re.findall(r'd\.get\("(\w+)"', from_dict_src):
+            assert key in fast_src or key == "affinityGroupBindInfo", (
+                f"PodBindInfo.from_dict consumes {key!r} but the fast decoder "
+                f"in extract_pod_bind_info does not mention it"
+            )
+
 
 class TestHealthz:
     def test_healthz(self, stack):

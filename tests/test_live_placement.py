@@ -1,0 +1,177 @@
+"""Guard for the live-placement handoff (HivedAlgorithm.add_allocated_pod).
+
+The optimistic add may reuse the placement objects Schedule just computed
+instead of re-deriving them from the bind annotation. These tests pin the
+equivalence: a sequence run with the handoff enabled must produce exactly the
+same group state (physical AND virtual placements, by cell address) as the
+same sequence with the handoff disabled, and the handoff must disarm when
+anything happens between Schedule and Add.
+"""
+
+import logging
+import os
+import random
+
+import pytest
+
+from helpers import all_node_names, make_pod, set_healthy_nodes
+
+from hivedscheduler_tpu.api.config import load_config
+from hivedscheduler_tpu.algorithm import HivedAlgorithm
+from hivedscheduler_tpu.k8s.types import Node
+from hivedscheduler_tpu.runtime.types import FILTERING_PHASE
+from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+logging.getLogger().setLevel(logging.ERROR)
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "example", "config", "design", "tpu-hive.yaml",
+)
+
+SEQUENCE = [
+    ("a", {"virtualCluster": "vc2", "priority": 5, "chipType": "v5p-chip",
+           "chipNumber": 4,
+           "affinityGroup": {"name": "ga",
+                             "members": [{"podNumber": 2, "chipNumber": 4}]}}, 2),
+    ("b", {"virtualCluster": "vc2", "priority": 0, "chipType": "v5e-chip",
+           "chipNumber": 8}, 1),
+    ("d", {"virtualCluster": "vc1", "priority": 2, "pinnedCellId": "pin1",
+           "chipNumber": 4}, 1),
+    ("c", {"virtualCluster": "vc1", "priority": -1, "chipType": "v5p-chip",
+           "chipNumber": 4,
+           "affinityGroup": {"name": "gc",
+                             "members": [{"podNumber": 2, "chipNumber": 4}]}}, 2),
+]
+
+
+def run_sequence(disable_handoff):
+    random.seed(0)
+    h = HivedAlgorithm(load_config(FIXTURE))
+    nodes = set_healthy_nodes(h)
+    for name, spec, pods in SEQUENCE:
+        for i in range(pods):
+            pod = make_pod(f"{name}-{i}", spec)
+            r = h.schedule(pod, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None, (name, r.pod_wait_info)
+            if disable_handoff:
+                h._live_stash = None
+            h.add_allocated_pod(new_binding_pod(pod, r.pod_bind_info))
+    return h
+
+
+def group_state(h):
+    out = {}
+    for g in h.affinity_groups.values():
+        phys = {
+            ln: [[c.address if c is not None else None for c in podp]
+                 for podp in podps]
+            for ln, podps in g.physical_leaf_cell_placement.items()
+        }
+        virt = None
+        if g.virtual_leaf_cell_placement is not None:
+            virt = {
+                ln: [[c.address if c is not None else None for c in podp]
+                     for podp in podps]
+                for ln, podps in g.virtual_leaf_cell_placement.items()
+            }
+        out[g.name] = (g.state, phys, virt)
+    return out
+
+
+def free_state(h):
+    return {
+        (chain, lv): sorted(c.address for c in ccl[lv])
+        for chain, ccl in h.free_cell_list.items()
+        for lv in sorted(ccl)
+    }
+
+
+def test_live_placement_equivalence():
+    fast = run_sequence(disable_handoff=False)
+    slow = run_sequence(disable_handoff=True)
+    assert group_state(fast) == group_state(slow)
+    assert free_state(fast) == free_state(slow)
+    # virtual bindings must agree too (which physical cells carry which
+    # virtual cells)
+    def bindings(h):
+        return {
+            (chain, c.address): c.virtual_cell.address
+            for chain, ccl in h.full_cell_list.items()
+            for lv in ccl
+            for c in ccl[lv]
+            if c.virtual_cell is not None
+        }
+    assert bindings(fast) == bindings(slow)
+
+
+def test_inlined_usage_walk_matches_canonical_method():
+    """cell_allocation.update_used_leaf_cell_num_at_priority inlines the
+    zero-popping dict update of Cell.increase_used_leaf_cell_num_at_priority
+    for speed; this guard pins the copies together behaviorally across
+    positive, negative and zero-crossing deltas."""
+    from hivedscheduler_tpu.algorithm.cell import PhysicalCell
+    from hivedscheduler_tpu.algorithm.cell_allocation import (
+        update_used_leaf_cell_num_at_priority,
+    )
+
+    def chain():
+        cells = [
+            PhysicalCell(chain="c", level=lv, at_or_higher_than_node=True,
+                         total_leaf_cell_num=1, cell_type="t", address=str(lv),
+                         is_node_level=lv == 1)
+            for lv in (1, 2, 3)
+        ]
+        cells[0].parent = cells[1]
+        cells[1].parent = cells[2]
+        return cells
+
+    walked, canonical = chain(), chain()
+    deltas = [(5, True), (5, True), (7, True), (7, False), (5, False)]
+    for p, inc in deltas:
+        update_used_leaf_cell_num_at_priority(walked[0], p, inc)
+        c = canonical[0]
+        while c is not None:
+            c.increase_used_leaf_cell_num_at_priority(p, 1 if inc else -1)
+            c = c.parent
+    for w, k in zip(walked, canonical):
+        assert w.used_leaf_cell_num_at_priorities == k.used_leaf_cell_num_at_priorities
+        # zero entries must be POPPED, not stored as 0 (the opportunistic
+        # packing sort iterates this dict)
+        assert 7 not in w.used_leaf_cell_num_at_priorities
+
+
+def test_handoff_disarms_on_interleaved_mutation():
+    """A node event between Schedule and Add must invalidate the stash; the
+    annotation-driven path then runs (and still succeeds)."""
+    random.seed(0)
+    h = HivedAlgorithm(load_config(FIXTURE))
+    nodes = set_healthy_nodes(h)
+    pod = make_pod("x", {"virtualCluster": "vc2", "priority": 5,
+                         "chipType": "v5p-chip", "chipNumber": 4})
+    r = h.schedule(pod, nodes, FILTERING_PHASE)
+    assert r.pod_bind_info is not None
+    assert h._live_stash is not None
+    # interleaved mutation: a node health event
+    h.add_node(Node(name=nodes[0]))
+    h.add_allocated_pod(new_binding_pod(pod, r.pod_bind_info))
+    g = h.get_affinity_group("default/x")
+    assert g.status.state == "Allocated"
+
+
+def test_handoff_disarms_on_stale_annotation():
+    """An annotation whose gang fragment differs from the stashed one (e.g.
+    a bind retry of an older decision) must not use the live placement."""
+    random.seed(0)
+    h = HivedAlgorithm(load_config(FIXTURE))
+    nodes = set_healthy_nodes(h)
+    pod = make_pod("y", {"virtualCluster": "vc2", "priority": 5,
+                         "chipType": "v5p-chip", "chipNumber": 4})
+    r = h.schedule(pod, nodes, FILTERING_PHASE)
+    bp = new_binding_pod(pod, r.pod_bind_info)
+    # corrupt the stash fragment: the byte-compare must reject it
+    seq, name, frag, gp, gv = h._live_stash
+    h._live_stash = (seq, name, frag + " ", gp, gv)
+    h.add_allocated_pod(bp)
+    g = h.get_affinity_group("default/y")
+    assert g.status.state == "Allocated"
