@@ -724,6 +724,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
             affinity_group_name=s.affinity_group.name,
             suggested_nodes=suggested_nodes,
             ignore_suggested_nodes=s.ignore_k8s_suggested_nodes,
+            multi_chain_relax=s.multi_chain_relax_enable,
         )
         for m in s.affinity_group.members:
             sr.affinity_group_pod_nums[m.leaf_cell_number] = (
@@ -758,17 +759,31 @@ class HivedAlgorithm(SchedulerAlgorithm):
         hived_algorithm.go:800-829."""
         vc_has_type = False
         failed_reason = ""
+        candidate_chains: List[CellChain] = []
         for chain in self.cell_chains[leaf_cell_type]:
             if (
                 sr.priority < MIN_GUARANTEED_PRIORITY
                 or chain in self.vc_schedulers[sr.vc].non_pinned_preassigned_cells
             ):
                 vc_has_type = True
+                candidate_chains.append(chain)
                 log.info("Searching chain %s", chain)
                 sr.chain = chain
                 physical, virtual, failed_reason = self._handle_scheduling_request(sr)
                 if physical is not None:
                     return physical, virtual, ""
+        if len(candidate_chains) > 1 and sr.multi_chain_relax:
+            # no single chain fits the whole gang: relax it across chains of
+            # the same leaf type (closes the reference TODO at
+            # intra_vc_scheduler.go:52); opt out per group via
+            # multiChainRelaxEnable: false
+            physical, virtual, relax_reason = self._schedule_relaxed_across_chains(
+                sr, candidate_chains
+            )
+            if physical is not None:
+                return physical, virtual, ""
+            if relax_reason:
+                failed_reason = relax_reason
         if type_specified and sr.priority >= MIN_GUARANTEED_PRIORITY and not vc_has_type:
             raise api.as_bad_request(
                 f"[{internal_utils.key(pod)}]: Pod requesting leaf cell type "
@@ -797,6 +812,87 @@ class HivedAlgorithm(SchedulerAlgorithm):
                 failed_reason = type_failed_reason
         return None, None, failed_reason
 
+    def _schedule_relaxed_across_chains(
+        self, sr: SchedulingRequest, chains: List[CellChain]
+    ) -> Tuple[
+        Optional[GroupPhysicalPlacement], Optional[GroupVirtualPlacement], str
+    ]:
+        """Multi-chain relaxation: split one affinity group across several
+        chains of the same leaf cell type when no single chain can host it.
+
+        Closes the reference's TODO (intra_vc_scheduler.go:52: "Support an
+        affinity group can relax to be allocated across multiple chains").
+        Greedy partition: per chain (in chain order), take the largest prefix
+        of the remaining pods (largest members first) the chain accepts; each
+        sub-request runs the normal per-chain path, so VC-safety accounting
+        is preserved chain by chain. All-or-nothing: if pods remain after the
+        last chain, every committed lazy preemption is reverted and the group
+        waits. Per-pod cell chains are recorded in the bind info, and
+        recovery relies on find_physical_leaf_cell's cross-chain fallback.
+        """
+        flat: List[int] = []
+        for ln in sorted(sr.affinity_group_pod_nums, reverse=True):
+            flat.extend([ln] * sr.affinity_group_pod_nums[ln])
+        merged_phys: GroupPhysicalPlacement = {}
+        merged_virt: GroupVirtualPlacement = {}
+        committed_lazy: Dict[str, GroupVirtualPlacement] = {}
+        guaranteed = sr.priority >= MIN_GUARANTEED_PRIORITY
+        original_pod_nums = sr.affinity_group_pod_nums
+        idx = 0
+        try:
+            for chain in chains:
+                if idx >= len(flat):
+                    break
+                # chip-count upper bound: no point probing prefixes that hold
+                # more chips than the whole chain (keeps the descent linear
+                # overall instead of O(pods) probes per small chain)
+                chain_chips = sum(
+                    c.total_leaf_cell_num
+                    for c in self.full_cell_list[chain][max(self.full_cell_list[chain])]
+                )
+                max_take = 0
+                chips = 0
+                for ln in flat[idx:]:
+                    if chips + ln > chain_chips:
+                        break
+                    chips += ln
+                    max_take += 1
+                for take in range(max_take, 0, -1):
+                    counts: Dict[int, int] = {}
+                    for ln in flat[idx:idx + take]:
+                        counts[ln] = counts.get(ln, 0) + 1
+                    sr.chain = chain
+                    sr.affinity_group_pod_nums = counts
+                    physical, virtual, _ = self._handle_scheduling_request(
+                        sr, collect_lazy=committed_lazy
+                    )
+                    if physical is not None:
+                        for ln, podps in physical.items():
+                            merged_phys.setdefault(ln, []).extend(podps)
+                        if virtual is not None:
+                            for ln, podps in virtual.items():
+                                merged_virt.setdefault(ln, []).extend(podps)
+                        idx += take
+                        log.info(
+                            "Relaxed %s pod(s) of group %s onto chain %s",
+                            take, sr.affinity_group_name, chain,
+                        )
+                        break
+        finally:
+            sr.affinity_group_pod_nums = original_pod_nums
+        if idx < len(flat):
+            for group_name, placement in committed_lazy.items():
+                g = self.affinity_groups.get(group_name)
+                if g is not None:
+                    self._revert_lazy_preempt(g, placement)
+            return None, None, (
+                "insufficient capacity even after relaxing the affinity group "
+                "across cell chains"
+            )
+        log.info("Affinity group %s relaxed across chains: %s pods placed",
+                 sr.affinity_group_name, len(flat))
+        return merged_phys, (merged_virt if guaranteed else None), ""
+
     def _validate_scheduling_request(self, sr: SchedulingRequest, pod: Pod) -> None:
         """Reference: validateSchedulingRequest, hived_algorithm.go:857-871."""
         message = ""
@@ -813,7 +909,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
             raise api.as_bad_request(f"[{internal_utils.key(pod)}]: {message}")
 
     def _handle_scheduling_request(
-        self, sr: SchedulingRequest
+        self, sr: SchedulingRequest, collect_lazy: Optional[Dict] = None
     ) -> Tuple[
         Optional[GroupPhysicalPlacement], Optional[GroupVirtualPlacement], str
     ]:
@@ -822,7 +918,9 @@ class HivedAlgorithm(SchedulerAlgorithm):
         log.info("Processing scheduling request: %s, leaf cell numbers %s, priority %s",
                  where, sr.affinity_group_pod_nums, sr.priority)
         if sr.priority >= MIN_GUARANTEED_PRIORITY:
-            physical, virtual, failed_reason = self._schedule_guaranteed_affinity_group(sr)
+            physical, virtual, failed_reason = self._schedule_guaranteed_affinity_group(
+                sr, collect_lazy
+            )
         else:
             physical, failed_reason = self._schedule_opportunistic_affinity_group(sr)
             virtual = None
@@ -833,12 +931,16 @@ class HivedAlgorithm(SchedulerAlgorithm):
         return physical, virtual, ""
 
     def _schedule_guaranteed_affinity_group(
-        self, sr: SchedulingRequest
+        self, sr: SchedulingRequest, collect_lazy: Optional[Dict] = None
     ) -> Tuple[
         Optional[GroupPhysicalPlacement], Optional[GroupVirtualPlacement], str
     ]:
         """VC placement → binding paths → lazy preempt → map to physical
-        (reference: scheduleGuaranteedAffinityGroup, hived_algorithm.go:900-942)."""
+        (reference: scheduleGuaranteedAffinityGroup, hived_algorithm.go:900-942).
+
+        ``collect_lazy`` (multi-chain relaxation): on success, the lazy
+        preemptions this attempt committed are recorded there so the caller
+        can revert them if the overall relaxed placement later fails."""
         virtual_placement, failed_reason = self.vc_schedulers[sr.vc].schedule(sr)
         if virtual_placement is None:
             return None, None, failed_reason
@@ -864,6 +966,9 @@ class HivedAlgorithm(SchedulerAlgorithm):
             sr.ignore_suggested_nodes,
             bindings,
         ):
+            if collect_lazy is not None:
+                for group_name, placement in lazy_preempted_groups.items():
+                    collect_lazy.setdefault(group_name, placement)
             return (
                 virtual_to_physical_placement(virtual_placement, bindings, leaf_cell_nums),
                 virtual_placement,

@@ -95,6 +95,7 @@ class SchedulingRequest:
     priority: CellPriority = 0
     suggested_nodes: Set[str] = field(default_factory=set)
     ignore_suggested_nodes: bool = False
+    multi_chain_relax: bool = True
 
 
 # placements: leafCellNum -> list over pods -> list of leaf cells of the pod

@@ -110,6 +110,11 @@ def generate_affinity_group_bind_info(
         bind_info, chain = cached[1], cached[2]
         for mbi_cached in bind_info:
             if len(mbi_cached.pod_placements[0].physical_leaf_cell_indices) == current_leaf_cell_num:
+                # cell chain is per POD: a multi-chain-relaxed group spans
+                # chains, so derive it from the current pod's own placement
+                p_cell = group_physical_placement[current_leaf_cell_num][current_pod_index][0]
+                if p_cell is not None:
+                    chain = p_cell.chain
                 return (
                     bind_info,
                     mbi_cached.pod_placements[current_pod_index].physical_node,

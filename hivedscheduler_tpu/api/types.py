@@ -316,6 +316,10 @@ class PodSchedulingSpec:
     gang_release_enable: bool = False
     lazy_preemption_enable: bool = False
     ignore_k8s_suggested_nodes: bool = True
+    # opt-out for gangs that need single-chain interconnect locality:
+    # with False the group waits (reference behavior) instead of being
+    # split across same-leaf-type chains when no single chain fits
+    multi_chain_relax_enable: bool = True
     affinity_group: Optional[AffinityGroupSpec] = None
 
     @staticmethod
@@ -331,6 +335,7 @@ class PodSchedulingSpec:
             gang_release_enable=bool(d.get("gangReleaseEnable", False)),
             lazy_preemption_enable=bool(d.get("lazyPreemptionEnable", False)),
             ignore_k8s_suggested_nodes=bool(d.get("ignoreK8sSuggestedNodes", True)),
+            multi_chain_relax_enable=bool(d.get("multiChainRelaxEnable", True)),
             affinity_group=(
                 AffinityGroupSpec.from_dict(d["affinityGroup"]) if d.get("affinityGroup") else None
             ),
@@ -345,6 +350,7 @@ class PodSchedulingSpec:
             "gangReleaseEnable": self.gang_release_enable,
             "lazyPreemptionEnable": self.lazy_preemption_enable,
             "ignoreK8sSuggestedNodes": self.ignore_k8s_suggested_nodes,
+            "multiChainRelaxEnable": self.multi_chain_relax_enable,
         }
         if self.pinned_cell_id:
             out["pinnedCellId"] = self.pinned_cell_id

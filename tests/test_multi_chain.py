@@ -1,0 +1,194 @@
+"""Multi-chain relaxation: one affinity group spanning several cell chains of
+the same leaf type when no single chain fits it.
+
+Closes the reference's TODO (``intra_vc_scheduler.go:52``) — the reference
+can only wait in this situation. VC safety must hold per chain, the gang
+stays all-or-nothing, and recovery must survive per-pod chains.
+"""
+
+import logging
+import random
+
+import pytest
+
+from helpers import make_pod
+
+from hivedscheduler_tpu.api.config import Config, new_config
+from hivedscheduler_tpu.api.types import (
+    CellTypeSpec,
+    MeshLevelSpec,
+    MeshSpec,
+    PhysicalCellSpec,
+    PhysicalClusterSpec,
+    VirtualCellSpec,
+    VirtualClusterSpec,
+)
+from hivedscheduler_tpu.algorithm import HivedAlgorithm
+from hivedscheduler_tpu.algorithm.constants import GROUP_ALLOCATED
+from hivedscheduler_tpu.k8s.types import Node
+from hivedscheduler_tpu.runtime.types import FILTERING_PHASE
+from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+logging.getLogger().setLevel(logging.ERROR)
+
+
+def build_config():
+    """Two v5p chains of 8 chips each (2x2x2 mesh, 4-chip hosts); vc1 owns
+    both whole chains, vc2 owns nothing here."""
+    def mesh():
+        return MeshSpec(
+            topology=(2, 2, 2), chip_type="v5p-chip", host_shape=(2, 2, 1),
+            levels=[MeshLevelSpec(name_shape[0], name_shape[1])
+                    for name_shape in []],
+        )
+
+    return new_config(Config(
+        physical_cluster=PhysicalClusterSpec(
+            cell_types={
+                "podA": CellTypeSpec(mesh=mesh()),
+                "podB": CellTypeSpec(mesh=mesh()),
+            },
+            physical_cells=[
+                PhysicalCellSpec(cell_type="podA", cell_address="a0"),
+                PhysicalCellSpec(cell_type="podB", cell_address="b0"),
+            ],
+        ),
+        virtual_clusters={
+            "vc1": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=1, cell_type="podA"),
+                VirtualCellSpec(cell_number=1, cell_type="podB"),
+            ]),
+        },
+    ))
+
+
+@pytest.fixture
+def algo():
+    random.seed(0)
+    h = HivedAlgorithm(build_config())
+    for n in sorted({n for ccl in h.full_cell_list.values()
+                     for c in ccl[max(ccl)] for n in c.nodes}):
+        h.add_node(Node(name=n))
+    return h
+
+
+def nodes_of(h):
+    return sorted({n for ccl in h.full_cell_list.values()
+                   for c in ccl[max(ccl)] for n in c.nodes})
+
+
+def gang_spec(pods, name="relax", prio=1):
+    return {"virtualCluster": "vc1", "priority": prio, "chipType": "v5p-chip",
+            "chipNumber": 4,
+            "affinityGroup": {"name": name,
+                              "members": [{"podNumber": pods, "chipNumber": 4}]}}
+
+
+def free_snapshot(h):
+    return {
+        (chain, lv): sorted(c.address for c in ccl[lv])
+        for chain, ccl in h.free_cell_list.items()
+        for lv in sorted(ccl)
+    }
+
+
+class TestMultiChainRelaxation:
+    def test_group_spans_two_chains(self, algo):
+        """3 pods x 4 chips = 12 chips; each chain holds 8. Only a relaxed
+        placement fits — and it must be a real gang (all three bind)."""
+        nodes = nodes_of(algo)
+        initial = free_snapshot(algo)
+        spec = gang_spec(3)
+        bound, chains_used = [], set()
+        for i in range(3):
+            pod = make_pod(f"r-{i}", spec)
+            r = algo.schedule(pod, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None, (i, r.pod_wait_info)
+            chains_used.add(r.pod_bind_info.cell_chain)
+            bp = new_binding_pod(pod, r.pod_bind_info)
+            algo.add_allocated_pod(bp)
+            bound.append(bp)
+        assert chains_used == {"podA", "podB"}, (
+            f"gang must span both chains, used {chains_used}"
+        )
+        g = algo.get_affinity_group("relax")
+        assert g.status.state == GROUP_ALLOCATED
+        # full delete restores both chains' free lists exactly
+        for bp in reversed(bound):
+            algo.delete_allocated_pod(bp)
+        assert free_snapshot(algo) == initial
+
+    def test_single_chain_still_preferred(self, algo):
+        """A gang that fits one chain must NOT be relaxed."""
+        nodes = nodes_of(algo)
+        spec = gang_spec(2, name="fits")
+        chains_used = set()
+        for i in range(2):
+            pod = make_pod(f"f-{i}", spec)
+            r = algo.schedule(pod, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None
+            chains_used.add(r.pod_bind_info.cell_chain)
+            algo.add_allocated_pod(new_binding_pod(pod, r.pod_bind_info))
+        assert len(chains_used) == 1
+
+    def test_relaxation_is_all_or_nothing(self, algo):
+        """5 pods x 4 chips = 20 chips > 16 total: must wait, and the failed
+        relaxation must leave no state behind."""
+        nodes = nodes_of(algo)
+        initial = free_snapshot(algo)
+        r = algo.schedule(make_pod("w-0", gang_spec(5, name="toolarge")),
+                          nodes, FILTERING_PHASE)
+        assert r.pod_wait_info is not None
+        assert free_snapshot(algo) == initial
+        assert "toolarge" not in {g.name for g in algo.get_all_affinity_groups()}
+
+    def test_relaxed_group_recovers_through_crash(self, algo):
+        """Replay the multi-chain gang's bind annotations into a fresh
+        scheduler: per-pod chains + cross-chain fallback must reconstruct the
+        same placement."""
+        nodes = nodes_of(algo)
+        spec = gang_spec(3, name="recover")
+        bound = []
+        for i in range(3):
+            pod = make_pod(f"c-{i}", spec)
+            r = algo.schedule(pod, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None
+            bp = new_binding_pod(pod, r.pod_bind_info)
+            algo.add_allocated_pod(bp)
+            bound.append(bp)
+        placement = {
+            bp.uid: sorted(algo.get_affinity_group("recover").status
+                           .physical_placement)
+            for bp in bound
+        }
+        fresh = HivedAlgorithm(build_config())
+        for n in nodes:
+            fresh.add_node(Node(name=n))
+        for bp in bound:
+            fresh.add_allocated_pod(bp)
+        g = fresh.get_affinity_group("recover")
+        assert g.status.state == GROUP_ALLOCATED
+        assert sorted(g.status.physical_placement) == sorted(
+            algo.get_affinity_group("recover").status.physical_placement
+        )
+
+    def test_opt_out_restores_reference_wait_behavior(self, algo):
+        """multiChainRelaxEnable: false — the gang must wait exactly like the
+        reference instead of being split across chains."""
+        nodes = nodes_of(algo)
+        spec = gang_spec(3, name="nosplit")
+        spec["multiChainRelaxEnable"] = False
+        r = algo.schedule(make_pod("n-0", spec), nodes, FILTERING_PHASE)
+        assert r.pod_wait_info is not None, r.pod_bind_info
+
+    def test_opportunistic_gang_relaxes_too(self, algo):
+        nodes = nodes_of(algo)
+        spec = gang_spec(4, name="opp", prio=-1)
+        chains_used = set()
+        for i in range(4):
+            pod = make_pod(f"o-{i}", spec)
+            r = algo.schedule(pod, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None, (i, r.pod_wait_info)
+            chains_used.add(r.pod_bind_info.cell_chain)
+            algo.add_allocated_pod(new_binding_pod(pod, r.pod_bind_info))
+        assert chains_used == {"podA", "podB"}
