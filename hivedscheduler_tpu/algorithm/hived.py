@@ -752,11 +752,17 @@ class HivedAlgorithm(SchedulerAlgorithm):
         leaf_cell_type: str,
         pod: Pod,
         type_specified: bool,
+        relax_allowed: bool = True,
+        single_chain_allowed: bool = True,
     ) -> Tuple[
         Optional[GroupPhysicalPlacement], Optional[GroupVirtualPlacement], str
     ]:
         """Reference: scheduleAffinityGroupForLeafCellType,
-        hived_algorithm.go:800-829."""
+        hived_algorithm.go:800-829.
+
+        The any-type caller splits the work into two passes via
+        ``single_chain_allowed`` / ``relax_allowed`` so that relaxation never
+        preempts another leaf type's whole-gang placement."""
         vc_has_type = False
         failed_reason = ""
         candidate_chains: List[CellChain] = []
@@ -767,12 +773,14 @@ class HivedAlgorithm(SchedulerAlgorithm):
             ):
                 vc_has_type = True
                 candidate_chains.append(chain)
+                if not single_chain_allowed:
+                    continue
                 log.info("Searching chain %s", chain)
                 sr.chain = chain
                 physical, virtual, failed_reason = self._handle_scheduling_request(sr)
                 if physical is not None:
                     return physical, virtual, ""
-        if len(candidate_chains) > 1 and sr.multi_chain_relax:
+        if len(candidate_chains) > 1 and sr.multi_chain_relax and relax_allowed:
             # no single chain fits the whole gang: relax it across chains of
             # the same leaf type (closes the reference TODO at
             # intra_vc_scheduler.go:52); opt out per group via
@@ -797,19 +805,25 @@ class HivedAlgorithm(SchedulerAlgorithm):
         Optional[GroupPhysicalPlacement], Optional[GroupVirtualPlacement], str
     ]:
         """Reference: scheduleAffinityGroupForAnyLeafCellType,
-        hived_algorithm.go:833-853."""
+        hived_algorithm.go:833-853.
+
+        Two passes: every type's single-chain attempts run before ANY type is
+        relaxed across chains — a whole-gang placement on some other leaf
+        type always beats splitting the gang."""
         failed_reason = ""
-        for leaf_cell_type in self.cell_chains:
-            log.info("Searching leaf cell type %s", leaf_cell_type)
-            physical, virtual, type_failed_reason = (
-                self._schedule_affinity_group_for_leaf_cell_type(
-                    sr, leaf_cell_type, pod, type_specified=False
+        for relax in (False, True) if sr.multi_chain_relax else (False,):
+            for leaf_cell_type in self.cell_chains:
+                log.info("Searching leaf cell type %s (relax=%s)", leaf_cell_type, relax)
+                physical, virtual, type_failed_reason = (
+                    self._schedule_affinity_group_for_leaf_cell_type(
+                        sr, leaf_cell_type, pod, type_specified=False,
+                        relax_allowed=relax, single_chain_allowed=not relax,
+                    )
                 )
-            )
-            if physical is not None:
-                return physical, virtual, ""
-            if type_failed_reason:
-                failed_reason = type_failed_reason
+                if physical is not None:
+                    return physical, virtual, ""
+                if type_failed_reason:
+                    failed_reason = type_failed_reason
         return None, None, failed_reason
 
     def _schedule_relaxed_across_chains(
@@ -858,6 +872,11 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     chips += ln
                     max_take += 1
                 for take in range(max_take, 0, -1):
+                    if idx == 0 and take == len(flat):
+                        # the whole-group attempt on this chain already ran
+                        # (and failed, self-reverting) in the single-chain
+                        # pass; re-probing it verbatim is pure waste
+                        continue
                     counts: Dict[int, int] = {}
                     for ln in flat[idx:idx + take]:
                         counts[ln] = counts.get(ln, 0) + 1

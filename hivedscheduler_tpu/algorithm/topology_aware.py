@@ -127,27 +127,20 @@ def _node_healthy_and_in_suggested(
     return True, True, ""
 
 
-def _find_nodes_for_pods(
-    cv: List[_Node], leaf_cell_nums: List[int], pack: bool = True
+def _greedy_assign(
+    cv: List[_Node], order: List[int], leaf_cell_nums: List[int]
 ) -> Tuple[Optional[List[int]], str]:
-    """Greedy bin-packing over the sorted view (reference: findNodesForPods,
-    topology_aware_scheduler.go:268-306). Nodes sorted by: healthy first,
-    suggested first, then busiest-first (``pack``, the reference behavior) or
-    emptiest-first (``spread`` policy), fewer higher-priority-used last."""
-    sign = -1 if pack else 1
-    cv.sort(
-        key=lambda n: (
-            not n.healthy,
-            not n.suggested,
-            sign * n.used_leaf_cell_num_same_priority,
-            n.used_leaf_cell_num_higher_priority,
-        )
-    )
+    """The reference's greedy walk (findNodesForPods inner loop,
+    topology_aware_scheduler.go:280-305) over ``order`` (indices into cv).
+    The gang-contiguity pass calls this with enclosure members pre-filtered
+    to healthy+suggested nodes, so for it the bad/non-suggested failures
+    cannot fire; the flat fallback owns those failure reasons."""
     picked = [0] * len(leaf_cell_nums)
     pod_index = 0
     picked_leaf_cell_num = 0
-    node_index = 0
-    while node_index < len(cv):
+    oi = 0
+    while oi < len(order):
+        node_index = order[oi]
         n = cv[node_index]
         if n.free_leaf_cell_num_at_priority - picked_leaf_cell_num >= leaf_cell_nums[pod_index]:
             # fail when forced onto a bad or non-suggested node
@@ -162,8 +155,57 @@ def _find_nodes_for_pods(
                 return picked, ""
         else:
             picked_leaf_cell_num = 0
-            node_index += 1
+            oi += 1
     return None, "insufficient capacity"
+
+
+def _find_nodes_for_pods(
+    cv: List[_Node], leaf_cell_nums: List[int], pack: bool = True
+) -> Tuple[Optional[List[int]], str]:
+    """Node selection for a gang (reference: findNodesForPods,
+    topology_aware_scheduler.go:268-306). Nodes sorted by: healthy first,
+    suggested first, then busiest-first (``pack``, the reference behavior) or
+    emptiest-first (``spread`` policy), fewer higher-priority-used last.
+
+    TPU-first extension over the reference's flat greedy: a multi-node gang
+    first tries to fit inside the TIGHTEST enclosing cell (gang-level LCA
+    minimization) — on a mesh chain that enclosing cell is a contiguous ICI
+    sub-mesh, so a gang no longer straddles buddy cells in an L-shape while a
+    whole free cell exists. Falls back to the reference's flat greedy (which
+    also owns the bad/non-suggested failure reasons)."""
+    sign = -1 if pack else 1
+    cv.sort(
+        key=lambda n: (
+            not n.healthy,
+            not n.suggested,
+            sign * n.used_leaf_cell_num_same_priority,
+            n.used_leaf_cell_num_higher_priority,
+        )
+    )
+    if len(leaf_cell_nums) > 1:
+        total = sum(leaf_cell_nums)
+        # (ancestor level, ancestor address) -> member indices into the
+        # sorted cv, ascending; only healthy+suggested nodes join an
+        # enclosure, so enclosure capacity is usable capacity
+        groups: Dict[Tuple[int, str], List[int]] = {}
+        for i, n in enumerate(cv):
+            if not n.healthy or not n.suggested:
+                continue
+            anc = n.cell.parent
+            while anc is not None:
+                groups.setdefault((anc.level, anc.address), []).append(i)
+                anc = anc.parent
+        # visit enclosures tightest level first, then by their best (lowest)
+        # position in the sorted view — pack order within a level
+        for (_lv, _addr), members in sorted(
+            groups.items(), key=lambda kv: (kv[0][0], kv[1][0])
+        ):
+            if sum(cv[i].free_leaf_cell_num_at_priority for i in members) < total:
+                continue
+            picked, _ = _greedy_assign(cv, members, leaf_cell_nums)
+            if picked is not None:
+                return picked, ""
+    return _greedy_assign(cv, list(range(len(cv))), leaf_cell_nums)
 
 
 def _get_optimal_affinity(leaf_cell_num: int, level_leaf_cell_num: Dict[CellLevel, int]) -> CellLevel:

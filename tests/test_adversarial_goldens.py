@@ -85,16 +85,18 @@ SUCCEED = [
      ("gp0/0-0-0", [0])),
     ("p02", spec("vcB", 1, "v5p-chip", 1, "g02", [(1, 1)]),
      ("gp0/0-0-0", [1])),  # buddy chip of p01
-    # 8-chip gang: greedy packing splits across buddy cells (parity with the
-    # reference's per-pod bin-packing; contiguity preference is a tracked
-    # improvement — changing it MUST diff this golden)
+    # 8-chip gang: the gang-contiguity pass places it on ONE contiguous
+    # 2x2x2 (hosts 2-0-0 + 2-0-1) instead of the reference-greedy L-shape
+    # across buddy cells (0-0-1 + 2-0-0) — the TPU-first improvement over
+    # the reference's flat per-pod bin-packing
     ("p03a", spec("vcB", 2, "v5p-chip", 4, "g03", [(2, 4)]),
-     ("gp0/0-0-1", [0, 1, 2, 3])),
-    ("p03b", spec("vcB", 2, "v5p-chip", 4, "g03", [(2, 4)]),
      ("gp0/2-0-0", [0, 1, 2, 3])),
-    # opportunistic stays away from guaranteed pods
+    ("p03b", spec("vcB", 2, "v5p-chip", 4, "g03", [(2, 4)]),
+     ("gp0/2-0-1", [0, 1, 2, 3])),
+    # opportunistic stays away from guaranteed pods; backfills the cell
+    # already fragmented by p01/p02 instead of breaking a fresh one
     ("p04", spec("vcB", -1, "v5p-chip", 1, "g04", [(1, 1)]),
-     ("gp0/2-0-1", [0])),
+     ("gp0/0-0-1", [0])),
     # pinned-cell gang fills the pinned 4x4x2 half host by host
     ("p05a", spec("vcA", 1, "v5p-chip", 4, "g05", [(8, 4)], pinned="pin-gp"),
      ("gp0/4-0-0", [0, 1, 2, 3])),

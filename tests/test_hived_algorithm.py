@@ -104,6 +104,27 @@ class TestNormalOperations:
         assert zs in ([0, 1], [2, 3])
         assert len(set(origins)) == 8
 
+    def test_gang_prefers_contiguous_submesh_over_fragments(self, algo):
+        """Gang-level LCA minimization: with one 2x2x2 partially used, an
+        8-chip gang must take a WHOLE free 2x2x2 (contiguous ICI sub-mesh),
+        not an L-shape straddling the fragmented cell and a fresh one."""
+        frag = {"virtualCluster": "vc2", "priority": 5, "chipType": "v5p-chip",
+                "chipNumber": 1}
+        schedule_and_allocate(algo, make_pod("frag", frag))
+        gang = {"virtualCluster": "vc2", "priority": 5, "chipType": "v5p-chip",
+                "chipNumber": 4,
+                "affinityGroup": {"name": "contig",
+                                  "members": [{"podNumber": 2, "chipNumber": 4}]}}
+        origins = []
+        for i in range(2):
+            _, info = schedule_and_allocate(algo, make_pod(f"contig-{i}", gang))
+            origins.append(tuple(int(x) for x in info.node.split("/")[-1].split("-")))
+        # the two hosts must be the two halves of one 2x2x2: same (x, y),
+        # z in {0, 1}, and 2x2x2-aligned
+        (x0, y0, z0), (x1, y1, z1) = sorted(origins)
+        assert (x0, y0) == (x1, y1) and [z0, z1] == [0, 1], origins
+        assert x0 % 2 == 0 and y0 % 2 == 0, origins
+
     def test_pinned_cell_scheduling(self, algo):
         spec = {"virtualCluster": "vc1", "priority": 2, "pinnedCellId": "pin1",
                 "chipNumber": 4,

@@ -181,6 +181,57 @@ class TestMultiChainRelaxation:
         r = algo.schedule(make_pod("n-0", spec), nodes, FILTERING_PHASE)
         assert r.pod_wait_info is not None, r.pod_bind_info
 
+    def test_any_type_prefers_whole_gang_on_other_type_over_splitting(self):
+        """An untyped gang that no single chain of type A fits must NOT be
+        split across A's chains when a single chain of type B can host it
+        whole — all single-chain attempts across all types run before any
+        relaxation."""
+        random.seed(0)
+        mesh_a = MeshSpec(topology=(2, 2, 2), chip_type="a-chip",
+                          host_shape=(2, 2, 1), levels=[])
+        mesh_b = MeshSpec(topology=(4, 2, 2), chip_type="b-chip",
+                          host_shape=(2, 2, 1), levels=[])
+        cfg = new_config(Config(
+            physical_cluster=PhysicalClusterSpec(
+                cell_types={
+                    "aA": CellTypeSpec(mesh=mesh_a),
+                    "aB": CellTypeSpec(mesh=mesh_a),
+                    "bigB": CellTypeSpec(mesh=mesh_b),
+                },
+                physical_cells=[
+                    PhysicalCellSpec(cell_type="aA", cell_address="aa0"),
+                    PhysicalCellSpec(cell_type="aB", cell_address="ab0"),
+                    PhysicalCellSpec(cell_type="bigB", cell_address="bb0"),
+                ],
+            ),
+            virtual_clusters={
+                "vc1": VirtualClusterSpec(virtual_cells=[
+                    VirtualCellSpec(cell_number=1, cell_type="aA"),
+                    VirtualCellSpec(cell_number=1, cell_type="aB"),
+                    VirtualCellSpec(cell_number=1, cell_type="bigB"),
+                ]),
+            },
+        ))
+        h = HivedAlgorithm(cfg)
+        nodes = sorted({n for ccl in h.full_cell_list.values()
+                        for c in ccl[max(ccl)] for n in c.nodes})
+        for n in nodes:
+            h.add_node(Node(name=n))
+        spec = {"virtualCluster": "vc1", "priority": 1, "chipNumber": 4,
+                "affinityGroup": {"name": "untyped",
+                                  "members": [{"podNumber": 3, "chipNumber": 4}]}}
+        chains_used = set()
+        for i in range(3):
+            pod = make_pod(f"u-{i}", spec)
+            r = h.schedule(pod, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None, (i, r.pod_wait_info)
+            chains_used.add(r.pod_bind_info.cell_chain)
+            h.add_allocated_pod(new_binding_pod(pod, r.pod_bind_info))
+        assert chains_used == {"bigB"}, (
+            f"whole-gang placement on bigB must beat splitting across aA/aB; "
+            f"got {chains_used}"
+        )
+
     def test_opportunistic_gang_relaxes_too(self, algo):
         nodes = nodes_of(algo)
         spec = gang_spec(4, name="opp", prio=-1)
