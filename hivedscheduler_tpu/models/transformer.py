@@ -40,7 +40,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
-    # "xla" | "flash" | "ring" | "ulysses"
+    # "xla" | "flash" | "ring" | "ring_zigzag" | "ulysses"
     attn_impl: str = "xla"
     # switch-MoE: 0 = dense MLP; >0 = experts per MoE layer (ep-sharded)
     n_experts: int = 0
@@ -280,16 +280,21 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
         from hivedscheduler_tpu.parallel.ring_attention import (
             _ring_attention_local,
             _ulysses_local,
+            _zigzag_ring_attention_local,
         )
 
         if cfg.attn_impl == "ulysses":
             attn = _ulysses_local(q, k, v, axis_name=manual_sp_axis, causal=True)
+        elif cfg.attn_impl == "ring_zigzag":
+            attn = _zigzag_ring_attention_local(
+                q, k, v, axis_name=manual_sp_axis, mesh_axes=manual_vma_axes,
+            )
         else:
             attn = _ring_attention_local(
                 q, k, v, axis_name=manual_sp_axis, causal=True,
                 mesh_axes=manual_vma_axes,
             )
-    elif cfg.attn_impl in ("ring", "ulysses"):
+    elif cfg.attn_impl in ("ring", "ring_zigzag", "ulysses"):
         attn = attn_fn(q, k, v, mesh, causal=True)
     else:
         attn = attn_fn(q, k, v, causal=True)
@@ -310,15 +315,27 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
     return x, aux
 
 
+ATTN_IMPLS = ("xla", "flash", "ring", "ring_zigzag", "ulysses")
+
+
 def _resolve_attn_fn(cfg: TransformerConfig):
     if cfg.attn_impl == "flash":
         from hivedscheduler_tpu.ops.attention import flash_attention as attn_fn
-    elif cfg.attn_impl in ("ring", "ulysses"):
+    elif cfg.attn_impl in ("ring", "ring_zigzag", "ulysses"):
         from hivedscheduler_tpu.parallel import ring_attention as ra
 
-        attn_fn = ra.ring_attention if cfg.attn_impl == "ring" else ra.ulysses_attention
-    else:
+        attn_fn = {
+            "ring": ra.ring_attention,
+            "ring_zigzag": ra.zigzag_ring_attention,
+            "ulysses": ra.ulysses_attention,
+        }[cfg.attn_impl]
+    elif cfg.attn_impl == "xla":
         from hivedscheduler_tpu.ops.attention import xla_attention as attn_fn
+    else:
+        # a typo must not silently train with dense attention
+        raise ValueError(
+            f"unknown attn_impl {cfg.attn_impl!r}; expected one of {ATTN_IMPLS}"
+        )
     return attn_fn
 
 
@@ -337,7 +354,7 @@ def forward_with_aux(
     # [1, T] broadcasts against any (micro)batch size, incl. pipeline stages
     positions = jnp.arange(t, dtype=jnp.int32)[None, :]
     attn_fn = _resolve_attn_fn(cfg)
-    if cfg.attn_impl in ("ring", "ulysses") or cfg.pipeline_microbatches > 0:
+    if cfg.attn_impl in ("ring", "ring_zigzag", "ulysses") or cfg.pipeline_microbatches > 0:
         assert mesh is not None, f"{cfg.attn_impl}/pipeline requires a mesh"
 
     def layer(x, lp):
@@ -345,20 +362,20 @@ def forward_with_aux(
 
     aux_total = jnp.zeros((), jnp.float32)
     if cfg.pipeline_microbatches > 0:
-        assert cfg.attn_impl in ("xla", "flash", "ring", "ulysses")
+        assert cfg.attn_impl in ("xla", "flash", "ring", "ring_zigzag", "ulysses")
         manual_tp = None
         manual_sp = None
         manual_ep = None
         manual_fsdp = None
         if mesh is not None:
             shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-            if shape.get("sp", 1) > 1 and cfg.attn_impl not in ("ring", "ulysses"):
+            if shape.get("sp", 1) > 1 and cfg.attn_impl not in ("ring", "ring_zigzag", "ulysses"):
                 raise ValueError(
-                    "pipeline with mesh sp > 1 requires attn_impl='ring' or "
-                    f"'ulysses' (got {cfg.attn_impl}): the sequence axis is "
+                    "pipeline with mesh sp > 1 requires attn_impl='ring', "
+                    f"'ring_zigzag' or 'ulysses' (got {cfg.attn_impl}): the sequence axis is "
                     "sharded inside the stage"
                 )
-            if cfg.attn_impl in ("ring", "ulysses") and "sp" in shape:
+            if cfg.attn_impl in ("ring", "ring_zigzag", "ulysses") and "sp" in shape:
                 # always run the manual attention body inside the stage (a
                 # GSPMD shard_map cannot open inside the pipeline's manual
                 # context; with sp == 1 it degenerates to local attention)

@@ -25,16 +25,24 @@ from jax.sharding import PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _block_attention(q, k, v, q_offset, k_offset, causal, scale):
-    """Online-softmax attention of a local q block against one k/v block.
+def _get_shard_map():
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+def _block_attention_pos(q, k, v, q_pos, k_pos, scale, masked: bool):
+    """Online-softmax attention of a local q block against one k/v block,
+    with explicit per-row positions (zigzag chunks are non-contiguous);
+    ``masked=False`` skips the mask for blocks known fully visible.
 
     q: [B, Tq, H, D]; k/v: [B, Tk, H, D]. Returns (o, m, l) partials with
     o: [B, H, Tq, D], m/l: [B, H, Tq] in f32.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
-    if causal:
-        q_pos = q_offset + lax.iota(jnp.int32, q.shape[1])
-        k_pos = k_offset + lax.iota(jnp.int32, k.shape[1])
+    if masked:
         mask = q_pos[:, None] >= k_pos[None, :]
         s = jnp.where(mask[None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1)
@@ -44,6 +52,28 @@ def _block_attention(q, k, v, q_offset, k_offset, causal, scale):
     l = jnp.sum(p, axis=-1)
     o = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
     return o, m_safe, l
+
+
+def _block_attention(q, k, v, q_offset, k_offset, causal, scale):
+    """Contiguous-block wrapper over :func:`_block_attention_pos`."""
+    q_pos = q_offset + lax.iota(jnp.int32, q.shape[1])
+    k_pos = k_offset + lax.iota(jnp.int32, k.shape[1])
+    return _block_attention_pos(q, k, v, q_pos, k_pos, scale, masked=causal)
+
+
+def _merge_partial(acc, blk):
+    """Merge one (o, m, l) online-softmax partial into an accumulator
+    triple — the single home of the numerically delicate merge."""
+    o_acc, m_acc, l_acc = acc
+    o_blk, m_blk, l_blk = blk
+    m_new = jnp.maximum(m_acc, m_blk)
+    corr_acc = jnp.exp(m_acc - m_new)
+    corr_blk = jnp.exp(m_blk - m_new)
+    return (
+        o_acc * corr_acc[..., None] + o_blk * corr_blk[..., None],
+        m_new,
+        l_acc * corr_acc + l_blk * corr_blk,
+    )
 
 
 from hivedscheduler_tpu.parallel.shard_utils import varying as _varying
@@ -73,17 +103,12 @@ def _ring_forward(q, k, v, axis_name: str, causal: bool, mesh_axes):
 
         def attend(args):
             o_acc, m_acc, l_acc, k_cur, v_cur = args
-            o_blk, m_blk, l_blk = _block_attention(
+            blk = _block_attention(
                 qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
                 q_offset=my_index * t_q, k_offset=src * t_k,
                 causal=causal, scale=scale,
             )
-            m_new = jnp.maximum(m_acc, m_blk)
-            corr_acc = jnp.exp(m_acc - m_new)
-            corr_blk = jnp.exp(m_blk - m_new)
-            o_acc = o_acc * corr_acc[..., None] + o_blk * corr_blk[..., None]
-            l_acc = l_acc * corr_acc + l_blk * corr_blk
-            return o_acc, m_new, l_acc
+            return _merge_partial((o_acc, m_acc, l_acc), blk)
 
         if causal:
             # blocks entirely in my future are fully masked: skip the compute
@@ -201,29 +226,43 @@ def _ring_backward(q, k, v, out, m, l, g, axis_name: str, causal: bool, mesh_axe
 _RING_CORES = {}
 
 
-def _ring_core(axis_name: str, causal: bool, mesh_axes):
-    """custom_vjp-wrapped ring attention core, cached per configuration."""
-    key = (axis_name, causal, tuple(mesh_axes))
-    core = _RING_CORES.get(key)
+def _make_vjp_core(cache: dict, key, forward_fn, backward_fn):
+    """custom_vjp-wrapped flash-style core, cached per configuration.
+    ``forward_fn(q, k, v) -> (out, m, l)``;
+    ``backward_fn(q, k, v, out, m, l, g) -> (dq, dk, dv)``."""
+    core = cache.get(key)
     if core is not None:
         return core
 
     @jax.custom_vjp
     def core(q, k, v):
-        out, _, _ = _ring_forward(q, k, v, axis_name, causal, mesh_axes)
+        out, _, _ = forward_fn(q, k, v)
         return out
 
     def fwd(q, k, v):
-        out, m, l = _ring_forward(q, k, v, axis_name, causal, mesh_axes)
+        out, m, l = forward_fn(q, k, v)
         return out, (q, k, v, out, m, l)
 
     def bwd(res, g):
         q, k, v, out, m, l = res
-        return _ring_backward(q, k, v, out, m, l, g, axis_name, causal, mesh_axes)
+        return backward_fn(q, k, v, out, m, l, g)
 
     core.defvjp(fwd, bwd)
-    _RING_CORES[key] = core
+    cache[key] = core
     return core
+
+
+def _ring_core(axis_name: str, causal: bool, mesh_axes):
+    return _make_vjp_core(
+        _RING_CORES,
+        (axis_name, causal, tuple(mesh_axes)),
+        functools.partial(
+            _ring_forward, axis_name=axis_name, causal=causal, mesh_axes=mesh_axes
+        ),
+        functools.partial(
+            _ring_backward, axis_name=axis_name, causal=causal, mesh_axes=mesh_axes
+        ),
+    )
 
 
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool, mesh_axes=()):
@@ -249,10 +288,7 @@ def ring_attention(
     Inputs are [B, T, H, D] logically; physically T is split over ``seq_axis``,
     B over ``batch_axes``, H over ``head_axis``.
     """
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    shard_map = _get_shard_map()
 
     spec = P(batch_axes, seq_axis, head_axis, None)
     # accumulators inside must be varying exactly over the sharded axes
@@ -263,6 +299,322 @@ def ring_attention(
             axis_name=seq_axis,
             causal=causal,
             mesh_axes=vma_axes,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Zigzag ring schedule (balanced causal load)
+# ---------------------------------------------------------------------------
+#
+# With contiguous blocks, the causal skip makes shard 0 compute 1 block and
+# shard n-1 compute n blocks — the ring stalls on the last shard. In the
+# zigzag layout shard i owns sequence chunks (i, 2n-1-i) (half-blocks), and
+# each ring step costs every shard the same ~2 quarter-blocks:
+#   (hi_q, lo_k): always fully visible  -> unmasked dense
+#   (hi_q, hi_k): visible iff src >= i  -> cond-skipped otherwise
+#   (lo_q, lo_k): visible iff i >= src  -> cond-skipped otherwise
+#   (lo_q, hi_k): never visible         -> never computed
+# Total per shard = 2n+1 quarter-blocks, constant across the ring.
+
+
+def _zigzag_chunk_pos(chunk, half):
+    return chunk * half + lax.iota(jnp.int32, half)
+
+
+def _zigzag_forward(q, k, v, axis_name: str, mesh_axes):
+    """Forward zigzag ring (causal). Local rows are [chunk i, chunk 2n-1-i],
+    each of ``half`` rows. Returns (out, m, l) like _ring_forward."""
+    axis_size = lax.psum(1, axis_name)
+    i = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    half = t // 2
+    scale = 1.0 / (d**0.5)
+    qf = q.astype(jnp.float32)
+    q_lo, q_hi = qf[:, :half], qf[:, half:]
+    pos_lo = _zigzag_chunk_pos(i, half)
+    pos_hi = _zigzag_chunk_pos(2 * axis_size - 1 - i, half)
+
+    def zeros():
+        return (
+            _varying(jnp.zeros((b, h, half, d), jnp.float32), mesh_axes),
+            _varying(jnp.full((b, h, half), NEG_INF, jnp.float32), mesh_axes),
+            _varying(jnp.zeros((b, h, half), jnp.float32), mesh_axes),
+        )
+
+    acc_lo, acc_hi = zeros(), zeros()
+    perm = [(s, (s + 1) % axis_size) for s in range(axis_size)]
+
+    def merge_block(step, acc_lo, acc_hi, k_cur, v_cur):
+        src = (i - step) % axis_size
+        k_lo, k_hi = k_cur[:, :half], k_cur[:, half:]
+        v_lo, v_hi = v_cur[:, :half], v_cur[:, half:]
+        kpos_lo = _zigzag_chunk_pos(src, half)
+        kpos_hi = _zigzag_chunk_pos(2 * axis_size - 1 - src, half)
+
+        # (hi_q, lo_k): chunk 2n-1-i vs chunk src — always fully visible
+        acc_hi = _merge_partial(acc_hi, _block_attention_pos(
+            q_hi, k_lo.astype(jnp.float32), v_lo, pos_hi, kpos_lo, scale,
+            masked=False,
+        ))
+
+        # (hi_q, hi_k): visible iff src >= i (diagonal at src == i)
+        def attend_hi(acc):
+            return _merge_partial(acc, _block_attention_pos(
+                q_hi, k_hi.astype(jnp.float32), v_hi, pos_hi, kpos_hi, scale,
+                masked=True,
+            ))
+
+        acc_hi = lax.cond(src >= i, attend_hi, lambda a: a, acc_hi)
+
+        # (lo_q, lo_k): visible iff i >= src (diagonal at src == i)
+        def attend_lo(acc):
+            return _merge_partial(acc, _block_attention_pos(
+                q_lo, k_lo.astype(jnp.float32), v_lo, pos_lo, kpos_lo, scale,
+                masked=True,
+            ))
+
+        acc_lo = lax.cond(i >= src, attend_lo, lambda a: a, acc_lo)
+        return acc_lo, acc_hi
+
+    def body(step, carry):
+        acc_lo, acc_hi, k_cur, v_cur = carry
+        acc_lo, acc_hi = merge_block(step, acc_lo, acc_hi, k_cur, v_cur)
+        return (
+            acc_lo, acc_hi,
+            lax.ppermute(k_cur, axis_name, perm),
+            lax.ppermute(v_cur, axis_name, perm),
+        )
+
+    acc_lo, acc_hi, k_last, v_last = lax.fori_loop(
+        0, axis_size - 1, body, (acc_lo, acc_hi, k, v)
+    )
+    acc_lo, acc_hi = merge_block(axis_size - 1, acc_lo, acc_hi, k_last, v_last)
+
+    def finish(acc):
+        o_acc, m_acc, l_acc = acc
+        l_safe = jnp.where(l_acc == 0.0, 1.0, l_acc)
+        return (o_acc / l_safe[..., None]).astype(q.dtype)
+
+    out = jnp.concatenate(
+        [jnp.einsum("bhqd->bqhd", finish(acc_lo)),
+         jnp.einsum("bhqd->bqhd", finish(acc_hi))], axis=1,
+    )
+    m = jnp.concatenate([acc_lo[1], acc_hi[1]], axis=2)
+    l = jnp.concatenate([acc_lo[2], acc_hi[2]], axis=2)
+    return out, m, l
+
+
+def _zigzag_backward(q, k, v, out, m, l, g, axis_name: str, mesh_axes):
+    """Backward zigzag ring: same 3-sub-block schedule; dk/dv accumulators
+    travel with their k/v halves and arrive home after a full rotation."""
+    axis_size = lax.psum(1, axis_name)
+    i = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    half = t // 2
+    scale = 1.0 / (d**0.5)
+
+    qf = jnp.einsum("bqhd->bhqd", q.astype(jnp.float32))
+    do = jnp.einsum("bqhd->bhqd", g.astype(jnp.float32))
+    of = jnp.einsum("bqhd->bhqd", out.astype(jnp.float32))
+    delta = jnp.sum(do * of, axis=-1)  # [B,H,T]
+    m_safe = jnp.maximum(m, -0.5 * abs(NEG_INF))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+
+    pos_lo = _zigzag_chunk_pos(i, half)
+    pos_hi = _zigzag_chunk_pos(2 * axis_size - 1 - i, half)
+    halves = {
+        0: (qf[:, :, :half], do[:, :, :half], m_safe[:, :, :half],
+            l_safe[:, :, :half], delta[:, :, :half], pos_lo),
+        1: (qf[:, :, half:], do[:, :, half:], m_safe[:, :, half:],
+            l_safe[:, :, half:], delta[:, :, half:], pos_hi),
+    }
+
+    dq = _varying(jnp.zeros((b, h, t, d), jnp.float32), mesh_axes)
+    dkv0 = (
+        _varying(jnp.zeros((b, h, t, d), jnp.float32), mesh_axes),
+        _varying(jnp.zeros((b, h, t, d), jnp.float32), mesh_axes),
+    )
+    perm = [(s, (s + 1) % axis_size) for s in range(axis_size)]
+
+    def sub_grad(q_half, k_cur, v_cur, dq, dk_cur, dv_cur, q_slice, k_slice,
+                 kpos, masked):
+        """Gradients of one quarter-block; q_slice/k_slice are static row
+        ranges into the local q / traveling kv tensors."""
+        qh, doh, mh, lh, dh, qpos = q_half
+        kf = jnp.einsum("bkhd->bhkd", k_cur[:, k_slice].astype(jnp.float32))
+        vf = jnp.einsum("bkhd->bhkd", v_cur[:, k_slice].astype(jnp.float32))
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kf) * scale
+        if masked:
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        p = jnp.exp(s - mh[..., None]) / lh[..., None]
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, doh)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", doh, vf)
+        ds = p * (dp - dh[..., None])
+        dq = dq.at[:, :, q_slice].add(jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale)
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qh) * scale
+        dk_cur = dk_cur.at[:, :, k_slice].add(dk_blk)
+        dv_cur = dv_cur.at[:, :, k_slice].add(dv_blk)
+        return dq, dk_cur, dv_cur
+
+    lo_s, hi_s = slice(0, half), slice(half, t)
+
+    def merge_grad(step, dq, dk_cur, dv_cur, k_cur, v_cur):
+        src = (i - step) % axis_size
+        kpos_lo = _zigzag_chunk_pos(src, half)
+        kpos_hi = _zigzag_chunk_pos(2 * axis_size - 1 - src, half)
+
+        # (hi_q, lo_k) unmasked
+        dq, dk_cur, dv_cur = sub_grad(
+            halves[1], k_cur, v_cur, dq, dk_cur, dv_cur, hi_s, lo_s,
+            kpos_lo, masked=False,
+        )
+
+        def g_hi(args):
+            dq, dk_cur, dv_cur = args
+            return sub_grad(halves[1], k_cur, v_cur, dq, dk_cur, dv_cur,
+                            hi_s, hi_s, kpos_hi, masked=True)
+
+        dq, dk_cur, dv_cur = lax.cond(
+            src >= i, g_hi, lambda a: a, (dq, dk_cur, dv_cur))
+
+        def g_lo(args):
+            dq, dk_cur, dv_cur = args
+            return sub_grad(halves[0], k_cur, v_cur, dq, dk_cur, dv_cur,
+                            lo_s, lo_s, kpos_lo, masked=True)
+
+        dq, dk_cur, dv_cur = lax.cond(
+            i >= src, g_lo, lambda a: a, (dq, dk_cur, dv_cur))
+        return dq, dk_cur, dv_cur
+
+    def body(step, carry):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        dq, dk_cur, dv_cur = merge_grad(step, dq, dk_cur, dv_cur, k_cur, v_cur)
+        return (
+            dq,
+            lax.ppermute(k_cur, axis_name, perm),
+            lax.ppermute(v_cur, axis_name, perm),
+            lax.ppermute(dk_cur, axis_name, perm),
+            lax.ppermute(dv_cur, axis_name, perm),
+        )
+
+    dq, k_last, v_last, dk_last, dv_last = lax.fori_loop(
+        0, axis_size - 1, body, (dq, k, v) + dkv0
+    )
+    dq, dk_last, dv_last = merge_grad(axis_size - 1, dq, dk_last, dv_last,
+                                      k_last, v_last)
+    dk = lax.ppermute(dk_last, axis_name, perm)
+    dv = lax.ppermute(dv_last, axis_name, perm)
+    return (
+        jnp.einsum("bhqd->bqhd", dq).astype(q.dtype),
+        jnp.einsum("bhkd->bkhd", dk).astype(k.dtype),
+        jnp.einsum("bhkd->bkhd", dv).astype(v.dtype),
+    )
+
+
+_ZIGZAG_CORES = {}
+
+
+def _zigzag_core(axis_name: str, mesh_axes):
+    return _make_vjp_core(
+        _ZIGZAG_CORES,
+        (axis_name, tuple(mesh_axes)),
+        functools.partial(_zigzag_forward, axis_name=axis_name, mesh_axes=mesh_axes),
+        functools.partial(_zigzag_backward, axis_name=axis_name, mesh_axes=mesh_axes),
+    )
+
+
+def _zigzag_relayout(x, axis_name: str, axis_size, inverse: bool):
+    """Permute between the contiguous layout (shard i holds chunks 2i, 2i+1)
+    and the zigzag layout (shard i holds chunks i, 2n-1-i). Two paired
+    ppermutes — a chunk pair (j, 2n-1-j) always has one even and one odd
+    member, so each shard sends/receives exactly one half per call. Built
+    from differentiable ppermutes, so it lives OUTSIDE the custom-VJP core
+    and autodiff transposes it for free."""
+    n = axis_size
+    i = lax.axis_index(axis_name)
+    half = x.shape[1] // 2
+    lo, hi = x[:, :half], x[:, half:]
+
+    def owner(c):  # zigzag owner of global half-chunk c
+        return c if c < n else 2 * n - 1 - c
+
+    if not inverse:
+        # contiguous -> zigzag: shard s sends chunk 2s and chunk 2s+1
+        perm_a = [(s, owner(2 * s)) for s in range(n)]
+        perm_b = [(s, owner(2 * s + 1)) for s in range(n)]
+        recv_a = lax.ppermute(lo, axis_name, perm_a)  # the even chunk of (i, 2n-1-i)
+        recv_b = lax.ppermute(hi, axis_name, perm_b)  # the odd chunk
+        # shard i's rows must be ordered [chunk i, chunk 2n-1-i]; chunk i has
+        # the parity of i
+        even_first = (i % 2) == 0
+        first = jnp.where(even_first, recv_a, recv_b)
+        second = jnp.where(even_first, recv_b, recv_a)
+        return jnp.concatenate([first, second], axis=1)
+    # zigzag -> contiguous: invert both permutations
+    inv_a = [(owner(2 * s), s) for s in range(n)]
+    inv_b = [(owner(2 * s + 1), s) for s in range(n)]
+    even_first = (i % 2) == 0
+    send_a = jnp.where(even_first, lo, hi)  # this shard's even chunk
+    send_b = jnp.where(even_first, hi, lo)  # odd chunk
+    back_lo = lax.ppermute(send_a, axis_name, inv_a)
+    back_hi = lax.ppermute(send_b, axis_name, inv_b)
+    return jnp.concatenate([back_lo, back_hi], axis=1)
+
+
+def _zigzag_ring_attention_local(q, k, v, axis_name: str, mesh_axes=()):
+    """Per-shard body: relayout to zigzag, run the balanced ring core,
+    relayout back. Inputs are in the model's contiguous layout."""
+    if q.shape[1] % 2:
+        raise ValueError(
+            f"zigzag ring attention needs an even per-shard block to split "
+            f"into two chunks; got {q.shape[1]} rows per shard "
+            f"(require T % (2 * sp) == 0)"
+        )
+    axis_size = lax.psum(1, axis_name)
+    qz = _zigzag_relayout(q, axis_name, axis_size, inverse=False)
+    kz = _zigzag_relayout(k, axis_name, axis_size, inverse=False)
+    vz = _zigzag_relayout(v, axis_name, axis_size, inverse=False)
+    out = _zigzag_core(axis_name, mesh_axes)(qz, kz, vz)
+    return _zigzag_relayout(out, axis_name, axis_size, inverse=True)
+
+
+def zigzag_ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    seq_axis: str = "sp",
+    batch_axes=("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+    causal: bool = True,
+) -> jax.Array:
+    """Causal ring attention with the zigzag-balanced schedule.
+
+    Same contract as :func:`ring_attention` (contiguous sequence layout in
+    and out) but every shard does a constant 2n+1 quarter-blocks of causal
+    work instead of 4(i+1) — the ring no longer stalls on the last shard.
+    Requires an even per-shard block (T/sp rows split into two chunks).
+    Causal only; use :func:`ring_attention` for bidirectional attention.
+    """
+    if not causal:
+        raise ValueError(
+            "the zigzag schedule balances the CAUSAL skip; use ring_attention "
+            "for non-causal attention"
+        )
+    shard_map = _get_shard_map()
+
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    vma_axes = tuple(batch_axes) + (seq_axis,) + ((head_axis,) if head_axis else ())
+    fn = shard_map(
+        functools.partial(
+            _zigzag_ring_attention_local, axis_name=seq_axis, mesh_axes=vma_axes,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -297,10 +649,7 @@ def ulysses_attention(
     causal: bool = True,
 ) -> jax.Array:
     """DeepSpeed-Ulysses-style sequence parallelism via all_to_all."""
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    shard_map = _get_shard_map()
 
     spec = P(batch_axes, seq_axis, head_axis, None)
     fn = shard_map(

@@ -44,7 +44,7 @@ def main(argv=None) -> int:
     parser.add_argument("--n-experts", type=int, default=0)
     parser.add_argument("--moe-top-k", type=int, default=1)
     parser.add_argument("--attn", default=None,
-                        help="xla|flash|ring|ulysses (default: ring when sp>1)")
+                        help="xla|flash|ring|ring_zigzag|ulysses (default: ring when sp>1)")
     parser.add_argument("--data", default="",
                         help="packed token file; synthetic corpus when omitted")
     parser.add_argument("--data-dtype", default="uint16",

@@ -271,6 +271,9 @@ class TestMeshLayoutInvariance:
         layouts = [
             (tiny_cfg(), topology.MeshAxes(dp=8)),
             (tiny_cfg(attn_impl="ring"), topology.MeshAxes(dp=2, tp=2, sp=2)),
+            (tiny_cfg(attn_impl="ring_zigzag"), topology.MeshAxes(dp=2, tp=2, sp=2)),
+            (tiny_cfg(attn_impl="ring_zigzag", pipeline_microbatches=2),
+             topology.MeshAxes(dp=2, pp=2, sp=2)),
             (tiny_cfg(pipeline_microbatches=2), topology.MeshAxes(dp=2, pp=2, tp=2)),
         ]
         losses = []
