@@ -51,6 +51,9 @@ class TransformerConfig:
     # weight of the Switch load-balancing auxiliary loss (router collapse
     # prevention); added to the LM loss by parallel/train.py
     moe_aux_weight: float = 0.01
+    # ST-MoE router z-loss weight (penalizes large router logits for
+    # numerical stability); 0 disables
+    moe_zloss_weight: float = 0.0
     # GPipe microbatches over the pp axis; 0 = no pipelining
     pipeline_microbatches: int = 0
 
@@ -178,8 +181,10 @@ def _moe_mlp(
     """Top-k MoE with capacity-based dense dispatch; the expert axis is
     ep-sharded so GSPMD turns the dispatch einsums into all_to_alls. Top-1
     uses the raw switch gate; top-2 renormalizes the gates over the chosen
-    experts. Returns (output, aux) where aux is the Switch load-balancing
-    loss term E * sum_e(first_choice_frac_e * mean_prob_e) for this layer.
+    experts. Returns (output, aux) where aux is this layer's WEIGHTED
+    auxiliary loss: moe_aux_weight * the Switch load-balancing term
+    E * sum_e(first_choice_frac_e * mean_prob_e), plus moe_zloss_weight *
+    the ST-MoE router z-loss mean(logsumexp(logits)^2).
 
     ``manual_ep_axis`` (shard_map / pipeline-stage mode): expert weights are
     device-local slices; routing runs on the full expert count (the router is
@@ -197,9 +202,15 @@ def _moe_mlp(
         top_gates = top_gates / jnp.sum(top_gates, axis=-1, keepdims=True)
     masks = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)  # [B, T, K, E]
     # aux loss on the first choice (standard Switch load balancing)
-    aux = E * jnp.sum(
+    lb = E * jnp.sum(
         jnp.mean(masks[:, :, 0, :], axis=(0, 1)) * jnp.mean(probs, axis=(0, 1))
     )
+    aux = cfg.moe_aux_weight * lb
+    if cfg.moe_zloss_weight > 0.0:
+        # ST-MoE router z-loss: keeps router logits small so the softmax
+        # stays in a numerically comfortable range
+        z = jax.nn.logsumexp(logits, axis=-1)
+        aux = aux + cfg.moe_zloss_weight * jnp.mean(jnp.square(z))
     # per-expert slot assignment: choice 0 tokens queue first, then choice 1
     combine = jnp.zeros((b, t, E, capacity), jnp.float32)
     counts = jnp.zeros((b, E), jnp.float32)
@@ -247,7 +258,7 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
                  manual_tp_axis=None, manual_sp_axis=None, manual_ep_axis=None,
                  manual_vma_axes=()):
     """One transformer block; lp leaves have no leading layer axis.
-    Returns (x, aux) — aux is the layer's MoE load-balancing loss (0 for
+    Returns (x, aux) — aux is the layer's weighted MoE auxiliary loss (0 for
     dense layers).
 
     Manual (shard_map / pipeline-stage) mode:
@@ -294,7 +305,7 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
                 q, k, v, axis_name=manual_sp_axis, causal=True,
                 mesh_axes=manual_vma_axes,
             )
-    elif cfg.attn_impl in ("ring", "ring_zigzag", "ulysses"):
+    elif cfg.attn_impl in RING_FAMILY:
         attn = attn_fn(q, k, v, mesh, causal=True)
     else:
         attn = attn_fn(q, k, v, causal=True)
@@ -316,12 +327,13 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
 
 
 ATTN_IMPLS = ("xla", "flash", "ring", "ring_zigzag", "ulysses")
+RING_FAMILY = ("ring", "ring_zigzag", "ulysses")  # need a mesh + sp axis
 
 
 def _resolve_attn_fn(cfg: TransformerConfig):
     if cfg.attn_impl == "flash":
         from hivedscheduler_tpu.ops.attention import flash_attention as attn_fn
-    elif cfg.attn_impl in ("ring", "ring_zigzag", "ulysses"):
+    elif cfg.attn_impl in RING_FAMILY:
         from hivedscheduler_tpu.parallel import ring_attention as ra
 
         attn_fn = {
@@ -345,7 +357,8 @@ def forward_with_aux(
     cfg: TransformerConfig,
     mesh=None,
 ):
-    """tokens [B, T] int32 -> (logits [B, T, vocab] f32, moe_aux_loss f32).
+    """tokens [B, T] int32 -> (logits [B, T, vocab] f32, weighted MoE aux
+    loss f32 — add it to the task loss directly).
 
     ``mesh`` is required for ring/ulysses attention and for pipelining."""
     dtype = cfg.dtype
@@ -354,7 +367,7 @@ def forward_with_aux(
     # [1, T] broadcasts against any (micro)batch size, incl. pipeline stages
     positions = jnp.arange(t, dtype=jnp.int32)[None, :]
     attn_fn = _resolve_attn_fn(cfg)
-    if cfg.attn_impl in ("ring", "ring_zigzag", "ulysses") or cfg.pipeline_microbatches > 0:
+    if cfg.attn_impl in RING_FAMILY or cfg.pipeline_microbatches > 0:
         assert mesh is not None, f"{cfg.attn_impl}/pipeline requires a mesh"
 
     def layer(x, lp):
@@ -362,20 +375,19 @@ def forward_with_aux(
 
     aux_total = jnp.zeros((), jnp.float32)
     if cfg.pipeline_microbatches > 0:
-        assert cfg.attn_impl in ("xla", "flash", "ring", "ring_zigzag", "ulysses")
         manual_tp = None
         manual_sp = None
         manual_ep = None
         manual_fsdp = None
         if mesh is not None:
             shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-            if shape.get("sp", 1) > 1 and cfg.attn_impl not in ("ring", "ring_zigzag", "ulysses"):
+            if shape.get("sp", 1) > 1 and cfg.attn_impl not in RING_FAMILY:
                 raise ValueError(
                     "pipeline with mesh sp > 1 requires attn_impl='ring', "
                     f"'ring_zigzag' or 'ulysses' (got {cfg.attn_impl}): the sequence axis is "
                     "sharded inside the stage"
                 )
-            if cfg.attn_impl in ("ring", "ring_zigzag", "ulysses") and "sp" in shape:
+            if cfg.attn_impl in RING_FAMILY and "sp" in shape:
                 # always run the manual attention body inside the stage (a
                 # GSPMD shard_map cannot open inside the pipeline's manual
                 # context; with sp == 1 it degenerates to local attention)

@@ -11,7 +11,8 @@ so one jitted train step backpropagates through the pipeline naturally.
 Composition (validated in ``models.transformer.forward_with_aux``):
 - tensor parallelism composes — stage weights keep their tp sharding and
   ``_apply_layer`` inserts Megatron-style row-parallel psums;
-- sequence parallelism composes with ``attn_impl`` "ring" or "ulysses" —
+- sequence parallelism composes with ``attn_impl`` "ring", "ring_zigzag" or
+  "ulysses" —
   ``seq_axis`` shards T into the stage and the manual attention body runs
   directly in the stage (sp > 1 with local attention is rejected);
 - MoE composes — expert weights stay ep-sharded, each device computes its
@@ -124,10 +125,9 @@ def pipeline_apply(
     shards the T dimension into the stage (ring/Ulysses attention runs
     inside the stage body).
     """
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from hivedscheduler_tpu.parallel.ring_attention import _get_shard_map
+
+    shard_map = _get_shard_map()
 
     hidden_spec = P(tuple(batch_axes), seq_axis, None)
     fn = shard_map(

@@ -36,7 +36,9 @@ def loss_fn(params, tokens, cfg: tm.TransformerConfig, mesh=None) -> jax.Array:
     mask = jnp.ones_like(per_tok).at[:, -1].set(0.0)
     loss = jnp.sum(per_tok * mask) / jnp.sum(mask)
     if cfg.n_experts > 0:
-        loss = loss + cfg.moe_aux_weight * moe_aux
+        # moe_aux arrives pre-weighted per layer (load-balance + router
+        # z-loss, each with its own configured weight)
+        loss = loss + moe_aux
     return loss
 
 

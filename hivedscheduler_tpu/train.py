@@ -43,6 +43,8 @@ def main(argv=None) -> int:
                         help="expert-parallel size (with --n-experts)")
     parser.add_argument("--n-experts", type=int, default=0)
     parser.add_argument("--moe-top-k", type=int, default=1)
+    parser.add_argument("--moe-zloss", type=float, default=0.0,
+                        help="ST-MoE router z-loss weight (0 disables)")
     parser.add_argument("--attn", default=None,
                         help="xla|flash|ring|ring_zigzag|ulysses (default: ring when sp>1)")
     parser.add_argument("--data", default="",
@@ -95,6 +97,7 @@ def main(argv=None) -> int:
         attn_impl=attn,
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,
+        moe_zloss_weight=args.moe_zloss,
         pipeline_microbatches=args.microbatches if args.pp > 1 else 0,
     )
     step_fn, init_fn, token_sharding = make_sharded_train_step(cfg, mesh)
