@@ -1356,9 +1356,42 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     log.warning("[%s]: Cannot find virtual cell: %s",
                                 internal_utils.key(pod), message)
                     return p_leaf_cell, None, True
+                if (
+                    v_leaf_cell.preassigned_cell.physical_cell is None
+                    and self._under_foreign_pin(p_leaf_cell)
+                ):
+                    # Physical reconfiguration can move a pinned cell onto a
+                    # placement recovered from annotations. Binding the fresh
+                    # preassigned cell would need free-list surgery inside a
+                    # pin that was never in the free list — the reference
+                    # panics here (allocatePreassignedCell ->
+                    # removeCellFromFreeList, hived_algorithm.go:1356-1427);
+                    # we extend the tolerance ladder and lazy preempt instead.
+                    log.warning(
+                        "[%s]: Recovered placement lies inside a pinned cell "
+                        "after reconfiguration; lazy preempting",
+                        internal_utils.key(pod),
+                    )
+                    return p_leaf_cell, None, True
                 return p_leaf_cell, v_leaf_cell, False
             return p_leaf_cell, None, None
         return p_leaf_cell, None, False
+
+    @staticmethod
+    def _under_foreign_pin(p_leaf_cell: PhysicalCell) -> bool:
+        """True iff any cell on the leaf's path to the root is pinned —
+        including pins rooted BELOW the preassigned level, whose init-time
+        allocation also removed cells from the free list that the fresh
+        preassigned binding would try to remove again. A non-pinned virtual
+        mapping can never legitimately bind inside a pin (pins are
+        exclusively owned), so a recovered placement matching this is a
+        reconfiguration artifact."""
+        c: Optional[PhysicalCell] = p_leaf_cell
+        while c is not None:
+            if c.pinned:
+                return True
+            c = c.parent  # type: ignore[assignment]
+        return False
 
     # ------------------------------------------------------------------
     # leaf cell allocation / release with safety accounting

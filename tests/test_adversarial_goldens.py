@@ -370,6 +370,64 @@ class TestGnarlyReconfiguration:
         assert g2.status.lazy_preemption_status is not None
 
 
+class TestGnarlyPhysicalReconfiguration:
+    def test_moved_pin_lazy_preempts_instead_of_crashing(self, algo):
+        """Physical reconfiguration analogue of the reference's
+        cell-hierarchy-splitting cases (pods 18-23): the pinned 4x4x2 MOVES
+        to the other half of the mesh. Replaying the old placements must
+        not panic (the reference's allocatePreassignedCell would) — both
+        affected groups are lazy-preempted but keep running."""
+        nodes = set_healthy_nodes(algo)
+        allocated = []
+
+        def alloc(name, s):
+            p = make_pod(name, s)
+            r = algo.schedule(p, nodes, PREEMPTING_PHASE)
+            assert r.pod_bind_info is not None, (name, r.pod_wait_info)
+            bp = new_binding_pod(p, r.pod_bind_info)
+            algo.add_allocated_pod(bp)
+            allocated.append(bp)
+            return r.pod_bind_info.node
+
+        # pinned gang in the (current) x>=4 pinned half
+        for i in range(4):
+            node = alloc(f"pa-{i}", spec("vcA", 1, "v5p-chip", 4, "g-pa",
+                                         [(4, 4)], pinned="pin-gp"))
+            assert node.startswith(("gp0/4", "gp0/6"))
+        # non-pinned vcB gang in the free half (which the pin will move onto)
+        for i in range(2):
+            node = alloc(f"pb-{i}", spec("vcB", 2, "v5p-chip", 4, "g-pb",
+                                         [(2, 4)]))
+            assert node.startswith(("gp0/0", "gp0/2"))
+
+        raw = yaml.safe_load(open(FIXTURE))
+        for pc in raw["physicalCluster"]["physicalCells"]:
+            if pc.get("cellAddress") == "gp0":
+                pc["cellChildren"][0]["cellAddress"] = "0-0-0"  # pin moves
+        h2 = HivedAlgorithm(new_config(Config.from_dict(raw)))
+        set_healthy_nodes(h2)
+        for bp in allocated:  # must not raise
+            h2.add_allocated_pod(bp)
+
+        g_pa = h2.get_affinity_group("g-pa")
+        g_pb = h2.get_affinity_group("g-pb")
+        # both groups keep their placements and keep running...
+        assert g_pa.status.state == GROUP_ALLOCATED
+        assert g_pb.status.state == GROUP_ALLOCATED
+        # ...but are demoted (lazy-preempted): pa's cells left the pin, pb's
+        # cells are now inside it
+        assert g_pa.status.lazy_preemption_status is not None
+        assert g_pb.status.lazy_preemption_status is not None
+        # and a fresh pinned gang can take the NEW pin location
+        p = make_pod("new-pin", spec("vcA", 5, "v5p-chip", 4, "g-new",
+                                     [(1, 4)], pinned="pin-gp"))
+        r = h2.schedule(p, nodes, PREEMPTING_PHASE)
+        assert r.pod_preempt_info is not None or (
+            r.pod_bind_info is not None
+            and r.pod_bind_info.node.startswith(("gp0/0", "gp0/2"))
+        )
+
+
 class TestGnarlyBadNodes:
     def test_bad_host_avoided_and_doomed_bad_binding(self, algo):
         nodes = set_healthy_nodes(algo)
