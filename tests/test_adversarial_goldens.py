@@ -405,7 +405,7 @@ class TestGnarlyPhysicalReconfiguration:
             if pc.get("cellAddress") == "gp0":
                 pc["cellChildren"][0]["cellAddress"] = "0-0-0"  # pin moves
         h2 = HivedAlgorithm(new_config(Config.from_dict(raw)))
-        set_healthy_nodes(h2)
+        nodes2 = set_healthy_nodes(h2)
         for bp in allocated:  # must not raise
             h2.add_allocated_pod(bp)
 
@@ -421,7 +421,7 @@ class TestGnarlyPhysicalReconfiguration:
         # and a fresh pinned gang can take the NEW pin location
         p = make_pod("new-pin", spec("vcA", 5, "v5p-chip", 4, "g-new",
                                      [(1, 4)], pinned="pin-gp"))
-        r = h2.schedule(p, nodes, PREEMPTING_PHASE)
+        r = h2.schedule(p, nodes2, PREEMPTING_PHASE)
         assert r.pod_preempt_info is not None or (
             r.pod_bind_info is not None
             and r.pod_bind_info.node.startswith(("gp0/0", "gp0/2"))
