@@ -370,6 +370,50 @@ class TestGnarlyReconfiguration:
         assert g2.status.lazy_preemption_status is not None
 
 
+class TestGnarlySuggestedNodes:
+    """Suggested-nodes interplay on the asymmetric mesh (reference:
+    testSuggestedNodes, hived_algorithm_test.go:753-853). The 2x2x2 cells
+    span one z=0 and one z=1 host, so restricting suggestions to z=1 makes
+    host-sized pods placeable but whole-cell gangs impossible."""
+
+    Z1 = staticmethod(lambda nodes: [
+        n for n in nodes if n.startswith("gp0/") and n.endswith("-1")])
+
+    def test_single_host_lands_in_suggested_set(self, algo):
+        nodes = set_healthy_nodes(algo)
+        s = spec("vcB", 2, "v5p-chip", 4, "sg1", [(1, 4)])
+        s["ignoreK8sSuggestedNodes"] = False
+        r = algo.schedule(make_pod("sg1", s), self.Z1(nodes), FILTERING_PHASE)
+        assert r.pod_bind_info is not None
+        assert r.pod_bind_info.node.endswith("-1")
+
+    def test_whole_cell_gang_waits_outside_suggested_set(self, algo):
+        """No 2x2x2 fits inside z=1 alone: the mapping failure reason must
+        surface to the user (FailedNodes wait reason)."""
+        nodes = set_healthy_nodes(algo)
+        s = spec("vcB", 2, "v5p-chip", 4, "sg2", [(2, 4)])
+        s["ignoreK8sSuggestedNodes"] = False
+        r = algo.schedule(make_pod("sg2", s), self.Z1(nodes), FILTERING_PHASE)
+        assert r.pod_wait_info is not None
+        assert "bad or non-suggested node" in r.pod_wait_info.reason
+
+    def test_buddy_alloc_backtracks_past_bad_cell(self, algo):
+        """One bad host in the first candidate 2x2x2: the gang must land on
+        the next whole healthy cell (golden), not an L-shape around the bad
+        host."""
+        nodes = set_healthy_nodes(algo)
+        algo.delete_node(Node(name="gp0/0-0-1"))
+        s = spec("vcB", 2, "v5p-chip", 4, "sg3", [(2, 4)])
+        got = []
+        for i in range(2):
+            p = make_pod(f"sg3-{i}", s)
+            r = algo.schedule(p, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None
+            algo.add_allocated_pod(new_binding_pod(p, r.pod_bind_info))
+            got.append(r.pod_bind_info.node)
+        assert got == ["gp0/2-0-0", "gp0/2-0-1"]
+
+
 class TestGnarlyPhysicalReconfiguration:
     def test_moved_pin_lazy_preempts_instead_of_crashing(self, algo):
         """Physical reconfiguration analogue of the reference's
