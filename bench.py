@@ -122,6 +122,12 @@ class Cluster:
         for n in self.nodes:
             self.algo.add_node(Node(name=n))
         self.groups = {}  # name -> list of bound pods
+        # steady state after the runtime's recovery barrier: the cell trees
+        # are frozen out of gen-2 GC scans (runtime/utils.py:
+        # freeze_long_lived_state), which is what bounds scheduling p99
+        from hivedscheduler_tpu.runtime.utils import freeze_long_lived_state
+
+        freeze_long_lived_state()
 
     def schedule_gang(self, vc, priority, group, pods, chips, allow_preempt=False):
         """Schedule + allocate a whole gang; returns (ok, seconds, preempted).

@@ -263,29 +263,23 @@ class VirtualCell(Cell):
 
 
 def _shallow_copy_physical_status(s: api.PhysicalCellStatus) -> api.PhysicalCellStatus:
-    out = api.PhysicalCellStatus(
-        cell_type=s.cell_type,
-        cell_address=s.cell_address,
-        cell_state=s.cell_state,
-        cell_healthiness=s.cell_healthiness,
-        cell_priority=s.cell_priority,
-        leaf_cell_type=s.leaf_cell_type,
-        is_node_level=s.is_node_level,
-        mesh_origin=s.mesh_origin,
-        mesh_shape=s.mesh_shape,
-        vc=s.vc,
-    )
+    """Copy every scalar field, drop children and the virtual cross-link
+    (breaks serialization cycles). Implemented as a C-level ``__dict__`` copy:
+    this runs twice per cell bind, which makes it a gang-allocation hot spot
+    (guard: ``test_e2e.py::test_status_shallow_copy_covers_all_fields``)."""
+    out = api.PhysicalCellStatus.__new__(api.PhysicalCellStatus)
+    d = dict(s.__dict__)
+    d["cell_children"] = []
+    d["virtual_cell"] = None
+    out.__dict__ = d
     return out
 
 
 def _shallow_copy_virtual_status(s: api.VirtualCellStatus) -> api.VirtualCellStatus:
-    out = api.VirtualCellStatus(
-        cell_type=s.cell_type,
-        cell_address=s.cell_address,
-        cell_state=s.cell_state,
-        cell_healthiness=s.cell_healthiness,
-        cell_priority=s.cell_priority,
-        leaf_cell_type=s.leaf_cell_type,
-        is_node_level=s.is_node_level,
-    )
+    """See ``_shallow_copy_physical_status``."""
+    out = api.VirtualCellStatus.__new__(api.VirtualCellStatus)
+    d = dict(s.__dict__)
+    d["cell_children"] = []
+    d["physical_cell"] = None
+    out.__dict__ = d
     return out

@@ -394,3 +394,70 @@ def update_used_leaf_cell_num_at_priority(c: Optional[Cell], p: CellPriority, in
         else:
             d[p] = n
         c = c.parent
+
+
+def allocate_cell_walk(c: Cell, p: CellPriority) -> None:
+    """Fused ``set_cell_priority(c, p)`` + ``update_used_leaf_cell_num_at_priority
+    (c, p, True)`` in one leaf->root walk — the leaf-allocation hot path runs
+    both over the same ancestor chain, and the two touch disjoint state
+    (priority + api mirrors vs. the used-count dicts), so interleaving them is
+    observationally identical (guard: ``tests/test_walk_fusion.py``).
+
+    The fast path assumes a pure priority *raise* (``p >= c.priority`` — always
+    true when allocating a free leaf); anything else falls back to the exact
+    two-step composition."""
+    if p < c.priority:
+        set_cell_priority(c, p)
+        update_used_leaf_cell_num_at_priority(c, p, True)
+        return
+    cur: Optional[Cell] = c
+    raising = True
+    first = True
+    while cur is not None:
+        if raising:
+            if first or p > cur.priority:
+                cur.set_priority(p)
+            else:
+                # invariant parent = max(children): priorities are monotone
+                # non-decreasing up the path, so no higher ancestor needs a
+                # raise either
+                raising = False
+        d = cur.used_leaf_cell_num_at_priorities
+        d[p] = d.get(p, 0) + 1
+        first = False
+        cur = cur.parent
+
+
+def release_cell_walk(c: Cell, old_p: CellPriority) -> None:
+    """Fused ``update_used_leaf_cell_num_at_priority(c, old_p, False)`` +
+    ``set_cell_priority(c, FREE_PRIORITY)`` in one leaf->root walk (the
+    leaf-release hot path); same disjoint-state argument as
+    ``allocate_cell_walk``, guarded by ``tests/test_walk_fusion.py``."""
+    target = FREE_PRIORITY
+    prio_active = True
+    cur: Optional[Cell] = c
+    while cur is not None:
+        d = cur.used_leaf_cell_num_at_priorities
+        n = d.get(old_p, 0) - 1
+        if n == 0:
+            d.pop(old_p, None)
+        else:
+            d[old_p] = n
+        if prio_active:
+            original = cur.priority
+            cur.set_priority(target)
+            parent = cur.parent
+            if parent is None:
+                prio_active = False
+            elif target > parent.priority:
+                pass  # mirror set_cell_priority's raise branch (unreachable
+                # on release: target <= original <= parent.priority)
+            elif original == parent.priority and target < original:
+                max_buddy_priority = FREE_PRIORITY
+                for buddy in parent.children:
+                    if buddy.priority > max_buddy_priority:
+                        max_buddy_priority = buddy.priority
+                target = max_buddy_priority
+            else:
+                prio_active = False
+        cur = cur.parent

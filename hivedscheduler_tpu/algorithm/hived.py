@@ -29,13 +29,13 @@ from hivedscheduler_tpu.algorithm.cell import (
     VirtualCell,
 )
 from hivedscheduler_tpu.algorithm.cell_allocation import (
+    allocate_cell_walk,
     bind_cell,
     get_unbound_virtual_cell,
     map_physical_cell_to_virtual,
     map_virtual_placement_to_physical,
-    set_cell_priority,
+    release_cell_walk,
     unbind_cell,
-    update_used_leaf_cell_num_at_priority,
 )
 from hivedscheduler_tpu.algorithm.config_parser import parse_config
 from hivedscheduler_tpu.algorithm.constants import (
@@ -1407,10 +1407,8 @@ class HivedAlgorithm(SchedulerAlgorithm):
         """Reference: allocateLeafCell, hived_algorithm.go:1294-1323."""
         safety_ok, reason = True, ""
         if v_leaf_cell is not None:
-            set_cell_priority(v_leaf_cell, p)
-            update_used_leaf_cell_num_at_priority(v_leaf_cell, p, True)
-            set_cell_priority(p_leaf_cell, p)
-            update_used_leaf_cell_num_at_priority(p_leaf_cell, p, True)
+            allocate_cell_walk(v_leaf_cell, p)
+            allocate_cell_walk(p_leaf_cell, p)
             pac = v_leaf_cell.preassigned_cell
             preassigned_newly_bound = pac.physical_cell is None
             if p_leaf_cell.virtual_cell is None:
@@ -1421,10 +1419,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     pac.physical_cell, vcn, doomed_bad=False
                 )
         else:
-            set_cell_priority(p_leaf_cell, OPPORTUNISTIC_PRIORITY)
-            update_used_leaf_cell_num_at_priority(
-                p_leaf_cell, OPPORTUNISTIC_PRIORITY, True
-            )
+            allocate_cell_walk(p_leaf_cell, OPPORTUNISTIC_PRIORITY)
             p_leaf_cell.api_status.vc = vcn
             self.api_cluster_status.virtual_clusters[vcn].append(
                 generate_ot_virtual_cell(p_leaf_cell.api_status)
@@ -1435,8 +1430,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
         """Reference: releaseLeafCell, hived_algorithm.go:1327-1352."""
         v_leaf_cell = p_leaf_cell.virtual_cell
         if v_leaf_cell is not None:
-            update_used_leaf_cell_num_at_priority(v_leaf_cell, v_leaf_cell.priority, False)
-            set_cell_priority(v_leaf_cell, FREE_PRIORITY)
+            release_cell_walk(v_leaf_cell, v_leaf_cell.priority)
             preassigned_physical = v_leaf_cell.preassigned_cell.physical_cell
             if p_leaf_cell.healthy:
                 # keep the binding if the cell is bad
@@ -1454,8 +1448,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
             self.api_cluster_status.virtual_clusters[vcn] = delete_ot_virtual_cell(
                 self.api_cluster_status.virtual_clusters[vcn], p_leaf_cell.address
             )
-        update_used_leaf_cell_num_at_priority(p_leaf_cell, p_leaf_cell.priority, False)
-        set_cell_priority(p_leaf_cell, FREE_PRIORITY)
+        release_cell_walk(p_leaf_cell, p_leaf_cell.priority)
 
     def _allocate_preassigned_cell(
         self, c: PhysicalCell, vcn: str, doomed_bad: bool

@@ -70,9 +70,15 @@ class HivedScheduler:
     def start(self) -> None:
         """Sync current cluster state through the handlers — the crash-recovery
         barrier: every bound pod is replayed into add_allocated_pod before any
-        scheduling request is served (reference: Run, scheduler.go:196-216)."""
+        scheduling request is served (reference: Run, scheduler.go:196-216).
+
+        Also freezes the process heap out of gen-2 GC scans (the cell trees
+        are permanent; this bounds scheduling p99) — a process-global side
+        effect embedders can disable with ``HIVED_GC_FREEZE=0``; see
+        runtime.utils.freeze_long_lived_state."""
         log.info("Recovering tpu-hive scheduler")
         self.kube_client.sync()
+        internal_utils.freeze_long_lived_state()
         self._started = True
         log.info("Running tpu-hive scheduler")
 

