@@ -232,6 +232,50 @@ class TestExampleConfigsValid:
         assert ext["filterVerb"] == "filter" and ext["bindVerb"] == "bind"
         assert ext["preemptVerb"] == "preempt"
 
+    def test_modern_deploy_manifest(self):
+        """deploy-modern.yaml replaces the removed-in-1.23 Policy file with a
+        KubeSchedulerConfiguration; its extender block must carry the same
+        contract (verbs matching our routes, managed resource matching the
+        admission predicate) and its embedded scheduler config must boot."""
+        import yaml
+
+        from hivedscheduler_tpu.api import constants as C
+        from hivedscheduler_tpu.api.config import Config, new_config
+        from hivedscheduler_tpu.algorithm import HivedAlgorithm
+
+        path = os.path.join(os.path.dirname(FIXTURE), "..", "..", "run",
+                            "deploy-modern.yaml")
+        docs = list(yaml.safe_load_all(open(path)))
+        cm = next(d for d in docs if d and d.get("kind") == "ConfigMap")
+        cfg = Config.from_dict(yaml.safe_load(cm["data"]["tpu-hive.yaml"]))
+        h = HivedAlgorithm(new_config(cfg))
+        assert "v5p-256" in h.full_cell_list
+
+        ksc = yaml.safe_load(cm["data"]["kube-scheduler-vc-research.yaml"])
+        assert ksc["kind"] == "KubeSchedulerConfiguration"
+        assert ksc["apiVersion"].startswith("kubescheduler.config.k8s.io/")
+        names = [p["schedulerName"] for p in ksc["profiles"]]
+        assert names == ["tpu-hive-vc-research"]
+        ext = ksc["extenders"][0]
+        # urlPrefix + verb must reproduce the routes the webserver serves
+        for verb, route in (("filterVerb", C.FILTER_PATH),
+                            ("bindVerb", C.BIND_PATH),
+                            ("preemptVerb", C.PREEMPT_PATH)):
+            assert ext["urlPrefix"].endswith(C.EXTENDER_PATH)
+            assert route == C.EXTENDER_PATH + "/" + ext[verb]
+        assert ext["ignorable"] is False and ext["nodeCacheCapable"] is True
+        assert (ext["managedResources"][0]["name"]
+                == C.RESOURCE_NAME_POD_SCHEDULING_ENABLE)
+        # every kube-scheduler pod must consume a config file that exists in
+        # the ConfigMap (the legacy --policy-configmap flag is gone)
+        for d in docs:
+            if d and d.get("kind") == "StatefulSet" and "kube-scheduler" in d["metadata"]["name"]:
+                cmd = d["spec"]["template"]["spec"]["containers"][0]["command"]
+                cfg_flags = [a for a in cmd if a.startswith("--config=")]
+                assert cfg_flags, cmd
+                fname = cfg_flags[0].split("/")[-1]
+                assert fname in cm["data"], fname
+
 
 def test_sku_types_round_trip():
     """HiveD configs carrying skuTypes (external-tooling metadata) must
