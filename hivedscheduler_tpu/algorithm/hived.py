@@ -105,6 +105,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
         self.bad_nodes: Set[str] = set()
         self.cell_chains = parsed.leaf_cell_type_to_chain
         self.cell_types = parsed.cell_level_to_type
+        self.leaf_cell_nums = parsed.cell_level_to_leaf_cell_num
         self.mesh_chains = parsed.mesh_chains
         self.api_cluster_status = api.ClusterStatus()
         self.algorithm_lock = threading.RLock()
@@ -836,21 +837,64 @@ class HivedAlgorithm(SchedulerAlgorithm):
 
         Closes the reference's TODO (intra_vc_scheduler.go:52: "Support an
         affinity group can relax to be allocated across multiple chains").
-        Greedy partition: per chain (in chain order), take the largest prefix
-        of the remaining pods (largest members first) the chain accepts; each
-        sub-request runs the normal per-chain path, so VC-safety accounting
-        is preserved chain by chain. All-or-nothing: if pods remain after the
-        last chain, every committed lazy preemption is reverted and the group
-        waits. Per-pod cell chains are recorded in the bind info, and
-        recovery relies on find_physical_leaf_cell's cross-chain fallback.
+        Greedy partition, largest free capacity first: chains are probed in
+        descending order of free leaf-cell capacity (the VC's free cells for
+        guaranteed requests, the physical free list for opportunistic ones,
+        ties broken by config order for determinism), and each chain takes
+        the largest prefix of the remaining pods (largest members first) it
+        accepts. Largest-capacity-first minimizes the number of chains a gang
+        is split across — fewer cross-chain (DCN) boundaries inside the gang
+        — and on the success path leaves full chains unprobed (an
+        unplaceable gang still probes every chain before giving up, since
+        the ranking is an estimate, not a guarantee). Each sub-request
+        runs the normal per-chain path, so VC-safety accounting is preserved
+        chain by chain. All-or-nothing: if pods remain after the last chain,
+        every committed lazy preemption is reverted and the group waits.
+        Per-pod cell chains are recorded in the bind info, and recovery
+        relies on find_physical_leaf_cell's cross-chain fallback.
         """
+        guaranteed_req = sr.priority >= MIN_GUARANTEED_PRIORITY
+
+        def free_leaf_capacity(chain: CellChain) -> int:
+            if guaranteed_req:
+                # a guaranteed request can also take lazily-preemptible
+                # capacity (anything its VC holds below sr.priority), so
+                # count quota minus same-or-higher-priority usage — free
+                # cells alone would under-rank chains full of preemptible
+                # pods and smear the gang across more chains
+                full = self.vc_schedulers[sr.vc].non_pinned_full_cell_list.get(chain)
+                if not full:
+                    return 0
+                # sum over preassigned roots at every level (a VC may mix
+                # whole-pod and sub-cell quotas in one chain); descendants
+                # are skipped to avoid double counting
+                return sum(
+                    c.total_leaf_cell_num
+                    - sum(
+                        n
+                        for q, n in c.used_leaf_cell_num_at_priorities.items()
+                        if q >= sr.priority
+                    )
+                    for level in full
+                    for c in full[level]
+                    if c.preassigned_cell is c
+                )
+            leaf_num = self.leaf_cell_nums[chain]
+            return sum(
+                len(cells) * leaf_num[l]
+                for l, cells in self.free_cell_list[chain].items()
+            )
+
+        config_order = {c: i for i, c in enumerate(chains)}
+        chains = sorted(
+            chains, key=lambda c: (-free_leaf_capacity(c), config_order[c])
+        )
         flat: List[int] = []
         for ln in sorted(sr.affinity_group_pod_nums, reverse=True):
             flat.extend([ln] * sr.affinity_group_pod_nums[ln])
         merged_phys: GroupPhysicalPlacement = {}
         merged_virt: GroupVirtualPlacement = {}
         committed_lazy: Dict[str, GroupVirtualPlacement] = {}
-        guaranteed = sr.priority >= MIN_GUARANTEED_PRIORITY
         original_pod_nums = sr.affinity_group_pod_nums
         idx = 0
         try:
@@ -910,7 +954,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
             )
         log.info("Affinity group %s relaxed across chains: %s pods placed",
                  sr.affinity_group_name, len(flat))
-        return merged_phys, (merged_virt if guaranteed else None), ""
+        return merged_phys, (merged_virt if guaranteed_req else None), ""
 
     def _validate_scheduling_request(self, sr: SchedulingRequest, pod: Pod) -> None:
         """Reference: validateSchedulingRequest, hived_algorithm.go:857-871."""

@@ -92,6 +92,37 @@ def free_snapshot(h):
     }
 
 
+def build_three_chain_config():
+    """Two 8-chip chains (config-listed FIRST) + one 16-chip chain, all owned
+    whole by vc1 — the asymmetric fixture for the capacity-first partition
+    tests."""
+    small = MeshSpec(topology=(2, 2, 2), chip_type="v5p-chip",
+                     host_shape=(2, 2, 1), levels=[])
+    big = MeshSpec(topology=(4, 2, 2), chip_type="v5p-chip",
+                   host_shape=(2, 2, 1), levels=[])
+    return new_config(Config(
+        physical_cluster=PhysicalClusterSpec(
+            cell_types={
+                "podA": CellTypeSpec(mesh=small),
+                "podB": CellTypeSpec(mesh=small),
+                "podC": CellTypeSpec(mesh=big),
+            },
+            physical_cells=[
+                PhysicalCellSpec(cell_type="podA", cell_address="a0"),
+                PhysicalCellSpec(cell_type="podB", cell_address="b0"),
+                PhysicalCellSpec(cell_type="podC", cell_address="c0"),
+            ],
+        ),
+        virtual_clusters={
+            "vc1": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=1, cell_type="podA"),
+                VirtualCellSpec(cell_number=1, cell_type="podB"),
+                VirtualCellSpec(cell_number=1, cell_type="podC"),
+            ]),
+        },
+    ))
+
+
 class TestMultiChainRelaxation:
     def test_group_spans_two_chains(self, algo):
         """3 pods x 4 chips = 12 chips; each chain holds 8. Only a relaxed
@@ -180,6 +211,80 @@ class TestMultiChainRelaxation:
         spec["multiChainRelaxEnable"] = False
         r = algo.schedule(make_pod("n-0", spec), nodes, FILTERING_PHASE)
         assert r.pod_wait_info is not None, r.pod_bind_info
+
+    def test_partition_touches_fewest_chains(self):
+        """Capacity-first partition: with chains of 8, 8 and 16 chips (small
+        ones FIRST in config order), a 24-chip gang must land on 2 chains —
+        the 16-chip chain hosting 4 pods — not be smeared across all 3 in
+        config order."""
+        random.seed(0)
+        h = HivedAlgorithm(build_three_chain_config())
+        nodes = nodes_of(h)
+        for n in nodes:
+            h.add_node(Node(name=n))
+        spec = gang_spec(6, name="fewest")
+        per_chain = {}
+        for i in range(6):
+            pod = make_pod(f"p-{i}", spec)
+            r = h.schedule(pod, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None, (i, r.pod_wait_info)
+            per_chain[r.pod_bind_info.cell_chain] = (
+                per_chain.get(r.pod_bind_info.cell_chain, 0) + 1
+            )
+            h.add_allocated_pod(new_binding_pod(pod, r.pod_bind_info))
+        assert per_chain.get("podC") == 4, per_chain
+        assert len(per_chain) == 2, per_chain
+
+    def test_partition_counts_preemptible_capacity(self):
+        """The capacity ranking must count lazily-preemptible lower-priority
+        usage, not just free cells: with the 16-chip chain fully held by a
+        priority-1 gang, a priority-10 24-chip gang must still take 4 pods
+        there (evicting the victims) + 2 on one 8-chip chain = 2 chains, not
+        smear across all 3 because the big chain has zero *free* cells."""
+        random.seed(0)
+        h = HivedAlgorithm(build_three_chain_config())
+        nodes = nodes_of(h)
+        for n in nodes:
+            h.add_node(Node(name=n))
+        from hivedscheduler_tpu.runtime.types import PREEMPTING_PHASE
+
+        # a 16-chip priority-1 gang lands whole on podC (the only chain that
+        # fits it single-chain)
+        low_spec = gang_spec(4, name="low", prio=1)
+        bound = {}
+        for i in range(4):
+            pod = make_pod(f"low-{i}", low_spec)
+            r = h.schedule(pod, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None
+            assert r.pod_bind_info.cell_chain == "podC"
+            bp = new_binding_pod(pod, r.pod_bind_info)
+            h.add_allocated_pod(bp)
+            bound[bp.uid] = bp
+
+        hi_spec = gang_spec(6, name="high", prio=10)
+        per_chain = {}
+        for i in range(6):
+            pod = make_pod(f"hi-{i}", hi_spec)
+            r = None
+            for attempt in range(32):
+                r = h.schedule(
+                    pod, nodes,
+                    PREEMPTING_PHASE if attempt else FILTERING_PHASE,
+                )
+                if r.pod_preempt_info is not None:
+                    for victim in r.pod_preempt_info.victim_pods:
+                        bp = bound.pop(victim.uid, None)
+                        if bp is not None:
+                            h.delete_allocated_pod(bp)
+                    continue
+                break
+            assert r.pod_bind_info is not None, (i, r.pod_wait_info)
+            per_chain[r.pod_bind_info.cell_chain] = (
+                per_chain.get(r.pod_bind_info.cell_chain, 0) + 1
+            )
+            h.add_allocated_pod(new_binding_pod(pod, r.pod_bind_info))
+        assert per_chain.get("podC") == 4, per_chain
+        assert len(per_chain) == 2, per_chain
 
     def test_any_type_prefers_whole_gang_on_other_type_over_splitting(self):
         """An untyped gang that no single chain of type A fits must NOT be
