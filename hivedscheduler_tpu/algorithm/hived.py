@@ -837,10 +837,12 @@ class HivedAlgorithm(SchedulerAlgorithm):
 
         Closes the reference's TODO (intra_vc_scheduler.go:52: "Support an
         affinity group can relax to be allocated across multiple chains").
-        Greedy partition, largest free capacity first: chains are probed in
-        descending order of free leaf-cell capacity (the VC's free cells for
-        guaranteed requests, the physical free list for opportunistic ones,
-        ties broken by config order for determinism), and each chain takes
+        Greedy partition, largest usable capacity first: chains are probed
+        in descending order of usable leaf-cell capacity (for guaranteed
+        requests the VC's quota minus same-or-higher-priority usage, so
+        lazily-preemptible lower-priority cells count; the physical free
+        list for opportunistic ones; ties broken by config order for
+        determinism), and each chain takes
         the largest prefix of the remaining pods (largest members first) it
         accepts. Largest-capacity-first minimizes the number of chains a gang
         is split across — fewer cross-chain (DCN) boundaries inside the gang

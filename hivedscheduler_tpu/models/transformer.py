@@ -176,7 +176,7 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 def _moe_mlp(
     h: jax.Array, lp: Dict[str, Any], cfg: TransformerConfig, dtype, mesh=None,
-    manual_ep_axis=None, manual_tp_axis=None,
+    manual_ep_axis=None, manual_tp_axis=None, manual_sp_axis=None,
 ):
     """Top-k MoE with capacity-based dense dispatch; the expert axis is
     ep-sharded so GSPMD turns the dispatch einsums into all_to_alls. Top-1
@@ -189,41 +189,81 @@ def _moe_mlp(
     ``manual_ep_axis`` (shard_map / pipeline-stage mode): expert weights are
     device-local slices; routing runs on the full expert count (the router is
     replicated), each device computes only its experts' slots, and the
-    combine partial-sums are psum'd over the axis."""
+    combine partial-sums are psum'd over the axis.
+
+    ``manual_sp_axis``: the sequence is sharded over that axis, but routing
+    reproduces GLOBAL capacity semantics exactly — capacity is computed on
+    the global token count, slot positions add an exclusive prefix of
+    earlier shards' per-expert counts (an all_gather of [B, E] counts, tiny),
+    the load-balance/z-loss statistics are pmean'd to their global values,
+    and expert inputs are reduce-scattered over the axis (each shard runs
+    the expert FFN on a 1/sp slice of the capacity dim, all_gathered back
+    before the combine; psum fallback when capacity is not divisible by sp)
+    so every expert sees its tokens from all shards without redundant FLOPs.
+    A token therefore overflows capacity iff it would in the unsharded
+    computation (guard:
+    test_pipeline_moe.py::test_moe_inside_sp_pipeline_matches_dense)."""
     b, t, d = h.shape
     # the router is always full-width: its E dim is the global expert count
     E = lp["router"].shape[-1]
     top_k = max(1, min(cfg.moe_top_k, E))
-    capacity = max(1, int(math.ceil(t * top_k / E * cfg.expert_capacity_factor)))
+    sp_size = 1
+    if manual_sp_axis is not None:
+        assert mesh is not None, "manual sp MoE needs the mesh for the axis size"
+        sp_size = dict(zip(mesh.axis_names, mesh.devices.shape))[manual_sp_axis]
+    # capacity is defined on the GLOBAL sequence length
+    capacity = max(
+        1, int(math.ceil(t * sp_size * top_k / E * cfg.expert_capacity_factor))
+    )
     logits = jnp.einsum("btd,de->bte", h, lp["router"].astype(dtype)).astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
     top_gates, top_idx = lax.top_k(probs, top_k)  # [B, T, K]
     if top_k > 1:
         top_gates = top_gates / jnp.sum(top_gates, axis=-1, keepdims=True)
     masks = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)  # [B, T, K, E]
-    # aux loss on the first choice (standard Switch load balancing)
-    lb = E * jnp.sum(
-        jnp.mean(masks[:, :, 0, :], axis=(0, 1)) * jnp.mean(probs, axis=(0, 1))
-    )
+    # aux loss on the first choice (standard Switch load balancing); with a
+    # sequence-sharded stage the means are pmean'd to their global values
+    # BEFORE the nonlinear product
+    mean_mask0 = jnp.mean(masks[:, :, 0, :], axis=(0, 1))
+    mean_probs = jnp.mean(probs, axis=(0, 1))
+    if manual_sp_axis is not None:
+        mean_mask0 = lax.pmean(mean_mask0, manual_sp_axis)
+        mean_probs = lax.pmean(mean_probs, manual_sp_axis)
+    lb = E * jnp.sum(mean_mask0 * mean_probs)
     aux = cfg.moe_aux_weight * lb
     if cfg.moe_zloss_weight > 0.0:
         # ST-MoE router z-loss: keeps router logits small so the softmax
         # stays in a numerically comfortable range
-        z = jax.nn.logsumexp(logits, axis=-1)
-        aux = aux + cfg.moe_zloss_weight * jnp.mean(jnp.square(z))
+        z = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+        if manual_sp_axis is not None:
+            z = lax.pmean(z, manual_sp_axis)
+        aux = aux + cfg.moe_zloss_weight * z
     # per-expert slot assignment: choice 0 tokens queue first, then choice 1
     combine = jnp.zeros((b, t, E, capacity), jnp.float32)
-    counts = jnp.zeros((b, E), jnp.float32)
+    counts = jnp.zeros((b, E), jnp.float32)  # global counts of prior choices
     for i in range(top_k):
         m = masks[:, :, i, :]  # [B, T, E]
-        pos = jnp.cumsum(m, axis=1) * m - 1.0 + counts[:, None, :] * m
+        local_cum = jnp.cumsum(m, axis=1)
+        if manual_sp_axis is not None:
+            # global slot position = (this choice's counts on earlier
+            # shards) + local cumsum + (all shards' counts of prior choices)
+            cnt = jnp.sum(m, axis=1)  # [B, E]
+            all_cnt = lax.all_gather(cnt, manual_sp_axis)  # [sp, B, E]
+            before = (
+                jnp.arange(sp_size) < lax.axis_index(manual_sp_axis)
+            ).astype(jnp.float32)
+            prefix = jnp.einsum("s,sbe->be", before, all_cnt)
+            pos = (local_cum + prefix[:, None, :]) * m - 1.0 + counts[:, None, :] * m
+            counts = counts + jnp.sum(all_cnt, axis=0)
+        else:
+            pos = local_cum * m - 1.0 + counts[:, None, :] * m
+            counts = counts + jnp.sum(m, axis=1)
         keep = m * ((pos >= 0) & (pos < capacity)).astype(jnp.float32)
         slot = jax.nn.one_hot(
             jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity,
             dtype=jnp.float32,
         ) * keep[..., None]  # [B, T, E, C]
         combine = combine + slot * top_gates[:, :, i][..., None, None]
-        counts = counts + jnp.sum(m, axis=1)
     dispatch = (combine > 0.0).astype(jnp.float32)  # [B, T, E, C]
     if manual_ep_axis is not None:
         # manual (pipeline-stage) mode: this device holds E_local experts;
@@ -233,6 +273,18 @@ def _moe_mlp(
         dispatch = lax.dynamic_slice_in_dim(dispatch, start, e_local, axis=2)
         combine = lax.dynamic_slice_in_dim(combine, start, e_local, axis=2)
     expert_in = jnp.einsum("btec,btd->ebcd", dispatch.astype(dtype), h)
+    sp_scattered = False
+    if manual_sp_axis is not None:
+        # each expert's slots aggregate tokens from every sequence shard;
+        # scatter the capacity dim across sp so the expert FFN below runs on
+        # 1/sp of the slots per shard instead of sp-fold redundantly
+        if capacity % sp_size == 0:
+            expert_in = lax.psum_scatter(
+                expert_in, manual_sp_axis, scatter_dimension=2, tiled=True
+            )
+            sp_scattered = True
+        else:
+            expert_in = lax.psum(expert_in, manual_sp_axis)
     if manual_ep_axis is None and mesh is not None:
         from jax.sharding import NamedSharding
 
@@ -244,6 +296,11 @@ def _moe_mlp(
     expert_out = jnp.einsum(
         "ebcf,efd->ebcd", jax.nn.silu(g) * u, lp["w_down"].astype(dtype)
     )
+    if sp_scattered:
+        # reassemble the full capacity dim before the local combine
+        expert_out = lax.all_gather(
+            expert_out, manual_sp_axis, axis=2, tiled=True
+        )
     # `combine` already carries the per-token gate weights per slot
     out = jnp.einsum("btec,ebcd->btd", combine.astype(dtype), expert_out)
     # manual mode: the output is partial over local experts (ep) AND over the
@@ -315,7 +372,8 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
     if cfg.n_experts > 0:
         moe_out, aux = _moe_mlp(h, lp, cfg, dtype, mesh,
                                 manual_ep_axis=manual_ep_axis,
-                                manual_tp_axis=manual_tp_axis)
+                                manual_tp_axis=manual_tp_axis,
+                                manual_sp_axis=manual_sp_axis)
         x = x + moe_out
     else:
         gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))
@@ -402,12 +460,6 @@ def forward_with_aux(
                     raise ValueError(
                         f"n_experts {cfg.n_experts} not divisible by mesh "
                         f"ep={shape['ep']} inside the pipeline"
-                    )
-                if shape.get("sp", 1) > 1:
-                    raise ValueError(
-                        "MoE with a sequence-sharded pipeline stage (sp > 1) "
-                        "is not supported: per-shard routing would change "
-                        "capacity semantics"
                     )
                 manual_ep = "ep"
             if (
