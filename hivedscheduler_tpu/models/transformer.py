@@ -209,8 +209,8 @@ def _moe_mlp(
     top_k = max(1, min(cfg.moe_top_k, E))
     sp_size = 1
     if manual_sp_axis is not None:
-        assert mesh is not None, "manual sp MoE needs the mesh for the axis size"
-        sp_size = dict(zip(mesh.axis_names, mesh.devices.shape))[manual_sp_axis]
+        # static python int inside the shard_map body — capacity is a shape
+        sp_size = lax.axis_size(manual_sp_axis)
     # capacity is defined on the GLOBAL sequence length
     capacity = max(
         1, int(math.ceil(t * sp_size * top_k / E * cfg.expert_capacity_factor))

@@ -42,8 +42,38 @@ def loss_fn(params, tokens, cfg: tm.TransformerConfig, mesh=None) -> jax.Array:
     return loss
 
 
-def train_step(params, opt_state, tokens, cfg: tm.TransformerConfig, optimizer, mesh=None):
-    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+def train_step(params, opt_state, tokens, cfg: tm.TransformerConfig, optimizer,
+               mesh=None, grad_accum: int = 1):
+    """One optimizer update. With ``grad_accum > 1`` the batch's leading dim
+    is split into that many slices and gradients are averaged over them with
+    a ``lax.scan`` (one slice's activations live at a time — the standard
+    trade of step latency for activation memory on top of remat; the update
+    is numerically the full-batch gradient since the LM loss is a mean)."""
+    if grad_accum <= 1:
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+    else:
+        b = tokens.shape[0]
+        assert b % grad_accum == 0, (
+            f"batch {b} not divisible by grad_accum {grad_accum}"
+        )
+        slices = tokens.reshape(grad_accum, b // grad_accum, *tokens.shape[1:])
+
+        def accumulate(carry, micro_tokens):
+            loss_sum, grad_sum = carry
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, micro_tokens, cfg, mesh
+            )
+            return (
+                loss_sum + loss,
+                jax.tree.map(jnp.add, grad_sum, grads),
+            ), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            accumulate, (jnp.zeros(()), zeros), slices
+        )
+        loss = loss_sum / grad_accum
+        grads = jax.tree.map(lambda g: g / grad_accum, grad_sum)
     updates, opt_state = optimizer.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
     return params, opt_state, loss
@@ -53,13 +83,15 @@ def make_sharded_train_step(
     cfg: tm.TransformerConfig,
     mesh,
     optimizer: Optional[optax.GradientTransformation] = None,
+    grad_accum: int = 1,
 ):
     """Returns (jitted_step, init_fn, token_sharding).
 
     ``init_fn(key)`` -> (params, opt_state) placed per the sharding specs;
     ``jitted_step(params, opt_state, tokens)`` -> (params, opt_state, loss)
     with donated carries; ``token_sharding`` is the [dp(+fsdp), sp]
-    NamedSharding to device_put batches with.
+    NamedSharding to device_put batches with. ``grad_accum`` splits each
+    batch into that many gradient-accumulation slices (see train_step).
     """
     optimizer = optimizer or make_optimizer()
     param_specs = tm.sharding_specs(cfg)
@@ -98,7 +130,8 @@ def make_sharded_train_step(
         return params, opt_state
 
     def step(params, opt_state, tokens):
-        return train_step(params, opt_state, tokens, cfg, optimizer, mesh)
+        return train_step(params, opt_state, tokens, cfg, optimizer, mesh,
+                          grad_accum=grad_accum)
 
     jitted = jax.jit(step, donate_argnums=(0, 1))
     return jitted, init_fn, token_sharding

@@ -45,6 +45,9 @@ def main(argv=None) -> int:
     parser.add_argument("--moe-top-k", type=int, default=1)
     parser.add_argument("--moe-zloss", type=float, default=0.0,
                         help="ST-MoE router z-loss weight (0 disables)")
+    parser.add_argument("--grad-accum", type=int, default=1,
+                        help="gradient-accumulation slices per batch "
+                        "(batch must divide evenly)")
     parser.add_argument("--attn", default=None,
                         help="xla|flash|ring|ring_zigzag|ulysses (default: ring when sp>1)")
     parser.add_argument("--data", default="",
@@ -100,7 +103,9 @@ def main(argv=None) -> int:
         moe_zloss_weight=args.moe_zloss,
         pipeline_microbatches=args.microbatches if args.pp > 1 else 0,
     )
-    step_fn, init_fn, token_sharding = make_sharded_train_step(cfg, mesh)
+    step_fn, init_fn, token_sharding = make_sharded_train_step(
+        cfg, mesh, grad_accum=args.grad_accum
+    )
     params, opt_state = init_fn(jax.random.PRNGKey(0))
 
     # 3. resume if this gang incarnation has a previous checkpoint

@@ -202,6 +202,52 @@ class TestTrainStep:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]  # memorizing a fixed batch
 
+    def test_grad_accum_matches_full_batch(self):
+        """One update with grad_accum=4 must equal the full-batch update
+        (the LM loss is a mean over equal-size slices, so averaged gradients
+        are exactly the full-batch gradient for a dense model)."""
+        from hivedscheduler_tpu.models import transformer as tm
+        from hivedscheduler_tpu.parallel.train import make_sharded_train_step
+
+        mesh = cpu_mesh(topology.MeshAxes(dp=2))
+        cfg = tm.TransformerConfig(
+            vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+            max_seq_len=64, dtype=jnp.float32,
+        )
+        tokens_host = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128)
+        results = {}
+        for accum in (1, 4):
+            step, init_fn, token_sharding = make_sharded_train_step(
+                cfg, mesh, grad_accum=accum
+            )
+            params, opt_state = init_fn(jax.random.PRNGKey(0))
+            tokens = jax.device_put(tokens_host, token_sharding)
+            params, opt_state, loss = step(params, opt_state, tokens)
+            results[accum] = (jax.tree.map(np.asarray, params), float(loss))
+        p1, l1 = results[1]
+        p4, l4 = results[4]
+        assert abs(l1 - l4) < 1e-5
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5), p1, p4
+        )
+
+    def test_grad_accum_indivisible_batch_rejected(self):
+        from hivedscheduler_tpu.models import transformer as tm
+        from hivedscheduler_tpu.parallel.train import make_sharded_train_step
+
+        mesh = cpu_mesh(topology.MeshAxes(dp=2))
+        cfg = tm.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+            max_seq_len=32,
+        )
+        step, init_fn, token_sharding = make_sharded_train_step(
+            cfg, mesh, grad_accum=3
+        )
+        params, opt_state = init_fn(jax.random.PRNGKey(0))
+        tokens = jax.device_put(jnp.zeros((4, 16), jnp.int32), token_sharding)
+        with pytest.raises(Exception, match="not divisible"):
+            step(params, opt_state, tokens)
+
     def test_graft_entry(self):
         import __graft_entry__ as ge
 

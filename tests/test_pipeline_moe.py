@@ -369,15 +369,19 @@ class TestMoEInPipeline:
             losses.append(float(loss))
         assert all(np.isfinite(losses)) and losses[-1] < losses[0]
 
-    @pytest.mark.parametrize("cf", [1.25, 2.0])
-    def test_moe_inside_sp_pipeline_matches_dense(self, cf):
+    @pytest.mark.parametrize("cf,top_k", [(1.25, 1), (2.0, 1), (1.25, 2)])
+    def test_moe_inside_sp_pipeline_matches_dense(self, cf, top_k):
         """pp=2 x sp=2 with MoE layers: the sequence-sharded stage must
         reproduce GLOBAL routing-capacity semantics exactly (same tokens
         overflow as in the dense computation), so the pipelined logits equal
-        the dense ones. cf=1.25 gives capacity 5 (not divisible by sp=2, the
-        psum fallback); cf=2.0 gives capacity 8 (the reduce-scatter path)."""
-        cfg_ref = tiny_cfg(n_experts=4, expert_capacity_factor=cf)
+        the dense ones. cf=1.25 gives an sp-indivisible capacity (the psum
+        fallback); cf=2.0 an even one (the reduce-scatter path); top_k=2
+        pins the cross-shard choice-ordering (global choice-0 counts before
+        any choice-1 slot)."""
+        cfg_ref = tiny_cfg(n_experts=4, expert_capacity_factor=cf,
+                           moe_top_k=top_k)
         cfg_pp = tiny_cfg(n_experts=4, expert_capacity_factor=cf,
+                          moe_top_k=top_k,
                           pipeline_microbatches=2, attn_impl="ring")
         mesh = cpu_mesh(topology.MeshAxes(dp=2, pp=2, sp=2))
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
