@@ -57,10 +57,23 @@ class TransformerConfig:
     # GPipe microbatches over the pp axis; 0 = no pipelining
     pipeline_microbatches: int = 0
 
+    # grouped-query attention: number of shared k/v heads (0 = n_heads,
+    # classic MHA; 1 = MQA). q heads are grouped contiguously: q head i
+    # attends with k/v head i // (n_heads // n_kv_heads)
+    n_kv_heads: int = 0
+
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        n_kv = self.n_kv_heads or self.n_heads
+        assert self.n_heads % n_kv == 0, (
+            f"n_heads {self.n_heads} not divisible by n_kv_heads {n_kv}"
+        )
+        return n_kv
 
 
 def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
@@ -76,11 +89,12 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
 
     ks = jax.random.split(k_attn, 4)
     km = jax.random.split(k_mlp, 4)
+    h_kv = cfg.kv_heads
     layers: Dict[str, Any] = {
         "attn_norm": jnp.ones((L, d), jnp.float32),
         "wq": norm_init(ks[0], (L, d, h, hd), d),
-        "wk": norm_init(ks[1], (L, d, h, hd), d),
-        "wv": norm_init(ks[2], (L, d, h, hd), d),
+        "wk": norm_init(ks[1], (L, d, h_kv, hd), d),
+        "wv": norm_init(ks[2], (L, d, h_kv, hd), d),
         "wo": norm_init(ks[3], (L, h, hd, d), d),
         "mlp_norm": jnp.ones((L, d), jnp.float32),
     }
@@ -344,6 +358,14 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
     v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
+    if k.shape[2] != q.shape[2]:
+        # GQA: materialize each shared k/v head for its q-head group (after
+        # RoPE, so the rotation runs on the small head count). Contiguous
+        # grouping keeps groups aligned with tp shards when both head counts
+        # divide by tp.
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     if manual_sp_axis is not None:
         from hivedscheduler_tpu.parallel.ring_attention import (
             _ring_attention_local,
@@ -454,6 +476,11 @@ def forward_with_aux(
                 # Megatron-style psums inside the stage; with tp == 1 the
                 # psum is free but still normalizes the shard_map vma of the
                 # tp-sharded (possibly size-1) weights
+                if cfg.kv_heads % shape["tp"]:
+                    raise ValueError(
+                        f"GQA in pipeline needs kv heads divisible by tp: "
+                        f"{cfg.kv_heads} kv heads, tp={shape['tp']}"
+                    )
                 manual_tp = "tp"
             if cfg.n_experts > 0 and "ep" in shape:
                 if cfg.n_experts % shape["ep"]:

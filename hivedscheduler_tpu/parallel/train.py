@@ -47,8 +47,11 @@ def train_step(params, opt_state, tokens, cfg: tm.TransformerConfig, optimizer,
     """One optimizer update. With ``grad_accum > 1`` the batch's leading dim
     is split into that many slices and gradients are averaged over them with
     a ``lax.scan`` (one slice's activations live at a time — the standard
-    trade of step latency for activation memory on top of remat; the update
-    is numerically the full-batch gradient since the LM loss is a mean)."""
+    trade of step latency for activation memory on top of remat). For dense
+    models the update equals the full-batch gradient exactly (the LM loss is
+    a mean over equal slices; guard: test_grad_accum_matches_full_batch);
+    MoE aux losses are nonlinear batch statistics, so they are computed per
+    slice and averaged — the standard approximation."""
     if grad_accum <= 1:
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
     else:
@@ -94,6 +97,15 @@ def make_sharded_train_step(
     batch into that many gradient-accumulation slices (see train_step).
     """
     optimizer = optimizer or make_optimizer()
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tp = mesh_shape.get("tp", 1)
+    if cfg.n_heads % tp or cfg.kv_heads % tp:
+        # fail here with a clear message instead of deep inside pjit when
+        # the head axis of wq/wk/wv cannot shard evenly
+        raise ValueError(
+            f"head counts must divide the tp axis: n_heads={cfg.n_heads}, "
+            f"kv_heads={cfg.kv_heads}, tp={tp}"
+        )
     param_specs = tm.sharding_specs(cfg)
     param_shardings = jax.tree.map(
         lambda spec: NamedSharding(mesh, spec), param_specs,

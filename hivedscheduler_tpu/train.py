@@ -31,6 +31,8 @@ def main(argv=None) -> int:
     parser.add_argument("--d-model", type=int, default=512)
     parser.add_argument("--n-layers", type=int, default=8)
     parser.add_argument("--n-heads", type=int, default=8)
+    parser.add_argument("--n-kv-heads", type=int, default=0,
+                        help="GQA shared k/v heads (0 = n_heads, 1 = MQA)")
     parser.add_argument("--d-ff", type=int, default=1408)
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
@@ -94,6 +96,7 @@ def main(argv=None) -> int:
         vocab_size=args.vocab_size,
         d_model=args.d_model,
         n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
         n_layers=args.n_layers,
         d_ff=args.d_ff,
         max_seq_len=args.seq_len,
