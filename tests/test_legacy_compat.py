@@ -154,6 +154,51 @@ class TestLegacyBindInfo:
             r.pod_bind_info.leaf_cell_isolation
         )
 
+    def test_memoized_fragment_with_legacy_head_falls_back(self, algo):
+        """extract_pod_bind_info's fast path scans only the annotation head
+        for legacy keys once the gang fragment is memoized — which is safe
+        ONLY because fragments enter the memo after a full-raw scan passed.
+        Pin both halves: a legacy-keyed head spliced onto an
+        already-memoized clean fragment must take the rewritten full parse,
+        with the fragment still parsed correctly."""
+        from hivedscheduler_tpu.runtime import utils as iu
+
+        ann = to_yaml({
+            "virtualCluster": "vc2",
+            "priority": 5,
+            "leafCellType": "v5e-chip",
+            "leafCellNumber": 8,
+            "affinityGroup": {
+                "name": "legacy/memo",
+                "members": [{"podNumber": 1, "leafCellNumber": 8}],
+            },
+        })
+        pod = legacy_pod("m1", ann)
+        r = algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+        assert r.pod_bind_info is not None
+        bp = new_binding_pod(pod, r.pod_bind_info)
+        raw = bp.annotations[C.ANNOTATION_POD_BIND_INFO]
+        # machine format: memoize the clean fragment via the fast path
+        info_fast = extract_pod_bind_info(bp)
+        head, marker, frag_tail = raw.partition(iu._GROUP_SPLICE_MARKER)
+        assert marker and frag_tail[:-1] in iu._group_frag_memo
+        # splice a legacy-keyed head onto the SAME fragment bytes
+        legacy_head = head.replace(
+            '"leafCellIsolation"', '"gpuIsolation"'
+        )
+        assert legacy_head != head
+        legacy_raw = legacy_head + marker + frag_tail
+        legacy_bp = bp.deep_copy()
+        legacy_bp.annotations[C.ANNOTATION_POD_BIND_INFO] = legacy_raw
+        info = extract_pod_bind_info(legacy_bp)
+        # the legacy head was rewritten (gpuIsolation -> leafCellIsolation)
+        # and the fragment still parsed — NOT skipped by the fast path
+        assert info.leaf_cell_isolation == info_fast.leaf_cell_isolation
+        assert info.node == info_fast.node
+        assert len(info.affinity_group_bind_info) == len(
+            info_fast.affinity_group_bind_info
+        )
+
     def test_rewrite_table_is_exhaustive(self):
         """Guard: every key the reference rewrites must be rewritten here."""
         reference_pairs = {
