@@ -359,13 +359,22 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     if k.shape[2] != q.shape[2]:
-        # GQA: materialize each shared k/v head for its q-head group (after
-        # RoPE, so the rotation runs on the small head count). Contiguous
-        # grouping keeps groups aligned with tp shards when both head counts
-        # divide by tp.
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+        # GQA. The ring schedules consume compact k/v directly via grouped
+        # einsums — their ppermute rotation then ships H_kv/H of the bytes —
+        # when the compact head count still shards evenly over tp (the
+        # manual pipeline path rejects indivisible kv/tp upfront). All other
+        # impls (and the indivisible GSPMD case) materialize each shared
+        # k/v head for its q-head group here, after RoPE so the rotation
+        # runs on the small head count; contiguous grouping keeps groups
+        # aligned with tp shards.
+        compact_ok = cfg.attn_impl in ("ring", "ring_zigzag")
+        if compact_ok and manual_sp_axis is None and mesh is not None:
+            tp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
+            compact_ok = k.shape[2] % tp_size == 0
+        if not compact_ok:
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
     if manual_sp_axis is not None:
         from hivedscheduler_tpu.parallel.ring_attention import (
             _ring_attention_local,

@@ -38,20 +38,32 @@ def _block_attention_pos(q, k, v, q_pos, k_pos, scale, masked: bool):
     with explicit per-row positions (zigzag chunks are non-contiguous);
     ``masked=False`` skips the mask for blocks known fully visible.
 
-    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]. Returns (o, m, l) partials with
-    o: [B, H, Tq, D], m/l: [B, H, Tq] in f32.
+    q: [B, Tq, H, D]; k/v: [B, Tk, H_kv, D] where H_kv may divide H (GQA —
+    q head i shares k/v head i // (H/H_kv); the compact k/v is consumed via
+    grouped einsums, never materialized at H heads). Returns (o, m, l)
+    partials with o: [B, H, Tq, D], m/l: [B, H, Tq] in f32.
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    b, t_q, h, d = q.shape
+    h_kv = k.shape[2]
+    gsz = h // h_kv  # 1 for MHA; the size-1 group dim is free in XLA
+    qg = q.reshape(b, t_q, h_kv, gsz, d)
+    s = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    ) * scale
     if masked:
         mask = q_pos[:, None] >= k_pos[None, :]
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1)
     # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1, so clamp
     m_safe = jnp.maximum(m, -0.5 * abs(NEG_INF))
     p = jnp.exp(s - m_safe[..., None])
     l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
-    return o, m_safe, l
+    o = jnp.einsum("bhgqk,bkhd->bhgqd", p, v.astype(jnp.float32))
+    return (
+        o.reshape(b, h, t_q, d),
+        m_safe.reshape(b, h, t_q),
+        l.reshape(b, h, t_q),
+    )
 
 
 def _block_attention(q, k, v, q_offset, k_offset, causal, scale):
@@ -59,6 +71,33 @@ def _block_attention(q, k, v, q_offset, k_offset, causal, scale):
     q_pos = q_offset + lax.iota(jnp.int32, q.shape[1])
     k_pos = k_offset + lax.iota(jnp.int32, k.shape[1])
     return _block_attention_pos(q, k, v, q_pos, k_pos, scale, masked=causal)
+
+
+def _block_grad(qh, doh, mh, lh, dh, kf, vf, q_pos, k_pos, scale, masked):
+    """Gradients of one attention block (shared by the ring and zigzag
+    backwards). qh/doh: [B,H,Tq,D]; mh/lh/dh: [B,H,Tq]; kf/vf:
+    [B,H_kv,Tk,D] with H_kv | H (compact GQA k/v, consumed via grouped
+    einsums). Returns (dq_blk [B,H,Tq,D], dk_blk/dv_blk [B,H_kv,Tk,D]) —
+    dk/dv pre-summed over each kv head's q group."""
+    b, h, t_q, d = qh.shape
+    h_kv = kf.shape[1]
+    gsz = h // h_kv  # 1 for MHA; the size-1 group dim is free in XLA
+    qg = qh.reshape(b, h_kv, gsz, t_q, d)
+    dog = doh.reshape(b, h_kv, gsz, t_q, d)
+    mg = mh.reshape(b, h_kv, gsz, t_q)
+    lg = lh.reshape(b, h_kv, gsz, t_q)
+    dg = dh.reshape(b, h_kv, gsz, t_q)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kf) * scale
+    if masked:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
+    p = jnp.exp(s - mg[..., None]) / lg[..., None]
+    dv_blk = jnp.einsum("bhgqk,bhgqd->bhkd", p, dog)
+    dp = jnp.einsum("bhgqd,bhkd->bhgqk", dog, vf)
+    ds = p * (dp - dg[..., None])
+    dq_blk = jnp.einsum("bhgqk,bhkd->bhgqd", ds, kf).reshape(b, h, t_q, d) * scale
+    dk_blk = jnp.einsum("bhgqk,bhgqd->bhkd", ds, qg) * scale
+    return dq_blk, dk_blk, dv_blk
 
 
 def _merge_partial(acc, blk):
@@ -158,9 +197,10 @@ def _ring_backward(q, k, v, out, m, l, g, axis_name: str, causal: bool, mesh_axe
     m_safe = jnp.maximum(m, -0.5 * abs(NEG_INF))
     l_safe = jnp.where(l == 0.0, 1.0, l)
 
+    h_kv = k.shape[2]
     dq = _varying(jnp.zeros((b, h, t_q, d), jnp.float32), mesh_axes)
-    dk0 = _varying(jnp.zeros((b, h, t_k, d), jnp.float32), mesh_axes)
-    dv0 = _varying(jnp.zeros((b, h, t_k, d), jnp.float32), mesh_axes)
+    dk0 = _varying(jnp.zeros((b, h_kv, t_k, d), jnp.float32), mesh_axes)
+    dv0 = _varying(jnp.zeros((b, h_kv, t_k, d), jnp.float32), mesh_axes)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def merge_grad(step, dq, dk_cur, dv_cur, k_cur, v_cur):
@@ -172,20 +212,13 @@ def _ring_backward(q, k, v, out, m, l, g, axis_name: str, causal: bool, mesh_axe
             dq, dk_cur, dv_cur, k_cur, v_cur = args
             kf = jnp.einsum("bkhd->bhkd", k_cur.astype(jnp.float32))
             vf = jnp.einsum("bkhd->bhkd", v_cur.astype(jnp.float32))
-            s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
-            if causal:
-                q_pos = my_index * t_q + lax.iota(jnp.int32, t_q)
-                k_pos = src * t_k + lax.iota(jnp.int32, t_k)
-                mask = q_pos[:, None] >= k_pos[None, :]
-                s = jnp.where(mask[None, None], s, NEG_INF)
-            # exact probabilities from the saved global max and denominator
-            p = jnp.exp(s - m_safe[..., None]) / l_safe[..., None]
-            dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, do)
-            dp = jnp.einsum("bhqd,bhkd->bhqk", do, vf)
-            ds = p * (dp - delta[..., None])
-            dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
-            dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
-            return dq, dk_cur + dk_blk, dv_cur + dv_blk
+            q_pos = my_index * t_q + lax.iota(jnp.int32, t_q)
+            k_pos = src * t_k + lax.iota(jnp.int32, t_k)
+            dq_blk, dk_blk, dv_blk = _block_grad(
+                qf, do, m_safe, l_safe, delta, kf, vf, q_pos, k_pos, scale,
+                masked=causal,
+            )
+            return dq + dq_blk, dk_cur + dk_blk, dv_cur + dv_blk
 
         if causal:
             return lax.cond(
@@ -434,10 +467,11 @@ def _zigzag_backward(q, k, v, out, m, l, g, axis_name: str, mesh_axes):
             l_safe[:, :, half:], delta[:, :, half:], pos_hi),
     }
 
+    h_kv = k.shape[2]
     dq = _varying(jnp.zeros((b, h, t, d), jnp.float32), mesh_axes)
     dkv0 = (
-        _varying(jnp.zeros((b, h, t, d), jnp.float32), mesh_axes),
-        _varying(jnp.zeros((b, h, t, d), jnp.float32), mesh_axes),
+        _varying(jnp.zeros((b, h_kv, t, d), jnp.float32), mesh_axes),
+        _varying(jnp.zeros((b, h_kv, t, d), jnp.float32), mesh_axes),
     )
     perm = [(s, (s + 1) % axis_size) for s in range(axis_size)]
 
@@ -448,16 +482,10 @@ def _zigzag_backward(q, k, v, out, m, l, g, axis_name: str, mesh_axes):
         qh, doh, mh, lh, dh, qpos = q_half
         kf = jnp.einsum("bkhd->bhkd", k_cur[:, k_slice].astype(jnp.float32))
         vf = jnp.einsum("bkhd->bhkd", v_cur[:, k_slice].astype(jnp.float32))
-        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kf) * scale
-        if masked:
-            mask = qpos[:, None] >= kpos[None, :]
-            s = jnp.where(mask[None, None], s, NEG_INF)
-        p = jnp.exp(s - mh[..., None]) / lh[..., None]
-        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, doh)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", doh, vf)
-        ds = p * (dp - dh[..., None])
-        dq = dq.at[:, :, q_slice].add(jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale)
-        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qh) * scale
+        dq_blk, dk_blk, dv_blk = _block_grad(
+            qh, doh, mh, lh, dh, kf, vf, qpos, kpos, scale, masked=masked,
+        )
+        dq = dq.at[:, :, q_slice].add(dq_blk)
         dk_cur = dk_cur.at[:, :, k_slice].add(dk_blk)
         dv_cur = dv_cur.at[:, :, k_slice].add(dv_blk)
         return dq, dk_cur, dv_cur
