@@ -1,0 +1,192 @@
+"""Autoregressive decoding for the flagship transformer: KV-cache prefill,
+incremental step, and a jit-friendly ``generate`` loop.
+
+The reference is a scheduler with no model runtime; this is part of the
+workload runtime built around it. TPU-first choices:
+
+- **Static shapes**: the cache is allocated at ``max_len`` up front and
+  attention always scores the full cache with a position mask — no dynamic
+  shapes, one compiled step for the whole decode.
+- **Compact GQA cache**: k/v are cached at ``cfg.kv_heads`` ([L, B, M,
+  H_kv, D]) and consumed by grouped einsums, so MQA/GQA cuts cache HBM and
+  bandwidth by H/H_kv — the main GQA serving win.
+- **One program for prefill and decode**: ``advance`` takes [B, S] tokens at
+  any position; prefill is S=prompt_len, decoding is S=1. The layer stack
+  runs under ``lax.scan`` over the stacked layer params, updating the
+  per-layer cache slices in the scanned carry.
+
+MoE layers decode with NO-DROP capacity (every token reaches its routed
+experts): training's capacity factor is a throughput knob whose drop
+decisions depend on the chunk length of the forward call, so reproducing it
+per decode step would diverge anyway — serving uses the exact mixture
+instead. Decoded MoE logits therefore match a training forward exactly iff
+nothing overflowed capacity there (guard:
+test_decode.py::test_moe_decode_uses_no_drop_capacity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from hivedscheduler_tpu.models.transformer import (
+    TransformerConfig,
+    _moe_mlp,
+    _rms_norm,
+    _rope,
+)
+from hivedscheduler_tpu.ops.attention import NEG_INF
+
+
+class KVCache(NamedTuple):
+    """Per-layer key/value cache and the number of tokens already absorbed.
+
+    k/v: [n_layers, B, max_len, kv_heads, head_dim] in the model dtype;
+    length: scalar int32 (same for every sequence of the batch — decode
+    assumes an unpadded, position-aligned batch)."""
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def _cached_attention(q, ck, cv, pos0, scale):
+    """q: [B,S,H,D] at absolute positions pos0..pos0+S-1; ck/cv:
+    [B,M,H_kv,D] full cache (entries past the live length are masked by the
+    causal position test, since they can only sit at positions > pos0+s).
+    Returns [B,S,H,D]."""
+    b, s_len, h, d = q.shape
+    m_len, h_kv = ck.shape[1], ck.shape[2]
+    gsz = h // h_kv  # 1 for MHA; the size-1 group dim is free in XLA
+    qg = q.reshape(b, s_len, h_kv, gsz, d)
+    s = jnp.einsum(
+        "bshgd,bmhd->bhgsm", qg, ck, preferred_element_type=jnp.float32
+    ) * scale
+    key_pos = lax.iota(jnp.int32, m_len)
+    q_pos = pos0 + lax.iota(jnp.int32, s_len)
+    mask = key_pos[None, :] <= q_pos[:, None]  # [S, M]
+    s = jnp.where(mask[None, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgsm,bmhd->bshgd", p, cv.astype(jnp.float32))
+    return o.reshape(b, s_len, h, d).astype(q.dtype)
+
+
+def advance(
+    params: Dict[str, Any],
+    cache: KVCache,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+) -> Tuple[jax.Array, KVCache]:
+    """Absorb ``tokens`` [B, S] starting at position ``cache.length`` and
+    return (logits [B, S, vocab] f32, updated cache). S=prompt length for
+    prefill, S=1 while decoding — same compiled program shape per S."""
+    dtype = cfg.dtype
+    b, s_len = tokens.shape
+    pos0 = cache.length
+    x = params["embed"].astype(dtype)[tokens]  # [B, S, D]
+    positions = (pos0 + lax.iota(jnp.int32, s_len))[None, :]
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    if cfg.n_experts > 0:
+        # no-drop inference capacity: ceil(S*k*E/E) = S*k slots per expert
+        # covers the worst-case routing skew (see module docstring)
+        cfg = dataclasses.replace(
+            cfg, expert_capacity_factor=float(max(cfg.n_experts, 1))
+        )
+
+    def layer(x, scanned):
+        lp, ck, cv = scanned
+        h = _rms_norm(x, lp["attn_norm"])
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(dtype))
+        k_new = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(dtype))
+        v_new = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(dtype))
+        q = _rope(q, positions, cfg.rope_theta)
+        k_new = _rope(k_new, positions, cfg.rope_theta)
+        ck = lax.dynamic_update_slice_in_dim(ck, k_new.astype(ck.dtype), pos0, 1)
+        cv = lax.dynamic_update_slice_in_dim(cv, v_new.astype(cv.dtype), pos0, 1)
+        attn = _cached_attention(q, ck, cv, pos0, scale)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"].astype(dtype))
+        h = _rms_norm(x, lp["mlp_norm"])
+        if cfg.n_experts > 0:
+            moe_out, _ = _moe_mlp(h, lp, cfg, dtype)
+            x = x + moe_out
+        else:
+            gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dtype))
+            up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dtype))
+            x = x + jnp.einsum(
+                "bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"].astype(dtype)
+            )
+        return x, (ck, cv)
+
+    (x, (new_k, new_v)) = lax.scan(
+        lambda carry, scanned: layer(carry, scanned),
+        x,
+        (params["layers"], cache.k, cache.v),
+    )
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"].astype(dtype)
+    ).astype(jnp.float32)
+    new_cache = KVCache(k=new_k, v=new_v, length=pos0 + s_len)
+    return logits, new_cache
+
+
+def generate(
+    params: Dict[str, Any],
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Greedy (temperature 0) or sampled continuation of ``prompt`` [B, T].
+    Returns [B, max_new_tokens]. The whole decode loop is one ``lax.scan``
+    over a fixed-shape cached step, so it stays inside a single jit."""
+    b, t = prompt.shape
+    total = t + max_new_tokens
+    if max_len is None:
+        max_len = total
+    assert max_len >= total, (max_len, total)
+    assert temperature == 0.0 or key is not None, (
+        "sampling (temperature > 0) needs a PRNG key"
+    )
+    cache = init_kv_cache(cfg, b, max_len)
+    logits, cache = advance(params, cache, prompt, cfg)
+    last = logits[:, -1]
+
+    def pick(logits_b, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits_b, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            k, logits_b / temperature, axis=-1
+        ).astype(prompt.dtype)
+
+    keys = (
+        jax.random.split(key, max_new_tokens)
+        if key is not None
+        else jnp.zeros((max_new_tokens, 2), jnp.uint32)
+    )
+
+    def step(carry, k):
+        last_logits, cache = carry
+        tok = pick(last_logits, k)
+        logits, cache = advance(params, cache, tok[:, None], cfg)
+        return (logits[:, -1], cache), tok
+
+    (_, _), toks = lax.scan(step, (last, cache), keys)
+    return jnp.swapaxes(toks, 0, 1)  # [B, max_new]
