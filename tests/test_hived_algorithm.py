@@ -230,6 +230,88 @@ class TestUserErrors:
         with pytest.raises(api.WebServerError):
             algo.schedule(make_pod("g1-1", spec), all_node_names(algo), FILTERING_PHASE)
 
+    # --- remaining bad-request shapes of the reference's failure table
+    # (hived_algorithm_test.go:245-293) and spec validation
+    # (internal/utils.go:230-289); every one must recover as HTTP 4xx ---
+
+    def _assert_bad_request(self, algo, spec_dict):
+        with pytest.raises(api.WebServerError) as e:
+            algo.schedule(make_pod("bad", spec_dict), all_node_names(algo),
+                          FILTERING_PHASE)
+        assert 400 <= e.value.code < 500, e.value.code
+
+    def test_unknown_pinned_cell_guaranteed(self, algo):
+        # reference pod14: invalid pinned cell
+        self._assert_bad_request(algo, {
+            "virtualCluster": "vc1", "priority": 1,
+            "pinnedCellId": "surprise!", "chipNumber": 1})
+
+    def test_pod_not_in_group_members(self, algo):
+        # reference pod11/pod12 family: invalid affinity group configuration
+        self._assert_bad_request(algo, {
+            "virtualCluster": "vc1", "priority": 0, "chipNumber": 3,
+            "affinityGroup": {"name": "mismatch",
+                              "members": [{"podNumber": 2, "chipNumber": 4}]}})
+
+    def test_priority_below_opportunistic(self, algo):
+        self._assert_bad_request(algo, {
+            "virtualCluster": "vc1", "priority": -2, "chipNumber": 1})
+
+    def test_non_positive_leaf_cell_number(self, algo):
+        self._assert_bad_request(algo, {
+            "virtualCluster": "vc1", "priority": 0, "chipNumber": 0})
+
+    def test_non_positive_pod_number_in_members(self, algo):
+        self._assert_bad_request(algo, {
+            "virtualCluster": "vc1", "priority": 0, "chipNumber": 4,
+            "affinityGroup": {"name": "zero",
+                              "members": [{"podNumber": 0, "chipNumber": 4}]}})
+
+    def test_empty_virtual_cluster(self, algo):
+        self._assert_bad_request(algo, {"priority": 0, "chipNumber": 1})
+
+    def test_empty_group_name(self, algo):
+        self._assert_bad_request(algo, {
+            "virtualCluster": "vc1", "priority": 0, "chipNumber": 4,
+            "affinityGroup": {"name": "",
+                              "members": [{"podNumber": 1, "chipNumber": 4}]}})
+
+    def test_malformed_annotation(self, algo):
+        from hivedscheduler_tpu.api import constants as C
+        from hivedscheduler_tpu.k8s.types import Container
+
+        pod = Pod(
+            name="mal", uid="mal",
+            annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: "{not: [valid"},
+            containers=[Container(
+                resource_limits={C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})],
+        )
+        with pytest.raises(api.WebServerError) as e:
+            algo.schedule(pod, all_node_names(algo), FILTERING_PHASE)
+        assert 400 <= e.value.code < 500
+
+    def test_user_errors_leave_no_state(self, algo):
+        """A rejected request must not leak a group or touch the free lists."""
+        before = {
+            (chain, lv): len(ccl[lv])
+            for chain, ccl in algo.free_cell_list.items() for lv in sorted(ccl)
+        }
+        for spec_dict in (
+            {"virtualCluster": "ghost", "priority": 0, "chipNumber": 1},
+            {"virtualCluster": "vc1", "priority": 1001, "chipNumber": 1},
+            {"virtualCluster": "vc1", "priority": 1,
+             "pinnedCellId": "surprise!", "chipNumber": 1},
+        ):
+            with pytest.raises(api.WebServerError):
+                algo.schedule(make_pod("bad", spec_dict), all_node_names(algo),
+                              FILTERING_PHASE)
+        after = {
+            (chain, lv): len(ccl[lv])
+            for chain, ccl in algo.free_cell_list.items() for lv in sorted(ccl)
+        }
+        assert after == before
+        assert algo.get_all_affinity_groups() == []
+
 
 # ---------------------------------------------------------------------------
 # preemption
