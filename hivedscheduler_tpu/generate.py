@@ -37,6 +37,10 @@ def main(argv=None) -> int:
     parser.add_argument("--moe-top-k", type=int, default=1)
     parser.add_argument("--checkpoint-dir", default="",
                         help="restore params from a training checkpoint")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel serving over a tp mesh axis")
+    parser.add_argument("--dp", type=int, default=1,
+                        help="batch-parallel serving over a dp mesh axis")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -78,10 +82,26 @@ def main(argv=None) -> int:
         0, cfg.vocab_size, jnp.int32,
     )
     key = jax.random.PRNGKey(args.seed + 2) if args.temperature > 0 else None
-    out = decode.generate(
-        params, prompt, cfg, args.new_tokens,
-        temperature=args.temperature, key=key,
-    )
+    if args.tp > 1 or args.dp > 1:
+        from hivedscheduler_tpu.parallel import topology
+
+        if args.batch % args.dp:
+            log.error("--batch %s must be divisible by --dp %s",
+                      args.batch, args.dp)
+            return 1
+        axes = topology.MeshAxes(dp=args.dp, tp=args.tp)
+        mesh = topology.make_mesh(axes, topology.get_devices(axes.size))
+        run, param_shardings, prompt_sharding = decode.make_sharded_generate(
+            cfg, mesh, args.new_tokens, temperature=args.temperature,
+        )
+        params = jax.device_put(params, param_shardings)
+        prompt = jax.device_put(prompt, prompt_sharding)
+        out = run(params, prompt, key)
+    else:
+        out = decode.generate(
+            params, prompt, cfg, args.new_tokens,
+            temperature=args.temperature, key=key,
+        )
     for row in jax.device_get(out):
         print(" ".join(str(int(t)) for t in row))
     return 0

@@ -190,3 +190,45 @@ def generate(
 
     (_, _), toks = lax.scan(step, (last, cache), keys)
     return jnp.swapaxes(toks, 0, 1)  # [B, max_new]
+
+
+def make_sharded_generate(
+    cfg: TransformerConfig,
+    mesh,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+):
+    """Sharded serving: returns (jitted_generate, param_shardings,
+    prompt_sharding). Params laid out by ``transformer.sharding_specs``
+    (tp shards heads/ff — the decode einsums then run tensor-parallel under
+    GSPMD, with the kv cache sharded over the compact head axis), prompts
+    over dp. ``jitted_generate(params, prompt, key)`` -> [B, max_new]
+    (pass ``key=None`` for greedy)."""
+    import functools
+
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from hivedscheduler_tpu.models import transformer as tm
+
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tp = mesh_shape.get("tp", 1)
+    if cfg.n_heads % tp or cfg.kv_heads % tp:
+        raise ValueError(
+            f"head counts must divide the tp axis: n_heads={cfg.n_heads}, "
+            f"kv_heads={cfg.kv_heads}, tp={tp}"
+        )
+    param_specs = tm.sharding_specs(cfg)
+    param_shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), param_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    prompt_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+
+    run = functools.partial(
+        generate, cfg=cfg, max_new_tokens=max_new_tokens,
+        temperature=temperature,
+    )
+    jitted = jax.jit(lambda params, prompt, key=None: run(params, prompt, key=key))
+    return jitted, param_shardings, prompt_sharding

@@ -111,6 +111,33 @@ class TestKVCacheDecode:
             np.asarray(out1), np.asarray(seq[:, 5:])
         )
 
+    def test_tp_sharded_generate_matches_single_device(self):
+        """Tensor-parallel serving: greedy tokens from a dp=2 x tp=2 mesh
+        must equal the single-device decode exactly."""
+        from hivedscheduler_tpu.parallel import topology
+
+        cfg = cfg_of(n_kv_heads=2)
+        params, prompt = setup(cfg, t=5)
+        ref = decode.generate(params, prompt, cfg, max_new_tokens=6)
+        axes = topology.MeshAxes(dp=2, tp=2)
+        mesh = topology.make_mesh(axes, topology.get_devices(axes.size))
+        run, param_sh, prompt_sh = decode.make_sharded_generate(
+            cfg, mesh, max_new_tokens=6
+        )
+        sharded_params = jax.device_put(params, param_sh)
+        sharded_prompt = jax.device_put(prompt, prompt_sh)
+        out = run(sharded_params, sharded_prompt)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_sharded_generate_rejects_indivisible_heads(self):
+        from hivedscheduler_tpu.parallel import topology
+
+        cfg = cfg_of(n_kv_heads=1)
+        axes = topology.MeshAxes(tp=2)
+        mesh = topology.make_mesh(axes, topology.get_devices(axes.size))
+        with pytest.raises(ValueError, match="divide the tp axis"):
+            decode.make_sharded_generate(cfg, mesh, max_new_tokens=4)
+
     def test_sampled_generate_runs(self):
         cfg = cfg_of()
         params, prompt = setup(cfg, t=4)
