@@ -396,7 +396,75 @@ def update_used_leaf_cell_num_at_priority(c: Optional[Cell], p: CellPriority, in
         c = c.parent
 
 
-def allocate_cell_walk(c: Cell, p: CellPriority) -> None:
+class UsedCountBatch:
+    """Deferred used-leaf-cell-count updates for whole-gang bookkeeping.
+
+    A 256-leaf gang runs one leaf->root walk per leaf per tree; the count
+    half of those walks writes the same ancestor dicts 256 times.  Group
+    lifecycle operations (create/delete allocated or preempting groups, lazy
+    preemption) instead collect per-leaf ``(cell, priority, delta)`` records
+    and :meth:`flush` applies the *sums* bottom-up, one dict update per
+    distinct ancestor — O(distinct cells) instead of O(leaves x depth).
+
+    Deferral is observationally safe because nothing inside those loops reads
+    ``used_leaf_cell_num_at_priorities``: the readers (cluster-view sorting in
+    ``topology_aware``, candidate ranking in ``get_usable_physical_cells``,
+    multi-chain capacity ranking, inspect) all run outside an open batch
+    window, and priority/binding/free-list state keeps updating per leaf.
+    Guard: ``tests/test_walk_fusion.py::test_batched_walks_match_composition``.
+    """
+
+    __slots__ = ("_groups",)
+
+    def __init__(self) -> None:
+        # priority -> {id(cell): [cell, signed count]} — merged at add time,
+        # so N same-priority ops on one leaf collapse to a single entry
+        self._groups: Dict[CellPriority, Dict[int, list]] = {}
+
+    def add(self, c: Cell, p: CellPriority, delta: int) -> None:
+        g = self._groups.get(p)
+        if g is None:
+            g = self._groups[p] = {}
+        e = g.get(id(c))
+        if e is None:
+            g[id(c)] = [c, delta]
+        else:
+            e[1] += delta
+
+    def flush(self) -> None:
+        if not self._groups:
+            return
+        groups, self._groups = self._groups, {}
+        for p, frontier in groups.items():
+            # propagate strictly by level so a parent receives every child's
+            # contribution before its own dict is touched (virtual and
+            # physical cells mix freely: parent chains are independent)
+            by_level: Dict[CellLevel, Dict[int, list]] = {}
+            for e in frontier.values():
+                by_level.setdefault(e[0].level, {})[id(e[0])] = e
+            while by_level:
+                l = min(by_level)
+                for c, n in by_level.pop(l).values():
+                    if n:
+                        counts = c.used_leaf_cell_num_at_priorities
+                        m = counts.get(p, 0) + n
+                        if m == 0:
+                            counts.pop(p, None)
+                        else:
+                            counts[p] = m
+                    parent = c.parent
+                    if parent is not None:
+                        lv = by_level.setdefault(parent.level, {})
+                        e = lv.get(id(parent))
+                        if e is None:
+                            lv[id(parent)] = [parent, n]
+                        else:
+                            e[1] += n
+
+
+def allocate_cell_walk(
+    c: Cell, p: CellPriority, batch: Optional[UsedCountBatch] = None
+) -> None:
     """Fused ``set_cell_priority(c, p)`` + ``update_used_leaf_cell_num_at_priority
     (c, p, True)`` in one leaf->root walk — the leaf-allocation hot path runs
     both over the same ancestor chain, and the two touch disjoint state
@@ -405,7 +473,16 @@ def allocate_cell_walk(c: Cell, p: CellPriority) -> None:
 
     The fast path assumes a pure priority *raise* (``p >= c.priority`` — always
     true when allocating a free leaf); anything else falls back to the exact
-    two-step composition."""
+    two-step composition.
+
+    With ``batch``, the count half is deferred to ``batch.flush()`` and the
+    priority half is exactly ``set_cell_priority`` (which early-exits as soon
+    as an ancestor already holds priority >= p, so the 2nd..Nth leaf of a
+    gang stops after a step or two)."""
+    if batch is not None:
+        batch.add(c, p, 1)
+        set_cell_priority(c, p)
+        return
     if p < c.priority:
         set_cell_priority(c, p)
         update_used_leaf_cell_num_at_priority(c, p, True)
@@ -428,11 +505,21 @@ def allocate_cell_walk(c: Cell, p: CellPriority) -> None:
         cur = cur.parent
 
 
-def release_cell_walk(c: Cell, old_p: CellPriority) -> None:
+def release_cell_walk(
+    c: Cell, old_p: CellPriority, batch: Optional[UsedCountBatch] = None
+) -> None:
     """Fused ``update_used_leaf_cell_num_at_priority(c, old_p, False)`` +
     ``set_cell_priority(c, FREE_PRIORITY)`` in one leaf->root walk (the
     leaf-release hot path); same disjoint-state argument as
-    ``allocate_cell_walk``, guarded by ``tests/test_walk_fusion.py``."""
+    ``allocate_cell_walk``, guarded by ``tests/test_walk_fusion.py``.
+
+    With ``batch``, the count half is deferred to ``batch.flush()`` and the
+    priority half is exactly ``set_cell_priority(c, FREE_PRIORITY)`` (which
+    stops as soon as the downgrade no longer changes an ancestor)."""
+    if batch is not None:
+        batch.add(c, old_p, -1)
+        set_cell_priority(c, FREE_PRIORITY)
+        return
     target = FREE_PRIORITY
     prio_active = True
     cur: Optional[Cell] = c

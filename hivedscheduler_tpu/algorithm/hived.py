@@ -29,6 +29,7 @@ from hivedscheduler_tpu.algorithm.cell import (
     VirtualCell,
 )
 from hivedscheduler_tpu.algorithm.cell_allocation import (
+    UsedCountBatch,
     allocate_cell_walk,
     bind_cell,
     get_unbound_virtual_cell,
@@ -1112,6 +1113,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
             s.ignore_k8s_suggested_nodes, s.priority, GROUP_ALLOCATED,
         )
         should_lazy_preempt = False
+        batch = UsedCountBatch()
         for gms in info.affinity_group_bind_info:
             leaf_cell_number = len(gms.pod_placements[0].physical_leaf_cell_indices)
             for pod_index in range(len(gms.pod_placements)):
@@ -1165,13 +1167,14 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     else:
                         should_lazy_preempt = should_lazy_preempt or lazy_preempt
                     safety_ok, reason = self._allocate_leaf_cell(
-                        p_leaf_cell, v_leaf_cell, s.priority, new_group.vc
+                        p_leaf_cell, v_leaf_cell, s.priority, new_group.vc, batch
                     )
                     p_leaf_cell.add_using_group(new_group)
                     set_cell_state(p_leaf_cell, CELL_USED)
                     if not safety_ok:
                         should_lazy_preempt = True
                         log.warning("[%s]: %s", internal_utils.key(pod), reason)
+        batch.flush()
         if should_lazy_preempt:
             self._lazy_preempt_affinity_group(new_group, new_group.name)
         self.affinity_groups[s.affinity_group.name] = new_group
@@ -1182,6 +1185,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
         """Reference: deleteAllocatedAffinityGroup, hived_algorithm.go:1045-1070."""
         log.info("[%s]: All pods complete, deleting allocated affinity group: %s",
                  internal_utils.key(pod), g.name)
+        batch = UsedCountBatch()
         for pod_placements in g.physical_leaf_cell_placement.values():
             for pod_placement in pod_placements:
                 for leaf_cell in pod_placement:
@@ -1190,10 +1194,11 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     assert isinstance(leaf_cell, PhysicalCell)
                     leaf_cell.delete_using_group(g)
                     if leaf_cell.state == CELL_USED:
-                        self._release_leaf_cell(leaf_cell, g.vc)
+                        self._release_leaf_cell(leaf_cell, g.vc, batch)
                         set_cell_state(leaf_cell, CELL_FREE)
                     else:  # Reserving: already allocated to the reserving group
                         set_cell_state(leaf_cell, CELL_RESERVED)
+        batch.flush()
         del self.affinity_groups[g.name]
         log.info("[%s]: Allocated affinity group deleted: %s",
                  internal_utils.key(pod), g.name)
@@ -1216,6 +1221,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
         )
         new_group.physical_leaf_cell_placement = physical_placement
         new_group.virtual_leaf_cell_placement = virtual_placement
+        batch = UsedCountBatch()
         for leaf_cell_num, pod_placements in physical_placement.items():
             for pod_index, pod_placement in enumerate(pod_placements):
                 for leaf_cell_index, leaf_cell in enumerate(pod_placement):
@@ -1224,9 +1230,11 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     assert isinstance(v_leaf_cell, VirtualCell)
                     if leaf_cell.state == CELL_USED:
                         using_group = leaf_cell.using_group
-                        self._release_leaf_cell(leaf_cell, using_group.vc)
+                        self._release_leaf_cell(leaf_cell, using_group.vc, batch)
                         using_group.state = GROUP_BEING_PREEMPTED
-                    self._allocate_leaf_cell(leaf_cell, v_leaf_cell, s.priority, new_group.vc)
+                    self._allocate_leaf_cell(
+                        leaf_cell, v_leaf_cell, s.priority, new_group.vc, batch
+                    )
                     leaf_cell.add_reserving_or_reserved_group(new_group)
                     # cell is Used or Free here (Reserving/Reserved preemptors
                     # were canceled before in schedule())
@@ -1234,6 +1242,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
                         set_cell_state(leaf_cell, CELL_RESERVING)
                     else:
                         set_cell_state(leaf_cell, CELL_RESERVED)
+        batch.flush()
         new_group.preempting_pods[pod.uid] = pod
         self.affinity_groups[s.affinity_group.name] = new_group
         log.info("[%s]: New preempting affinity group created: %s",
@@ -1243,11 +1252,12 @@ class HivedAlgorithm(SchedulerAlgorithm):
         """Revoke a preemption; Reserving cells return to the being-preempted
         group (reference: deletePreemptingAffinityGroup,
         hived_algorithm.go:1116-1144)."""
+        batch = UsedCountBatch()
         for pod_placements in g.physical_leaf_cell_placement.values():
             for pod_placement in pod_placements:
                 for leaf_cell in pod_placement:
                     assert isinstance(leaf_cell, PhysicalCell)
-                    self._release_leaf_cell(leaf_cell, g.vc)
+                    self._release_leaf_cell(leaf_cell, g.vc, batch)
                     leaf_cell.delete_reserving_or_reserved_group(
                         leaf_cell.reserving_or_reserved_group
                     )
@@ -1263,10 +1273,11 @@ class HivedAlgorithm(SchedulerAlgorithm):
                             )
                         self._allocate_leaf_cell(
                             leaf_cell, being_preempted_v, being_preempted.priority,
-                            being_preempted.vc,
+                            being_preempted.vc, batch,
                         )
                     else:  # Reserved
                         set_cell_state(leaf_cell, CELL_FREE)
+        batch.flush()
         del self.affinity_groups[g.name]
         log.info("[%s]: Preempting affinity group %s deleted",
                  internal_utils.key(pod), g.name)
@@ -1290,16 +1301,18 @@ class HivedAlgorithm(SchedulerAlgorithm):
     ) -> Optional[GroupVirtualPlacement]:
         """Demote a group to opportunistic (reference:
         lazyPreemptAffinityGroup, hived_algorithm.go:1166-1189)."""
+        batch = UsedCountBatch()
         for pod_virtual_placements in (victim.virtual_leaf_cell_placement or {}).values():
             for pod_virtual_placement in pod_virtual_placements:
                 for leaf_cell in pod_virtual_placement:
                     if leaf_cell is not None:
                         assert isinstance(leaf_cell, VirtualCell)
                         p_leaf_cell = leaf_cell.physical_cell
-                        self._release_leaf_cell(p_leaf_cell, victim.vc)
+                        self._release_leaf_cell(p_leaf_cell, victim.vc, batch)
                         self._allocate_leaf_cell(
-                            p_leaf_cell, None, OPPORTUNISTIC_PRIORITY, victim.vc
+                            p_leaf_cell, None, OPPORTUNISTIC_PRIORITY, victim.vc, batch
                         )
+        batch.flush()
         original = victim.virtual_leaf_cell_placement
         victim.virtual_leaf_cell_placement = None
         victim.placement_version += 1
@@ -1322,6 +1335,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
         self, g: AlgoAffinityGroup, virtual_placement: GroupVirtualPlacement
     ) -> None:
         """Reference: revertLazyPreempt, hived_algorithm.go:1202-1219."""
+        batch = UsedCountBatch()
         for leaf_cell_num, pod_placements in g.physical_leaf_cell_placement.items():
             for pod_index, pod_placement in enumerate(pod_placements):
                 for leaf_cell_index, leaf_cell in enumerate(pod_placement):
@@ -1330,8 +1344,9 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     assert isinstance(leaf_cell, PhysicalCell)
                     v_leaf_cell = virtual_placement[leaf_cell_num][pod_index][leaf_cell_index]
                     assert isinstance(v_leaf_cell, VirtualCell)
-                    self._release_leaf_cell(leaf_cell, g.vc)
-                    self._allocate_leaf_cell(leaf_cell, v_leaf_cell, g.priority, g.vc)
+                    self._release_leaf_cell(leaf_cell, g.vc, batch)
+                    self._allocate_leaf_cell(leaf_cell, v_leaf_cell, g.priority, g.vc, batch)
+        batch.flush()
         g.virtual_leaf_cell_placement = virtual_placement
         g.placement_version += 1
         g.lazy_preemption_status = None
@@ -1449,12 +1464,13 @@ class HivedAlgorithm(SchedulerAlgorithm):
         v_leaf_cell: Optional[VirtualCell],
         p: CellPriority,
         vcn: str,
+        batch: Optional[UsedCountBatch] = None,
     ) -> Tuple[bool, str]:
         """Reference: allocateLeafCell, hived_algorithm.go:1294-1323."""
         safety_ok, reason = True, ""
         if v_leaf_cell is not None:
-            allocate_cell_walk(v_leaf_cell, p)
-            allocate_cell_walk(p_leaf_cell, p)
+            allocate_cell_walk(v_leaf_cell, p, batch)
+            allocate_cell_walk(p_leaf_cell, p, batch)
             pac = v_leaf_cell.preassigned_cell
             preassigned_newly_bound = pac.physical_cell is None
             if p_leaf_cell.virtual_cell is None:
@@ -1465,18 +1481,23 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     pac.physical_cell, vcn, doomed_bad=False
                 )
         else:
-            allocate_cell_walk(p_leaf_cell, OPPORTUNISTIC_PRIORITY)
+            allocate_cell_walk(p_leaf_cell, OPPORTUNISTIC_PRIORITY, batch)
             p_leaf_cell.api_status.vc = vcn
             self.api_cluster_status.virtual_clusters[vcn].append(
                 generate_ot_virtual_cell(p_leaf_cell.api_status)
             )
         return safety_ok, reason
 
-    def _release_leaf_cell(self, p_leaf_cell: PhysicalCell, vcn: str) -> None:
+    def _release_leaf_cell(
+        self,
+        p_leaf_cell: PhysicalCell,
+        vcn: str,
+        batch: Optional[UsedCountBatch] = None,
+    ) -> None:
         """Reference: releaseLeafCell, hived_algorithm.go:1327-1352."""
         v_leaf_cell = p_leaf_cell.virtual_cell
         if v_leaf_cell is not None:
-            release_cell_walk(v_leaf_cell, v_leaf_cell.priority)
+            release_cell_walk(v_leaf_cell, v_leaf_cell.priority, batch)
             preassigned_physical = v_leaf_cell.preassigned_cell.physical_cell
             if p_leaf_cell.healthy:
                 # keep the binding if the cell is bad
@@ -1494,7 +1515,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
             self.api_cluster_status.virtual_clusters[vcn] = delete_ot_virtual_cell(
                 self.api_cluster_status.virtual_clusters[vcn], p_leaf_cell.address
             )
-        release_cell_walk(p_leaf_cell, p_leaf_cell.priority)
+        release_cell_walk(p_leaf_cell, p_leaf_cell.priority, batch)
 
     def _allocate_preassigned_cell(
         self, c: PhysicalCell, vcn: str, doomed_bad: bool

@@ -89,11 +89,17 @@ def main(argv=None) -> int:
             log.error("--batch %s must be divisible by --dp %s",
                       args.batch, args.dp)
             return 1
-        axes = topology.MeshAxes(dp=args.dp, tp=args.tp)
-        mesh = topology.make_mesh(axes, topology.get_devices(axes.size))
-        run, param_shardings, prompt_sharding = decode.make_sharded_generate(
-            cfg, mesh, args.new_tokens, temperature=args.temperature,
-        )
+        try:
+            axes = topology.MeshAxes(dp=args.dp, tp=args.tp)
+            mesh = topology.make_mesh(axes, topology.get_devices(axes.size))
+            run, param_shardings, prompt_sharding = decode.make_sharded_generate(
+                cfg, mesh, args.new_tokens, temperature=args.temperature,
+            )
+        except ValueError as e:
+            # user errors (head counts vs --tp, device count vs --tp/--dp)
+            # get the same one-line treatment as the --batch/--dp check
+            log.error("%s", e)
+            return 1
         params = jax.device_put(params, param_shardings)
         prompt = jax.device_put(prompt, prompt_sharding)
         out = run(params, prompt, key)

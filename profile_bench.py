@@ -1,0 +1,48 @@
+"""Profile one measured 256-chip gang decision from bench.py's scenario.
+
+Usage: python profile_bench.py [--deletes] [--sort tottime] [--rows 40]
+Not part of the shipped package; a dev tool for finding scheduling fat.
+"""
+
+import cProfile
+import pstats
+import sys
+
+import bench
+
+
+def main():
+    rows = 40
+    sort = "cumtime"
+    if "--sort" in sys.argv:
+        sort = sys.argv[sys.argv.index("--sort") + 1]
+    if "--rows" in sys.argv:
+        rows = int(sys.argv[sys.argv.index("--rows") + 1])
+    deletes = "--deletes" in sys.argv
+
+    cluster = bench.Cluster()
+    # warm-up: one full gang, freed again
+    cluster.schedule_gang("vc-a", 10, "warm", 64, 4, allow_preempt=True)
+    cluster.free_gang("warm")
+
+    pr = cProfile.Profile()
+    if deletes:
+        for i in range(8):
+            cluster.schedule_gang("vc-a", 10, f"g{i}", 64, 4, allow_preempt=True)
+            pr.enable()
+            cluster.free_gang(f"g{i}")
+            pr.disable()
+    else:
+        pr.enable()
+        for i in range(8):
+            cluster.schedule_gang("vc-a", 10, f"g{i}", 64, 4, allow_preempt=True)
+            pr.disable()
+            cluster.free_gang(f"g{i}")
+            pr.enable()
+        pr.disable()
+    stats = pstats.Stats(pr)
+    stats.sort_stats(sort).print_stats(rows)
+
+
+if __name__ == "__main__":
+    main()

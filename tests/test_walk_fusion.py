@@ -99,3 +99,44 @@ def test_fused_alloc_falls_back_on_priority_drop():
     allocate_cell_walk(leaf, 1)
     _composed_alloc(leaf2, 1)
     assert _snapshot(ccl) == _snapshot(ccl2)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_batched_walks_match_composition(seed):
+    """Group-lifecycle loops defer the count half of the walks to a
+    ``UsedCountBatch`` flushed once per gang; after each flush the whole tree
+    state must equal the exact per-leaf two-step composition."""
+    from hivedscheduler_tpu.algorithm.cell_allocation import UsedCountBatch
+
+    batch_ccl, comp_ccl = _fresh_tree(), _fresh_tree()
+    batch_leaves, comp_leaves = _leaves(batch_ccl), _leaves(comp_ccl)
+
+    rng = random.Random(seed)
+    allocated = {}  # index -> priority
+    for gang in range(60):
+        batch = UsedCountBatch()
+        # a "gang": several leaf ops deferred into one flush, like
+        # _create/_delete_allocated_affinity_group do
+        n_ops = rng.randint(1, 6)
+        for _ in range(n_ops):
+            if allocated and (rng.random() < 0.45 or len(allocated) == len(batch_leaves)):
+                i = rng.choice(list(allocated))
+                allocated.pop(i)
+                release_cell_walk(batch_leaves[i], batch_leaves[i].priority, batch)
+                _composed_release(comp_leaves[i], comp_leaves[i].priority)
+            else:
+                free = [i for i in range(len(batch_leaves)) if i not in allocated]
+                i = rng.choice(free)
+                p = rng.choice([-1, 0, 1, 5, 10, 1000])
+                allocated[i] = p
+                allocate_cell_walk(batch_leaves[i], p, batch)
+                _composed_alloc(comp_leaves[i], p)
+            # priorities (and their api mirrors) must be exact mid-batch:
+            # the group loops read them between leaves
+            prio = [(c.address, c.priority, c.api_status.cell_priority)
+                    for lv in sorted(batch_ccl) for c in batch_ccl[lv]]
+            prio2 = [(c.address, c.priority, c.api_status.cell_priority)
+                     for lv in sorted(comp_ccl) for c in comp_ccl[lv]]
+            assert prio == prio2, f"priorities diverged mid-batch at gang {gang}"
+        batch.flush()
+        assert _snapshot(batch_ccl) == _snapshot(comp_ccl), f"diverged after gang {gang}"
