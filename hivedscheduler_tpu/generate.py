@@ -25,6 +25,11 @@ def main(argv=None) -> int:
     parser.add_argument("--new-tokens", type=int, default=32)
     parser.add_argument("--temperature", type=float, default=0.0,
                         help="0 = greedy")
+    parser.add_argument("--top-k", type=int, default=0,
+                        help="sample only from the k most likely tokens (0 = off)")
+    parser.add_argument("--top-p", type=float, default=1.0,
+                        help="nucleus sampling: smallest prefix with cumulative "
+                        "probability >= p (1.0 = off)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--vocab-size", type=int, default=32000)
     parser.add_argument("--d-model", type=int, default=512)
@@ -41,6 +46,13 @@ def main(argv=None) -> int:
                         help="tensor-parallel serving over a tp mesh axis")
     parser.add_argument("--dp", type=int, default=1,
                         help="batch-parallel serving over a dp mesh axis")
+    parser.add_argument("--draft-layers", type=int, default=0,
+                        help="speculative decoding: layers of the draft model "
+                        "(0 = off; demo uses random draft weights)")
+    parser.add_argument("--draft-d-model", type=int, default=0,
+                        help="draft width (default: half the target)")
+    parser.add_argument("--gamma", type=int, default=4,
+                        help="draft tokens proposed per verification round")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -81,7 +93,49 @@ def main(argv=None) -> int:
         jax.random.PRNGKey(args.seed + 1), (args.batch, args.prompt_len),
         0, cfg.vocab_size, jnp.int32,
     )
+    if args.top_k > cfg.vocab_size:
+        log.error("--top-k %s exceeds --vocab-size %s", args.top_k, cfg.vocab_size)
+        return 1
     key = jax.random.PRNGKey(args.seed + 2) if args.temperature > 0 else None
+    if args.draft_layers > 0:
+        if args.tp > 1 or args.dp > 1:
+            log.error("--draft-layers does not compose with --tp/--dp yet")
+            return 1
+        if args.gamma < 1:
+            log.error("--gamma must be >= 1, got %s", args.gamma)
+            return 1
+        import dataclasses
+
+        from hivedscheduler_tpu.models.speculative import generate_speculative
+
+        # derived default width: ~half the target, rounded up so head_dim
+        # stays an even integer (RoPE rotates sin/cos pairs)
+        quantum = 2 * args.n_heads
+        d_model = args.draft_d_model or max(64, args.d_model // 2)
+        if not args.draft_d_model:
+            d_model = -(-d_model // quantum) * quantum
+        if d_model % quantum:
+            log.error("--draft-d-model %s must be a multiple of 2*--n-heads "
+                      "(%s): RoPE needs an even head_dim", d_model, quantum)
+            return 1
+        dft_cfg = dataclasses.replace(
+            cfg, n_layers=args.draft_layers, d_model=d_model,
+            d_ff=2 * d_model, n_experts=0, n_kv_heads=0,
+        )
+        dft_params = tm.init_params(dft_cfg, jax.random.PRNGKey(args.seed + 3))
+        out, stats = generate_speculative(
+            params, dft_params, prompt, cfg, dft_cfg, args.new_tokens,
+            gamma=args.gamma, temperature=args.temperature,
+            top_k=args.top_k, top_p=args.top_p, key=key,
+        )
+        log.info(
+            "speculation: %s rounds, %s/%s draft tokens accepted (%.0f%%)",
+            int(stats.rounds), int(stats.accepted), int(stats.drafted),
+            100.0 * int(stats.accepted) / max(1, int(stats.drafted)),
+        )
+        for row in jax.device_get(out):
+            print(" ".join(str(int(t)) for t in row))
+        return 0
     if args.tp > 1 or args.dp > 1:
         from hivedscheduler_tpu.parallel import topology
 
@@ -94,6 +148,7 @@ def main(argv=None) -> int:
             mesh = topology.make_mesh(axes, topology.get_devices(axes.size))
             run, param_shardings, prompt_sharding = decode.make_sharded_generate(
                 cfg, mesh, args.new_tokens, temperature=args.temperature,
+                top_k=args.top_k, top_p=args.top_p,
             )
         except ValueError as e:
             # user errors (head counts vs --tp, device count vs --tp/--dp)
@@ -106,7 +161,8 @@ def main(argv=None) -> int:
     else:
         out = decode.generate(
             params, prompt, cfg, args.new_tokens,
-            temperature=args.temperature, key=key,
+            temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+            key=key,
         )
     for row in jax.device_get(out):
         print(" ".join(str(int(t)) for t in row))

@@ -144,6 +144,32 @@ def advance(
     return logits, new_cache
 
 
+def filter_logits(logits: jax.Array, top_k: int = 0, top_p: float = 1.0) -> jax.Array:
+    """Nucleus/top-k filtering on ``logits`` [..., V]: everything outside the
+    top-k entries (if ``top_k`` > 0) and outside the smallest prefix of the
+    sorted distribution with cumulative probability >= ``top_p`` (if
+    ``top_p`` < 1) is masked to -inf. Static-shape, jit-friendly (sort +
+    mask, no dynamic vocab slicing); filters compose k-then-p like the
+    standard HF sampling processors."""
+    if top_k > 0:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep every token up to AND including the one that crosses top_p;
+        # the most likely token is always kept (top_p <= 0 would otherwise
+        # mask the whole vocabulary)
+        keep_sorted = (cum - probs) < top_p
+        keep_sorted = keep_sorted.at[..., 0].set(True)
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, NEG_INF, logits)
+    return logits
+
+
 def generate(
     params: Dict[str, Any],
     prompt: jax.Array,
@@ -151,12 +177,16 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
 ) -> jax.Array:
-    """Greedy (temperature 0) or sampled continuation of ``prompt`` [B, T].
-    Returns [B, max_new_tokens]. The whole decode loop is one ``lax.scan``
-    over a fixed-shape cached step, so it stays inside a single jit."""
+    """Greedy (temperature 0) or sampled continuation of ``prompt`` [B, T],
+    with optional top-k / nucleus (top-p) filtering of the sampled
+    distribution. Returns [B, max_new_tokens]. The whole decode loop is one
+    ``lax.scan`` over a fixed-shape cached step, so it stays inside a single
+    jit."""
     b, t = prompt.shape
     total = t + max_new_tokens
     if max_len is None:
@@ -173,7 +203,7 @@ def generate(
         if temperature == 0.0:
             return jnp.argmax(logits_b, axis=-1).astype(prompt.dtype)
         return jax.random.categorical(
-            k, logits_b / temperature, axis=-1
+            k, filter_logits(logits_b / temperature, top_k, top_p), axis=-1
         ).astype(prompt.dtype)
 
     keys = (
@@ -198,6 +228,8 @@ def make_sharded_generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ):
     """Sharded serving: returns (jitted_generate, param_shardings,
     prompt_sharding). Params laid out by ``transformer.sharding_specs``
@@ -228,7 +260,7 @@ def make_sharded_generate(
 
     run = functools.partial(
         generate, cfg=cfg, max_new_tokens=max_new_tokens,
-        temperature=temperature,
+        temperature=temperature, top_k=top_k, top_p=top_p,
     )
     jitted = jax.jit(lambda params, prompt, key=None: run(params, prompt, key=key))
     return jitted, param_shardings, prompt_sharding

@@ -1,0 +1,211 @@
+"""Speculative decoding: a small draft model proposes ``gamma`` tokens per
+round, the target model verifies them in ONE batched forward, and rejection
+sampling keeps the output distribution exactly the target's (greedy output is
+bit-identical to target-only greedy decoding — guard:
+``tests/test_speculative.py::test_greedy_matches_vanilla``).
+
+The reference scheduler has no model runtime; this extends the workload
+runtime's serving path (``models/decode.py``). TPU-first choices:
+
+- **Static shapes end to end**: the whole loop is one ``lax.while_loop``;
+  each round runs the draft ``gamma+1`` single-token steps and the target one
+  ``S=gamma+1`` step — both fixed-shape compiled programs. Variable
+  acceptance is handled by rolling the KV-cache ``length`` back (stale cache
+  entries beyond ``length`` are masked by the causal position test in
+  ``decode._cached_attention``, so rollback is O(1) — no copies).
+- **Verification rides the MXU**: the target scores all gamma+1 positions in
+  one call, turning gamma sequential target steps into one matmul-batched
+  step — the whole point of speculation on hardware whose matmuls are cheap
+  and whose per-step latency is HBM-bound.
+- **Full-batch**: per-sequence acceptance lengths are aligned by truncating
+  every sequence to the round's minimum accepted prefix; truncated-but-
+  accepted draft tokens are still emitted verbatim (they passed their own
+  acceptance test, so the per-sequence output law is unchanged), which keeps
+  one scalar cache length for the whole batch.
+
+Acceptance rule (the standard speculative-sampling one): draft token t_j is
+accepted iff u < p_target(t_j)/p_draft(t_j); on rejection the replacement is
+sampled from norm(max(p_target - p_draft, 0)); if all gamma are accepted a
+bonus token is sampled from the target's gamma+1-th distribution.
+Temperature/top-k/top-p filters apply to BOTH models' logits, so exactness
+holds w.r.t. the *filtered* target distribution.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from hivedscheduler_tpu.models.decode import (
+    KVCache,
+    advance,
+    filter_logits,
+    init_kv_cache,
+)
+from hivedscheduler_tpu.models.transformer import TransformerConfig
+
+
+class SpecStats(NamedTuple):
+    """Per-run speculation counters (all scalars): verification rounds,
+    draft tokens proposed, draft tokens accepted. acceptance rate =
+    accepted/drafted; tokens per target step ~ emitted/rounds."""
+
+    rounds: jax.Array
+    drafted: jax.Array
+    accepted: jax.Array
+
+
+def generate_speculative(
+    target_params: Dict[str, Any],
+    draft_params: Dict[str, Any],
+    prompt: jax.Array,
+    target_cfg: TransformerConfig,
+    draft_cfg: TransformerConfig,
+    max_new_tokens: int,
+    *,
+    gamma: int = 4,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    key: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, SpecStats]:
+    """Speculative continuation of ``prompt`` [B, T] -> ([B, max_new_tokens],
+    SpecStats). ``temperature == 0`` decodes greedily (and is bit-identical
+    to ``decode.generate``'s greedy output); sampling needs ``key``. The two
+    configs must share the vocab; everything else (depth/width/heads) may
+    differ."""
+    assert target_cfg.vocab_size == draft_cfg.vocab_size, "vocabs must match"
+    assert gamma >= 1, gamma
+    assert temperature == 0.0 or key is not None, (
+        "sampling (temperature > 0) needs a PRNG key"
+    )
+    b, t = prompt.shape
+    vocab = target_cfg.vocab_size
+    # headroom: a round may absorb gamma+1 tokens past the accepted prefix
+    # before rolling back
+    max_len = t + max_new_tokens + gamma + 1
+    greedy = temperature == 0.0
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused on the greedy path
+
+    def probs_of(logits):
+        # filtered, temperature-scaled distribution in f32
+        if greedy:
+            return jax.nn.softmax(logits, axis=-1)
+        return jax.nn.softmax(
+            filter_logits(logits / temperature, top_k, top_p), axis=-1
+        )
+
+    def pick(p, k):
+        if greedy:
+            return jnp.argmax(p, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(k, jnp.log(p), axis=-1).astype(jnp.int32)
+
+    # prefill both models on the full prompt; invariant from here on: both
+    # caches have absorbed the same prefix and last_tok is NOT absorbed yet
+    tgt_cache = init_kv_cache(target_cfg, b, max_len)
+    dft_cache = init_kv_cache(draft_cfg, b, max_len)
+    tgt_logits, tgt_cache = advance(target_params, tgt_cache, prompt, target_cfg)
+    _, dft_cache = advance(draft_params, dft_cache, prompt, draft_cfg)
+    key, k0 = jax.random.split(key)
+    first = pick(probs_of(tgt_logits[:, -1]), k0)
+
+    buf = jnp.zeros((b, max_new_tokens + gamma + 1), jnp.int32)
+    buf = buf.at[:, 0].set(first)
+    stats0 = SpecStats(
+        rounds=jnp.zeros((), jnp.int32),
+        drafted=jnp.zeros((), jnp.int32),
+        accepted=jnp.zeros((), jnp.int32),
+    )
+
+    def round_body(state):
+        tgt_cache, dft_cache, last_tok, buf, n_out, key, stats = state
+        key, kd, ka, kr = jax.random.split(key, 4)
+
+        # --- draft: propose gamma tokens (gamma single-token steps), plus
+        # one extra step so the draft cache absorbs its own last proposal
+        # (needed when every proposal is accepted)
+        def draft_step(carry, k):
+            cache, tok = carry
+            logits, cache = advance(draft_params, cache, tok[:, None], draft_cfg)
+            p = probs_of(logits[:, -1])
+            nxt = pick(p, k)
+            return (cache, nxt), (nxt, p)
+
+        (dft_cache, last_draft), (t_draft, p_d) = lax.scan(
+            draft_step, (dft_cache, last_tok), jax.random.split(kd, gamma)
+        )
+        _, dft_cache = advance(
+            draft_params, dft_cache, last_draft[:, None], draft_cfg
+        )
+        t_draft = jnp.swapaxes(t_draft, 0, 1)  # [B, gamma]
+        p_d = jnp.swapaxes(p_d, 0, 1)  # [B, gamma, V]
+
+        # --- target: verify all proposals in one S=gamma+1 step
+        tgt_in = jnp.concatenate([last_tok[:, None], t_draft], axis=1)
+        tgt_logits, tgt_cache = advance(
+            target_params, tgt_cache, tgt_in, target_cfg
+        )
+        p_t = probs_of(tgt_logits)  # [B, gamma+1, V]
+
+        # --- acceptance: n_i = accepted prefix per sequence, n = batch min
+        if greedy:
+            acc = t_draft == jnp.argmax(p_t[:, :gamma], axis=-1)  # [B, gamma]
+        else:
+            pt_tok = jnp.take_along_axis(
+                p_t[:, :gamma], t_draft[..., None], axis=-1
+            )[..., 0]
+            pd_tok = jnp.take_along_axis(p_d, t_draft[..., None], axis=-1)[..., 0]
+            u = jax.random.uniform(ka, t_draft.shape)
+            acc = u * pd_tok < pt_tok
+        n_i = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)  # [B]
+        n = jnp.min(n_i)
+
+        # --- emit n accepted draft tokens + one correction/bonus token
+        if greedy:
+            emit = jnp.argmax(p_t, axis=-1).astype(jnp.int32)  # [B, gamma+1]
+        else:
+            # residual resample at column n for sequences rejected there;
+            # sequences whose own acceptance went past n keep their accepted
+            # draft token; p_d padded with zeros at column gamma makes the
+            # residual at n == gamma the plain bonus distribution p_t[gamma]
+            p_d_pad = jnp.pad(p_d, ((0, 0), (0, 1), (0, 0)))
+            p_t_n = p_t[:, n]  # [B, V] (dynamic row gather)
+            residual = jnp.maximum(p_t_n - p_d_pad[:, n], 0.0)
+            # float-exact draft==target leaves an empty residual; fall back
+            # to the target distribution (rejection there has probability 0)
+            residual = jnp.where(
+                jnp.sum(residual, axis=-1, keepdims=True) > 0, residual, p_t_n
+            )
+            resample = jax.random.categorical(
+                kr, jnp.log(residual), axis=-1
+            ).astype(jnp.int32)
+            t_pad = jnp.pad(t_draft, ((0, 0), (0, 1)))
+            at_n = jnp.where(n_i > n, t_pad[:, n], resample)  # [B]
+            cols = lax.iota(jnp.int32, gamma + 1)[None, :]
+            emit = jnp.where(cols < n, t_pad, at_n[:, None])
+        buf = lax.dynamic_update_slice(buf, emit, (0, n_out))
+        new_last = emit[:, n]
+
+        # --- roll both caches back to the accepted prefix (last_tok +
+        # t_0..t_{n-1}); stale entries past length are masked by position
+        rollback = tgt_cache.length - (gamma + 1) + (n + 1)
+        tgt_cache = KVCache(tgt_cache.k, tgt_cache.v, rollback)
+        dft_cache = KVCache(dft_cache.k, dft_cache.v, rollback)
+
+        stats = SpecStats(
+            rounds=stats.rounds + 1,
+            drafted=stats.drafted + gamma,
+            accepted=stats.accepted + n,
+        )
+        return (tgt_cache, dft_cache, new_last, buf, n_out + n + 1, key, stats)
+
+    def cond(state):
+        return state[4] < max_new_tokens
+
+    state = (tgt_cache, dft_cache, first, buf, jnp.ones((), jnp.int32), key, stats0)
+    (_, _, _, buf, _, _, stats) = lax.while_loop(cond, round_body, state)
+    return buf[:, :max_new_tokens].astype(prompt.dtype), stats

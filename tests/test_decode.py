@@ -147,3 +147,51 @@ class TestKVCacheDecode:
         )
         assert out.shape == (2, 5)
         assert ((np.asarray(out) >= 0) & (np.asarray(out) < 64)).all()
+
+
+class TestSamplingFilters:
+    """top-k / top-p (nucleus) logit filtering, decode.filter_logits."""
+
+    def test_top_k_masks_all_but_k(self):
+        logits = jnp.array([[1.0, 3.0, 2.0, -1.0, 0.5]])
+        out = decode.filter_logits(logits, top_k=2)
+        kept = np.asarray(out[0] > -1e29)
+        assert kept.tolist() == [False, True, True, False, False]
+
+    def test_top_p_keeps_smallest_covering_prefix(self):
+        # probs ~ [0.643, 0.237, 0.087, 0.032] -> top_p=0.7 keeps the first
+        # two (prefix crosses 0.7 at the second token)
+        logits = jnp.log(jnp.array([[0.643, 0.237, 0.087, 0.033]]))
+        out = decode.filter_logits(logits, top_p=0.7)
+        kept = np.asarray(out[0] > -1e29)
+        assert kept.tolist() == [True, True, False, False]
+
+    def test_top_p_one_and_top_k_zero_are_identity(self):
+        logits = jnp.array([[1.0, 3.0, 2.0]])
+        out = decode.filter_logits(logits, top_k=0, top_p=1.0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(logits))
+
+    def test_sampled_generation_respects_top_k_one(self):
+        # top_k=1 sampling must equal greedy decoding whatever the seed
+        cfg = cfg_of()
+        params, prompt = setup(cfg)
+        greedy = decode.generate(params, prompt, cfg, 6)
+        sampled = decode.generate(
+            params, prompt, cfg, 6, temperature=1.3, top_k=1,
+            key=jax.random.PRNGKey(9),
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+    def test_filters_compose_k_then_p(self):
+        logits = jnp.log(jnp.array([[0.40, 0.30, 0.15, 0.10, 0.05]]))
+        # top_k=3 keeps {0,1,2}; renormalized probs ~ [0.47, 0.35, 0.18];
+        # top_p=0.5 then keeps {0,1} (the 0.47 prefix doesn't cover 0.5 yet)
+        out = decode.filter_logits(logits, top_k=3, top_p=0.5)
+        kept = np.asarray(out[0] > -1e29)
+        assert kept.tolist() == [True, True, False, False, False]
+
+    def test_top_p_zero_keeps_most_likely_token(self):
+        logits = jnp.array([[1.0, 3.0, 2.0]])
+        out = decode.filter_logits(logits, top_p=0.0)
+        kept = np.asarray(out[0] > -1e29)
+        assert kept.tolist() == [False, True, False]

@@ -1,0 +1,104 @@
+"""Speculative decoding (models/speculative.py).
+
+The load-bearing property is exactness: speculation is an acceleration, not
+an approximation. Greedy speculative output must be bit-identical to vanilla
+greedy decoding regardless of draft quality; sampled speculation with
+draft == target must accept every proposal (the rejection test u < p_t/p_d
+degenerates to u < 1).
+"""
+
+import numpy as np
+import pytest
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from hivedscheduler_tpu.models import decode, transformer as tm  # noqa: E402
+from hivedscheduler_tpu.models.speculative import generate_speculative  # noqa: E402
+
+
+def cfg_of(**kw):
+    base = dict(vocab_size=97, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                max_seq_len=128, dtype=jnp.float32)
+    base.update(kw)
+    return tm.TransformerConfig(**base)
+
+
+def setup(cfg, b=2, t=7, seed=0):
+    params = tm.init_params(cfg, jax.random.PRNGKey(seed))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(seed + 1), (b, t), 0, cfg.vocab_size, jnp.int32
+    )
+    return params, prompt
+
+
+class TestSpeculative:
+    def test_greedy_matches_vanilla(self):
+        """Greedy speculative == target-only greedy, even with an unrelated
+        random draft model (rejections just fall back to the target argmax)."""
+        tgt_cfg = cfg_of()
+        dft_cfg = cfg_of(d_model=16, n_layers=1, n_heads=2, d_ff=32)
+        tgt_params, prompt = setup(tgt_cfg)
+        dft_params = tm.init_params(dft_cfg, jax.random.PRNGKey(42))
+        want = decode.generate(tgt_params, prompt, tgt_cfg, 14)
+        for gamma in (1, 3, 5):
+            got, stats = generate_speculative(
+                tgt_params, dft_params, prompt, tgt_cfg, dft_cfg, 14,
+                gamma=gamma,
+            )
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+            assert int(stats.rounds) >= 1
+            assert 0 <= int(stats.accepted) <= int(stats.drafted)
+
+    def test_self_draft_accepts_everything(self):
+        """draft == target => acceptance probability 1 at every position, so
+        each round accepts all gamma proposals."""
+        cfg = cfg_of()
+        params, prompt = setup(cfg)
+        got, stats = generate_speculative(
+            params, params, prompt, cfg, cfg, 12, gamma=4,
+            temperature=0.8, key=jax.random.PRNGKey(3),
+        )
+        assert got.shape == (2, 12)
+        assert int(stats.accepted) == int(stats.drafted)
+
+    def test_sampled_output_is_valid_and_deterministic(self):
+        tgt_cfg = cfg_of()
+        dft_cfg = cfg_of(d_model=16, n_layers=1, n_heads=2, d_ff=32)
+        tgt_params, prompt = setup(tgt_cfg)
+        dft_params = tm.init_params(dft_cfg, jax.random.PRNGKey(7))
+        kw = dict(gamma=3, temperature=1.0, top_k=20, top_p=0.9,
+                  key=jax.random.PRNGKey(11))
+        a, stats = generate_speculative(
+            tgt_params, dft_params, prompt, tgt_cfg, dft_cfg, 10, **kw)
+        b, _ = generate_speculative(
+            tgt_params, dft_params, prompt, tgt_cfg, dft_cfg, 10, **kw)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert np.asarray(a).min() >= 0 and np.asarray(a).max() < tgt_cfg.vocab_size
+        assert int(stats.drafted) == 3 * int(stats.rounds)
+
+    def test_greedy_exact_with_gqa_target(self):
+        """Compact-GQA target + dense draft still greedy-exact."""
+        tgt_cfg = cfg_of(n_heads=4, n_kv_heads=2)
+        dft_cfg = cfg_of(d_model=16, n_layers=1, n_heads=2, d_ff=32)
+        tgt_params, prompt = setup(tgt_cfg, b=1)
+        dft_params = tm.init_params(dft_cfg, jax.random.PRNGKey(5))
+        want = decode.generate(tgt_params, prompt, tgt_cfg, 9)
+        got, _ = generate_speculative(
+            tgt_params, dft_params, prompt, tgt_cfg, dft_cfg, 9, gamma=2,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_jits_whole_loop(self):
+        tgt_cfg = cfg_of()
+        dft_cfg = cfg_of(n_layers=1)
+        tgt_params, prompt = setup(tgt_cfg)
+        dft_params = tm.init_params(dft_cfg, jax.random.PRNGKey(1))
+        jitted = jax.jit(
+            lambda tp, dp, pr: generate_speculative(
+                tp, dp, pr, tgt_cfg, dft_cfg, 8, gamma=3
+            )
+        )
+        got, _ = jitted(tgt_params, dft_params, prompt)
+        want = decode.generate(tgt_params, prompt, tgt_cfg, 8)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
