@@ -42,6 +42,11 @@ def main(argv=None) -> int:
     parser.add_argument("--moe-top-k", type=int, default=1)
     parser.add_argument("--checkpoint-dir", default="",
                         help="restore params from a training checkpoint")
+    parser.add_argument("--lora-rank", type=int, default=0,
+                        help="the checkpoint is a LoRA run of this rank: "
+                        "adapters are restored and merged into the base "
+                        "weights before serving")
+    parser.add_argument("--lora-alpha", type=float, default=16.0)
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel serving over a tp mesh axis")
     parser.add_argument("--dp", type=int, default=1,
@@ -73,21 +78,29 @@ def main(argv=None) -> int:
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,
     )
-    params = tm.init_params(cfg, jax.random.PRNGKey(args.seed))
+    init_cfg = cfg
+    if args.lora_rank > 0:
+        import dataclasses
+
+        init_cfg = dataclasses.replace(
+            cfg, lora_rank=args.lora_rank, lora_alpha=args.lora_alpha
+        )
+    params = tm.init_params(init_cfg, jax.random.PRNGKey(args.seed))
     if args.checkpoint_dir:
         from hivedscheduler_tpu.parallel import checkpoint as ckpt
 
-        step = ckpt.latest_step(args.checkpoint_dir)
-        if step is None:
-            log.error("no checkpoint found in %s", args.checkpoint_dir)
+        # params-only restore: inference needs no optimizer moments, and a
+        # LoRA run's adapter-only optimizer tree wouldn't match anyway
+        try:
+            step, params = ckpt.restore_params(args.checkpoint_dir, params)
+        except FileNotFoundError as e:
+            log.error("%s", e)
             return 1
-        # opt state is not needed for inference; the template just has to
-        # match the treedef training saved — single source of truth
-        from hivedscheduler_tpu.parallel.train import make_optimizer
-
-        opt_template = make_optimizer().init(params)
-        _, params, _ = ckpt.restore(args.checkpoint_dir, params, opt_template)
         log.info("restored params from step %s", step)
+    if args.lora_rank > 0:
+        params = tm.merge_lora(params, init_cfg)
+        log.info("merged rank-%s LoRA adapters into the base weights",
+                 args.lora_rank)
 
     prompt = jax.random.randint(
         jax.random.PRNGKey(args.seed + 1), (args.batch, args.prompt_len),

@@ -62,6 +62,14 @@ class TransformerConfig:
     # attends with k/v head i // (n_heads // n_kv_heads)
     n_kv_heads: int = 0
 
+    # LoRA adapters on the attention projections (q/k/v/o): 0 = off; > 0
+    # adds rank-r factors (lora_*_a Gaussian, lora_*_b zero — identity at
+    # init) scaled by lora_alpha/lora_rank. Fine-tuning freezes the base
+    # weights and trains only the adapters (parallel/train.py:
+    # make_sharded_lora_train_step); merge_lora folds them back for serving
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
@@ -112,12 +120,67 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
             w_up=norm_init(km[1], (L, d, f), d),
             w_down=norm_init(km[2], (L, f, d), f),
         )
+    if cfg.lora_rank > 0:
+        r = cfg.lora_rank
+        kl = jax.random.split(jax.random.fold_in(key, 7), 4)
+        layers.update(
+            # a ~ N(0, 1/d) like the base projections, b = 0: the adapted
+            # model starts exactly equal to the base model
+            lora_wq_a=norm_init(kl[0], (L, d, r), d),
+            lora_wq_b=jnp.zeros((L, r, h, hd), jnp.float32),
+            lora_wk_a=norm_init(kl[1], (L, d, r), d),
+            lora_wk_b=jnp.zeros((L, r, h_kv, hd), jnp.float32),
+            lora_wv_a=norm_init(kl[2], (L, d, r), d),
+            lora_wv_b=jnp.zeros((L, r, h_kv, hd), jnp.float32),
+            lora_wo_a=norm_init(kl[3], (L, h, hd, r), d),
+            lora_wo_b=jnp.zeros((L, r, d), jnp.float32),
+        )
     return {
         "embed": norm_init(k_emb, (cfg.vocab_size, d), d),
         "layers": layers,
         "final_norm": jnp.ones((d,), jnp.float32),
         "lm_head": norm_init(k_out, (d, cfg.vocab_size), d),
     }
+
+
+LORA_BASES = ("wq", "wk", "wv", "wo")
+
+
+def split_lora_params(params: Dict[str, Any]):
+    """Split a LoRA-enabled param tree into (base, adapters) — the two
+    arguments of the LoRA train step. Inverse: ``combine_lora_params``."""
+    layers = params["layers"]
+    lora = {k: v for k, v in layers.items() if k.startswith("lora_")}
+    base = dict(params)
+    base["layers"] = {k: v for k, v in layers.items() if not k.startswith("lora_")}
+    return base, {"layers": lora}
+
+
+def combine_lora_params(base: Dict[str, Any], lora: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    out["layers"] = {**base["layers"], **lora["layers"]}
+    return out
+
+
+def merge_lora(params: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    """Fold the adapters into the base weights (W + (alpha/r) A B) and drop
+    the lora leaves: the result has the base tree shape, loads into the
+    decode/serving path unchanged, and computes the same function (guard:
+    tests/test_lora.py::test_merge_matches_adapter_forward)."""
+    assert cfg.lora_rank > 0, "merge_lora needs a LoRA config"
+    s = cfg.lora_alpha / cfg.lora_rank
+    layers = dict(params["layers"])
+    for name in LORA_BASES:
+        a = layers.pop(f"lora_{name}_a")
+        b = layers.pop(f"lora_{name}_b")
+        if name == "wo":
+            delta = jnp.einsum("lhkr,lrd->lhkd", a, b)
+        else:
+            delta = jnp.einsum("ldr,lrhk->ldhk", a, b)
+        layers[name] = (layers[name] + s * delta).astype(params["layers"][name].dtype)
+    out = dict(params)
+    out["layers"] = layers
+    return out
 
 
 def sharding_specs(cfg: TransformerConfig) -> Dict[str, Any]:
@@ -153,6 +216,19 @@ def sharding_specs(cfg: TransformerConfig) -> Dict[str, Any]:
             w_gate=P(pl, fsdp, tp),
             w_up=P(pl, fsdp, tp),
             w_down=P(pl, tp, fsdp),
+        )
+    if cfg.lora_rank > 0:
+        # the rank axis stays replicated (it is tiny); the head/width axes
+        # mirror the base projections so the delta einsums stay tp-local
+        layers.update(
+            lora_wq_a=P(pl, fsdp, None),
+            lora_wq_b=P(pl, None, tp, None),
+            lora_wk_a=P(pl, fsdp, None),
+            lora_wk_b=P(pl, None, tp, None),
+            lora_wv_a=P(pl, fsdp, None),
+            lora_wv_b=P(pl, None, tp, None),
+            lora_wo_a=P(pl, tp, None, None),
+            lora_wo_b=P(pl, None, fsdp),
         )
     return {
         "embed": P(None, "fsdp"),
@@ -356,6 +432,18 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
     q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
     k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
     v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+    if "lora_wq_a" in lp:
+        # rank-r adapter delta x A B, scaled alpha/r; the rank axis is tiny
+        # and replicated, so these ride the MXU as two thin matmuls
+        s = cfg.lora_alpha / cfg.lora_rank
+
+        def lora(inp, name):
+            z = jnp.einsum("btd,dr->btr", inp, lp[f"{name}_a"].astype(dtype))
+            return jnp.einsum("btr,rhk->bthk", z, lp[f"{name}_b"].astype(dtype)) * s
+
+        q = q + lora(h, "lora_wq")
+        k = k + lora(h, "lora_wk")
+        v = v + lora(h, "lora_wv")
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     if k.shape[2] != q.shape[2]:
@@ -397,7 +485,15 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
         attn = attn_fn(q, k, v, mesh, causal=True)
     else:
         attn = attn_fn(q, k, v, causal=True)
-    x = x + row_parallel(jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype)))
+    o = jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
+    if "lora_wo_a" in lp:
+        # both the base wo and the adapter's A contract the (sharded) head
+        # axis, so the partial sums share the row-parallel psum
+        zo = jnp.einsum("bthk,hkr->btr", attn, lp["lora_wo_a"].astype(dtype))
+        o = o + jnp.einsum("btr,rd->btd", zo, lp["lora_wo_b"].astype(dtype)) * (
+            cfg.lora_alpha / cfg.lora_rank
+        )
+    x = x + row_parallel(o)
     h = _rms_norm(x, lp["mlp_norm"])
     aux = jnp.zeros((), jnp.float32)
     if cfg.n_experts > 0:

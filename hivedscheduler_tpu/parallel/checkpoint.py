@@ -47,6 +47,46 @@ def latest_step(directory: str) -> Optional[int]:
     return step
 
 
+def restore_params(
+    directory: str,
+    params_template: Any,
+    step: Optional[int] = None,
+) -> Tuple[int, Any]:
+    """Restore (step, params) only — the optimizer state is left untouched.
+
+    For inference (serving never needs moments) and for warm starts
+    (``train --init-from``: fine-tune from a pretrained base with a fresh
+    optimizer, including LoRA runs whose adapter-only optimizer tree never
+    matches the pretraining checkpoint's)."""
+    import orbax.checkpoint as ocp
+
+    def as_abstract(tree):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
+            tree,
+        )
+
+    if not os.path.isdir(directory):
+        raise FileNotFoundError(f"no checkpoint found under {directory}")
+    mgr = _manager(directory)
+    if step is None:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {directory}")
+    restored = mgr.restore(step, args=ocp.args.Composite(
+        params=ocp.args.StandardRestore(as_abstract(params_template)),
+    ))
+    mgr.close()
+    params = jax.tree.map(
+        lambda x, t: (
+            jax.device_put(x, t.sharding) if getattr(t, "sharding", None) is not None else x
+        ),
+        restored["params"],
+        params_template,
+    )
+    return step, params
+
+
 def restore(
     directory: str,
     params_template: Any,

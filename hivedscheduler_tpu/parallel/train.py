@@ -147,3 +147,47 @@ def make_sharded_train_step(
 
     jitted = jax.jit(step, donate_argnums=(0, 1))
     return jitted, init_fn, token_sharding
+
+
+def make_sharded_lora_train_step(
+    cfg: tm.TransformerConfig,
+    mesh,
+    optimizer: Optional[optax.GradientTransformation] = None,
+):
+    """LoRA fine-tuning: the base weights are genuinely frozen — gradients
+    are taken w.r.t. the adapter subtree only (no base grads computed, no
+    base optimizer moments allocated) and the optimizer state covers just
+    the adapters, which is the whole point of parameter-efficient tuning.
+
+    Returns (jitted_step, init_fn, token_sharding) where ``init_fn(key)`` ->
+    (base_params, lora_params, opt_state) and ``jitted_step(base, lora,
+    opt_state, tokens)`` -> (lora_params, opt_state, loss) with the small
+    carries donated."""
+    assert cfg.lora_rank > 0, "set cfg.lora_rank to use the LoRA step"
+    optimizer = optimizer or make_optimizer()
+    param_specs = tm.sharding_specs(cfg)
+    param_shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), param_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    token_sharding = NamedSharding(mesh, tm.activation_spec())
+
+    def init_fn(key: jax.Array):
+        init = jax.jit(
+            functools.partial(tm.init_params, cfg), out_shardings=param_shardings
+        )
+        base, lora = tm.split_lora_params(init(key))
+        opt_state = optimizer.init(lora)
+        return base, lora, opt_state
+
+    def lora_loss(lora, base, tokens):
+        return loss_fn(tm.combine_lora_params(base, lora), tokens, cfg, mesh)
+
+    def step(base, lora, opt_state, tokens):
+        loss, grads = jax.value_and_grad(lora_loss)(lora, base, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, lora)
+        lora = optax.apply_updates(lora, updates)
+        return lora, opt_state, loss
+
+    jitted = jax.jit(step, donate_argnums=(1, 2))
+    return jitted, init_fn, token_sharding

@@ -50,6 +50,11 @@ def main(argv=None) -> int:
     parser.add_argument("--grad-accum", type=int, default=1,
                         help="gradient-accumulation slices per batch "
                         "(batch must divide evenly)")
+    parser.add_argument("--lora-rank", type=int, default=0,
+                        help="LoRA fine-tuning: adapter rank on the attention "
+                        "projections (0 = full training)")
+    parser.add_argument("--lora-alpha", type=float, default=16.0,
+                        help="LoRA scale (delta = alpha/rank * A B)")
     parser.add_argument("--attn", default=None,
                         help="xla|flash|ring|ring_zigzag|ulysses (default: ring when sp>1)")
     parser.add_argument("--data", default="",
@@ -57,6 +62,10 @@ def main(argv=None) -> int:
     parser.add_argument("--data-dtype", default="uint16",
                         choices=["uint16", "uint32"],
                         help="token dtype of the --data file")
+    parser.add_argument("--init-from", default="",
+                        help="warm-start params from another run's checkpoint "
+                        "(fresh optimizer). With --lora-rank this is the "
+                        "pretrained BASE model the adapters fine-tune")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=50)
     parser.add_argument("--log-every", type=int, default=10)
@@ -82,7 +91,10 @@ def main(argv=None) -> int:
     from hivedscheduler_tpu.models import transformer as tm
     from hivedscheduler_tpu.parallel import checkpoint as ckpt
     from hivedscheduler_tpu.parallel import topology
-    from hivedscheduler_tpu.parallel.train import make_sharded_train_step
+    from hivedscheduler_tpu.parallel.train import (
+        make_sharded_lora_train_step,
+        make_sharded_train_step,
+    )
 
     # 2. mesh over the granted slice
     n_devices = len(jax.devices())
@@ -105,13 +117,34 @@ def main(argv=None) -> int:
         moe_top_k=args.moe_top_k,
         moe_zloss_weight=args.moe_zloss,
         pipeline_microbatches=args.microbatches if args.pp > 1 else 0,
+        lora_rank=args.lora_rank,
+        lora_alpha=args.lora_alpha,
     )
-    step_fn, init_fn, token_sharding = make_sharded_train_step(
-        cfg, mesh, grad_accum=args.grad_accum
-    )
-    params, opt_state = init_fn(jax.random.PRNGKey(0))
+    lora_mode = args.lora_rank > 0
+    if lora_mode:
+        if args.grad_accum > 1 or args.pp > 1:
+            log.error("--lora-rank does not compose with --grad-accum/--pp yet")
+            return 1
+        step_fn, init_fn, token_sharding = make_sharded_lora_train_step(cfg, mesh)
+        base_params, lora_params, opt_state = init_fn(jax.random.PRNGKey(0))
+        params = tm.combine_lora_params(base_params, lora_params)
+    else:
+        step_fn, init_fn, token_sharding = make_sharded_train_step(
+            cfg, mesh, grad_accum=args.grad_accum
+        )
+        params, opt_state = init_fn(jax.random.PRNGKey(0))
 
-    # 3. resume if this gang incarnation has a previous checkpoint
+    # 3. warm start (params only, fresh optimizer) — for LoRA this loads the
+    #    frozen pretrained base the adapters are tuned against
+    if args.init_from:
+        if lora_mode:
+            _, base_params = ckpt.restore_params(args.init_from, base_params)
+            params = tm.combine_lora_params(base_params, lora_params)
+        else:
+            _, params = ckpt.restore_params(args.init_from, params)
+        log.info("warm-started params from %s", args.init_from)
+
+    # resume if this gang incarnation has a previous checkpoint
     start_step = 0
     if args.checkpoint_dir:
         last = ckpt.latest_step(args.checkpoint_dir)
@@ -119,6 +152,8 @@ def main(argv=None) -> int:
             start_step, params, opt_state = ckpt.restore(
                 args.checkpoint_dir, params, opt_state
             )
+            if lora_mode:
+                base_params, lora_params = tm.split_lora_params(params)
             log.info("resumed from checkpoint step %s", start_step)
 
     from hivedscheduler_tpu.parallel import data as data_lib
@@ -144,7 +179,13 @@ def main(argv=None) -> int:
         tokens = data_lib.device_put_global(
             next(batches), token_sharding, args.batch
         )
-        params, opt_state, loss = step_fn(params, opt_state, tokens)
+        if lora_mode:
+            lora_params, opt_state, loss = step_fn(
+                base_params, lora_params, opt_state, tokens
+            )
+            params = tm.combine_lora_params(base_params, lora_params)
+        else:
+            params, opt_state, loss = step_fn(params, opt_state, tokens)
         if (step + 1) % args.log_every == 0:
             loss_v = float(loss)
             dt = time.perf_counter() - t0

@@ -49,3 +49,28 @@ def test_save_restore_roundtrip(tmp_path):
 def test_restore_missing_dir(tmp_path):
     with pytest.raises(FileNotFoundError):
         checkpoint.restore(str(tmp_path / "nope"), {}, {})
+
+
+def test_restore_params_only_from_full_checkpoint(tmp_path):
+    """restore_params loads a full training checkpoint's params without
+    needing (or matching) its optimizer tree — the inference / --init-from
+    warm-start path, incl. LoRA runs whose adapter-only optimizer state
+    never matches the pretraining checkpoint's."""
+    cfg = tm.TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    mesh = topology.make_mesh(topology.MeshAxes(dp=2), topology.get_devices(2))
+    _, init_fn, _ = make_sharded_train_step(cfg, mesh)
+    params, opt_state = init_fn(jax.random.PRNGKey(0))
+    checkpoint.save(str(tmp_path), 7, params, opt_state)
+
+    template = jax.tree.map(jnp.zeros_like, params)
+    step, restored = checkpoint.restore_params(str(tmp_path), template)
+    assert step == 7
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        restored, params,
+    )
+    with pytest.raises(FileNotFoundError):
+        checkpoint.restore_params(str(tmp_path / "nope"), template)
