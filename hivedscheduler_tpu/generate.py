@@ -17,6 +17,22 @@ from hivedscheduler_tpu.common import utils as common
 log = logging.getLogger(__name__)
 
 
+def _serving_mesh(args):
+    """Build the dp x tp serving mesh from CLI flags; raises ValueError on
+    any bad flag combination (the single validation site for both the
+    vanilla and the speculative sharded branches)."""
+    from hivedscheduler_tpu.parallel import topology
+
+    if args.dp < 1 or args.tp < 1:
+        raise ValueError(f"--dp/--tp must be >= 1, got dp={args.dp} tp={args.tp}")
+    if args.batch % args.dp:
+        raise ValueError(
+            f"--batch {args.batch} must be divisible by --dp {args.dp}"
+        )
+    axes = topology.MeshAxes(dp=args.dp, tp=args.tp)
+    return topology.make_mesh(axes, topology.get_devices(axes.size))
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="tpu-hive-generate")
     parser.add_argument("--batch", type=int, default=1)
@@ -111,15 +127,15 @@ def main(argv=None) -> int:
         return 1
     key = jax.random.PRNGKey(args.seed + 2) if args.temperature > 0 else None
     if args.draft_layers > 0:
-        if args.tp > 1 or args.dp > 1:
-            log.error("--draft-layers does not compose with --tp/--dp yet")
-            return 1
         if args.gamma < 1:
             log.error("--gamma must be >= 1, got %s", args.gamma)
             return 1
         import dataclasses
 
-        from hivedscheduler_tpu.models.speculative import generate_speculative
+        from hivedscheduler_tpu.models.speculative import (
+            generate_speculative,
+            make_sharded_speculative,
+        )
 
         # derived default width: ~half the target, rounded up so head_dim
         # stays an even integer (RoPE rotates sin/cos pairs)
@@ -136,11 +152,28 @@ def main(argv=None) -> int:
             d_ff=2 * d_model, n_experts=0, n_kv_heads=0,
         )
         dft_params = tm.init_params(dft_cfg, jax.random.PRNGKey(args.seed + 3))
-        out, stats = generate_speculative(
-            params, dft_params, prompt, cfg, dft_cfg, args.new_tokens,
-            gamma=args.gamma, temperature=args.temperature,
-            top_k=args.top_k, top_p=args.top_p, key=key,
-        )
+        if args.tp > 1 or args.dp > 1:
+            try:
+                mesh = _serving_mesh(args)
+                run, tgt_sh, dft_sh, prompt_sh = make_sharded_speculative(
+                    cfg, dft_cfg, mesh, args.new_tokens, gamma=args.gamma,
+                    temperature=args.temperature, top_k=args.top_k,
+                    top_p=args.top_p,
+                )
+            except ValueError as e:
+                log.error("%s", e)
+                return 1
+            out, stats = run(
+                jax.device_put(params, tgt_sh),
+                jax.device_put(dft_params, dft_sh),
+                jax.device_put(prompt, prompt_sh), key,
+            )
+        else:
+            out, stats = generate_speculative(
+                params, dft_params, prompt, cfg, dft_cfg, args.new_tokens,
+                gamma=args.gamma, temperature=args.temperature,
+                top_k=args.top_k, top_p=args.top_p, key=key,
+            )
         log.info(
             "speculation: %s rounds, %s/%s draft tokens accepted (%.0f%%)",
             int(stats.rounds), int(stats.accepted), int(stats.drafted),
@@ -150,22 +183,15 @@ def main(argv=None) -> int:
             print(" ".join(str(int(t)) for t in row))
         return 0
     if args.tp > 1 or args.dp > 1:
-        from hivedscheduler_tpu.parallel import topology
-
-        if args.batch % args.dp:
-            log.error("--batch %s must be divisible by --dp %s",
-                      args.batch, args.dp)
-            return 1
         try:
-            axes = topology.MeshAxes(dp=args.dp, tp=args.tp)
-            mesh = topology.make_mesh(axes, topology.get_devices(axes.size))
+            mesh = _serving_mesh(args)
             run, param_shardings, prompt_sharding = decode.make_sharded_generate(
                 cfg, mesh, args.new_tokens, temperature=args.temperature,
                 top_k=args.top_k, top_p=args.top_p,
             )
         except ValueError as e:
-            # user errors (head counts vs --tp, device count vs --tp/--dp)
-            # get the same one-line treatment as the --batch/--dp check
+            # user errors (bad dp/tp/batch flags, head counts vs --tp,
+            # device count) get the same one-line treatment everywhere
             log.error("%s", e)
             return 1
         params = jax.device_put(params, param_shardings)

@@ -222,6 +222,41 @@ def generate(
     return jnp.swapaxes(toks, 0, 1)  # [B, max_new]
 
 
+def serving_shardings(cfg: TransformerConfig, mesh, *, require: bool = True):
+    """Validate ``cfg`` against the mesh's tp axis and build the param
+    NamedSharding tree (``transformer.sharding_specs`` laid over ``mesh``).
+    The single source of the serving sharding contract: heads, vocab and ff
+    must divide tp. ``require=False`` returns None instead of raising when a
+    dim doesn't divide (callers then replicate — the speculative draft's
+    fallback)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from hivedscheduler_tpu.models import transformer as tm
+
+    tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
+    if cfg.n_heads % tp or cfg.kv_heads % tp:
+        if not require:
+            return None
+        raise ValueError(
+            f"head counts must divide the tp axis: n_heads={cfg.n_heads}, "
+            f"kv_heads={cfg.kv_heads}, tp={tp}"
+        )
+    if cfg.vocab_size % tp or cfg.d_ff % tp:
+        # lm_head/MLP shard their wide axis over tp; fail with a clear
+        # message instead of device_put's divisibility error
+        if not require:
+            return None
+        raise ValueError(
+            f"vocab_size ({cfg.vocab_size}) and d_ff ({cfg.d_ff}) must "
+            f"divide the tp axis ({tp})"
+        )
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), tm.sharding_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def make_sharded_generate(
     cfg: TransformerConfig,
     mesh,
@@ -242,20 +277,7 @@ def make_sharded_generate(
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    from hivedscheduler_tpu.models import transformer as tm
-
-    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-    tp = mesh_shape.get("tp", 1)
-    if cfg.n_heads % tp or cfg.kv_heads % tp:
-        raise ValueError(
-            f"head counts must divide the tp axis: n_heads={cfg.n_heads}, "
-            f"kv_heads={cfg.kv_heads}, tp={tp}"
-        )
-    param_specs = tm.sharding_specs(cfg)
-    param_shardings = jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec), param_specs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    param_shardings = serving_shardings(cfg, mesh)
     prompt_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
 
     run = functools.partial(

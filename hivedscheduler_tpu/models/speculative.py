@@ -209,3 +209,55 @@ def generate_speculative(
     state = (tgt_cache, dft_cache, first, buf, jnp.ones((), jnp.int32), key, stats0)
     (_, _, _, buf, _, _, stats) = lax.while_loop(cond, round_body, state)
     return buf[:, :max_new_tokens].astype(prompt.dtype), stats
+
+
+def make_sharded_speculative(
+    target_cfg: TransformerConfig,
+    draft_cfg: TransformerConfig,
+    mesh,
+    max_new_tokens: int,
+    *,
+    gamma: int = 4,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+):
+    """Speculative serving over a dp x tp mesh: the (big) target runs
+    tensor-parallel exactly like ``decode.make_sharded_generate``; the
+    (small) draft shards the same way when its head counts divide tp and is
+    replicated otherwise — a replicated draft costs its tiny weights per
+    device and keeps every round's gamma single-token steps collective-free.
+
+    Returns (jitted_run, target_shardings, draft_shardings,
+    prompt_sharding); ``jitted_run(target_params, draft_params, prompt,
+    key)`` -> ([B, max_new], SpecStats)."""
+    import functools
+
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from hivedscheduler_tpu.models import transformer as tm
+    from hivedscheduler_tpu.models.decode import serving_shardings
+
+    target_shardings = serving_shardings(target_cfg, mesh)
+    draft_shardings = serving_shardings(draft_cfg, mesh, require=False)
+    if draft_shardings is None:
+        replicated = NamedSharding(mesh, P())
+        draft_shardings = jax.tree.map(
+            lambda spec: replicated, tm.sharding_specs(draft_cfg),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+    prompt_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+
+    run = functools.partial(
+        generate_speculative, gamma=gamma, temperature=temperature,
+        top_k=top_k, top_p=top_p,
+    )
+
+    def wrapped(target_params, draft_params, prompt, key=None):
+        return run(
+            target_params, draft_params, prompt, target_cfg, draft_cfg,
+            max_new_tokens, key=key,
+        )
+
+    return jax.jit(wrapped), target_shardings, draft_shardings, prompt_sharding

@@ -102,3 +102,66 @@ class TestSpeculative:
         got, _ = jitted(tgt_params, dft_params, prompt)
         want = decode.generate(tgt_params, prompt, tgt_cfg, 8)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestShardedSpeculative:
+    def test_tp_sharded_speculation_matches_single_device(self):
+        """dp=2 x tp=2 speculative greedy == single-device speculative ==
+        vanilla greedy (the draft here shards over tp too)."""
+        from hivedscheduler_tpu.models.speculative import make_sharded_speculative
+        from hivedscheduler_tpu.parallel import topology
+
+        # vocab/ff/width all divide tp=2 (the sharded-serving contract)
+        tgt_cfg = cfg_of(n_kv_heads=2, vocab_size=96)
+        dft_cfg = cfg_of(n_layers=1, vocab_size=96)
+        tgt_params, prompt = setup(tgt_cfg)
+        dft_params = tm.init_params(dft_cfg, jax.random.PRNGKey(8))
+        want = decode.generate(tgt_params, prompt, tgt_cfg, 9)
+        mesh = topology.make_mesh(
+            topology.MeshAxes(dp=2, tp=2), topology.get_devices(4)
+        )
+        run, tgt_sh, dft_sh, prompt_sh = make_sharded_speculative(
+            tgt_cfg, dft_cfg, mesh, 9, gamma=3,
+        )
+        got, stats = run(
+            jax.device_put(tgt_params, tgt_sh),
+            jax.device_put(dft_params, dft_sh),
+            jax.device_put(prompt, prompt_sh),
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert int(stats.rounds) >= 1
+
+    def test_indivisible_draft_heads_replicate(self):
+        """A draft whose heads don't divide tp is replicated, not rejected."""
+        from hivedscheduler_tpu.models.speculative import make_sharded_speculative
+        from hivedscheduler_tpu.parallel import topology
+
+        tgt_cfg = cfg_of(vocab_size=96)
+        dft_cfg = cfg_of(n_heads=1, d_model=16, n_layers=1, d_ff=32,
+                         vocab_size=96)
+        tgt_params, prompt = setup(tgt_cfg, b=2)
+        dft_params = tm.init_params(dft_cfg, jax.random.PRNGKey(8))
+        want = decode.generate(tgt_params, prompt, tgt_cfg, 6)
+        mesh = topology.make_mesh(
+            topology.MeshAxes(dp=2, tp=2), topology.get_devices(4)
+        )
+        run, tgt_sh, dft_sh, prompt_sh = make_sharded_speculative(
+            tgt_cfg, dft_cfg, mesh, 6, gamma=2,
+        )
+        from jax.sharding import PartitionSpec as P
+        flat = jax.tree.leaves(dft_sh)
+        assert all(s.spec == P() for s in flat)
+        got, _ = run(
+            jax.device_put(tgt_params, tgt_sh),
+            jax.device_put(dft_params, dft_sh),
+            jax.device_put(prompt, prompt_sh),
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_sharded_rejects_indivisible_target_heads(self):
+        from hivedscheduler_tpu.models.speculative import make_sharded_speculative
+        from hivedscheduler_tpu.parallel import topology
+
+        mesh = topology.make_mesh(topology.MeshAxes(tp=4), topology.get_devices(4))
+        with pytest.raises(ValueError, match="divide the tp axis"):
+            make_sharded_speculative(cfg_of(n_heads=2), cfg_of(), mesh, 4)
