@@ -39,6 +39,8 @@ from hivedscheduler_tpu.models.transformer import (
     _moe_mlp,
     _rms_norm,
     _rope,
+    is_quantized_leaf,
+    load_weight,
 )
 from hivedscheduler_tpu.ops.attention import NEG_INF
 
@@ -97,7 +99,13 @@ def advance(
     dtype = cfg.dtype
     b, s_len = tokens.shape
     pos0 = cache.length
-    x = params["embed"].astype(dtype)[tokens]  # [B, S, D]
+    emb = params["embed"]
+    if is_quantized_leaf(emb):
+        # int8 embedding: gather the rows, then scale per row — the gather
+        # itself moves int8 bytes
+        x = emb["qi8"][tokens].astype(dtype) * emb["scale"][tokens].astype(dtype)
+    else:
+        x = emb.astype(dtype)[tokens]  # [B, S, D]
     positions = (pos0 + lax.iota(jnp.int32, s_len))[None, :]
     scale = 1.0 / math.sqrt(cfg.head_dim)
     if cfg.n_experts > 0:
@@ -110,24 +118,25 @@ def advance(
     def layer(x, scanned):
         lp, ck, cv = scanned
         h = _rms_norm(x, lp["attn_norm"])
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(dtype))
-        k_new = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(dtype))
-        v_new = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(dtype))
+        q = jnp.einsum("bsd,dhk->bshk", h, load_weight(lp["wq"], dtype))
+        k_new = jnp.einsum("bsd,dhk->bshk", h, load_weight(lp["wk"], dtype))
+        v_new = jnp.einsum("bsd,dhk->bshk", h, load_weight(lp["wv"], dtype))
         q = _rope(q, positions, cfg.rope_theta)
         k_new = _rope(k_new, positions, cfg.rope_theta)
         ck = lax.dynamic_update_slice_in_dim(ck, k_new.astype(ck.dtype), pos0, 1)
         cv = lax.dynamic_update_slice_in_dim(cv, v_new.astype(cv.dtype), pos0, 1)
         attn = _cached_attention(q, ck, cv, pos0, scale)
-        x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"].astype(dtype))
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, load_weight(lp["wo"], dtype))
         h = _rms_norm(x, lp["mlp_norm"])
         if cfg.n_experts > 0:
             moe_out, _ = _moe_mlp(h, lp, cfg, dtype)
             x = x + moe_out
         else:
-            gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dtype))
-            up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dtype))
+            gate = jnp.einsum("bsd,df->bsf", h, load_weight(lp["w_gate"], dtype))
+            up = jnp.einsum("bsd,df->bsf", h, load_weight(lp["w_up"], dtype))
             x = x + jnp.einsum(
-                "bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"].astype(dtype)
+                "bsf,fd->bsd", jax.nn.silu(gate) * up,
+                load_weight(lp["w_down"], dtype),
             )
         return x, (ck, cv)
 
@@ -138,7 +147,7 @@ def advance(
     )
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum(
-        "bsd,dv->bsv", x, params["lm_head"].astype(dtype)
+        "bsd,dv->bsv", x, load_weight(params["lm_head"], dtype)
     ).astype(jnp.float32)
     new_cache = KVCache(k=new_k, v=new_v, length=pos0 + s_len)
     return logits, new_cache
@@ -222,13 +231,15 @@ def generate(
     return jnp.swapaxes(toks, 0, 1)  # [B, max_new]
 
 
-def serving_shardings(cfg: TransformerConfig, mesh, *, require: bool = True):
+def serving_shardings(
+    cfg: TransformerConfig, mesh, *, require: bool = True, quantized: bool = False
+):
     """Validate ``cfg`` against the mesh's tp axis and build the param
-    NamedSharding tree (``transformer.sharding_specs`` laid over ``mesh``).
-    The single source of the serving sharding contract: heads, vocab and ff
-    must divide tp. ``require=False`` returns None instead of raising when a
-    dim doesn't divide (callers then replicate — the speculative draft's
-    fallback)."""
+    NamedSharding tree (``transformer.sharding_specs`` laid over ``mesh``;
+    ``quantized`` uses ``quant.sharding_specs`` for int8 trees). The single
+    source of the serving sharding contract: heads, vocab and ff must divide
+    tp. ``require=False`` returns None instead of raising when a dim doesn't
+    divide (callers then replicate — the speculative draft's fallback)."""
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
@@ -251,8 +262,14 @@ def serving_shardings(cfg: TransformerConfig, mesh, *, require: bool = True):
             f"vocab_size ({cfg.vocab_size}) and d_ff ({cfg.d_ff}) must "
             f"divide the tp axis ({tp})"
         )
+    if quantized:
+        from hivedscheduler_tpu.models import quant
+
+        specs = quant.sharding_specs(cfg)
+    else:
+        specs = tm.sharding_specs(cfg)
     return jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec), tm.sharding_specs(cfg),
+        lambda spec: NamedSharding(mesh, spec), specs,
         is_leaf=lambda x: isinstance(x, P),
     )
 
@@ -265,19 +282,22 @@ def make_sharded_generate(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    quantized: bool = False,
 ):
     """Sharded serving: returns (jitted_generate, param_shardings,
-    prompt_sharding). Params laid out by ``transformer.sharding_specs``
-    (tp shards heads/ff — the decode einsums then run tensor-parallel under
-    GSPMD, with the kv cache sharded over the compact head axis), prompts
-    over dp. ``jitted_generate(params, prompt, key)`` -> [B, max_new]
+    prompt_sharding). Params laid out by ``transformer.sharding_specs`` —
+    or ``quant.sharding_specs`` when ``quantized=True``, for int8 trees from
+    ``quant.quantize_params`` — (tp shards heads/ff — the decode einsums
+    then run tensor-parallel under GSPMD, with the kv cache sharded over
+    the compact head axis), prompts over dp.
+    ``jitted_generate(params, prompt, key)`` -> [B, max_new]
     (pass ``key=None`` for greedy)."""
     import functools
 
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    param_shardings = serving_shardings(cfg, mesh)
+    param_shardings = serving_shardings(cfg, mesh, quantized=quantized)
     prompt_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
 
     run = functools.partial(

@@ -1,0 +1,124 @@
+"""Weight-only int8 quantization for serving.
+
+Decode latency on TPU is HBM-bound: each generated token reads every weight
+once, so shipping weights as int8 (+ a per-output-channel f32 scale)
+halves the bytes vs bf16 and quarters them vs f32 while the matmuls still
+run in the model dtype on the MXU (the int8->bf16 convert-and-scale fuses
+into the consuming einsum as an elementwise producer; under the stacked-
+layer ``lax.scan`` each step slices and dequantizes ONE layer's weights, so
+HBM traffic per token is the int8 bytes).
+
+Post-training, symmetric, per-output-channel: q = round(w / s), s =
+max|w| / 127 reduced over the input (contraction) axes. Norm weights and
+the MoE router stay f32 (tiny, accuracy-critical). The quantized tree
+mirrors the base tree except each quantized leaf becomes
+``{"qi8": int8, "scale": f32}`` — ``models/decode.py``'s weight loads
+dequantize transparently, so generate/speculative serving consume either
+tree. Training never quantizes (quantize after training, or after
+``merge_lora``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hivedscheduler_tpu.models.transformer import TransformerConfig
+
+# leaf name -> input (contraction) axes to reduce the scale over, for the
+# per-layer-stacked [L, ...] layout of init_params
+_LAYER_CONTRACT_AXES = {
+    "wq": (1,),        # [L, d, h, hd] contracts d
+    "wk": (1,),
+    "wv": (1,),
+    "wo": (1, 2),      # [L, h, hd, d] contracts h, hd
+    "w_gate": (1,),    # dense [L, d, f] contracts d; MoE [L, E, d, f] -> (2,)
+    "w_up": (1,),
+    "w_down": (1,),    # dense [L, f, d] contracts f; MoE [L, E, f, d] -> (2,)
+}
+
+
+def _quantize_leaf(w: jax.Array, axes: Tuple[int, ...]) -> Dict[str, jax.Array]:
+    scale = jnp.max(jnp.abs(w), axis=axes, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-8).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return {"qi8": q, "scale": scale}
+
+
+# the quantized-leaf predicate and the dequantize-or-cast weight load live
+# in transformer.py (the decode path and the MoE block share them);
+# re-exported here for discoverability
+from hivedscheduler_tpu.models.transformer import (  # noqa: E402,F401
+    is_quantized_leaf,
+    load_weight,
+)
+
+
+def quantize_params(params: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]:
+    """Quantize the serving-relevant matmul weights of a base param tree
+    (LoRA runs: ``merge_lora`` first — lora_* leaves are rejected here).
+
+    embed is quantized per row (the gather then scales one row per token);
+    lm_head per output column; layer projections per output channel."""
+    assert not any(
+        k.startswith("lora_") for k in params["layers"]
+    ), "quantize after merge_lora: adapters must be folded into the base"
+    moe = cfg.n_experts > 0
+    out: Dict[str, Any] = {}
+    # iterate the actual tree (unknown leaves pass through unchanged) so a
+    # new init_params leaf cannot be silently dropped; the key-structure
+    # guard is tests/test_quant.py::test_tree_mirrors_init_params
+    for name, leaf in params.items():
+        if name == "embed":
+            out[name] = _quantize_leaf(leaf, (1,))      # per-row (gathered)
+        elif name == "lm_head":
+            out[name] = _quantize_leaf(leaf, (0,))      # per-output-column
+        elif name == "layers":
+            layers: Dict[str, Any] = {}
+            for lname, w in leaf.items():
+                if lname in _LAYER_CONTRACT_AXES:
+                    axes = _LAYER_CONTRACT_AXES[lname]
+                    if moe and lname in ("w_gate", "w_up", "w_down"):
+                        axes = (2,)  # [L, E, in, out]: per-expert input
+                    layers[lname] = _quantize_leaf(w, axes)
+                else:
+                    layers[lname] = w  # norms, router
+            out[name] = layers
+        else:
+            out[name] = leaf  # final_norm and any future float leaf
+    return out
+
+
+def sharding_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpecs for a quantized tree: qi8 mirrors the base weight's
+    spec; the keepdims scale drops the sharding of every reduced (size-1)
+    axis. ``decode.serving_shardings(cfg, mesh, quantized=True)`` lays
+    these over a mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    from hivedscheduler_tpu.models import transformer as tm
+
+    base = tm.sharding_specs(cfg)
+    moe = cfg.n_experts > 0
+
+    def qspec(name: str, spec: P, axes: Tuple[int, ...]) -> Dict[str, Any]:
+        scale_spec = P(*[None if i in axes else s for i, s in enumerate(spec)])
+        return {"qi8": spec, "scale": scale_spec}
+
+    layers: Dict[str, Any] = {}
+    for name, spec in base["layers"].items():
+        if name in _LAYER_CONTRACT_AXES:
+            axes = _LAYER_CONTRACT_AXES[name]
+            if moe and name in ("w_gate", "w_up", "w_down"):
+                axes = (2,)
+            layers[name] = qspec(name, spec, axes)
+        else:
+            layers[name] = spec
+    return {
+        "embed": qspec("embed", base["embed"], (1,)),
+        "layers": layers,
+        "final_norm": base["final_norm"],
+        "lm_head": qspec("lm_head", base["lm_head"], (0,)),
+    }

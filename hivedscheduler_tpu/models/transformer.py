@@ -243,6 +243,22 @@ def activation_spec() -> P:
     return P(("dp", "fsdp"), "sp")
 
 
+def is_quantized_leaf(leaf) -> bool:
+    """The single structural test for an int8-quantized weight leaf
+    (models/quant.py's ``{"qi8", "scale"}`` encoding)."""
+    return isinstance(leaf, dict) and "qi8" in leaf
+
+
+def load_weight(leaf, dtype) -> jax.Array:
+    """Cast a weight leaf to the compute dtype, dequantizing transparently
+    when it is an int8-quantized ``{"qi8", "scale"}`` pair (models/quant.py).
+    The convert-and-scale fuses into the consuming einsum, so quantized
+    serving reads int8 bytes from HBM and multiplies in ``dtype``."""
+    if is_quantized_leaf(leaf):
+        return leaf["qi8"].astype(dtype) * leaf["scale"].astype(dtype)
+    return leaf.astype(dtype)
+
+
 def _rms_norm(x: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
     xf = x.astype(jnp.float32)
     norm = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
@@ -381,10 +397,10 @@ def _moe_mlp(
         expert_in = lax.with_sharding_constraint(
             expert_in, NamedSharding(mesh, P("ep", ("dp", "fsdp"), None, None))
         )
-    g = jnp.einsum("ebcd,edf->ebcf", expert_in, lp["w_gate"].astype(dtype))
-    u = jnp.einsum("ebcd,edf->ebcf", expert_in, lp["w_up"].astype(dtype))
+    g = jnp.einsum("ebcd,edf->ebcf", expert_in, load_weight(lp["w_gate"], dtype))
+    u = jnp.einsum("ebcd,edf->ebcf", expert_in, load_weight(lp["w_up"], dtype))
     expert_out = jnp.einsum(
-        "ebcf,efd->ebcd", jax.nn.silu(g) * u, lp["w_down"].astype(dtype)
+        "ebcf,efd->ebcd", jax.nn.silu(g) * u, load_weight(lp["w_down"], dtype)
     )
     if sp_scattered:
         # reassemble the full capacity dim before the local combine

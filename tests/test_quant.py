@@ -1,0 +1,133 @@
+"""Weight-only int8 quantization (models/quant.py).
+
+The contract: quantized serving is an approximation of the float model with
+bounded per-matmul error (symmetric per-output-channel scales), the tree
+mirrors the base tree, and the decode path consumes either transparently —
+including tp-sharded serving with the quantized sharding specs."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from hivedscheduler_tpu.models import decode, quant, transformer as tm  # noqa: E402
+
+
+def cfg_of(**kw):
+    base = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                max_seq_len=32, dtype=jnp.float32)
+    base.update(kw)
+    return tm.TransformerConfig(**base)
+
+
+def setup(cfg, b=2, t=8, seed=0):
+    params = tm.init_params(cfg, jax.random.PRNGKey(seed))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(seed + 1), (b, t), 0, cfg.vocab_size, jnp.int32
+    )
+    return params, prompt
+
+
+class TestQuant:
+    def test_roundtrip_error_is_bounded(self):
+        """Per-output-channel symmetric int8: dequantized weights are within
+        scale/2 of the originals elementwise (half a quantization step)."""
+        cfg = cfg_of()
+        params = tm.init_params(cfg, jax.random.PRNGKey(0))
+        qp = quant.quantize_params(params, cfg)
+        w = np.asarray(params["layers"]["wq"])
+        deq = np.asarray(quant.load_weight(qp["layers"]["wq"], jnp.float32))
+        step = np.asarray(qp["layers"]["wq"]["scale"])
+        assert np.all(np.abs(w - deq) <= 0.5 * step + 1e-8)
+        assert qp["layers"]["wq"]["qi8"].dtype == jnp.int8
+
+    def test_norms_and_router_stay_float(self):
+        cfg = cfg_of(n_experts=4)
+        params = tm.init_params(cfg, jax.random.PRNGKey(0))
+        qp = quant.quantize_params(params, cfg)
+        assert not quant.is_quantized_leaf(qp["layers"]["attn_norm"])
+        assert not quant.is_quantized_leaf(qp["layers"]["router"])
+        assert not quant.is_quantized_leaf(qp["final_norm"])
+        assert quant.is_quantized_leaf(qp["layers"]["w_gate"])
+
+    def test_quantized_decode_tracks_float_decode(self):
+        """int8 logits stay close to float logits, and wherever the float
+        model is decisive (top-1 margin above the quantization noise) the
+        quantized argmax agrees. Token-for-token equality is deliberately
+        NOT asserted: a random-init model's near-uniform logits make greedy
+        argmax unstable under any perturbation."""
+        cfg = cfg_of()
+        params, prompt = setup(cfg)
+        qp = quant.quantize_params(params, cfg)
+        cache_f = decode.init_kv_cache(cfg, 2, 8)
+        cache_q = decode.init_kv_cache(cfg, 2, 8)
+        lf, _ = decode.advance(params, cache_f, prompt, cfg)
+        lq, _ = decode.advance(qp, cache_q, prompt, cfg)
+        lf, lq = np.asarray(lf), np.asarray(lq)
+        noise = np.abs(lf - lq).max()
+        assert noise < 0.15
+        top2 = np.sort(lf, axis=-1)
+        margin = top2[..., -1] - top2[..., -2]
+        decisive = margin > 2 * noise
+        assert decisive.any()  # the check below must actually bite
+        np.testing.assert_array_equal(
+            lf.argmax(-1)[decisive], lq.argmax(-1)[decisive]
+        )
+        out_q = decode.generate(qp, prompt, cfg, 6)
+        assert out_q.shape == (2, 6)
+
+    def test_quantized_moe_decodes(self):
+        cfg = cfg_of(n_experts=4, expert_capacity_factor=8.0)
+        params, prompt = setup(cfg)
+        qp = quant.quantize_params(params, cfg)
+        out = decode.generate(qp, prompt, cfg, 4)
+        assert out.shape == (2, 4)
+
+    def test_rejects_unmerged_lora(self):
+        cfg = cfg_of(lora_rank=2)
+        params = tm.init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(AssertionError, match="merge_lora"):
+            quant.quantize_params(params, cfg)
+        merged = tm.merge_lora(params, cfg)
+        quant.quantize_params(merged, cfg_of())  # folded tree quantizes fine
+
+    def test_tp_sharded_quantized_matches_single_device(self):
+        from hivedscheduler_tpu.parallel import topology
+
+        cfg = cfg_of(n_kv_heads=2)
+        params, prompt = setup(cfg)
+        qp = quant.quantize_params(params, cfg)
+        want = decode.generate(qp, prompt, cfg, 6)
+        mesh = topology.make_mesh(
+            topology.MeshAxes(dp=2, tp=2), topology.get_devices(4)
+        )
+        run, param_sh, prompt_sh = decode.make_sharded_generate(
+            cfg, mesh, 6, quantized=True
+        )
+        # the sharding tree must mirror the quantized tree exactly
+        assert jax.tree.structure(param_sh) == jax.tree.structure(qp)
+        got = run(jax.device_put(qp, param_sh), jax.device_put(prompt, prompt_sh))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    @pytest.mark.parametrize("moe", [False, True])
+    def test_tree_mirrors_init_params(self, moe):
+        """CLAUDE.md guard rule for hand-rolled copies: the quantized tree
+        (and quant.sharding_specs) must carry exactly init_params' keys, so
+        a new param leaf cannot be silently dropped."""
+        cfg = cfg_of(n_experts=4 if moe else 0)
+        params = tm.init_params(cfg, jax.random.PRNGKey(0))
+        qp = quant.quantize_params(params, cfg)
+        assert set(qp) == set(params)
+        assert set(qp["layers"]) == set(params["layers"])
+        specs = quant.sharding_specs(cfg)
+        assert set(specs) == set(params)
+        assert set(specs["layers"]) == set(params["layers"])
+        # quantized positions agree between the tree and the specs: a
+        # {"qi8","scale"} leaf in one must be a {"qi8","scale"} dict in the
+        # other, else device_put hits a tree-structure mismatch
+        for k, v in qp["layers"].items():
+            assert quant.is_quantized_leaf(v) == (
+                isinstance(specs["layers"][k], dict)
+                and set(specs["layers"][k]) == {"qi8", "scale"}
+            ), k
