@@ -123,9 +123,8 @@ def main(argv=None) -> int:
                  args.lora_rank)
     quantized = args.quantize == "int8"
     if quantized:
-        if args.draft_layers > 0:
-            log.error("--quantize does not compose with --draft-layers yet")
-            return 1
+        # with --draft-layers the (big) target quantizes; the draft is small
+        # enough that its float weights are not the bandwidth term
         from hivedscheduler_tpu.models import quant
 
         params = quant.quantize_params(params, cfg)
@@ -171,7 +170,7 @@ def main(argv=None) -> int:
                 run, tgt_sh, dft_sh, prompt_sh = make_sharded_speculative(
                     cfg, dft_cfg, mesh, args.new_tokens, gamma=args.gamma,
                     temperature=args.temperature, top_k=args.top_k,
-                    top_p=args.top_p,
+                    top_p=args.top_p, quantized_target=quantized,
                 )
             except ValueError as e:
                 log.error("%s", e)

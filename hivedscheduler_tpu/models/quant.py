@@ -40,6 +40,15 @@ _LAYER_CONTRACT_AXES = {
 }
 
 
+def _contract_axes(name: str, moe: bool) -> Tuple[int, ...]:
+    """Input (contraction) axes for a layer leaf — the single source shared
+    by quantize_params and sharding_specs so the scale reduction and the
+    scale sharding can never drift apart."""
+    if moe and name in ("w_gate", "w_up", "w_down"):
+        return (2,)  # [L, E, in, out]: per-expert input
+    return _LAYER_CONTRACT_AXES[name]
+
+
 def _quantize_leaf(w: jax.Array, axes: Tuple[int, ...]) -> Dict[str, jax.Array]:
     scale = jnp.max(jnp.abs(w), axis=axes, keepdims=True) / 127.0
     scale = jnp.maximum(scale, 1e-8).astype(jnp.float32)
@@ -62,9 +71,10 @@ def quantize_params(params: Dict[str, Any], cfg: TransformerConfig) -> Dict[str,
 
     embed is quantized per row (the gather then scales one row per token);
     lm_head per output column; layer projections per output channel."""
-    assert not any(
-        k.startswith("lora_") for k in params["layers"]
-    ), "quantize after merge_lora: adapters must be folded into the base"
+    if any(k.startswith("lora_") for k in params["layers"]):
+        raise ValueError(
+            "quantize after merge_lora: adapters must be folded into the base"
+        )
     moe = cfg.n_experts > 0
     out: Dict[str, Any] = {}
     # iterate the actual tree (unknown leaves pass through unchanged) so a
@@ -79,10 +89,7 @@ def quantize_params(params: Dict[str, Any], cfg: TransformerConfig) -> Dict[str,
             layers: Dict[str, Any] = {}
             for lname, w in leaf.items():
                 if lname in _LAYER_CONTRACT_AXES:
-                    axes = _LAYER_CONTRACT_AXES[lname]
-                    if moe and lname in ("w_gate", "w_up", "w_down"):
-                        axes = (2,)  # [L, E, in, out]: per-expert input
-                    layers[lname] = _quantize_leaf(w, axes)
+                    layers[lname] = _quantize_leaf(w, _contract_axes(lname, moe))
                 else:
                     layers[lname] = w  # norms, router
             out[name] = layers
@@ -110,10 +117,7 @@ def sharding_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     layers: Dict[str, Any] = {}
     for name, spec in base["layers"].items():
         if name in _LAYER_CONTRACT_AXES:
-            axes = _LAYER_CONTRACT_AXES[name]
-            if moe and name in ("w_gate", "w_up", "w_down"):
-                axes = (2,)
-            layers[name] = qspec(name, spec, axes)
+            layers[name] = qspec(name, spec, _contract_axes(name, moe))
         else:
             layers[name] = spec
     return {

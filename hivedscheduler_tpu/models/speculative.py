@@ -221,6 +221,7 @@ def make_sharded_speculative(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    quantized_target: bool = False,
 ):
     """Speculative serving over a dp x tp mesh: the (big) target runs
     tensor-parallel exactly like ``decode.make_sharded_generate``; the
@@ -239,7 +240,9 @@ def make_sharded_speculative(
     from hivedscheduler_tpu.models import transformer as tm
     from hivedscheduler_tpu.models.decode import serving_shardings
 
-    target_shardings = serving_shardings(target_cfg, mesh)
+    target_shardings = serving_shardings(
+        target_cfg, mesh, quantized=quantized_target
+    )
     draft_shardings = serving_shardings(draft_cfg, mesh, require=False)
     if draft_shardings is None:
         replicated = NamedSharding(mesh, P())

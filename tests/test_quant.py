@@ -87,7 +87,7 @@ class TestQuant:
     def test_rejects_unmerged_lora(self):
         cfg = cfg_of(lora_rank=2)
         params = tm.init_params(cfg, jax.random.PRNGKey(0))
-        with pytest.raises(AssertionError, match="merge_lora"):
+        with pytest.raises(ValueError, match="merge_lora"):
             quant.quantize_params(params, cfg)
         merged = tm.merge_lora(params, cfg)
         quant.quantize_params(merged, cfg_of())  # folded tree quantizes fine
@@ -131,3 +131,37 @@ class TestQuant:
                 isinstance(specs["layers"][k], dict)
                 and set(specs["layers"][k]) == {"qi8", "scale"}
             ), k
+
+    def test_quantized_target_speculation(self):
+        """An int8 target verifies a float draft: greedy speculative output
+        equals vanilla greedy decoding of the QUANTIZED target (exactness is
+        w.r.t. the served model), locally and on a dp x tp mesh."""
+        from hivedscheduler_tpu.models.speculative import (
+            generate_speculative,
+            make_sharded_speculative,
+        )
+        from hivedscheduler_tpu.parallel import topology
+
+        tgt_cfg = cfg_of()
+        dft_cfg = cfg_of(n_layers=1)
+        params, prompt = setup(tgt_cfg)
+        qp = quant.quantize_params(params, tgt_cfg)
+        dft_params = tm.init_params(dft_cfg, jax.random.PRNGKey(9))
+        want = decode.generate(qp, prompt, tgt_cfg, 7)
+        got, _ = generate_speculative(
+            qp, dft_params, prompt, tgt_cfg, dft_cfg, 7, gamma=2,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        mesh = topology.make_mesh(
+            topology.MeshAxes(dp=2, tp=2), topology.get_devices(4)
+        )
+        run, tgt_sh, dft_sh, prompt_sh = make_sharded_speculative(
+            tgt_cfg, dft_cfg, mesh, 7, gamma=2, quantized_target=True,
+        )
+        assert jax.tree.structure(tgt_sh) == jax.tree.structure(qp)
+        got_sh, _ = run(
+            jax.device_put(qp, tgt_sh),
+            jax.device_put(dft_params, dft_sh),
+            jax.device_put(prompt, prompt_sh),
+        )
+        np.testing.assert_array_equal(np.asarray(got_sh), np.asarray(want))
