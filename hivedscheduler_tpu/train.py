@@ -66,6 +66,10 @@ def main(argv=None) -> int:
                         help="warm-start params from another run's checkpoint "
                         "(fresh optimizer). With --lora-rank this is the "
                         "pretrained BASE model the adapters fine-tune")
+    parser.add_argument("--profile-dir", default="",
+                        help="capture a jax.profiler trace (TensorBoard/"
+                        "Perfetto format) of steps 2..4 into this directory "
+                        "— step 1 is compile and would drown the trace")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=50)
     parser.add_argument("--log-every", type=int, default=10)
@@ -175,7 +179,27 @@ def main(argv=None) -> int:
     )
     t0 = time.perf_counter()
     tokens_per_step = args.batch * args.seq_len
+    profiling = False
+    if args.profile_dir and args.steps - start_step < 2:
+        log.warning(
+            "--profile-dir needs at least 2 steps to trace (step 1 is "
+            "compile); %s step(s) will run — no trace will be written",
+            args.steps - start_step,
+        )
     for step in range(start_step, args.steps):
+        if args.profile_dir:
+            # trace steps 2..4 of this incarnation: past compile, short
+            # enough that the Perfetto UI stays responsive
+            rel = step - start_step
+            if rel == 1 and not profiling:
+                jax.profiler.start_trace(args.profile_dir)
+                profiling = True
+                log.info("profiler trace started -> %s", args.profile_dir)
+            elif rel == 4 and profiling:
+                jax.block_until_ready(loss)
+                jax.profiler.stop_trace()
+                profiling = False
+                log.info("profiler trace written to %s", args.profile_dir)
         tokens = data_lib.device_put_global(
             next(batches), token_sharding, args.batch
         )
@@ -196,6 +220,11 @@ def main(argv=None) -> int:
             )
         if args.checkpoint_dir and (step + 1) % args.checkpoint_every == 0:
             ckpt.save(args.checkpoint_dir, step + 1, params, opt_state)
+    if profiling:
+        # fewer than 4 steps ran after the trace started
+        jax.block_until_ready(loss)
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", args.profile_dir)
     if args.checkpoint_dir:
         ckpt.save(args.checkpoint_dir, args.steps, params, opt_state)
     log.info("training complete: %s steps", args.steps)
