@@ -300,6 +300,14 @@ class HivedAlgorithm(SchedulerAlgorithm):
             vc.set_physical_cell(c)
             log.info("Virtual cell %s is bound to physical cell %s", vc.address, c.address)
 
+    def _reclaim_doomed_cell(self, pc: PhysicalCell, vcn: str) -> None:
+        """Delist a doomed-bad cell and release its preassigned allocation —
+        the single bookkeeping trio shared by the heal, unbind and
+        release-time reclaim paths."""
+        self.vc_doomed_bad_cells[vcn][pc.chain].remove(pc, pc.level)
+        self.all_vc_doomed_bad_cell_num[pc.chain][pc.level] -= 1
+        self._release_preassigned_cell(pc, vcn, doomed_bad=True)
+
     def _set_healthy_cell(self, c: PhysicalCell) -> None:
         """Reference: setHealthyCell, hived_algorithm.go:526-560."""
         if c.healthy:
@@ -317,9 +325,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
                          vc.address, c.address)
                 if vc.parent is None:
                     # a preassigned cell: must be a doomed bad cell
-                    self.vc_doomed_bad_cells[vc.vc][c.chain].remove(c, c.level)
-                    self.all_vc_doomed_bad_cell_num[c.chain][c.level] -= 1
-                    self._release_preassigned_cell(c, vc.vc, doomed_bad=True)
+                    self._reclaim_doomed_cell(c, vc.vc)
         if c.parent is None:
             return
         for buddy in c.parent.children:
@@ -380,26 +386,47 @@ class HivedAlgorithm(SchedulerAlgorithm):
                 self._allocate_preassigned_cell(pc, vc_name, doomed_bad=True)
 
     def _try_unbind_doomed_bad_cell(self, chain: CellChain, level: CellLevel) -> None:
-        """Reference: tryUnbindDoomedBadCell, hived_algorithm.go:632-653."""
+        """Reference: tryUnbindDoomedBadCell, hived_algorithm.go:632-653.
+
+        Documented deviation (PARITY.md): only doomed cells NOT in real use
+        (priority below guaranteed) are unbound. A gang may legally land on
+        the healed part of a doomed-bound cell (the Preempting phase admits
+        bad nodes); the reference unbinds ``vcDoomedBadCells[0]`` regardless
+        and returns the in-use cell to the free list, where buddy merges
+        then bury a running guaranteed gang inside "free" cells — VC safety
+        is broken from that point on. Found by
+        tests/test_invariant_fuzz.py's free-list invariant. In-use doomed
+        cells stay bound; they become eligible here once released."""
         for vc_name, vc_free in self.vc_free_cell_num.items():
             if chain not in vc_free:
                 continue
-            while len(self.vc_doomed_bad_cells[vc_name][chain][level]) != 0 and vc_free[
-                chain
-            ].get(level, 0) < (
+            while vc_free[chain].get(level, 0) < (
                 self.total_left_cell_num[chain][level] - len(self.bad_free_cells[chain][level])
             ):
-                pc = self.vc_doomed_bad_cells[vc_name][chain][level][0]
-                assert isinstance(pc, PhysicalCell)
-                log.info(
-                    "Cell %s is no longer doomed to be bad and is unbound from %s",
-                    pc.virtual_cell.address, pc.address,
+                pc = next(
+                    (
+                        c
+                        for c in self.vc_doomed_bad_cells[vc_name][chain][level]
+                        if c.priority < MIN_GUARANTEED_PRIORITY
+                    ),
+                    None,
                 )
-                pc.virtual_cell.set_physical_cell(None)
-                pc.set_virtual_cell(None)
-                self.vc_doomed_bad_cells[vc_name][chain].remove(pc, level)
-                self.all_vc_doomed_bad_cell_num[chain][level] -= 1
-                self._release_preassigned_cell(pc, vc_name, doomed_bad=True)
+                if pc is None:
+                    break
+                assert isinstance(pc, PhysicalCell)
+                if pc.virtual_cell is not None:
+                    log.info(
+                        "Cell %s is no longer doomed to be bad and is unbound from %s",
+                        pc.virtual_cell.address, pc.address,
+                    )
+                    pc.virtual_cell.set_physical_cell(None)
+                    pc.set_virtual_cell(None)
+                else:
+                    # the binding was already stripped by unbind_cell when the
+                    # group using the (healed) doomed cell released — the
+                    # reference nil-derefs here; we just reclaim the cell
+                    log.info("Doomed cell %s (already unbound) reclaimed", pc.address)
+                self._reclaim_doomed_cell(pc, vc_name)
 
     # ------------------------------------------------------------------
     # scheduling entry
@@ -1494,24 +1521,53 @@ class HivedAlgorithm(SchedulerAlgorithm):
         vcn: str,
         batch: Optional[UsedCountBatch] = None,
     ) -> None:
-        """Reference: releaseLeafCell, hived_algorithm.go:1327-1352."""
+        """Reference: releaseLeafCell, hived_algorithm.go:1327-1352.
+
+        Documented deviation (PARITY.md): when the virtual binding exists
+        only because the cell is doomed-bad (virtual priority still FREE —
+        the pod using the cell was opportunistic, and OT allocation never
+        touches the virtual books), the release takes the opportunistic
+        branch instead of the reference's virtual branch. The reference
+        decrements the virtual used-counts at freePriority here, planting a
+        permanent ``{freePriority: -1}`` entry that skews cluster-view
+        scoring; found by tests/test_invariant_fuzz.py's recount invariant."""
         v_leaf_cell = p_leaf_cell.virtual_cell
-        if v_leaf_cell is not None:
+        doomed_only = (
+            v_leaf_cell is not None and v_leaf_cell.priority == FREE_PRIORITY
+        )
+        if v_leaf_cell is not None and not doomed_only:
             release_cell_walk(v_leaf_cell, v_leaf_cell.priority, batch)
             preassigned_physical = v_leaf_cell.preassigned_cell.physical_cell
             if p_leaf_cell.healthy:
                 # keep the binding if the cell is bad
                 unbind_cell(p_leaf_cell)
+            doomed_list = self.vc_doomed_bad_cells[vcn][preassigned_physical.chain]
+            in_doomed = doomed_list.contains(
+                preassigned_physical, preassigned_physical.level
+            )
             if (
+                in_doomed
+                and preassigned_physical.virtual_cell is None
+                and not preassigned_physical.pinned
+            ):
+                # the last user of a doomed cell that HEALED while in use
+                # just released it (unbind_cell stripped the binding up to
+                # the preassigned level): reclaim it now, else the books
+                # count it allocated while in_free_cell_list sees it free
+                # (deviation, PARITY.md; found by test_invariant_fuzz)
+                log.info("Healed doomed cell %s reclaimed on release",
+                         preassigned_physical.address)
+                self._reclaim_doomed_cell(preassigned_physical, vcn)
+            elif (
                 not preassigned_physical.pinned
                 and v_leaf_cell.preassigned_cell.priority < MIN_GUARANTEED_PRIORITY
-                and not self.vc_doomed_bad_cells[vcn][preassigned_physical.chain].contains(
-                    preassigned_physical, preassigned_physical.level
-                )
+                and not in_doomed
             ):
                 self._release_preassigned_cell(preassigned_physical, vcn, doomed_bad=False)
         else:
-            p_leaf_cell.api_status.vc = ""
+            # doomed-bad-only binding: the binding (and its vc marking in
+            # the API mirror) survives; only the opportunistic books go
+            p_leaf_cell.api_status.vc = v_leaf_cell.vc if doomed_only else ""
             self.api_cluster_status.virtual_clusters[vcn] = delete_ot_virtual_cell(
                 self.api_cluster_status.virtual_clusters[vcn], p_leaf_cell.address
             )

@@ -1,0 +1,322 @@
+"""Randomized invariant-checking stress harness for the scheduler core.
+
+Golden tests pin known decision sequences; this harness explores the state
+space the goldens can't: hundreds of random schedule / allocate / delete /
+preempt / bad-node / cancel operations, with the algorithm's structural
+invariants re-derived FROM SCRATCH and checked after every operation:
+
+- **VC safety** (the paper's core guarantee, hived_algorithm.go:1242-1292):
+  totalLeftCellNum[chain][level] >= allVCFreeCellNum[chain][level] always.
+- **Used-count books**: every cell's used_leaf_cell_num_at_priorities dict
+  equals a recount of its allocated leaf descendants — this directly guards
+  the batched bookkeeping walks (UsedCountBatch) against drift.
+- **Priority max-invariant**: parent priority == max(children priorities)
+  on both trees (reference setCellPriority, cell_allocation.go:425-441).
+- **Free-list hygiene**: free cells carry FREE priority, no using group,
+  and a consistent parent split flag.
+- **Full-delete restoration**: after deleting every gang and healing every
+  node, the entire reachable state (free lists, counters, priorities,
+  states, bindings) equals a freshly built algorithm's — the reference's
+  testDeletePods invariant (hived_algorithm_test.go:734) at fuzz scale.
+"""
+
+import logging
+import random
+
+import pytest
+
+from hivedscheduler_tpu.algorithm.constants import (
+    CELL_FREE,
+    FREE_PRIORITY,
+    LOWEST_LEVEL,
+)
+from hivedscheduler_tpu.algorithm.hived import HivedAlgorithm
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.api.config import Config, new_config
+from hivedscheduler_tpu.api.types import (
+    CellTypeSpec,
+    MeshLevelSpec,
+    MeshSpec,
+    PhysicalCellSpec,
+    PhysicalClusterSpec,
+    VirtualCellSpec,
+    VirtualClusterSpec,
+)
+from hivedscheduler_tpu.k8s.types import Node
+from hivedscheduler_tpu.runtime.types import FILTERING_PHASE, PREEMPTING_PHASE
+from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+from helpers import make_pod
+
+
+@pytest.fixture(autouse=True)
+def _mute_algorithm_logs():
+    """The fuzz drives thousands of scheduler ops; scope the log muting to
+    this module so caplog-style tests elsewhere keep seeing records."""
+    logging.disable(logging.CRITICAL)
+    yield
+    logging.disable(logging.NOTSET)
+
+
+def build_config() -> Config:
+    """A v5p-64 mesh chain (4x4x4, 2x2x1 hosts) + a generic 16-chip chain,
+    three VCs with mixed quotas."""
+    mesh = MeshSpec(
+        topology=(4, 4, 4), chip_type="v5p-chip", host_shape=(2, 2, 1),
+        levels=[
+            MeshLevelSpec(name="v5p-2x2x1", shape=(2, 2, 1)),
+            MeshLevelSpec(name="v5p-2x2x2", shape=(2, 2, 2)),
+            MeshLevelSpec(name="v5p-4x2x2", shape=(4, 2, 2)),
+            MeshLevelSpec(name="v5p-4x4x2", shape=(4, 4, 2)),
+            MeshLevelSpec(name="v5p-4x4x4", shape=(4, 4, 4)),
+        ],
+    )
+    generic = CellTypeSpec(
+        child_cell_type="v4-node", child_cell_number=4, is_node_level=False,
+    )
+    v4_node = CellTypeSpec(
+        child_cell_type="v4-chip", child_cell_number=4, is_node_level=True,
+    )
+    return new_config(Config(
+        physical_cluster=PhysicalClusterSpec(
+            cell_types={
+                "v5p-64": CellTypeSpec(mesh=mesh),
+                "v4-pool": generic,
+                "v4-node": v4_node,
+            },
+            physical_cells=[
+                PhysicalCellSpec(cell_type="v5p-64", cell_address="pod0"),
+                PhysicalCellSpec(cell_type="v4-pool", cell_address="pool0"),
+            ],
+        ),
+        virtual_clusters={
+            "vc-a": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=1, cell_type="v5p-64.v5p-4x4x2"),
+                VirtualCellSpec(cell_number=2, cell_type="v4-pool.v4-node"),
+            ]),
+            "vc-b": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=2, cell_type="v5p-64.v5p-2x2x2"),
+            ]),
+            "vc-c": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=2, cell_type="v5p-64.v5p-2x2x1"),
+                VirtualCellSpec(cell_number=1, cell_type="v4-pool.v4-node"),
+            ]),
+        },
+    ))
+
+
+def all_cells(ccl):
+    for level in sorted(ccl):
+        for c in ccl[level]:
+            yield c
+
+
+def leaf_descendants(c):
+    if not c.children:
+        yield c
+        return
+    for ch in c.children:
+        yield from leaf_descendants(ch)
+
+
+class Harness:
+    def __init__(self, seed: int):
+        self.rng = random.Random(seed)
+        self.algo = HivedAlgorithm(build_config())
+        self.nodes = sorted({
+            n for ccl in self.algo.full_cell_list.values()
+            for c in ccl[max(ccl)] for n in c.nodes
+        })
+        for n in self.nodes:
+            self.algo.add_node(Node(name=n))
+        self.bad_nodes = set()
+        self.groups = {}  # name -> list of bound pods
+        self.gid = 0
+
+    # ---------------- operations ----------------
+
+    def op_schedule_gang(self):
+        rng = self.rng
+        vc = rng.choice(["vc-a", "vc-b", "vc-c"])
+        prio = rng.choice([-1, -1, 0, 1, 5, 10])
+        leaf_type = rng.choice(["v5p-chip", "v5p-chip", "v4-chip"])
+        pods, chips = rng.choice([(1, 1), (1, 2), (1, 4), (2, 4), (4, 4), (2, 8)])
+        name = f"g{self.gid}"
+        self.gid += 1
+        spec = {
+            "virtualCluster": vc, "priority": prio, "leafCellType": leaf_type,
+            "leafCellNumber": chips,
+            "affinityGroup": {
+                "name": name,
+                "members": [{"podNumber": pods, "leafCellNumber": chips}],
+            },
+        }
+        bound = []
+        for i in range(pods):
+            pod = make_pod(f"{name}-{i}", spec)
+            r = None
+            for _attempt in range(64):
+                phase = PREEMPTING_PHASE if _attempt else FILTERING_PHASE
+                try:
+                    r = self.algo.schedule(pod, self.nodes, phase)
+                except api.WebServerError as e:
+                    # a legitimate user-error rejection (e.g. a guaranteed
+                    # request for a leaf type this VC has no quota of) —
+                    # must be a 4xx and must leave no partial state behind
+                    assert 400 <= e.code < 500, e
+                    for bp in bound:
+                        self.algo.delete_allocated_pod(bp)
+                    return
+                if r.pod_preempt_info is not None:
+                    for victim in r.pod_preempt_info.victim_pods:
+                        self._kill_owner(victim)
+                    continue
+                break
+            if r.pod_bind_info is None:
+                # gang unplaceable: roll back my pods AND cancel a possible
+                # preempting group left behind (not all members placed)
+                for bp in bound:
+                    self.algo.delete_allocated_pod(bp)
+                self.algo.delete_unallocated_pod(pod)
+                return
+            bp = new_binding_pod(pod, r.pod_bind_info)
+            self.algo.add_allocated_pod(bp)
+            bound.append(bp)
+        self.groups[name] = bound
+
+    def _kill_owner(self, victim):
+        for name, pods in list(self.groups.items()):
+            if any(bp.uid == victim.uid for bp in pods):
+                self.op_delete_gang(name)
+                return
+
+    def op_delete_gang(self, name=None):
+        if not self.groups:
+            return
+        name = name or self.rng.choice(list(self.groups))
+        for bp in self.groups.pop(name):
+            self.algo.delete_allocated_pod(bp)
+
+    def op_flip_node(self):
+        n = self.rng.choice(self.nodes)
+        if n in self.bad_nodes:
+            self.bad_nodes.discard(n)
+            self.algo.add_node(Node(name=n))
+        else:
+            self.bad_nodes.add(n)
+            self.algo.delete_node(Node(name=n))
+
+    def heal_all(self):
+        for n in sorted(self.bad_nodes):
+            self.algo.add_node(Node(name=n))
+        self.bad_nodes.clear()
+
+    # ---------------- invariants ----------------
+
+    def check_invariants(self, ctx=""):
+        a = self.algo
+        # 1. VC safety inequality at every chain/level
+        for chain, levels in a.total_left_cell_num.items():
+            for level, left in levels.items():
+                free = a.all_vc_free_cell_num.get(chain, {}).get(level, 0)
+                assert left >= free, (
+                    f"{ctx}: VC safety broken: chain {chain} level {level}: "
+                    f"{left} left < {free} free in all VCs"
+                )
+        # 2 + 3. books and priority max-invariant on both trees
+        trees = list(a.full_cell_list.items()) + [
+            (f"{vcn}/{chain}", ccl)
+            for vcn, sched in a.vc_schedulers.items()
+            for chain, ccl in sched.non_pinned_full_cell_list.items()
+        ]
+        for label, ccl in trees:
+            for c in all_cells(ccl):
+                recount = {}
+                for leaf in leaf_descendants(c):
+                    if leaf.priority != FREE_PRIORITY:
+                        recount[leaf.priority] = recount.get(leaf.priority, 0) + 1
+                assert dict(c.used_leaf_cell_num_at_priorities) == recount, (
+                    f"{ctx}: used-count books drifted at {label}:{c.address}: "
+                    f"{c.used_leaf_cell_num_at_priorities} != recount {recount}"
+                )
+                if c.children:
+                    max_child = max(ch.priority for ch in c.children)
+                    assert c.priority == max_child, (
+                        f"{ctx}: priority invariant broken at {label}:"
+                        f"{c.address}: {c.priority} != max(children) {max_child}"
+                    )
+        # 4. free-list hygiene: "free" means free of a VC binding, not idle
+        # — opportunistic pods legitimately run on free-list cells (the
+        # reference's opportunistic path never touches the free list), but a
+        # GUARANTEED priority in the free list would mean a VC binding leaked
+        from hivedscheduler_tpu.algorithm.constants import MIN_GUARANTEED_PRIORITY
+
+        for chain, fl in a.free_cell_list.items():
+            for level in sorted(fl):
+                for c in fl[level]:
+                    assert c.priority < MIN_GUARANTEED_PRIORITY, (
+                        f"{ctx}: free cell {c.address} carries guaranteed "
+                        f"priority {c.priority}"
+                    )
+
+    def snapshot(self):
+        """Full reachable state of the physical + virtual trees."""
+        a = self.algo
+        snap = {}
+        for chain, ccl in a.full_cell_list.items():
+            for c in all_cells(ccl):
+                snap[("P", chain, c.address)] = (
+                    c.priority, c.state, c.healthy,
+                    dict(c.used_leaf_cell_num_at_priorities),
+                    c.virtual_cell.address if c.virtual_cell else None,
+                    c.split,
+                )
+        for vcn, sched in a.vc_schedulers.items():
+            for chain, ccl in sched.non_pinned_full_cell_list.items():
+                for c in all_cells(ccl):
+                    snap[("V", vcn, chain, c.address)] = (
+                        c.priority, c.state, c.healthy,
+                        dict(c.used_leaf_cell_num_at_priorities),
+                        c.physical_cell.address if c.physical_cell else None,
+                    )
+        snap["free"] = {
+            chain: {lvl: sorted(c.address for c in fl[lvl]) for lvl in fl}
+            for chain, fl in a.free_cell_list.items()
+        }
+        snap["left"] = {c: dict(v) for c, v in a.total_left_cell_num.items()}
+        snap["allvcfree"] = {c: dict(v) for c, v in a.all_vc_free_cell_num.items()}
+        return snap
+
+
+@pytest.mark.parametrize("seed", list(range(8)))
+def test_fuzzed_operations_preserve_invariants(seed):
+    h = Harness(seed)
+    h.check_invariants("init")
+    ops = [
+        (h.op_schedule_gang, 5),
+        (h.op_delete_gang, 3),
+        (h.op_flip_node, 1),
+    ]
+    weighted = [f for f, w in ops for _ in range(w)]
+    for i in range(400):
+        h.rng.choice(weighted)()
+        h.check_invariants(f"seed {seed} op {i}")
+    assert h.gid > 100  # the fuzz actually scheduled things
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_full_delete_restores_pristine_state(seed):
+    """After deleting every gang and healing every node, the whole reachable
+    state must equal a fresh algorithm's (reference testDeletePods scaled)."""
+    pristine = Harness(seed).snapshot()
+    h = Harness(seed)
+    for i in range(150):
+        h.rng.choice(
+            [h.op_schedule_gang, h.op_schedule_gang, h.op_schedule_gang,
+             h.op_delete_gang, h.op_flip_node]
+        )()
+    h.heal_all()
+    while h.groups:
+        h.op_delete_gang()
+    h.check_invariants("final")
+    assert h.snapshot() == pristine
