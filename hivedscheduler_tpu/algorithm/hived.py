@@ -308,6 +308,45 @@ class HivedAlgorithm(SchedulerAlgorithm):
         self.all_vc_doomed_bad_cell_num[pc.chain][pc.level] -= 1
         self._release_preassigned_cell(pc, vcn, doomed_bad=True)
 
+    def _reclaim_doomed_overlapping(self, top: PhysicalCell) -> None:
+        """Reclaim every doomed-bad binding overlapping ``top`` — inside its
+        subtree OR on its ancestor path (any VC): doomed bindings mark
+        FREE-but-bad capacity, so a recovered allocation that needs the
+        cell trumps them — the inequality that doomed them re-evaluates on
+        later events."""
+
+        def contains(outer: PhysicalCell, inner: PhysicalCell) -> bool:
+            c: Optional[PhysicalCell] = inner
+            while c is not None and c is not outer:
+                c = c.parent  # type: ignore[assignment]
+            return c is outer
+
+        for vc_name, chains in self.vc_doomed_bad_cells.items():
+            ccl = chains.get(top.chain)
+            if ccl is None:
+                continue
+            for level in sorted(ccl):
+                for pc in list(ccl[level]):
+                    assert isinstance(pc, PhysicalCell)
+                    if pc.priority >= MIN_GUARANTEED_PRIORITY:
+                        # in real use: a genuine conflict, not a marker —
+                        # the caller's allocatability guard lazy-preempts
+                        continue
+                    if not (
+                        contains(top, pc) if level <= top.level else contains(pc, top)
+                    ):
+                        continue
+                    fvc = pc.virtual_cell
+                    if fvc is not None:
+                        fvc.set_physical_cell(None)
+                        pc.set_virtual_cell(None)
+                    log.warning(
+                        "Doomed-bad binding on %s (VC %s) reclaimed: a "
+                        "recovered allocation needs overlapping cell %s",
+                        pc.address, vc_name, top.address,
+                    )
+                    self._reclaim_doomed_cell(pc, vc_name)
+
     def _set_healthy_cell(self, c: PhysicalCell) -> None:
         """Reference: setHealthyCell, hived_algorithm.go:526-560."""
         if c.healthy:
@@ -1413,6 +1452,28 @@ class HivedAlgorithm(SchedulerAlgorithm):
         if group.virtual_leaf_cell_placement is not None and not lazy_preempted:
             preassigned_type = preassigned_cell_types[index]
             if preassigned_type:
+                if p_leaf_cell.virtual_cell is not None:
+                    # a still-bad leaf keeps its init-time doomed-bad child
+                    # binding; mapPhysicalCellToVirtual would return that
+                    # (possibly other-VC) vcell verbatim and the allocation
+                    # books would be charged to the wrong VC — the reference
+                    # silently corrupts vcFreeCellNum here via Go map
+                    # auto-vivification. Reclaim the doomed chain first so
+                    # the mapping re-derives from the pod's own VC quota.
+                    b_pre = p_leaf_cell.virtual_cell.preassigned_cell
+                    held = b_pre.physical_cell
+                    if held is not None and held.priority < MIN_GUARANTEED_PRIORITY and self.vc_doomed_bad_cells[b_pre.vc][
+                        held.chain
+                    ].contains(held, held.level):
+                        log.warning(
+                            "[%s]: Recovered leaf %s carries doomed-bad "
+                            "binding %s (VC %s); reclaiming it before mapping",
+                            internal_utils.key(pod), p_leaf_cell.address,
+                            p_leaf_cell.virtual_cell.address, b_pre.vc,
+                        )
+                        b_pre.set_physical_cell(None)
+                        held.set_virtual_cell(None)
+                        self._reclaim_doomed_cell(held, b_pre.vc)
                 preassigned_level: Optional[CellLevel] = None
                 for l, t in self.cell_types.get(p_leaf_cell.chain, {}).items():
                     if t == preassigned_type:
@@ -1444,6 +1505,58 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     log.warning("[%s]: Cannot find virtual cell: %s",
                                 internal_utils.key(pod), message)
                     return p_leaf_cell, None, True
+                # Recovery starts with every uninformed node bad, so
+                # init-time doomed-bad binds can sit exactly where a
+                # replayed pod must allocate — either holding the pod's own
+                # preassigned vcell (pointed at the wrong physical cell) or
+                # holding the physical ancestor the pod needs (the reference
+                # panics in removeCellFromFreeList either way). A doomed
+                # marker yields to the rightful owner; any other conflicting
+                # binding lazy-preempts the group.
+                p_pre = p_leaf_cell
+                while p_pre.level < preassigned_level:
+                    p_pre = p_pre.parent  # type: ignore[assignment]
+                pac = v_leaf_cell.preassigned_cell
+                if pac.physical_cell is not None and pac.physical_cell is not p_pre:
+                    held = pac.physical_cell
+                    if held.priority < MIN_GUARANTEED_PRIORITY and self.vc_doomed_bad_cells[
+                        pac.vc
+                    ][held.chain].contains(held, held.level):
+                        log.warning(
+                            "[%s]: Recovered preassigned cell %s is doomed-bad "
+                            "bound to %s, not this pod's placement %s; "
+                            "reclaiming the doomed binding",
+                            internal_utils.key(pod), pac.address, held.address,
+                            p_pre.address,
+                        )
+                        pac.set_physical_cell(None)
+                        held.set_virtual_cell(None)
+                        self._reclaim_doomed_cell(held, pac.vc)
+                    else:
+                        log.warning(
+                            "[%s]: Recovered preassigned cell %s already bound "
+                            "to %s, not this pod's placement %s; lazy preempting",
+                            internal_utils.key(pod), pac.address, held.address,
+                            p_pre.address,
+                        )
+                        return p_leaf_cell, None, True
+                if pac.physical_cell is None:
+                    # the fresh preassigned binding will need p_pre whole:
+                    # clear any doomed-bad markers inside it (free-but-bad
+                    # capacity yields to the returning owner; reclaiming
+                    # also re-merges the buddies they split), then verify
+                    # the cell is actually allocatable — anything still
+                    # bound or split means a real conflicting binding, and
+                    # the tolerance ladder says lazy preempt, not panic
+                    self._reclaim_doomed_overlapping(p_pre)
+                    if p_pre.split or not in_free_cell_list(p_pre):
+                        log.warning(
+                            "[%s]: Recovered placement needs cell %s which "
+                            "is still held by conflicting bindings; lazy "
+                            "preempting",
+                            internal_utils.key(pod), p_pre.address,
+                        )
+                        return p_leaf_cell, None, True
                 if (
                     v_leaf_cell.preassigned_cell.physical_cell is None
                     and self._under_foreign_pin(p_leaf_cell)

@@ -320,3 +320,76 @@ def test_full_delete_restores_pristine_state(seed):
         h.op_delete_gang()
     h.check_invariants("final")
     assert h.snapshot() == pristine
+
+
+def _group_view(algo, with_lazy):
+    out = {}
+    for name, g in algo.affinity_groups.items():
+        placement = {}
+        for ln, podps in g.physical_leaf_cell_placement.items():
+            placement[ln] = [
+                sorted(c.address for c in podp if c is not None)
+                for podp in podps
+            ]
+        view = (g.vc, g.priority, placement)
+        if with_lazy:
+            view += (
+                g.virtual_leaf_cell_placement is None,
+                g.lazy_preemption_status is None,
+            )
+        out[name] = view
+    return out
+
+
+def _replay(h):
+    """The runtime's recovery barrier: fresh algorithm, healthy nodes
+    informed, every bound pod replayed from its annotations."""
+    fresh = HivedAlgorithm(build_config())
+    for n in h.nodes:
+        if n not in h.bad_nodes:
+            fresh.add_node(Node(name=n))
+    for name in sorted(h.groups):
+        for bp in h.groups[name]:
+            fresh.add_allocated_pod(bp)
+    h2 = Harness.__new__(Harness)  # reuse the invariant checker
+    h2.algo = fresh
+    return fresh, h2
+
+
+@pytest.mark.parametrize("seed", [0, 3, 5])
+def test_recovery_replay_preserves_state(seed):
+    """Crash recovery at a fuzzed state with every node healthy at crash
+    time: the replayed instance must carry the exact same groups —
+    placement, VC, priority AND lazy-preemption status."""
+    h = Harness(seed)
+    for i in range(150):
+        h.rng.choice(
+            [h.op_schedule_gang, h.op_schedule_gang, h.op_schedule_gang,
+             h.op_delete_gang, h.op_flip_node]
+        )()
+    h.heal_all()
+    before = _group_view(h.algo, with_lazy=True)
+    fresh, h2 = _replay(h)
+    h2.check_invariants(f"seed {seed} after replay")
+    assert _group_view(fresh, with_lazy=True) == before
+
+
+@pytest.mark.parametrize("seed", [0, 3, 5])
+def test_recovery_replay_under_bad_nodes(seed):
+    """Crash recovery with arbitrary bad nodes at crash time. The reference
+    panics or silently corrupts its books when init-time doomed-bad
+    bindings collide with replayed placements (see the doomed-bad
+    deviations in PARITY.md); we require a clean replay: invariants hold
+    and every group keeps its placement, VC and priority. Lazy-preemption
+    status is allowed to differ — the tolerance ladder deliberately
+    lazy-preempts groups whose safety cannot be proven mid-replay."""
+    h = Harness(seed)
+    for i in range(150):
+        h.rng.choice(
+            [h.op_schedule_gang, h.op_schedule_gang, h.op_schedule_gang,
+             h.op_delete_gang, h.op_flip_node]
+        )()
+    before = _group_view(h.algo, with_lazy=False)
+    fresh, h2 = _replay(h)
+    h2.check_invariants(f"seed {seed} after replay")
+    assert _group_view(fresh, with_lazy=False) == before
