@@ -417,6 +417,35 @@ def _moe_mlp(
     return out, aux
 
 
+def _flash_gspmd(q, k, v, mesh, attn_fn):
+    """Run the Pallas flash kernel sharded over dp/fsdp (batch) and tp
+    (heads) via shard_map. GSPMD treats a pallas_call as opaque and would
+    otherwise all-gather q/k/v and run it replicated on every device; batch
+    and head sharding need no cross-device communication, so the manual
+    wrapper keeps the kernel local. Falls back to the replicated call when
+    the shards don't divide (GSPMD then handles it correctly, just slower).
+    The sequence axis is gathered (spec None): flash attends over the full
+    sequence — sequence-parallel attention is the ring family's job."""
+    from hivedscheduler_tpu.parallel.ring_attention import _get_shard_map
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    ndp = sizes.get("dp", 1) * sizes.get("fsdp", 1)
+    tp = sizes.get("tp", 1)
+    b, _, h, _ = q.shape
+    h_kv = k.shape[2]
+    if b % ndp or h % tp or h_kv % tp:
+        return attn_fn(q, k, v, causal=True)
+    spec = P(("dp", "fsdp"), None, "tp", None)
+    body = lambda q, k, v: attn_fn(q, k, v, causal=True)
+    kw = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    try:
+        # the pallas_call's out_shape avals carry no vma info; skip the check
+        fn = _get_shard_map()(body, check_vma=False, **kw)
+    except TypeError:  # older jax spells it check_rep
+        fn = _get_shard_map()(body, check_rep=False, **kw)
+    return fn(q, k, v)
+
+
 def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
                  manual_tp_axis=None, manual_sp_axis=None, manual_ep_axis=None,
                  manual_vma_axes=()):
@@ -471,7 +500,7 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
         # k/v head for its q-head group here, after RoPE so the rotation
         # runs on the small head count; contiguous grouping keeps groups
         # aligned with tp shards.
-        compact_ok = cfg.attn_impl in ("ring", "ring_zigzag")
+        compact_ok = cfg.attn_impl in ("ring", "ring_zigzag", "flash")
         if compact_ok and manual_sp_axis is None and mesh is not None:
             tp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
             compact_ok = k.shape[2] % tp_size == 0
@@ -499,6 +528,16 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
             )
     elif cfg.attn_impl in RING_FAMILY:
         attn = attn_fn(q, k, v, mesh, causal=True)
+    elif cfg.attn_impl == "flash" and mesh is not None:
+        if manual_tp_axis is None and manual_ep_axis is None:
+            attn = _flash_gspmd(q, k, v, mesh, attn_fn)
+        else:
+            # GSPMD shard_map cannot open inside a manual (pipeline-stage)
+            # context (CLAUDE.md shard_map rule): arrays are already
+            # device-local, so call the kernel directly — passing the
+            # varying axes so its pallas out_shape avals type under the
+            # enclosing shard_map's vma checker
+            attn = attn_fn(q, k, v, causal=True, vma=manual_vma_axes)
     else:
         attn = attn_fn(q, k, v, causal=True)
     o = jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))

@@ -1,11 +1,24 @@
-"""Fused causal attention.
+"""Fused causal attention with a trainable Pallas TPU kernel.
 
-``flash_attention`` is a Pallas TPU kernel (online-softmax over key/value
-blocks, never materializing the [T, T] score matrix in HBM); on non-TPU
-backends it runs the same kernel through the Pallas interpreter, and
+``flash_attention`` is a flash-attention Pallas kernel (online-softmax over
+key/value blocks, never materializing the [T, T] score matrix in HBM) with a
+``jax.custom_vjp``: the forward kernel additionally emits the per-row
+log-sum-exp residual and two backward kernels recompute block scores to
+produce dq and dk/dv, so the op is usable in training, not just inference.
+On non-TPU backends the same kernels run through the Pallas interpreter;
 ``xla_attention`` is the plain einsum reference used for correctness checks
-and as a safe fallback. Blocks are sized to the MXU/VPU tiling constraints
-(multiples of 128 lanes).
+and as a safe fallback for shapes that don't tile.
+
+Grouped-query attention is supported natively: k/v may carry fewer heads than
+q (``h % h_kv == 0``) and the kernels index the shared k/v head for each
+query-head grid step directly, so compact GQA k/v never has to be
+materialized to the full head count.
+
+TPU/mosaic notes: all iotas are 2-D ``broadcasted_iota`` and the log-sum-exp
+residual is stored 128-lanes wide ([B*H, T, 128], every lane equal), matching
+the layout constraints the hardware vector unit imposes (the same convention
+jax's reference TPU kernel uses). Softmax statistics live as [block, 1]
+columns, which mosaic lane-broadcasts.
 """
 
 from __future__ import annotations
@@ -17,31 +30,68 @@ import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30
+_LANES = 128  # minimum lane width for stored residuals
 
 
 def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True) -> jax.Array:
-    """Reference attention: q/k/v [B, T, H, D] -> [B, T, H, D]."""
-    scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    """Reference attention: q [B, T, H, D], k/v [B, T, H_kv, D] -> [B, T, H, D].
+
+    Supports grouped-query attention (H_kv dividing H) via grouped einsums,
+    without materializing repeated k/v heads.
+    """
+    b, t_q, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(
+            f"GQA needs n_heads divisible by kv heads: {h} % {h_kv} != 0"
+        )
+    scale = 1.0 / (d**0.5)
+    if h_kv == h:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+        if causal:
+            t_k = k.shape[1]
+            mask = lax.iota(jnp.int32, t_q)[:, None] >= lax.iota(jnp.int32, t_k)[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)
+    g = h // h_kv
+    qg = q.reshape(b, t_q, h_kv, g, d)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32) * scale
     if causal:
-        t_q, t_k = q.shape[1], k.shape[1]
+        t_k = k.shape[1]
         mask = lax.iota(jnp.int32, t_q)[:, None] >= lax.iota(jnp.int32, t_k)[None, :]
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v.astype(p.dtype))
+    return out.reshape(b, t_q, h, d).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, scale: float):
-    """One grid step handles one (batch*head, q-block); loops over k blocks
-    with online softmax. Refs are [block_q, D] / [T, D] slices."""
+try:  # pallas is TPU/GPU-oriented; import lazily-tolerant for exotic builds
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover
+    pl = None
+
+
+def _causal_mask(s, q_offset, k_offset):
+    """Mask [bq, bk] scores with absolute row/col offsets (2-D iotas only)."""
+    bq, bk = s.shape
+    rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_offset
+    cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_offset
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
+    """One grid step handles one (batch*q-head, q-block); loops over k blocks
+    with online softmax. q/o refs are [block_q, D]; k/v refs [T, D] (the
+    shared GQA head for this q head); lse_ref [block_q, 128]."""
     block_q, d = q_ref.shape
     t_k = k_ref.shape[0]
     q_blk_idx = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32) * scale
     q_offset = q_blk_idx * block_q
 
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
     o = jnp.zeros((block_q, d), jnp.float32)
 
     num_k_blocks = t_k // block_k
@@ -57,30 +107,254 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sca
         v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
-            q_pos = q_offset + lax.iota(jnp.int32, block_q)
-            k_pos = j * block_k + lax.iota(jnp.int32, block_k)
-            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
-        m_blk = jnp.max(s, axis=-1)
+            s = _causal_mask(s, q_offset, j * block_k)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_blk)
+        # clamp so fully-masked partial rows exp() to 0 instead of 1
         m_safe = jnp.maximum(m_new, -0.5 * abs(NEG_INF))
-        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.exp(s - m_safe)
         corr = jnp.exp(jnp.maximum(m, -0.5 * abs(NEG_INF)) - m_safe)
-        l = l * corr + jnp.sum(p, axis=-1)
-        o = o * corr[:, None] + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * corr + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
         return m_new, l, o
 
     m, l, o = lax.fori_loop(0, last_block, body, (m, l, o))
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[:] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    o_ref[:] = (o / l_safe).astype(o_ref.dtype)
+    lse = jnp.maximum(m, -0.5 * abs(NEG_INF)) + jnp.log(l_safe)
+    lse_ref[:] = jnp.broadcast_to(lse, (block_q, _LANES))
 
 
-try:  # pallas is TPU/GPU-oriented; import lazily-tolerant for exotic builds
-    from jax.experimental import pallas as pl
-except Exception:  # pragma: no cover
-    pl = None
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *, block_k, causal, scale
+):
+    """dq for one (batch*q-head, q-block): recompute scores per k block.
+
+    ds = p * (dp - delta), dq = scale * ds @ k  (standard flash backward)."""
+    block_q, d = q_ref.shape
+    t_k = k_ref.shape[0]
+    q_blk_idx = pl.program_id(1)
+    q_offset = q_blk_idx * block_q
+    q = q_ref[:].astype(jnp.float32) * scale
+    do = do_ref[:].astype(jnp.float32)
+    o = o_ref[:].astype(jnp.float32)
+    lse = lse_ref[:, :1]  # [bq, 1]
+    delta = jnp.sum(do * o, axis=-1, keepdims=True)  # [bq, 1]
+
+    if causal:
+        last_block = lax.div(q_offset + block_q - 1, block_k) + 1
+    else:
+        last_block = t_k // block_k
+
+    def body(j, dq):
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_offset, j * block_k)
+        p = jnp.exp(s - lse)  # masked entries: exp(NEG_INF - lse) == 0
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, last_block, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def _bwd_dkv_kernel(
+    k_ref, v_ref, q_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
+    *, block_q, causal, scale,
+):
+    """dk/dv for one (batch*q-head, k-block): loop over contributing q blocks.
+
+    dv = p^T @ do ; dk = scale * ds^T @ q. For GQA the per-q-head partials
+    are summed over the head group outside the kernel."""
+    block_k, d = k_ref.shape
+    t_q = q_ref.shape[0]
+    k_blk_idx = pl.program_id(1)
+    k_offset = k_blk_idx * block_k
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+
+    num_q_blocks = t_q // block_q
+    first_block = lax.div(k_offset, block_q) if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
+        do_blk = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        o_blk = o_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[pl.ds(i * block_q, block_q), :1]  # [bq, 1]
+        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, i * block_q, k_offset)
+        p = jnp.exp(s - lse_blk)  # [bq, bk]
+        # dv += p^T @ do  (contract the q axis)
+        dv = dv + lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        delta = jnp.sum(do_blk * o_blk, axis=-1, keepdims=True)
+        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = lax.fori_loop(first_block, num_q_blocks, body, (zeros, zeros))
+    # q_blk already carried the 1/sqrt(d) scale; dk = d(scale*q k^T)/dk * ...
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _fold(x):
+    """[B, T, H, D] -> [B*H, T, D]."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _unfold(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret, vma=()):
+    """-> (o [B,T,H,D], lse [B*H, T, 128] f32). Accepts compact GQA k/v.
+
+    ``vma``: mesh axes the data varies over when called inside a manual
+    (shard_map) context with check_vma=True — stamped on the pallas
+    out_shape avals so the vma checker can type the outputs."""
+    svma = frozenset(vma) if vma else None
+    b, t, h, d = q.shape
+    h_kv = k.shape[2]
+    group = h // h_kv
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
+                               scale=1.0 / (d**0.5))
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j, g=group: (i // g, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j, g=group: (i // g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype, vma=svma),
+            jax.ShapeDtypeStruct((b * h, t, _LANES), jnp.float32, vma=svma),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return _unfold(o, b, h), lse
+
+
+def _flash_backward(q, k, v, o, lse, g, *, causal, block_q, block_k, interpret,
+                    vma=()):
+    svma = frozenset(vma) if vma else None
+    b, t, h, d = q.shape
+    h_kv = k.shape[2]
+    group = h // h_kv
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    of, gf = _fold(o), _fold(g)
+    scale = 1.0 / (d**0.5)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale
+    )
+    dqf = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j, g=group: (i // g, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j, g=group: (i // g, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype, vma=svma),
+        interpret=interpret,
+    )(qf, kf, vf, of, gf, lse)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale
+    )
+    # per-q-head partials; the GQA head-group sum happens below in XLA
+    dkf, dvf = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, t // block_k),
+        in_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j, g=group: (i // g, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j, g=group: (i // g, j, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, _LANES), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), jnp.float32, vma=svma),
+            jax.ShapeDtypeStruct((b * h, t, d), jnp.float32, vma=svma),
+        ],
+        interpret=interpret,
+    )(kf, vf, qf, of, gf, lse)
+
+    dq = _unfold(dqf, b, h)
+    if group > 1:
+        dkf = dkf.reshape(b, h_kv, group, t, d).sum(axis=2)
+        dvf = dvf.reshape(b, h_kv, group, t, d).sum(axis=2)
+        dk = dkf.transpose(0, 2, 1, 3)
+        dv = dvf.transpose(0, 2, 1, 3)
+    else:
+        dk = _unfold(dkf, b, h)
+        dv = _unfold(dvf, b, h)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_FLASH_CORES = {}
+
+
+def _flash_core(causal: bool, block_q: int, block_k: int, interpret: bool,
+                vma: tuple = ()):
+    """custom_vjp-wrapped kernel pair, cached per static configuration
+    (pattern shared with parallel/ring_attention._make_vjp_core)."""
+    key = (causal, block_q, block_k, interpret, vma)
+    core = _FLASH_CORES.get(key)
+    if core is not None:
+        return core
+
+    kw = dict(causal=causal, block_q=block_q, block_k=block_k,
+              interpret=interpret, vma=vma)
+
+    @jax.custom_vjp
+    def core(q, k, v):
+        o, _ = _flash_forward(q, k, v, **kw)
+        return o
+
+    def fwd(q, k, v):
+        o, lse = _flash_forward(q, k, v, **kw)
+        return o, (q, k, v, o, lse)
+
+    def bwd(res, g):
+        q, k, v, o, lse = res
+        return _flash_backward(q, k, v, o, lse, g, **kw)
+
+    core.defvjp(fwd, bwd)
+    _FLASH_CORES[key] = core
+    return core
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -89,36 +363,30 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
+    vma: tuple = (),
 ) -> jax.Array:
-    """Fused attention: q/k/v [B, T, H, D] -> [B, T, H, D].
+    """Fused trainable attention: q [B, T, H, D], k/v [B, T, H_kv, D].
 
-    Falls back to :func:`xla_attention` when Pallas is unavailable or shapes
-    don't tile (T must divide by the block sizes, D a multiple of 8)."""
+    Differentiable (custom_vjp with flash backward kernels) and GQA-aware
+    (H_kv may divide H; compact k/v is consumed directly). Falls back to
+    :func:`xla_attention` when Pallas is unavailable or shapes don't tile
+    (T must divide by the block sizes, D a multiple of 8, H by H_kv).
+
+    ``vma``: pass the manual-context varying axes when calling inside a
+    shard_map (e.g. a pipeline stage body) so the vma checker can type the
+    kernel outputs.
+    """
     b, t, h, d = q.shape
-    if pl is None or t % block_q or t % block_k or d % 8:
+    h_kv = k.shape[2]
+    if pl is None or t % block_q or t % block_k or d % 8 or (h_kv and h % h_kv):
         return xla_attention(q, k, v, causal=causal)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    scale = 1.0 / (d**0.5)
-
-    # fold batch and heads into the grid; blocks are [block_q, D] per program
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-
-    kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, scale=scale
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, t // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-        interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    if interpret and vma:
+        # the Pallas HLO interpreter re-typechecks the kernel jaxpr under
+        # the enclosing shard_map's vma rules, which the kernel's fresh
+        # accumulators cannot satisfy; interpret mode only exists for
+        # CPU testing, so use the einsum reference there. On real TPU the
+        # compiled kernel is opaque and the vma-stamped out_shapes type it.
+        return xla_attention(q, k, v, causal=causal)
+    return _flash_core(causal, block_q, block_k, interpret, tuple(vma))(q, k, v)

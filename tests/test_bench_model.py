@@ -1,0 +1,43 @@
+"""bench_model.py must stay runnable (CLAUDE.md blind spot: driver-facing
+artifacts rot silently). Smoke mode exercises the identical code path the
+TPU run takes — the sharded train-step factory + KV-cached decode — on tiny
+shapes."""
+
+import json
+
+import pytest
+
+pytest.importorskip("jax")
+
+
+def test_bench_model_smoke(capsys):
+    import bench_model
+
+    rc = bench_model.main(["--smoke", "--iters", "1"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    m = json.loads(line)
+    assert m["metric"].startswith("train_step_mfu_1chip")
+    assert set(m) >= {"value", "unit", "vs_baseline", "train_tokens_per_sec",
+                      "decode_tokens_per_sec", "train_step_ms"}
+    assert m["train_tokens_per_sec"] > 0
+    assert m["decode_tokens_per_sec"] > 0
+    assert m["loss_finite"]
+
+
+def test_train_flops_accounting():
+    # analytic FLOPs must track the config: doubling layers ~doubles FLOPs
+    import bench_model
+    from hivedscheduler_tpu.models import transformer as tm
+
+    def cfg(n_layers):
+        return tm.TransformerConfig(
+            vocab_size=0x1000, d_model=256, n_heads=8, n_kv_heads=4,
+            n_layers=n_layers, d_ff=1024, max_seq_len=512,
+        )
+
+    f1 = bench_model.train_flops_per_step(cfg(2), batch=2, seq=512)
+    f2 = bench_model.train_flops_per_step(cfg(4), batch=2, seq=512)
+    assert f1 > 0
+    lm_head = 3 * 2.0 * 256 * 0x1000 * 2 * 512  # layer-count-independent
+    assert abs((f2 - lm_head) / (f1 - lm_head) - 2.0) < 1e-6

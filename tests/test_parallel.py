@@ -195,6 +195,79 @@ class TestFlashAttention:
                                   interpret=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
+    def test_flash_gqa_compact_kv(self):
+        # k/v carry fewer heads than q; the kernel indexes the shared head
+        # directly, no materialized repeat
+        key = jax.random.PRNGKey(7)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            kq, kk, kv = jax.random.split(key, 3)
+            q = jax.random.normal(kq, (2, 256, 8, 16), jnp.float32)
+            k = jax.random.normal(kk, (2, 256, 2, 16), jnp.float32)
+            v = jax.random.normal(kv, (2, 256, 2, 16), jnp.float32)
+            ref = xla_attention(q, k, v, causal=True)
+            out = flash_attention(q, k, v, causal=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("h_kv", [4, 1])
+    def test_flash_gradients_match_xla(self, causal, h_kv):
+        # flash_attention carries a custom_vjp (flash backward kernels);
+        # grads must match the XLA reference exactly, incl. compact GQA
+        key = jax.random.PRNGKey(3)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            kq, kk, kv = jax.random.split(key, 3)
+            q = jax.random.normal(kq, (1, 256, 4, 16), jnp.float32)
+            k = jax.random.normal(kk, (1, 256, h_kv, 16), jnp.float32)
+            v = jax.random.normal(kv, (1, 256, h_kv, 16), jnp.float32)
+
+            def loss(fn):
+                return lambda q, k, v: jnp.sum(
+                    jnp.sin(fn(q, k, v, causal=causal))
+                )
+
+            gf = jax.grad(
+                loss(lambda q, k, v, causal: flash_attention(
+                    q, k, v, causal=causal, interpret=True)),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            gr = jax.grad(loss(xla_attention), argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5, err_msg=name
+            )
+
+    def test_train_cli_flash_attention(self):
+        # CLAUDE.md blind spot: features must be reachable (and trainable)
+        # from the train CLI — flash was forward-only in round 2
+        from hivedscheduler_tpu import train as train_cli
+
+        rc = train_cli.main([
+            "--steps", "2", "--batch", "4", "--seq-len", "256",
+            "--vocab-size", "128", "--d-model", "64", "--n-layers", "1",
+            "--n-heads", "8", "--n-kv-heads", "2", "--d-ff", "128",
+            "--tp", "2", "--attn", "flash", "--log-every", "1",
+        ])
+        assert rc == 0
+
+    def test_train_cli_flash_with_pipeline(self):
+        # flash inside the manual pipeline context must not open a nested
+        # GSPMD shard_map (CLAUDE.md shard_map rule); round-3 regression
+        from hivedscheduler_tpu import train as train_cli
+
+        rc = train_cli.main([
+            "--steps", "1", "--batch", "16", "--seq-len", "256",
+            "--vocab-size", "128", "--d-model", "64", "--n-layers", "2",
+            "--n-heads", "8", "--d-ff", "128", "--pp", "2",
+            "--microbatches", "2", "--attn", "flash", "--log-every", "1",
+        ])
+        assert rc == 0
+
+    def test_xla_attention_rejects_indivisible_gqa(self):
+        q = jnp.zeros((1, 8, 6, 8), jnp.float32)
+        k = jnp.zeros((1, 8, 4, 8), jnp.float32)
+        with pytest.raises(ValueError, match="divisible"):
+            xla_attention(q, k, k)
+
     def test_flash_fallback_on_odd_shapes(self):
         key = jax.random.PRNGKey(1)
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
