@@ -256,7 +256,7 @@ def run_scale_4096(seed: int = 7):
     for n in nodes:
         algo.add_node(Node(name=n))
     lat = []
-    for trial in range(4):
+    for trial in range(8):
         pods = []
         t0 = time.perf_counter()
         for i in range(256):
@@ -269,7 +269,7 @@ def run_scale_4096(seed: int = 7):
         lat.append(time.perf_counter() - t0)
         for bp in pods:
             algo.delete_allocated_pod(bp)
-    return statistics.median(lat) * 1000.0
+    return statistics.median(lat) * 1000.0, max(lat) * 1000.0
 
 
 def run_trace(n_jobs: int = 300, seed: int = 11):
@@ -401,11 +401,12 @@ if __name__ == "__main__":
         }))
         sys.exit(0)
     if "--scale-4096" in sys.argv:
-        p50 = run_scale_4096()
+        p50, p99 = run_scale_4096()
         print(json.dumps({
             "metric": "p50_gang_schedule_latency_1024chip_slice_v5p4096",
             "value": round(p50, 3), "unit": "ms",
             "vs_baseline": round(50.0 / p50, 3) if p50 > 0 else None,
+            "p99_ms": round(p99, 3),
         }))
         sys.exit(0)
     def model_bench_fields():

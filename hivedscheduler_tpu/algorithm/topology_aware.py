@@ -22,6 +22,7 @@ Python path is the semantic reference.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Dict, List, Optional, Set, Tuple
 
 from hivedscheduler_tpu.algorithm.cell import Cell, CellLevel, CellPriority, PhysicalCell, VirtualCell, cell_equal
@@ -314,6 +315,75 @@ def _find_leaf_cells_native(
     )
 
 
+def _find_leaf_cells_direct(
+    n: Cell, available_leaf_cells: CellList, leaf_cell_num: int
+) -> List[int]:
+    """Direct aligned-enclosure enumeration: the mesh-first replacement for
+    the reference's combination-backtracking search.
+
+    Key fact (why this is exact, not a heuristic): the reference's search
+    (topology_aware_scheduler.go:309-387) enumerates index combinations
+    lexicographically, keeps the first strictly-better LCA, and prunes
+    prefixes whose running LCA already exceeds the best. Since the LCA level
+    of a chip set is monotone in set growth, the set it returns is exactly
+    "the first ``leaf_cell_num`` candidates inside the lowest-level cell
+    that encloses at least ``leaf_cell_num`` candidates" (ties between
+    equal-level cells broken by earliest candidate index). Cells of a mesh
+    chain ARE the aligned sub-meshes (algorithm/mesh.py tilings), so walking
+    each candidate's ancestor chain enumerates exactly the aligned
+    enclosures — O(candidates x levels) total, no backtracking. The same
+    argument holds for generic chains, so this path serves both; the
+    backtracking implementation is kept below as the semantic reference for
+    differential tests (and the ``HIVED_DIRECT=0`` escape hatch).
+
+    Returns ascending indices into ``available_leaf_cells``.
+    """
+    counts: Dict[int, int] = {}
+    for chip in available_leaf_cells:
+        c: Optional[Cell] = chip
+        while c is not None:
+            counts[id(c)] = counts.get(id(c), 0) + 1
+            if c is n:
+                break
+            c = c.parent
+    best_level = HIGHEST_LEVEL
+    best_cell: Optional[Cell] = None
+    for chip in available_leaf_cells:
+        c = chip
+        while c is not None:
+            if counts[id(c)] >= leaf_cell_num:
+                # lowest qualifying enclosure containing this chip; counts
+                # are monotone up the tree so ancestors only tie or worsen,
+                # and later chips can only tie on level, never beat the
+                # first-index tie-break
+                if c.level < best_level:
+                    best_level = c.level
+                    best_cell = c
+                break
+            if c is n:
+                break
+            c = c.parent
+    if best_cell is None:
+        raise AssertionError(
+            f"Assert Failure: failed to allocate {leaf_cell_num} leaf cells "
+            f"in picked node {n.address}"
+        )
+    picked: List[int] = []
+    target = id(best_cell)
+    for idx, chip in enumerate(available_leaf_cells):
+        c = chip
+        while c is not None:
+            if id(c) == target:
+                picked.append(idx)
+                break
+            if c is n:
+                break
+            c = c.parent
+        if len(picked) == leaf_cell_num:
+            break
+    return picked
+
+
 def find_leaf_cells_in_node(
     n: Cell,
     leaf_cell_num: int,
@@ -321,14 +391,16 @@ def find_leaf_cells_in_node(
     available_leaf_cells: Optional[CellList],
     level_leaf_cell_num: Dict[CellLevel, int],
 ) -> Tuple[CellList, CellList]:
-    """Backtracking search for the `leaf_cell_num` chips with the lowest LCA in
-    a node (reference: findLeafCellsInNode, topology_aware_scheduler.go:309-387).
+    """Pick the `leaf_cell_num` chips with the lowest LCA in a node — on a
+    mesh chain, the tightest aligned sub-mesh enclosure (reference:
+    findLeafCellsInNode, topology_aware_scheduler.go:309-387).
 
     Free chips come before preemptible ones in the candidate list, so free
-    chips are preferred. Prunes branches whose running LCA already exceeds the
-    best seen; early-stops on an optimal (all-buddy / tightest sub-mesh)
-    solution. Returns (picked cells, remaining available cells).
-    """
+    chips are preferred. Uses the direct aligned-enclosure enumeration
+    (`_find_leaf_cells_direct`, exact and near-linear); the reference's
+    backtracking search below is the differential-testing reference,
+    selectable with HIVED_DIRECT=0. Returns (picked cells, remaining
+    available cells)."""
     if available_leaf_cells is None:
         free: CellList = []
         preemptible: CellList = []
@@ -336,6 +408,22 @@ def find_leaf_cells_in_node(
         available_leaf_cells = free + preemptible
 
     optimal = _get_optimal_affinity(leaf_cell_num, level_leaf_cell_num)
+    # Hybrid dispatch: below the threshold (typical mesh hosts hold 4-8
+    # chips) the reference's tight backtracking loop has the best constant
+    # factor; at or above it, the direct aligned-enclosure enumeration wins
+    # and is immune to the search's combinatorial worst case (it replaces
+    # the C++ accelerated backtracking on that tier).
+    if (
+        len(available_leaf_cells) >= _NATIVE_THRESHOLD
+        and os.environ.get("HIVED_DIRECT", "1") != "0"
+    ):
+        picked_idx = _find_leaf_cells_direct(
+            n, available_leaf_cells, leaf_cell_num
+        )
+        best_cells = [available_leaf_cells[i] for i in picked_idx]
+        _remove_picked(available_leaf_cells, picked_idx)
+        return best_cells, available_leaf_cells
+
     if len(available_leaf_cells) >= _NATIVE_THRESHOLD:
         picked_idx = _find_leaf_cells_native(
             n, available_leaf_cells, leaf_cell_num, optimal
