@@ -272,6 +272,68 @@ def run_scale_4096(seed: int = 7):
     return statistics.median(lat) * 1000.0, max(lat) * 1000.0
 
 
+def run_recovery(n_target_pods: int = 500, seed: int = 13):
+    """Work-preserving reconfiguration at v5p-1024 scale: load the cluster
+    with hundreds of allocated pods across the VCs, then "restart" — a fresh
+    scheduler runtime over a fake apiserver pre-loaded with the bound pods —
+    and time the recovery barrier (runtime/scheduler.py start(): every bound
+    pod replays through add_allocated_pod before any request is served;
+    reference behavior: hived_algorithm_test.go:1042-1092). Returns
+    (recovery_ms, n_pods, n_groups, preserved_pct). Run:
+    ``python bench.py --recovery``."""
+    from hivedscheduler_tpu.k8s.fake import FakeKubeClient
+    from hivedscheduler_tpu.runtime.scheduler import HivedScheduler
+
+    rng = random.Random(seed)
+    cluster = Cluster()
+    sizes = [(1, 4), (2, 4), (4, 4), (8, 4), (16, 4), (64, 4)]
+    gid = 0
+    attempts = 0
+    while (
+        sum(len(v) for v in cluster.groups.values()) < n_target_pods
+        and attempts < 4 * n_target_pods
+    ):
+        attempts += 1
+        vc = rng.choice(["vc-a", "vc-b", "vc-c"])
+        prio = rng.choice([-1, 0, 5, 10])
+        pods, chips = rng.choice(sizes)
+        name = f"g{gid}"
+        gid += 1
+        cluster.schedule_gang(vc, prio, name, pods, chips)
+    groups_before = {
+        name: {bp.node_name for bp in pods}
+        for name, pods in cluster.groups.items()
+    }
+    bound_pods = [bp for pods in cluster.groups.values() for bp in pods]
+
+    kube = FakeKubeClient()
+    for nname in cluster.nodes:
+        kube.create_node(Node(name=nname))
+    for bp in bound_pods:
+        kube.create_pod(bp)
+    sched = HivedScheduler(build_config(), kube)
+    t0 = time.perf_counter()
+    sched.start()
+    recovery_s = time.perf_counter() - t0
+
+    algo = sched.scheduler_algorithm
+    preserved = 0
+    for name, nodes_before in groups_before.items():
+        try:
+            g = algo.get_affinity_group(f"{name}")
+        except Exception:
+            continue
+        if set(g.status.physical_placement) == nodes_before:
+            preserved += 1
+    preserved_pct = 100.0 * preserved / max(1, len(groups_before))
+    return (
+        recovery_s * 1000.0,
+        len(bound_pods),
+        len(groups_before),
+        preserved_pct,
+    )
+
+
 def run_trace(n_jobs: int = 300, seed: int = 11):
     """Trace-driven evaluation in the style of HiveD's OSDI'20 methodology
     (the paper evaluates on a production trace; the repo ships none, so this
@@ -398,6 +460,16 @@ if __name__ == "__main__":
             "vs_baseline": round(50.0 / stats["sched_p50_ms"], 3)
             if stats["sched_p50_ms"] else None,
             **stats,
+        }))
+        sys.exit(0)
+    if "--recovery" in sys.argv:
+        rec_ms, n_pods, n_groups, preserved = run_recovery()
+        print(json.dumps({
+            "metric": "recovery_barrier_ms_v5p1024",
+            "value": round(rec_ms, 3), "unit": "ms",
+            "vs_baseline": None,
+            "allocated_pods": n_pods, "groups": n_groups,
+            "placement_preserved_pct": round(preserved, 2),
         }))
         sys.exit(0)
     if "--scale-4096" in sys.argv:

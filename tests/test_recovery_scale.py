@@ -1,0 +1,17 @@
+"""Recovery at scale: the work-preserving reconfiguration golden on the
+benchmark v5p-1024 topology. Hundreds of allocated pods must replay through
+the runtime's recovery barrier (runtime/scheduler.py start()) with every
+gang's physical placement preserved verbatim, in bounded time (reference
+behavior: hived_algorithm_test.go:1042-1092, tested there at toy scale)."""
+
+import bench
+
+
+def test_recovery_barrier_at_v5p1024_scale():
+    rec_ms, n_pods, n_groups, preserved_pct = bench.run_recovery()
+    # the random gang mix packs the full 1024-chip pod (256 x 4-chip pods)
+    assert n_pods >= 200, (n_pods, n_groups)
+    assert n_groups >= 10
+    assert preserved_pct == 100.0
+    # ~40 ms on the reference runner; generous CI headroom
+    assert rec_ms < 10_000.0, rec_ms
