@@ -1,18 +1,60 @@
 """Test session config.
 
 JAX tests run on a virtual 8-device CPU mesh (multi-chip TPU hardware is not
-available in CI): the env vars MUST be set before jax is first imported, so
-this conftest sets them at collection time and never imports jax itself.
+available in CI): the env vars are set at collection time, before any test
+imports jax.
+
+The axon TPU environment's sitecustomize exports ``JAX_PLATFORMS=axon`` /
+``PALLAS_AXON_POOL_IPS`` AND pre-imports jax at interpreter startup (its
+.pth hook registers the PJRT plugin), so by the time this conftest runs the
+``jax_platforms`` config default is already baked to ``"axon,cpu"`` — a
+bare ``pytest tests/`` would then contend for the single-grant TPU tunnel
+at the first ``jax.devices()`` (and can wedge it if killed mid-op). The
+test suite never needs the TPU, so this defuses both layers: the env vars
+(for subprocesses spawned by tests) and, when jax is already imported, the
+live config. Set ``HIVED_TEST_TPU=1`` to deliberately run tests against
+the real backend.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if os.environ.get("HIVED_TEST_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+if os.environ.get("HIVED_TEST_TPU") != "1" and "jax" in sys.modules:
+    # too late for the env var: sitecustomize already imported jax with the
+    # axon default, so override the live config (backends init lazily — no
+    # backend has been touched yet at collection time)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _no_tpu_tunnel():
+    """Guard: without the HIVED_TEST_TPU opt-in, no test process may reach
+    the axon TPU backend (single-grant tunnel; see module docstring).
+
+    Checked at session END, and only when some test actually imported jax:
+    probing eagerly would itself force a backend init (and, if the override
+    were ever broken, would be the very thing that grabs the tunnel)."""
+    yield
+    if os.environ.get("HIVED_TEST_TPU") != "1" and "jax" in sys.modules:
+        import jax
+
+        backends = getattr(jax._src.xla_bridge, "_backends", {})
+        touched = set(backends) - {"cpu"}
+        assert not touched, (
+            f"test session initialized non-cpu backend(s) {sorted(touched)} "
+            "without HIVED_TEST_TPU=1 — the conftest override failed"
+        )
