@@ -29,10 +29,76 @@ training (scaling-book north star), honestly labelled in the note.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import math
+import os
 import statistics
+import sys
+import threading
 import time
+
+
+def acquire_backend(timeout_s: float, grace_s: float = 120.0):
+    """First TPU touch with a bounded wait.
+
+    Under the axon environment the first backend access enters an
+    indefinite sleep-retry loop when the single-grant TPU tunnel is held by
+    another process (observed: >16 min asleep in ``make_c_api_client``). A
+    watchdog thread turns that into a loud, fast failure: if device
+    enumeration hasn't completed within ``timeout_s`` we print a
+    self-explanatory JSON line and ``os._exit(3)``. Exiting during the
+    *claim* retry loop is safe — the process holds no grant yet.
+
+    The dangerous case is the grant arriving right at the deadline:
+    exiting between grant acquisition and clean client shutdown wedges the
+    tunnel until the relay's grant timeout (~25 min, observed live). So the
+    deadline is followed by a ``grace_s`` second-chance window, and the
+    watchdog never exits once a backend object exists — at that point the
+    grant is held and enumeration is imminent, so killing would be the
+    worst possible move."""
+    done = threading.Event()
+
+    def backend_exists() -> bool:
+        xb = sys.modules.get("jax._src.xla_bridge")
+        return bool(getattr(xb, "_backends", None))
+
+    def watchdog():
+        if done.wait(timeout_s):
+            return
+        # Deadline passed while still waiting. The grant may have JUST
+        # arrived (client constructing, a few seconds) — give it a generous
+        # grace window rather than killing into a held grant.
+        if done.wait(grace_s):
+            return
+        if backend_exists():
+            return  # grant held, enumeration imminent: never exit now
+        print(json.dumps({
+            "metric": "train_step_mfu_1chip",
+            "value": None,
+            "unit": "%",
+            "vs_baseline": None,
+            "error": (
+                f"tpu_acquire_timeout: backend not granted within "
+                f"{timeout_s:.0f}s (+{grace_s:.0f}s grace) — single-grant "
+                "TPU tunnel busy (another process holds it); no TPU op "
+                "was started"
+            ),
+        }))
+        sys.stdout.flush()
+        os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    try:
+        import jax
+
+        devices = jax.devices()
+    finally:
+        # disarm even on a fast failure (e.g. backend-init error raised to
+        # an in-process caller): a still-armed watchdog would os._exit the
+        # whole host process minutes later with a bogus 'tunnel busy' note
+        done.set()
+    return jax, devices
 
 # peak per-chip specs by device_kind substring: (bf16 FLOP/s, HBM bytes/s)
 _CHIP_PEAKS = [
@@ -74,13 +140,15 @@ def train_flops_per_step(cfg, batch: int, seq: int) -> float:
     return 3.0 * fwd
 
 
-def bench_train(cfg, batch: int, seq: int, iters: int, mesh):
+def bench_train(cfg, batch: int, seq: int, iters: int, mesh, grad_accum: int = 1):
     import jax
     import jax.numpy as jnp
 
     from hivedscheduler_tpu.parallel.train import make_sharded_train_step
 
-    step, init_fn, token_sharding = make_sharded_train_step(cfg, mesh)
+    step, init_fn, token_sharding = make_sharded_train_step(
+        cfg, mesh, grad_accum=grad_accum
+    )
     params, opt_state = init_fn(jax.random.PRNGKey(0))
     tokens = jax.device_put(
         jax.random.randint(
@@ -109,7 +177,9 @@ def bench_decode(cfg, batch: int, prompt_len: int, new_tokens: int, iters: int):
     from hivedscheduler_tpu.models import decode as dec
     from hivedscheduler_tpu.models import transformer as tm
 
-    params = tm.init_params(cfg, jax.random.PRNGKey(0))
+    # serving path: bf16 weights up front (decode is HBM-bandwidth-bound;
+    # f32 master weights would stream twice the bytes per step)
+    params = tm.cast_params(tm.init_params(cfg, jax.random.PRNGKey(0)), cfg.dtype)
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size, jnp.int32
     )
@@ -142,14 +212,26 @@ def main(argv=None) -> int:
     parser.add_argument("--smoke", action="store_true",
                         help="tiny shapes regardless of backend (CI)")
     parser.add_argument("--skip-decode", action="store_true")
+    parser.add_argument(
+        "--acquire-timeout", type=float,
+        default=float(os.environ.get("HIVED_TPU_ACQUIRE_TIMEOUT_S", "240")),
+        help="max seconds to wait for the TPU grant before exiting rc=3",
+    )
+    # tuning knobs (defaults = the shipped flagship settings)
+    parser.add_argument("--remat", choices=("full", "dots", "none"), default=None)
+    parser.add_argument("--block-q", type=int, default=None)
+    parser.add_argument("--block-k", type=int, default=None)
+    parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--grad-accum", type=int, default=1)
+    parser.add_argument("--skip-train", action="store_true")
     args = parser.parse_args(argv)
 
-    import jax
+    jax, devices = acquire_backend(args.acquire_timeout)
 
     from hivedscheduler_tpu.models import transformer as tm
     from hivedscheduler_tpu.parallel import topology
 
-    dev = jax.devices()[0]
+    dev = devices[0]
     # "real" = the flagship chip-filling config; --smoke on a TPU must not
     # masquerade as the headline metric
     real = jax.default_backend() == "tpu" and not args.smoke
@@ -159,27 +241,40 @@ def main(argv=None) -> int:
         cfg = tm.TransformerConfig(
             vocab_size=32768, d_model=2048, n_heads=16, n_kv_heads=8,
             n_layers=6, d_ff=8192, max_seq_len=2048, attn_impl="flash",
+            remat=args.remat or "dots",
         )
-        batch, seq = 8, 2048
+        batch, seq = args.batch or 8, 2048
         dec_batch, dec_prompt, dec_new = 16, 128, 64
         iters = args.iters
     else:
         cfg = tm.TransformerConfig(
             vocab_size=512, d_model=128, n_heads=8, n_kv_heads=4,
             n_layers=2, d_ff=256, max_seq_len=256, attn_impl="flash",
+            remat=args.remat or "full",
         )
-        batch, seq = 2, 256
+        batch, seq = args.batch or 2, 256
         dec_batch, dec_prompt, dec_new = 2, 16, 8
         iters = min(args.iters, 2)
+    if args.block_q or args.block_k:
+        cfg = dataclasses.replace(
+            cfg,
+            attn_block_q=args.block_q or cfg.attn_block_q,
+            attn_block_k=args.block_k or cfg.attn_block_k,
+        )
 
     axes = topology.MeshAxes()  # all-1 axes: single chip
     mesh = topology.make_mesh(axes, jax.devices()[:1])
 
-    step_s, loss = bench_train(cfg, batch, seq, iters, mesh)
-    flops = train_flops_per_step(cfg, batch, seq)
-    achieved = flops / step_s
-    mfu = achieved / peak_flops if peak_flops else None
-    train_tps = batch * seq / step_s
+    if args.skip_train:
+        step_s, loss = None, 0.0
+        flops, achieved, mfu, train_tps = 0.0, None, None, None
+    else:
+        step_s, loss = bench_train(cfg, batch, seq, iters, mesh,
+                                   grad_accum=args.grad_accum)
+        flops = train_flops_per_step(cfg, batch, seq)
+        achieved = flops / step_s
+        mfu = achieved / peak_flops if peak_flops else None
+        train_tps = batch * seq / step_s
 
     decode_tps = None
     decode_bw_frac = None
@@ -197,10 +292,10 @@ def main(argv=None) -> int:
         "unit": "%",
         "vs_baseline": round(mfu / 0.40, 3) if mfu is not None else None,
         "device": getattr(dev, "device_kind", str(dev)),
-        "train_step_ms": round(step_s * 1e3, 2),
-        "train_tokens_per_sec": round(train_tps, 1),
+        "train_step_ms": round(step_s * 1e3, 2) if step_s else None,
+        "train_tokens_per_sec": round(train_tps, 1) if train_tps else None,
         "train_model_tflops_per_step": round(flops / 1e12, 3),
-        "achieved_tflops_per_sec": round(achieved / 1e12, 2),
+        "achieved_tflops_per_sec": round(achieved / 1e12, 2) if achieved else None,
         "peak_bf16_tflops_per_sec": round(peak_flops / 1e12, 1) if peak_flops else None,
         "decode_tokens_per_sec": round(decode_tps, 1) if decode_tps else None,
         "decode_hbm_roofline_frac": round(decode_bw_frac, 3) if decode_bw_frac else None,
@@ -211,6 +306,8 @@ def main(argv=None) -> int:
             "n_heads": cfg.n_heads, "n_kv_heads": cfg.kv_heads,
             "d_ff": cfg.d_ff, "batch": batch, "seq": seq,
             "attn_impl": cfg.attn_impl, "dtype": "bfloat16",
+            "remat": cfg.remat, "grad_accum": args.grad_accum,
+            "attn_block_q": cfg.attn_block_q, "attn_block_k": cfg.attn_block_k,
         },
         "vs_baseline_note": (
             "the reference scheduler ships no workload runtime, so there is "

@@ -57,6 +57,22 @@ class TransformerConfig:
     # GPipe microbatches over the pp axis; 0 = no pipelining
     pipeline_microbatches: int = 0
 
+    # rematerialization policy for the layer scan's backward pass:
+    # - "full": recompute the whole layer (HBM O(1) layers — the
+    #   long-context default, but the recompute is a full extra forward,
+    #   which caps MFU at 3/4 of hardware utilization);
+    # - "dots": jax.checkpoint with dots_with_no_batch_dims_saveable —
+    #   matmul outputs are saved, only elementwise work is recomputed
+    #   (near-zero FLOP overhead, activations ~= no-remat);
+    # - "none": save everything (fastest when activations fit in HBM).
+    remat: str = "full"
+
+    # Pallas flash-attention tile sizes (attn_impl="flash"); the sequence
+    # length must divide both. 128/128 matches the MXU systolic array;
+    # larger k blocks cut grid-loop overhead on long sequences.
+    attn_block_q: int = 128
+    attn_block_k: int = 128
+
     # grouped-query attention: number of shared k/v heads (0 = n_heads,
     # classic MHA; 1 = MQA). q heads are grouped contiguously: q head i
     # attends with k/v head i // (n_heads // n_kv_heads)
@@ -257,6 +273,27 @@ def load_weight(leaf, dtype) -> jax.Array:
     if is_quantized_leaf(leaf):
         return leaf["qi8"].astype(dtype) * leaf["scale"].astype(dtype)
     return leaf.astype(dtype)
+
+
+def cast_params(params: Dict[str, Any], dtype) -> Dict[str, Any]:
+    """Cast float weight leaves to the serving/compute dtype once, up front.
+
+    Training keeps f32 master weights and casts per use (``load_weight``),
+    which is right for the optimizer but makes autoregressive decode stream
+    4 bytes/param from HBM per step — decode is bandwidth-bound, so serving
+    should hold bf16 (or int8, via models/quant.py) weights instead.
+    Quantized ``{"qi8", "scale"}`` leaves pass through untouched; everything
+    else float is cast, so ``load_weight(leaf, dtype)`` becomes a no-op at
+    decode time."""
+
+    def cast(leaf):
+        if is_quantized_leaf(leaf):
+            return leaf
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.astype(dtype)
+        return leaf
+
+    return jax.tree.map(cast, params, is_leaf=is_quantized_leaf)
 
 
 def _rms_norm(x: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
@@ -570,9 +607,31 @@ ATTN_IMPLS = ("xla", "flash", "ring", "ring_zigzag", "ulysses")
 RING_FAMILY = ("ring", "ring_zigzag", "ulysses")  # need a mesh + sp axis
 
 
+def _remat_wrap(fn, cfg: TransformerConfig):
+    """Apply cfg.remat to a scanned layer/stage body (see the config field
+    docstring for the policy trade-offs)."""
+    if cfg.remat == "none":
+        return fn
+    if cfg.remat == "dots":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    if cfg.remat == "full":
+        return jax.checkpoint(fn)
+    raise ValueError(
+        f"unknown remat policy {cfg.remat!r}; expected 'full', 'dots' or 'none'"
+    )
+
+
 def _resolve_attn_fn(cfg: TransformerConfig):
     if cfg.attn_impl == "flash":
-        from hivedscheduler_tpu.ops.attention import flash_attention as attn_fn
+        import functools
+
+        from hivedscheduler_tpu.ops.attention import flash_attention
+
+        attn_fn = functools.partial(
+            flash_attention, block_q=cfg.attn_block_q, block_k=cfg.attn_block_k
+        )
     elif cfg.attn_impl in RING_FAMILY:
         from hivedscheduler_tpu.parallel import ring_attention as ra
 
@@ -702,7 +761,7 @@ def forward_with_aux(
                 return (out, aux + layer_aux), None
 
             (hh, aux), _ = lax.scan(
-                jax.checkpoint(stage_layer),
+                _remat_wrap(stage_layer, cfg),
                 (h, jnp.zeros((), jnp.float32) + 0.0 * jnp.sum(h[..., 0, 0])),
                 stage_params,
             )
@@ -718,15 +777,13 @@ def forward_with_aux(
             seq_axis=manual_sp,
         )
     else:
-        # rematerialize per-layer activations in the backward pass: HBM is
-        # O(1) layers instead of O(n_layers) — the long-context trade
         def scan_body(carry, lp):
             x, aux = carry
             x, layer_aux = layer(x, lp)
             return (x, aux + layer_aux), None
 
         (x, aux_total), _ = lax.scan(
-            jax.checkpoint(scan_body), (x, aux_total), params["layers"]
+            _remat_wrap(scan_body, cfg), (x, aux_total), params["layers"]
         )
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))

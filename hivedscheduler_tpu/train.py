@@ -55,6 +55,15 @@ def main(argv=None) -> int:
                         "projections (0 = full training)")
     parser.add_argument("--lora-alpha", type=float, default=16.0,
                         help="LoRA scale (delta = alpha/rank * A B)")
+    parser.add_argument("--remat", choices=("full", "dots", "none"),
+                        default="full",
+                        help="layer-scan remat policy: full recompute (HBM "
+                             "O(1) layers), dots (save matmul outputs — the "
+                             "MFU-tuned default of bench_model.py), none")
+    parser.add_argument("--block-q", type=int, default=128,
+                        help="flash-attention q tile (attn=flash)")
+    parser.add_argument("--block-k", type=int, default=128,
+                        help="flash-attention k tile (attn=flash)")
     parser.add_argument("--attn", default=None,
                         help="xla|flash|ring|ring_zigzag|ulysses (default: ring when sp>1)")
     parser.add_argument("--data", default="",
@@ -123,6 +132,9 @@ def main(argv=None) -> int:
         pipeline_microbatches=args.microbatches if args.pp > 1 else 0,
         lora_rank=args.lora_rank,
         lora_alpha=args.lora_alpha,
+        remat=args.remat,
+        attn_block_q=args.block_q,
+        attn_block_k=args.block_k,
     )
     lora_mode = args.lora_rank > 0
     if lora_mode:

@@ -25,6 +25,34 @@ def test_bench_model_smoke(capsys):
     assert m["loss_finite"]
 
 
+def test_acquire_timeout_fails_fast_and_loud():
+    """A wedged TPU tunnel must produce rc=3 + a self-explanatory JSON line
+    within the bounded wait — not an indefinite sleep-retry (the round-3
+    driver failure mode: rc=1 with all diagnostics discarded)."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys, types, time\n"
+        "stub = types.ModuleType('jax')\n"
+        "stub.devices = lambda: time.sleep(60)\n"
+        "sys.modules['jax'] = stub\n"  # simulate: enumeration never returns
+        "import bench_model\n"
+        "bench_model.acquire_backend(0.3, grace_s=0.3)\n"
+        "print('UNREACHABLE')\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=repo, timeout=60,
+    )
+    assert p.returncode == 3
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert "tpu_acquire_timeout" in out["error"]
+    assert "UNREACHABLE" not in p.stdout
+
+
 def test_train_flops_accounting():
     # analytic FLOPs must track the config: doubling layers ~doubles FLOPs
     import bench_model

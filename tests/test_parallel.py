@@ -442,6 +442,37 @@ class TestTrainStep:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]  # memorizing a fixed batch
 
+    def test_remat_policies_match(self):
+        """cfg.remat trades HBM for recompute FLOPs — it must never change
+        the computed loss or gradients (f32 model: exact up to reduction
+        order). Also pins the invalid-value error."""
+        import dataclasses
+
+        import pytest
+
+        from hivedscheduler_tpu.models import transformer as tm
+        from hivedscheduler_tpu.parallel.train import loss_fn
+
+        cfg0 = tm.TransformerConfig(
+            vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+            max_seq_len=64, dtype=jnp.float32,
+        )
+        params = tm.init_params(cfg0, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 128)
+        out = {}
+        for remat in ("full", "dots", "none"):
+            cfg = dataclasses.replace(cfg0, remat=remat)
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+            out[remat] = (float(loss), jax.tree.map(np.asarray, grads))
+        for remat in ("dots", "none"):
+            assert abs(out["full"][0] - out[remat][0]) < 1e-6
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+                out["full"][1], out[remat][1],
+            )
+        with pytest.raises(ValueError, match="remat"):
+            loss_fn(params, tokens, dataclasses.replace(cfg0, remat="bogus"))
+
     def test_grad_accum_matches_full_batch(self):
         """One update with grad_accum=4 must equal the full-batch update
         (the LM loss is a mean over equal-size slices, so averaged gradients
