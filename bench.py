@@ -300,9 +300,16 @@ def run_recovery(n_target_pods: int = 500, seed: int = 13):
         name = f"g{gid}"
         gid += 1
         cluster.schedule_gang(vc, prio, name, pods, chips)
+    def chip_placement(algo, name):
+        """node -> sorted leaf-cell indices: chip-granular identity of the
+        gang's slice (a same-nodes/different-chips restart breaks ICI
+        contiguity and must count as NOT preserved; reference reconfig
+        asserts exact cell placements, hived_algorithm_test.go:1042-1092)."""
+        g = algo.get_affinity_group(name)
+        return {n: sorted(ix) for n, ix in g.status.physical_placement.items()}
+
     groups_before = {
-        name: {bp.node_name for bp in pods}
-        for name, pods in cluster.groups.items()
+        name: chip_placement(cluster.algo, name) for name in cluster.groups
     }
     bound_pods = [bp for pods in cluster.groups.values() for bp in pods]
 
@@ -318,12 +325,12 @@ def run_recovery(n_target_pods: int = 500, seed: int = 13):
 
     algo = sched.scheduler_algorithm
     preserved = 0
-    for name, nodes_before in groups_before.items():
+    for name, chips_before in groups_before.items():
         try:
-            g = algo.get_affinity_group(f"{name}")
+            after = chip_placement(algo, name)
         except Exception:
             continue
-        if set(g.status.physical_placement) == nodes_before:
+        if after == chips_before:
             preserved += 1
     preserved_pct = 100.0 * preserved / max(1, len(groups_before))
     return (
@@ -473,12 +480,13 @@ if __name__ == "__main__":
         }))
         sys.exit(0)
     if "--scale-4096" in sys.argv:
-        p50, p99 = run_scale_4096()
+        p50, mx = run_scale_4096()
         print(json.dumps({
             "metric": "p50_gang_schedule_latency_1024chip_slice_v5p4096",
             "value": round(p50, 3), "unit": "ms",
             "vs_baseline": round(50.0 / p50, 3) if p50 > 0 else None,
-            "p99_ms": round(p99, 3),
+            # max over 8 trials — honestly labelled (a p99 needs more samples)
+            "max_ms": round(mx, 3),
         }))
         sys.exit(0)
     def model_bench_fields():
@@ -520,6 +528,10 @@ if __name__ == "__main__":
                     note["model_bench_stderr_tail"] = tail
                 return note
             m = last_json
+            if m.get("metric", "").endswith("_smoke"):
+                # the child fell back to CPU: no TPU numbers — and never
+                # overwrite the durable artifact with a smoke run
+                return {}
             # refresh the durable artifact so a stale builder-local number
             # can never stand in for a driver-captured one
             stamped = dict(m)
@@ -556,11 +568,40 @@ if __name__ == "__main__":
     platforms = os.environ.get("JAX_PLATFORMS", "")
     model_fields = {}
     if "--no-model" not in sys.argv and platforms and "cpu" not in platforms:
-        model_fields = model_bench_fields()
-        if model_fields.get("model_metric_note", "").endswith("_smoke"):
-            model_fields = {}  # child saw no TPU after all
+        model_fields = model_bench_fields()  # {} when the child saw no TPU
+
+    def aux_stage_fields():
+        """Driver-captured numbers for the round-3/4 scheduler work (VERDICT
+        round 3 item 5): the v5p-4096 mesh-direct search scale figure, the
+        chip-granular recovery barrier, and the synthetic-trace replay each
+        run in ~3 s, so they ride along in the one-line artifact instead of
+        living only as CI ceilings."""
+        fields = {}
+        try:
+            s_p50, s_max = run_scale_4096()
+            fields.update(scale4096_p50_ms=round(s_p50, 3),
+                          scale4096_max_ms=round(s_max, 3))
+        except Exception as e:  # pragma: no cover - defensive
+            fields["scale4096_error"] = f"{type(e).__name__}: {e}"
+        try:
+            rec_ms, n_pods, n_groups, preserved = run_recovery()
+            fields.update(recovery_ms=round(rec_ms, 3),
+                          recovery_pods=n_pods,
+                          placement_preserved_pct=round(preserved, 2))
+        except Exception as e:  # pragma: no cover - defensive
+            fields["recovery_error"] = f"{type(e).__name__}: {e}"
+        try:
+            t = run_trace()
+            fields.update(trace_sched_p50_ms=t["sched_p50_ms"],
+                          trace_sched_p99_ms=t["sched_p99_ms"],
+                          trace_utilization_pct=t["utilization_pct"],
+                          trace_preemption_events=t["preemption_events"])
+        except Exception as e:  # pragma: no cover - defensive
+            fields["trace_error"] = f"{type(e).__name__}: {e}"
+        return fields
 
     p50, p99, frag_pct = run()
+    aux_fields = aux_stage_fields()
     baseline_ms = 50.0  # reference deploy's per-pod FIFO blocking tick
     print(
         json.dumps(
@@ -578,6 +619,7 @@ if __name__ == "__main__":
                     "blocking knob (example/run/deploy.yaml:50), not a "
                     "measured latency; the reference publishes no numbers"
                 ),
+                **aux_fields,
                 **model_fields,
             }
         )

@@ -52,11 +52,16 @@ def acquire_backend(timeout_s: float, grace_s: float = 120.0):
 
     The dangerous case is the grant arriving right at the deadline:
     exiting between grant acquisition and clean client shutdown wedges the
-    tunnel until the relay's grant timeout (~25 min, observed live). So the
-    deadline is followed by a ``grace_s`` second-chance window, and the
-    watchdog never exits once a backend object exists — at that point the
-    grant is held and enumeration is imminent, so killing would be the
-    worst possible move."""
+    tunnel until the relay's grant timeout (~25 min, observed live). The
+    grant is held from *inside* client construction — before any
+    Python-visible signal exists — so no check can close the window
+    completely. The watchdog therefore (a) follows the deadline with a
+    generous ``grace_s`` second-chance window polled in short slices, (b)
+    never exits once a backend object exists (construction finished,
+    enumeration imminent), and (c) accepts the residual risk that a grant
+    arriving silently in the last grace slice is killed mid-construction —
+    the alternative (no bound at all) starves the driver forever, which is
+    the round-3 failure this exists to fix."""
     done = threading.Event()
 
     def backend_exists() -> bool:
@@ -66,13 +71,18 @@ def acquire_backend(timeout_s: float, grace_s: float = 120.0):
     def watchdog():
         if done.wait(timeout_s):
             return
-        # Deadline passed while still waiting. The grant may have JUST
-        # arrived (client constructing, a few seconds) — give it a generous
-        # grace window rather than killing into a held grant.
-        if done.wait(grace_s):
+        # Deadline passed while still waiting. Poll the grace window in
+        # slices: if the grant just arrived, client construction (a few
+        # seconds) completes well within it and either `done` fires or a
+        # backend object appears.
+        deadline = time.monotonic() + grace_s
+        while time.monotonic() < deadline:
+            if done.wait(min(5.0, max(0.1, deadline - time.monotonic()))):
+                return
+            if backend_exists():
+                return  # grant held, enumeration imminent: never exit now
+        if done.is_set() or backend_exists():
             return
-        if backend_exists():
-            return  # grant held, enumeration imminent: never exit now
         print(json.dumps({
             "metric": "train_step_mfu_1chip",
             "value": None,
@@ -299,7 +309,8 @@ def main(argv=None) -> int:
         "peak_bf16_tflops_per_sec": round(peak_flops / 1e12, 1) if peak_flops else None,
         "decode_tokens_per_sec": round(decode_tps, 1) if decode_tps else None,
         "decode_hbm_roofline_frac": round(decode_bw_frac, 3) if decode_bw_frac else None,
-        "loss_finite": math.isfinite(loss),
+        # null (not vacuously true) when no training ran
+        "loss_finite": math.isfinite(loss) if not args.skip_train else None,
         "model": {
             "params_m": round(param_count(cfg) / 1e6, 1),
             "d_model": cfg.d_model, "n_layers": cfg.n_layers,

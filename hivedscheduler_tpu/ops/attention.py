@@ -378,7 +378,10 @@ def flash_attention(
     """
     b, t, h, d = q.shape
     h_kv = k.shape[2]
-    if pl is None or t % block_q or t % block_k or d % 8 or (h_kv and h % h_kv):
+    # cross-length q/k (e.g. KV-cache decode) must fall back too: the
+    # BlockSpecs size k/v with q's sequence length
+    if (pl is None or t % block_q or t % block_k or d % 8
+            or (h_kv and h % h_kv) or k.shape[1] != t):
         return xla_attention(q, k, v, causal=causal)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

@@ -279,6 +279,20 @@ class TestFlashAttention:
             out = flash_attention(q, k, v, causal=True)  # falls back
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
+    def test_flash_fallback_on_cross_length_kv(self):
+        """t_q != t_k (KV-cache decode shape) must fall back to the einsum
+        reference even when both lengths tile: the pallas BlockSpecs size
+        k/v with q's length, so the kernel would mis-read or mask wrongly."""
+        key = jax.random.PRNGKey(2)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            kq, kk, kv = jax.random.split(key, 3)
+            q = jax.random.normal(kq, (1, 128, 2, 16), jnp.float32)
+            k = jax.random.normal(kk, (1, 256, 2, 16), jnp.float32)
+            v = jax.random.normal(kv, (1, 256, 2, 16), jnp.float32)
+            ref = xla_attention(q, k, v, causal=False)
+            out = flash_attention(q, k, v, causal=False)  # must fall back
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
 
 class TestTopology:
     def test_mesh_axes(self):

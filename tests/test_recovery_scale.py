@@ -1,8 +1,11 @@
 """Recovery at scale: the work-preserving reconfiguration golden on the
 benchmark v5p-1024 topology. Hundreds of allocated pods must replay through
 the runtime's recovery barrier (runtime/scheduler.py start()) with every
-gang's physical placement preserved verbatim, in bounded time (reference
-behavior: hived_algorithm_test.go:1042-1092, tested there at toy scale)."""
+gang's physical placement preserved verbatim — compared at CHIP granularity
+(node -> exact leaf-cell indices), so a restart that lands a gang on the
+same nodes but different chips (broken ICI contiguity) counts as lost — in
+bounded time (reference behavior: hived_algorithm_test.go:1042-1092, tested
+there at toy scale)."""
 
 import bench
 
