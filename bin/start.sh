@@ -1,0 +1,17 @@
+#!/bin/bash
+# Runtime launcher — the contract analogue of the reference's
+# bin/hivedscheduler/start.sh (exec the scheduler from the install dir,
+# passing CLI args through). The config file comes from either an explicit
+# --config argument or the CONFIG env var (api/constants.py ENV_CONFIG_FILE),
+# which the deployment manifests set; the reference wires the same path via
+# its ConfigMap mount.
+
+set -o errexit
+set -o nounset
+set -o pipefail
+
+BASH_DIR=$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)
+
+cd "${BASH_DIR}/.."
+
+exec python -m hivedscheduler_tpu.cli "$@"

@@ -19,6 +19,7 @@ TPU-native analogue of the reference's ``pkg/algorithm/config.go``:
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -169,6 +170,9 @@ class PhysicalTreeBuilder:
         self.pinned_cells: Dict[str, PhysicalCell] = {}
         self.chain_levels: Dict[CellChain, List[ChainLevel]] = {}
         self.mesh_chains: Dict[CellChain, MeshChain] = {}
+        # node name -> cellAddress per mesh chain, to reject two physical
+        # cells deriving the same node (double-counted chip capacity)
+        self._mesh_chain_nodes: Dict[CellChain, Dict[str, str]] = {}
 
     def build(self, specs: List[api.PhysicalCellSpec]) -> None:
         for spec in specs:
@@ -187,6 +191,16 @@ class PhysicalTreeBuilder:
                     chain, self.mesh_chains[chain], spec, top.level,
                     (0,) * len(self.mesh_chains[chain].spec.topology),
                 )
+                seen = self._mesh_chain_nodes.setdefault(chain, {})
+                for n in root.nodes:
+                    if n in seen:
+                        raise ValueError(
+                            f"physical cells {seen[n]!r} and "
+                            f"{spec.cell_address!r} of chain {chain} derive "
+                            f"the same node name {n!r}; include {{cell}} in "
+                            "hostNameFormat so hosts stay distinct"
+                        )
+                    seen[n] = spec.cell_address
             else:
                 root = self._build_generic_cell(chain, levels, spec, top, "")
             root.api_status.leaf_cell_type = top.leaf_cell_type
@@ -299,6 +313,35 @@ class PhysicalTreeBuilder:
         pins = self._mesh_pin_lookup(spec, mesh_chain)
         top_address = spec.cell_address
         levels = self.chain_levels[chain]
+        if mesh_chain.spec.host_name_format is not None:
+            # a custom format exists to target a REAL control plane: derived
+            # node names must be legal K8s (DNS-1123 subdomain) names and
+            # must vary with the host coordinate
+            fmt = mesh_chain.spec.host_name_format
+            if "{coords}" not in fmt:
+                raise ValueError(
+                    f"hostNameFormat {fmt!r} must contain {{coords}} so each "
+                    "host gets a distinct node name"
+                )
+            try:
+                sample = mesh_chain.node_name(
+                    top_address, tuple(0 for _ in mesh_chain.spec.topology)
+                )
+            except (KeyError, IndexError) as e:
+                raise ValueError(
+                    f"hostNameFormat {fmt!r} has an unknown placeholder "
+                    f"({e}); only {{cell}} and {{coords}} are available"
+                ) from None
+            # real DNS-1123: <=253 chars total, dot-separated labels each
+            # <=63 chars of [a-z0-9-] with alphanumeric ends
+            label = r"[a-z0-9]([-a-z0-9]{0,61}[a-z0-9])?"
+            if len(sample) > 253 or not all(
+                re.fullmatch(label, part) for part in sample.split(".")
+            ):
+                raise ValueError(
+                    f"hostNameFormat {fmt!r} yields {sample!r}, not a legal "
+                    "K8s node name (lowercase DNS-1123 subdomain)"
+                )
 
         def rec(level: int, origin: Tuple[int, ...], current_node: str) -> PhysicalCell:
             lv = levels[level - 1]

@@ -170,10 +170,12 @@ class MeshChain:
         return self.levels[level - 1]
 
     def node_name(self, top_address: str, host_origin: Coord) -> str:
-        """Stable node name for the host whose sub-mesh starts at host_origin,
-        e.g. ``pod-a/2-0-0``. Deployments map these to real hostnames via the
-        physical-cell spec's cellAddress."""
-        return f"{top_address}/{coord_str(host_origin)}"
+        """Stable node name for the host whose sub-mesh starts at
+        host_origin. Default format ``{cell}/{coords}`` (e.g. ``pod-a/2-0-0``)
+        for simulation; real deployments set ``spec.hostNameFormat`` to a
+        K8s-legal pattern matching their actual hostnames (see MeshSpec)."""
+        fmt = self.spec.host_name_format or "{cell}/{coords}"
+        return fmt.format(cell=top_address, coords=coord_str(host_origin))
 
     def host_origin_of(self, coord: Coord) -> Coord:
         return tuple((c // h) * h for c, h in zip(coord, self.spec.host_shape))

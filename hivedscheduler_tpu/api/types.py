@@ -78,12 +78,22 @@ class MeshSpec:
     sub-mesh directly attached to one host/node (e.g. ``[2, 2, 1]`` for v5p's
     4-chip hosts), and ``levels`` are the named allocatable shapes in
     ascending order. Chip level and host level are implicit (auto-inserted if
-    not listed)."""
+    not listed).
+
+    ``hostNameFormat`` maps each host sub-mesh to its Kubernetes node name:
+    a format string over ``{cell}`` (the physical cell's cellAddress) and
+    ``{coords}`` (the host origin, dash-joined, e.g. ``2-0-0``). The default
+    ``{cell}/{coords}`` is stable and readable for simulation/inspection but
+    contains ``/`` — NOT a legal K8s node name — so real-control-plane
+    deployments must set a DNS-1123-compatible format matching their actual
+    hostnames (e.g. ``tpu-{coords}.gke.internal``); the config parser
+    validates legality whenever a custom format is given."""
 
     topology: Tuple[int, ...]
     chip_type: CellType
     host_shape: Tuple[int, ...]
     levels: List[MeshLevelSpec] = field(default_factory=list)
+    host_name_format: Optional[str] = None
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "MeshSpec":
@@ -92,15 +102,19 @@ class MeshSpec:
             chip_type=d["chipType"],
             host_shape=tuple(int(x) for x in d["hostShape"]),
             levels=[MeshLevelSpec.from_dict(x) for x in d.get("levels", [])],
+            host_name_format=d.get("hostNameFormat"),
         )
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "topology": list(self.topology),
             "chipType": self.chip_type,
             "hostShape": list(self.host_shape),
             "levels": [x.to_dict() for x in self.levels],
         }
+        if self.host_name_format is not None:
+            out["hostNameFormat"] = self.host_name_format
+        return out
 
 
 @dataclass

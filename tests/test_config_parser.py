@@ -123,6 +123,68 @@ def test_mesh_validation_errors():
         )
 
 
+def test_host_name_format_k8s_legal():
+    """hostNameFormat maps mesh hosts onto real (DNS-1123-legal) node names —
+    required for any real control plane, where the default '{cell}/{coords}'
+    is rejected by the ApiServer. Bad formats fail at parse time."""
+    from hivedscheduler_tpu.api.config import Config, new_config
+    from hivedscheduler_tpu.api.types import PhysicalClusterSpec
+
+    def cfg(fmt):
+        return new_config(Config(physical_cluster=PhysicalClusterSpec.from_dict({
+            "cellTypes": {"m8": {"mesh": {
+                "topology": [4, 2], "chipType": "chip", "hostShape": [2, 2],
+                "levels": [{"name": "m-2x2", "shape": [2, 2]}],
+                **({"hostNameFormat": fmt} if fmt else {}),
+            }}},
+            "physicalCells": [{"cellType": "m8", "cellAddress": "pod0"}],
+        })))
+
+    parsed = parse_config(cfg("tpu-{coords}.gke.internal"))
+    top = parsed.physical_full_list["m8"][max(parsed.physical_full_list["m8"])][0]
+    assert sorted(top.nodes) == ["tpu-0-0.gke.internal", "tpu-2-0.gke.internal"]
+    # round-trips through the spec serializer
+    spec = cfg("tpu-{coords}.gke.internal").physical_cluster
+    assert spec.to_dict()["cellTypes"]["m8"]["mesh"]["hostNameFormat"]
+    with pytest.raises(ValueError, match="coords"):
+        parse_config(cfg("static-name"))
+    with pytest.raises(ValueError, match="legal"):
+        parse_config(cfg("UPPER-{coords}"))
+    with pytest.raises(ValueError, match="legal"):
+        parse_config(cfg("tpu-{coords}..internal"))  # empty DNS label
+    with pytest.raises(ValueError, match="legal"):
+        parse_config(cfg("x" * 70 + "-{coords}"))  # label > 63 chars
+    with pytest.raises(ValueError, match="placeholder"):
+        parse_config(cfg("tpu-{rack}-{coords}"))
+
+    # two physical cells of one chain must not derive the same node names
+    from hivedscheduler_tpu.api.config import Config, new_config as _nc
+
+    def two_cells(fmt):
+        return new_config(Config(physical_cluster=PhysicalClusterSpec.from_dict({
+            "cellTypes": {"m8": {"mesh": {
+                "topology": [4, 2], "chipType": "chip", "hostShape": [2, 2],
+                "levels": [{"name": "m-2x2", "shape": [2, 2]}],
+                "hostNameFormat": fmt,
+            }}},
+            "physicalCells": [
+                {"cellType": "m8", "cellAddress": "pod0"},
+                {"cellType": "m8", "cellAddress": "pod1"},
+            ],
+        })))
+
+    with pytest.raises(ValueError, match="same node name"):
+        parse_config(two_cells("tpu-{coords}"))
+    parsed2 = parse_config(two_cells("{cell}-{coords}"))
+    tops = parsed2.physical_full_list["m8"][max(parsed2.physical_full_list["m8"])]
+    assert {n for t in tops for n in t.nodes} == {
+        "pod0-0-0", "pod0-2-0", "pod1-0-0", "pod1-2-0"}
+    # default stays the simulation-friendly cell/coords form
+    parsed = parse_config(cfg(None))
+    top = parsed.physical_full_list["m8"][max(parsed.physical_full_list["m8"])][0]
+    assert sorted(top.nodes) == ["pod0/0-0", "pod0/2-0"]
+
+
 from helpers import V5E32_CELL_TYPES, make_pod, set_healthy_nodes
 
 
@@ -231,6 +293,53 @@ class TestExampleConfigsValid:
         ext = policy["extenders"][0]
         assert ext["filterVerb"] == "filter" and ext["bindVerb"] == "bind"
         assert ext["preemptVerb"] == "preempt"
+
+    def test_kind_e2e_fixtures_consistent(self):
+        """The kind-e2e manifests (example/run/kind-e2e/) must stay
+        internally consistent without a cluster: the embedded config boots,
+        its hostNameFormat-derived node names equal the fake kwok Node
+        names, and the exact test pod schedules + binds onto them through
+        the full algorithm (so the CI job can only fail on genuinely
+        control-plane concerns: RBAC, wire serialization, Bind merge)."""
+        import yaml
+
+        from hivedscheduler_tpu.api import constants as C
+        from hivedscheduler_tpu.api.config import Config, new_config
+        from hivedscheduler_tpu.algorithm import HivedAlgorithm
+        from hivedscheduler_tpu.k8s import serde
+        from hivedscheduler_tpu.k8s.types import Node
+        from hivedscheduler_tpu.runtime.types import FILTERING_PHASE
+        from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+        base = os.path.join(os.path.dirname(FIXTURE), "..", "..", "run",
+                            "kind-e2e")
+        docs = list(yaml.safe_load_all(open(os.path.join(base, "manifests.yaml"))))
+        cm = next(d for d in docs if d and d.get("kind") == "ConfigMap")
+        cfg = Config.from_dict(yaml.safe_load(cm["data"]["config.yaml"]))
+        algo = HivedAlgorithm(new_config(cfg))
+        derived = sorted({n for ccl in algo.full_cell_list.values()
+                          for c in ccl[max(ccl)] for n in c.nodes})
+        fake_nodes = [d["metadata"]["name"] for d in
+                      yaml.safe_load_all(open(os.path.join(base, "fake-nodes.yaml")))
+                      if d]
+        assert derived == sorted(fake_nodes), (derived, fake_nodes)
+        # RBAC covers exactly what the REST client needs
+        role = next(d for d in docs if d and d.get("kind") == "ClusterRole")
+        rules = {(r0, v) for r in role["rules"]
+                 for r0 in r["resources"] for v in r["verbs"]}
+        assert {("nodes", "watch"), ("pods", "watch"),
+                ("pods/binding", "create")} <= rules
+        # the shipped pod schedules and binds on this config
+        pod_doc = yaml.safe_load(open(os.path.join(base, "test-pod.yaml")))
+        pod = serde.pod_from_k8s(pod_doc)
+        for n in fake_nodes:
+            algo.add_node(Node(name=n))
+        result = algo.schedule(pod, fake_nodes, FILTERING_PHASE)
+        assert result.pod_bind_info is not None, result.pod_wait_info
+        assert result.pod_bind_info.node in fake_nodes
+        bp = new_binding_pod(pod, result.pod_bind_info)
+        assert bp.annotations[C.ANNOTATION_POD_CHIP_ISOLATION]
+        algo.add_allocated_pod(bp)
 
     def test_modern_deploy_manifest(self):
         """deploy-modern.yaml replaces the removed-in-1.23 Policy file with a

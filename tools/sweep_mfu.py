@@ -1,0 +1,117 @@
+"""One-process MFU/decode tuning sweep on the live TPU.
+
+Runs a list of flagship-config variants (remat policy, flash tile sizes,
+batch/grad-accum, decode) sequentially inside a SINGLE process — one tunnel
+acquisition, one backend — printing one JSON line per config. Used to pick
+the defaults shipped in bench_model.py; kept in tools/ so the tuning is
+reproducible on future chip generations.
+
+Usage (axon TPU env):  python tools/sweep_mfu.py [--iters 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench_model as bm  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--only", default="", help="comma list of tags to run")
+    args = ap.parse_args()
+
+    jax, devices = bm.acquire_backend(
+        float(os.environ.get("HIVED_TPU_ACQUIRE_TIMEOUT_S", "600"))
+    )
+    from hivedscheduler_tpu.models import transformer as tm
+    from hivedscheduler_tpu.parallel import topology
+
+    dev = devices[0]
+    peak_flops, peak_bw = bm.chip_peaks(dev)
+    print(json.dumps({"device": getattr(dev, "device_kind", str(dev)),
+                      "backend": jax.default_backend(),
+                      "peak_tflops": peak_flops and peak_flops / 1e12}),
+          flush=True)
+    axes = topology.MeshAxes()
+    mesh = topology.make_mesh(axes, jax.devices()[:1])
+
+    base = dict(vocab_size=32768, d_model=2048, n_heads=16, n_kv_heads=8,
+                n_layers=6, d_ff=8192, max_seq_len=2048, attn_impl="flash")
+    seq = 2048
+
+    def run_train(tag, batch=8, grad_accum=1, **kw):
+        cfg = tm.TransformerConfig(**{**base, **kw})
+        try:
+            t0 = time.time()
+            step_s, loss = bm.bench_train(cfg, batch, seq, args.iters, mesh,
+                                          grad_accum=grad_accum)
+            flops = bm.train_flops_per_step(cfg, batch, seq)
+            rec = {
+                "tag": tag,
+                "step_ms": round(step_s * 1e3, 1),
+                "mfu_pct": round(100.0 * flops / step_s / peak_flops, 2)
+                if peak_flops else None,
+                "tok_per_s": round(batch * seq / step_s),
+                "compile_s": round(time.time() - t0 - args.iters * step_s, 1),
+                "loss_ok": float(loss) == float(loss),
+            }
+        except Exception as e:
+            rec = {"tag": tag, "error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print(json.dumps(rec), flush=True)
+        gc.collect()
+
+    def run_decode(tag, dec_batch=16, prompt=128, new=64):
+        cfg = tm.TransformerConfig(**base)
+        try:
+            dec_s = bm.bench_decode(cfg, dec_batch, prompt, new,
+                                    max(1, args.iters // 2))
+            param_bytes = 2.0 * bm.param_count(cfg)
+            rec = {
+                "tag": tag,
+                "decode_tok_per_s": round(dec_batch * new / dec_s, 1),
+                "hbm_frac": round((new * param_bytes / dec_s) / peak_bw, 3)
+                if peak_bw else None,
+            }
+        except Exception as e:
+            rec = {"tag": tag, "error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print(json.dumps(rec), flush=True)
+        gc.collect()
+
+    experiments = [
+        ("remat_full", lambda: run_train("remat_full", remat="full")),
+        ("remat_dots", lambda: run_train("remat_dots", remat="dots")),
+        ("remat_none", lambda: run_train("remat_none", remat="none")),
+        ("none_accum2", lambda: run_train("none_accum2", remat="none",
+                                          grad_accum=2)),
+        ("dots_b256k256", lambda: run_train("dots_b256k256", remat="dots",
+                                            attn_block_q=256,
+                                            attn_block_k=256)),
+        ("dots_b256k512", lambda: run_train("dots_b256k512", remat="dots",
+                                            attn_block_q=256,
+                                            attn_block_k=512)),
+        ("dots_b512k512", lambda: run_train("dots_b512k512", remat="dots",
+                                            attn_block_q=512,
+                                            attn_block_k=512)),
+        ("dots_b16", lambda: run_train("dots_b16", remat="dots", batch=16)),
+        ("decode_bf16", lambda: run_decode("decode_bf16")),
+        ("decode_b32", lambda: run_decode("decode_b32", dec_batch=32)),
+    ]
+    only = {t for t in args.only.split(",") if t}
+    for tag, fn in experiments:
+        if only and tag not in only:
+            continue
+        fn()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
