@@ -87,9 +87,12 @@ def main() -> int:
         gc.collect()
 
     experiments = [
-        ("remat_full", lambda: run_train("remat_full", remat="full")),
+        # most-load-bearing first: if the tunnel dies mid-sweep we still
+        # have the shipped default's number
         ("remat_dots", lambda: run_train("remat_dots", remat="dots")),
+        ("decode_bf16_first", lambda: run_decode("decode_bf16_first")),
         ("remat_none", lambda: run_train("remat_none", remat="none")),
+        ("remat_full", lambda: run_train("remat_full", remat="full")),
         ("none_accum2", lambda: run_train("none_accum2", remat="none",
                                           grad_accum=2)),
         ("dots_b256k256", lambda: run_train("dots_b256k256", remat="dots",
