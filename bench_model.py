@@ -279,8 +279,20 @@ def main(argv=None) -> int:
         step_s, loss = None, 0.0
         flops, achieved, mfu, train_tps = 0.0, None, None, None
     else:
-        step_s, loss = bench_train(cfg, batch, seq, iters, mesh,
-                                   grad_accum=args.grad_accum)
+        try:
+            step_s, loss = bench_train(cfg, batch, seq, iters, mesh,
+                                       grad_accum=args.grad_accum)
+        except Exception as e:
+            # the tuned DEFAULT remat policy trades HBM for FLOPs; if it
+            # doesn't fit this chip, fall back to full remat rather than
+            # losing the driver's number entirely. An explicit --remat is a
+            # tuning question — "does it fit" is the answer, so re-raise.
+            if (args.remat is not None or cfg.remat == "full"
+                    or "RESOURCE_EXHAUSTED" not in str(e)):
+                raise
+            cfg = dataclasses.replace(cfg, remat="full")
+            step_s, loss = bench_train(cfg, batch, seq, iters, mesh,
+                                       grad_accum=args.grad_accum)
         flops = train_flops_per_step(cfg, batch, seq)
         achieved = flops / step_s
         mfu = achieved / peak_flops if peak_flops else None

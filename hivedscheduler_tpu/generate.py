@@ -129,6 +129,11 @@ def main(argv=None) -> int:
 
         params = quant.quantize_params(params, cfg)
         log.info("quantized weights to int8 (per-output-channel scales)")
+    else:
+        # serving holds weights in the compute dtype: decode is
+        # HBM-bandwidth-bound, and f32 checkpoint weights would stream twice
+        # the bytes per generated token (models/transformer.cast_params)
+        params = tm.cast_params(params, cfg.dtype)
 
     prompt = jax.random.randint(
         jax.random.PRNGKey(args.seed + 1), (args.batch, args.prompt_len),
@@ -163,7 +168,10 @@ def main(argv=None) -> int:
             cfg, n_layers=args.draft_layers, d_model=d_model,
             d_ff=2 * d_model, n_experts=0, n_kv_heads=0,
         )
-        dft_params = tm.init_params(dft_cfg, jax.random.PRNGKey(args.seed + 3))
+        dft_params = tm.cast_params(
+            tm.init_params(dft_cfg, jax.random.PRNGKey(args.seed + 3)),
+            dft_cfg.dtype,
+        )
         if args.tp > 1 or args.dp > 1:
             try:
                 mesh = _serving_mesh(args)

@@ -105,7 +105,6 @@ def main() -> int:
                                             attn_block_q=512,
                                             attn_block_k=512)),
         ("dots_b16", lambda: run_train("dots_b16", remat="dots", batch=16)),
-        ("decode_bf16", lambda: run_decode("decode_bf16")),
         ("decode_b32", lambda: run_decode("decode_b32", dec_batch=32)),
     ]
     only = {t for t in args.only.split(",") if t}
