@@ -63,6 +63,9 @@ def main(argv=None) -> int:
                         "adapters are restored and merged into the base "
                         "weights before serving")
     parser.add_argument("--lora-alpha", type=float, default=16.0)
+    parser.add_argument("--lora-mlp", action="store_true",
+                        help="the checkpoint's adapters also cover the "
+                             "dense-MLP projections")
     parser.add_argument("--quantize", choices=["none", "int8"], default="none",
                         help="weight-only int8 post-training quantization "
                         "(halves weight HBM traffic vs bf16 while matmuls "
@@ -103,7 +106,8 @@ def main(argv=None) -> int:
         import dataclasses
 
         init_cfg = dataclasses.replace(
-            cfg, lora_rank=args.lora_rank, lora_alpha=args.lora_alpha
+            cfg, lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
+            lora_mlp=args.lora_mlp,
         )
     params = tm.init_params(init_cfg, jax.random.PRNGKey(args.seed))
     if args.checkpoint_dir:

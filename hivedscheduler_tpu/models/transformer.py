@@ -85,6 +85,9 @@ class TransformerConfig:
     # make_sharded_lora_train_step); merge_lora folds them back for serving
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # extend the adapters to the dense-MLP projections (gate/up/down) too;
+    # requires lora_rank > 0 and a dense model (MoE experts are not adapted)
+    lora_mlp: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -136,9 +139,13 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
             w_up=norm_init(km[1], (L, d, f), d),
             w_down=norm_init(km[2], (L, f, d), f),
         )
+    if cfg.lora_mlp and cfg.lora_rank <= 0:
+        # silently training ALL parameters when the user asked for
+        # MLP adapters would defeat the point of the flag
+        raise ValueError("lora_mlp requires lora_rank > 0")
     if cfg.lora_rank > 0:
         r = cfg.lora_rank
-        kl = jax.random.split(jax.random.fold_in(key, 7), 4)
+        kl = jax.random.split(jax.random.fold_in(key, 7), 7)
         layers.update(
             # a ~ N(0, 1/d) like the base projections, b = 0: the adapted
             # model starts exactly equal to the base model
@@ -151,15 +158,24 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
             lora_wo_a=norm_init(kl[3], (L, h, hd, r), d),
             lora_wo_b=jnp.zeros((L, r, d), jnp.float32),
         )
+        if cfg.lora_mlp:
+            if cfg.n_experts > 0:
+                raise ValueError("lora_mlp adapts the dense MLP only "
+                                 "(MoE experts are not adapted)")
+            layers.update(
+                lora_w_gate_a=norm_init(kl[4], (L, d, r), d),
+                lora_w_gate_b=jnp.zeros((L, r, f), jnp.float32),
+                lora_w_up_a=norm_init(kl[5], (L, d, r), d),
+                lora_w_up_b=jnp.zeros((L, r, f), jnp.float32),
+                lora_w_down_a=norm_init(kl[6], (L, f, r), f),
+                lora_w_down_b=jnp.zeros((L, r, d), jnp.float32),
+            )
     return {
         "embed": norm_init(k_emb, (cfg.vocab_size, d), d),
         "layers": layers,
         "final_norm": jnp.ones((d,), jnp.float32),
         "lm_head": norm_init(k_out, (d, cfg.vocab_size), d),
     }
-
-
-LORA_BASES = ("wq", "wk", "wv", "wo")
 
 
 def split_lora_params(params: Dict[str, Any]):
@@ -186,13 +202,17 @@ def merge_lora(params: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, Any]
     assert cfg.lora_rank > 0, "merge_lora needs a LoRA config"
     s = cfg.lora_alpha / cfg.lora_rank
     layers = dict(params["layers"])
-    for name in LORA_BASES:
+    bases = [k[len("lora_"):-len("_a")] for k in layers
+             if k.startswith("lora_") and k.endswith("_a")]
+    for name in bases:
         a = layers.pop(f"lora_{name}_a")
         b = layers.pop(f"lora_{name}_b")
         if name == "wo":
             delta = jnp.einsum("lhkr,lrd->lhkd", a, b)
-        else:
+        elif name in ("wq", "wk", "wv"):
             delta = jnp.einsum("ldr,lrhk->ldhk", a, b)
+        else:  # MLP projections: plain 2-D factors
+            delta = jnp.einsum("lxr,lry->lxy", a, b)
         layers[name] = (layers[name] + s * delta).astype(params["layers"][name].dtype)
     out = dict(params)
     out["layers"] = layers
@@ -246,6 +266,15 @@ def sharding_specs(cfg: TransformerConfig) -> Dict[str, Any]:
             lora_wo_a=P(pl, tp, None, None),
             lora_wo_b=P(pl, None, fsdp),
         )
+        if cfg.lora_mlp:
+            layers.update(
+                lora_w_gate_a=P(pl, fsdp, None),
+                lora_w_gate_b=P(pl, None, tp),
+                lora_w_up_a=P(pl, fsdp, None),
+                lora_w_up_b=P(pl, None, tp),
+                lora_w_down_a=P(pl, tp, None),
+                lora_w_down_b=P(pl, None, fsdp),
+            )
     return {
         "embed": P(None, "fsdp"),
         "layers": layers,
@@ -597,9 +626,27 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
     else:
         gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))
         up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
-        x = x + row_parallel(jnp.einsum(
-            "btf,fd->btd", jax.nn.silu(gate) * up, lp["w_down"].astype(dtype)
-        ))
+        if "lora_w_gate_a" in lp:
+            s = cfg.lora_alpha / cfg.lora_rank
+
+            def lora_mlp(inp, name):
+                z = jnp.einsum("btd,dr->btr", inp, lp[f"{name}_a"].astype(dtype))
+                return jnp.einsum(
+                    "btr,rf->btf", z, lp[f"{name}_b"].astype(dtype)
+                ) * s
+
+            gate = gate + lora_mlp(h, "lora_w_gate")
+            up = up + lora_mlp(h, "lora_w_up")
+        mid = jax.nn.silu(gate) * up
+        down = jnp.einsum("btf,fd->btd", mid, lp["w_down"].astype(dtype))
+        if "lora_w_down_a" in lp:
+            # contracts the (tp-sharded) hidden dim like the base w_down, so
+            # the adapter's partial sums ride the same row-parallel psum
+            zd = jnp.einsum("btf,fr->btr", mid, lp["lora_w_down_a"].astype(dtype))
+            down = down + jnp.einsum(
+                "btr,rd->btd", zd, lp["lora_w_down_b"].astype(dtype)
+            ) * (cfg.lora_alpha / cfg.lora_rank)
+        x = x + row_parallel(down)
     return x, aux
 
 

@@ -42,41 +42,46 @@ def loss_fn(params, tokens, cfg: tm.TransformerConfig, mesh=None) -> jax.Array:
     return loss
 
 
+def _accumulated_value_and_grad(grad_fn, diff_params, tokens, grad_accum: int):
+    """(loss, grads) of ``grad_fn(diff_params, micro_tokens)`` averaged over
+    ``grad_accum`` equal batch slices via ``lax.scan`` — one slice's
+    activations live at a time (the standard trade of step latency for
+    activation memory on top of remat). For dense models the average equals
+    the full-batch gradient exactly (the LM loss is a mean over equal
+    slices; guards: test_grad_accum_matches_full_batch,
+    test_lora_grad_accum_matches_full_batch); MoE aux losses are nonlinear
+    batch statistics, so they are computed per slice and averaged — the
+    standard approximation."""
+    if grad_accum <= 1:
+        return grad_fn(diff_params, tokens)
+    b = tokens.shape[0]
+    assert b % grad_accum == 0, (
+        f"batch {b} not divisible by grad_accum {grad_accum}"
+    )
+    slices = tokens.reshape(grad_accum, b // grad_accum, *tokens.shape[1:])
+
+    def accumulate(carry, micro_tokens):
+        loss_sum, grad_sum = carry
+        loss, grads = grad_fn(diff_params, micro_tokens)
+        return (loss_sum + loss, jax.tree.map(jnp.add, grad_sum, grads)), None
+
+    zeros = jax.tree.map(jnp.zeros_like, diff_params)
+    (loss_sum, grad_sum), _ = jax.lax.scan(
+        accumulate, (jnp.zeros(()), zeros), slices
+    )
+    return loss_sum / grad_accum, jax.tree.map(
+        lambda g: g / grad_accum, grad_sum
+    )
+
+
 def train_step(params, opt_state, tokens, cfg: tm.TransformerConfig, optimizer,
                mesh=None, grad_accum: int = 1):
-    """One optimizer update. With ``grad_accum > 1`` the batch's leading dim
-    is split into that many slices and gradients are averaged over them with
-    a ``lax.scan`` (one slice's activations live at a time — the standard
-    trade of step latency for activation memory on top of remat). For dense
-    models the update equals the full-batch gradient exactly (the LM loss is
-    a mean over equal slices; guard: test_grad_accum_matches_full_batch);
-    MoE aux losses are nonlinear batch statistics, so they are computed per
-    slice and averaged — the standard approximation."""
-    if grad_accum <= 1:
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
-    else:
-        b = tokens.shape[0]
-        assert b % grad_accum == 0, (
-            f"batch {b} not divisible by grad_accum {grad_accum}"
-        )
-        slices = tokens.reshape(grad_accum, b // grad_accum, *tokens.shape[1:])
-
-        def accumulate(carry, micro_tokens):
-            loss_sum, grad_sum = carry
-            loss, grads = jax.value_and_grad(loss_fn)(
-                params, micro_tokens, cfg, mesh
-            )
-            return (
-                loss_sum + loss,
-                jax.tree.map(jnp.add, grad_sum, grads),
-            ), None
-
-        zeros = jax.tree.map(jnp.zeros_like, params)
-        (loss_sum, grad_sum), _ = jax.lax.scan(
-            accumulate, (jnp.zeros(()), zeros), slices
-        )
-        loss = loss_sum / grad_accum
-        grads = jax.tree.map(lambda g: g / grad_accum, grad_sum)
+    """One optimizer update; see ``_accumulated_value_and_grad`` for the
+    ``grad_accum > 1`` semantics."""
+    loss, grads = _accumulated_value_and_grad(
+        jax.value_and_grad(lambda p, t: loss_fn(p, t, cfg, mesh)),
+        params, tokens, grad_accum,
+    )
     updates, opt_state = optimizer.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
     return params, opt_state, loss
@@ -153,6 +158,7 @@ def make_sharded_lora_train_step(
     cfg: tm.TransformerConfig,
     mesh,
     optimizer: Optional[optax.GradientTransformation] = None,
+    grad_accum: int = 1,
 ):
     """LoRA fine-tuning: the base weights are genuinely frozen — gradients
     are taken w.r.t. the adapter subtree only (no base grads computed, no
@@ -162,7 +168,9 @@ def make_sharded_lora_train_step(
     Returns (jitted_step, init_fn, token_sharding) where ``init_fn(key)`` ->
     (base_params, lora_params, opt_state) and ``jitted_step(base, lora,
     opt_state, tokens)`` -> (lora_params, opt_state, loss) with the small
-    carries donated."""
+    carries donated. ``grad_accum`` splits the batch into that many
+    microbatch slices scanned with averaged adapter gradients (same trade
+    and exactness argument as ``train_step``)."""
     assert cfg.lora_rank > 0, "set cfg.lora_rank to use the LoRA step"
     optimizer = optimizer or make_optimizer()
     param_specs = tm.sharding_specs(cfg)
@@ -184,7 +192,10 @@ def make_sharded_lora_train_step(
         return loss_fn(tm.combine_lora_params(base, lora), tokens, cfg, mesh)
 
     def step(base, lora, opt_state, tokens):
-        loss, grads = jax.value_and_grad(lora_loss)(lora, base, tokens)
+        loss, grads = _accumulated_value_and_grad(
+            jax.value_and_grad(lambda lr, t: lora_loss(lr, base, t)),
+            lora, tokens, grad_accum,
+        )
         updates, opt_state = optimizer.update(grads, opt_state, lora)
         lora = optax.apply_updates(lora, updates)
         return lora, opt_state, loss

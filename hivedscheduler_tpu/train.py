@@ -53,6 +53,9 @@ def main(argv=None) -> int:
     parser.add_argument("--lora-rank", type=int, default=0,
                         help="LoRA fine-tuning: adapter rank on the attention "
                         "projections (0 = full training)")
+    parser.add_argument("--lora-mlp", action="store_true",
+                        help="extend LoRA adapters to the dense-MLP "
+                             "projections (gate/up/down)")
     parser.add_argument("--lora-alpha", type=float, default=16.0,
                         help="LoRA scale (delta = alpha/rank * A B)")
     parser.add_argument("--remat", choices=("full", "dots", "none"),
@@ -132,16 +135,19 @@ def main(argv=None) -> int:
         pipeline_microbatches=args.microbatches if args.pp > 1 else 0,
         lora_rank=args.lora_rank,
         lora_alpha=args.lora_alpha,
+        lora_mlp=args.lora_mlp,
         remat=args.remat,
         attn_block_q=args.block_q,
         attn_block_k=args.block_k,
     )
     lora_mode = args.lora_rank > 0
     if lora_mode:
-        if args.grad_accum > 1 or args.pp > 1:
-            log.error("--lora-rank does not compose with --grad-accum/--pp yet")
+        if args.pp > 1:
+            log.error("--lora-rank does not compose with --pp yet")
             return 1
-        step_fn, init_fn, token_sharding = make_sharded_lora_train_step(cfg, mesh)
+        step_fn, init_fn, token_sharding = make_sharded_lora_train_step(
+            cfg, mesh, grad_accum=args.grad_accum
+        )
         base_params, lora_params, opt_state = init_fn(jax.random.PRNGKey(0))
         params = tm.combine_lora_params(base_params, lora_params)
     else:

@@ -22,13 +22,16 @@ def cfg_of(**kw):
 
 
 def _perturb_lora_b(params, seed=5):
-    """Random-fill the B factors so the adapters actually do something."""
+    """Random-fill the B factors so the adapters actually do something
+    (covers whatever adapters the tree carries, incl. lora_mlp ones)."""
     layers = dict(params["layers"])
     k = jax.random.PRNGKey(seed)
-    for name in tm.LORA_BASES:
+    for name in sorted(layers):
+        if not (name.startswith("lora_") and name.endswith("_b")):
+            continue
         k, sub = jax.random.split(k)
-        b = layers[f"lora_{name}_b"]
-        layers[f"lora_{name}_b"] = 0.1 * jax.random.normal(sub, b.shape, b.dtype)
+        b = layers[name]
+        layers[name] = 0.1 * jax.random.normal(sub, b.shape, b.dtype)
     return {**params, "layers": layers}
 
 
@@ -63,6 +66,54 @@ class TestLoRA:
         base_out = tm.forward(base_params, tokens, base_cfg)
         assert np.abs(np.asarray(adapted) - np.asarray(base_out)).max() > 1e-4
 
+    def test_mlp_adapters_identity_merge_and_training(self):
+        """lora_mlp=True: zero-init is exactly the base model; merge folds
+        gate/up/down deltas exactly; a tp-sharded LoRA step trains the MLP
+        adapters too; MoE configs are rejected."""
+        from hivedscheduler_tpu.parallel import topology
+        from hivedscheduler_tpu.parallel.train import make_sharded_lora_train_step
+
+        cfg = cfg_of(lora_rank=3, lora_alpha=6.0, lora_mlp=True)
+        base_cfg = cfg_of()
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 64)
+        params = tm.init_params(cfg, jax.random.PRNGKey(0))
+        assert "lora_w_down_a" in params["layers"]
+        base_params, _ = tm.split_lora_params(params)
+        np.testing.assert_allclose(
+            np.asarray(tm.forward(params, tokens, cfg)),
+            np.asarray(tm.forward(base_params, tokens, base_cfg)), atol=1e-6,
+        )
+        params = _perturb_lora_b(params)
+        adapted = tm.forward(params, tokens, cfg)
+        merged = tm.merge_lora(params, cfg)
+        assert not any(k.startswith("lora_") for k in merged["layers"])
+        np.testing.assert_allclose(
+            np.asarray(tm.forward(merged, tokens, base_cfg)),
+            np.asarray(adapted), atol=1e-5,
+        )
+        # ... and the MLP deltas actually matter: zero them, outputs change
+        zeroed = {**params, "layers": {
+            k: (jnp.zeros_like(v) if k.startswith("lora_w_") and k.endswith("_b")
+                else v)
+            for k, v in params["layers"].items()}}
+        assert np.abs(np.asarray(tm.forward(zeroed, tokens, cfg))
+                      - np.asarray(adapted)).max() > 1e-5
+
+        mesh = topology.make_mesh(topology.MeshAxes(tp=2), topology.get_devices(2))
+        step_fn, init_fn, _tok = make_sharded_lora_train_step(cfg, mesh)
+        base, lora, opt = init_fn(jax.random.PRNGKey(0))
+        gate_a_before = np.asarray(lora["layers"]["lora_w_gate_a"])  # donated
+        lora2, opt, loss = step_fn(base, lora, opt, tokens)
+        assert np.isfinite(float(loss))
+        moved = float(np.abs(
+            np.asarray(lora2["layers"]["lora_w_gate_a"]) - gate_a_before
+        ).sum())
+        assert moved > 0.0
+
+        with pytest.raises(ValueError, match="dense"):
+            tm.init_params(cfg_of(lora_rank=2, lora_mlp=True, n_experts=2),
+                           jax.random.PRNGKey(0))
+
     def test_lora_step_trains_only_adapters(self):
         from hivedscheduler_tpu.parallel import topology
         from hivedscheduler_tpu.parallel.train import make_sharded_lora_train_step
@@ -92,6 +143,33 @@ class TestLoRA:
         assert moved > 0.0
         assert losses[-1] < losses[0]
 
+    def test_lora_grad_accum_matches_full_batch(self):
+        """One LoRA update with grad_accum=4 must equal the full-batch
+        update exactly (same argument as the dense train step: the LM loss
+        is a mean over equal slices; adapter grads average linearly)."""
+        from hivedscheduler_tpu.parallel import topology
+        from hivedscheduler_tpu.parallel.train import make_sharded_lora_train_step
+
+        cfg = cfg_of(lora_rank=2)
+        mesh = topology.make_mesh(topology.MeshAxes(dp=2), topology.get_devices(2))
+        tokens_host = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+        results = {}
+        for accum in (1, 4):
+            step_fn, init_fn, token_sharding = make_sharded_lora_train_step(
+                cfg, mesh, grad_accum=accum
+            )
+            base, lora, opt_state = init_fn(jax.random.PRNGKey(0))
+            lora = _perturb_lora_b(lora)  # make the adapters active
+            tokens = jax.device_put(tokens_host, token_sharding)
+            lora, opt_state, loss = step_fn(base, lora, opt_state, tokens)
+            results[accum] = (jax.tree.map(np.asarray, lora), float(loss))
+        l1, loss1 = results[1]
+        l4, loss4 = results[4]
+        assert abs(loss1 - loss4) < 1e-5
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5), l1, l4
+        )
+
     def test_tp_sharded_lora_matches_single_device(self):
         from hivedscheduler_tpu.parallel import topology
 
@@ -120,7 +198,7 @@ class TestLoRA:
         base, lora = tm.split_lora_params(params)
         assert not any(k.startswith("lora_") for k in base["layers"])
         assert set(lora["layers"]) == {
-            f"lora_{n}_{ab}" for n in tm.LORA_BASES for ab in "ab"
+            f"lora_{n}_{ab}" for n in ("wq", "wk", "wv", "wo") for ab in "ab"
         }
         back = tm.combine_lora_params(base, lora)
         assert jax.tree.structure(back) == jax.tree.structure(params)
