@@ -103,10 +103,25 @@ def acquire_backend(timeout_s: float, grace_s: float = 120.0):
         import jax
 
         devices = jax.devices()
+    except RuntimeError as e:
+        # the axon client retries internally for ~25 min and then fails
+        # terminally (observed: "UNAVAILABLE: TPU backend setup/compile
+        # error" when the pool itself is down). Surface that as a
+        # self-explanatory artifact line instead of a bare traceback.
+        done.set()
+        print(json.dumps({
+            "metric": "train_step_mfu_1chip",
+            "value": None,
+            "unit": "%",
+            "vs_baseline": None,
+            "error": f"tpu_backend_unavailable: {str(e)[:300]}",
+        }))
+        sys.stdout.flush()
+        raise SystemExit(4)
     finally:
-        # disarm even on a fast failure (e.g. backend-init error raised to
-        # an in-process caller): a still-armed watchdog would os._exit the
-        # whole host process minutes later with a bogus 'tunnel busy' note
+        # disarm even on a fast failure: a still-armed watchdog would
+        # os._exit the whole host process minutes later with a bogus
+        # 'tunnel busy' note
         done.set()
     return jax, devices
 

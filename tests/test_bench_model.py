@@ -53,6 +53,36 @@ def test_acquire_timeout_fails_fast_and_loud():
     assert "UNREACHABLE" not in p.stdout
 
 
+def test_backend_unavailable_fails_loud():
+    """A terminal backend-init failure (the axon client gives up after its
+    internal ~25-min retry with UNAVAILABLE when the pool is down) must
+    produce rc=4 + a self-explanatory JSON line, not a bare traceback."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys, types\n"
+        "stub = types.ModuleType('jax')\n"
+        "def boom():\n"
+        "    raise RuntimeError(\"Unable to initialize backend 'axon': "
+        "UNAVAILABLE: TPU backend setup/compile error\")\n"
+        "stub.devices = boom\n"
+        "sys.modules['jax'] = stub\n"
+        "import bench_model\n"
+        "bench_model.acquire_backend(5, grace_s=1)\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=repo, timeout=60,
+    )
+    assert p.returncode == 4
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert "tpu_backend_unavailable" in out["error"]
+    assert "UNAVAILABLE" in out["error"]
+
+
 def test_train_flops_accounting():
     # analytic FLOPs must track the config: doubling layers ~doubles FLOPs
     import bench_model
