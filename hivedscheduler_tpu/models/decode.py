@@ -66,6 +66,43 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
     )
 
 
+def embed_tokens(params: Dict[str, Any], tokens: jax.Array, dtype) -> jax.Array:
+    """Token embedding lookup, int8-quantization-aware (shared by the
+    uniform decode path and the ragged serving path so the quant handling
+    cannot drift between them)."""
+    emb = params["embed"]
+    if is_quantized_leaf(emb):
+        # int8 embedding: gather the rows, then scale per row — the gather
+        # itself moves int8 bytes
+        return emb["qi8"][tokens].astype(dtype) * emb["scale"][tokens].astype(dtype)
+    return emb.astype(dtype)[tokens]
+
+
+def qkv_proj(lp: Dict[str, Any], h: jax.Array, positions, theta: float, dtype):
+    """q/k/v projections + RoPE for one layer (shared decode/serving)."""
+    q = jnp.einsum("bsd,dhk->bshk", h, load_weight(lp["wq"], dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, load_weight(lp["wk"], dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, load_weight(lp["wv"], dtype))
+    return _rope(q, positions, theta), _rope(k, positions, theta), v
+
+
+def dense_mlp(lp: Dict[str, Any], h: jax.Array, dtype) -> jax.Array:
+    """SwiGLU MLP for one layer (shared decode/serving)."""
+    gate = jnp.einsum("bsd,df->bsf", h, load_weight(lp["w_gate"], dtype))
+    up = jnp.einsum("bsd,df->bsf", h, load_weight(lp["w_up"], dtype))
+    return jnp.einsum(
+        "bsf,fd->bsd", jax.nn.silu(gate) * up, load_weight(lp["w_down"], dtype)
+    )
+
+
+def final_logits(params: Dict[str, Any], x: jax.Array, dtype) -> jax.Array:
+    """Final RMSNorm + lm_head in f32 (shared decode/serving)."""
+    x = _rms_norm(x, params["final_norm"])
+    return jnp.einsum(
+        "bsd,dv->bsv", x, load_weight(params["lm_head"], dtype)
+    ).astype(jnp.float32)
+
+
 def _cached_attention(q, ck, cv, pos0, scale):
     """q: [B,S,H,D] at absolute positions pos0..pos0+S-1; ck/cv:
     [B,M,H_kv,D] full cache (entries past the live length are masked by the
@@ -99,13 +136,7 @@ def advance(
     dtype = cfg.dtype
     b, s_len = tokens.shape
     pos0 = cache.length
-    emb = params["embed"]
-    if is_quantized_leaf(emb):
-        # int8 embedding: gather the rows, then scale per row — the gather
-        # itself moves int8 bytes
-        x = emb["qi8"][tokens].astype(dtype) * emb["scale"][tokens].astype(dtype)
-    else:
-        x = emb.astype(dtype)[tokens]  # [B, S, D]
+    x = embed_tokens(params, tokens, dtype)  # [B, S, D]
     positions = (pos0 + lax.iota(jnp.int32, s_len))[None, :]
     scale = 1.0 / math.sqrt(cfg.head_dim)
     if cfg.n_experts > 0:
@@ -118,11 +149,7 @@ def advance(
     def layer(x, scanned):
         lp, ck, cv = scanned
         h = _rms_norm(x, lp["attn_norm"])
-        q = jnp.einsum("bsd,dhk->bshk", h, load_weight(lp["wq"], dtype))
-        k_new = jnp.einsum("bsd,dhk->bshk", h, load_weight(lp["wk"], dtype))
-        v_new = jnp.einsum("bsd,dhk->bshk", h, load_weight(lp["wv"], dtype))
-        q = _rope(q, positions, cfg.rope_theta)
-        k_new = _rope(k_new, positions, cfg.rope_theta)
+        q, k_new, v_new = qkv_proj(lp, h, positions, cfg.rope_theta, dtype)
         ck = lax.dynamic_update_slice_in_dim(ck, k_new.astype(ck.dtype), pos0, 1)
         cv = lax.dynamic_update_slice_in_dim(cv, v_new.astype(cv.dtype), pos0, 1)
         attn = _cached_attention(q, ck, cv, pos0, scale)
@@ -132,12 +159,7 @@ def advance(
             moe_out, _ = _moe_mlp(h, lp, cfg, dtype)
             x = x + moe_out
         else:
-            gate = jnp.einsum("bsd,df->bsf", h, load_weight(lp["w_gate"], dtype))
-            up = jnp.einsum("bsd,df->bsf", h, load_weight(lp["w_up"], dtype))
-            x = x + jnp.einsum(
-                "bsf,fd->bsd", jax.nn.silu(gate) * up,
-                load_weight(lp["w_down"], dtype),
-            )
+            x = x + dense_mlp(lp, h, dtype)
         return x, (ck, cv)
 
     (x, (new_k, new_v)) = lax.scan(
@@ -145,10 +167,7 @@ def advance(
         x,
         (params["layers"], cache.k, cache.v),
     )
-    x = _rms_norm(x, params["final_norm"])
-    logits = jnp.einsum(
-        "bsd,dv->bsv", x, load_weight(params["lm_head"], dtype)
-    ).astype(jnp.float32)
+    logits = final_logits(params, x, dtype)
     new_cache = KVCache(k=new_k, v=new_v, length=pos0 + s_len)
     return logits, new_cache
 
