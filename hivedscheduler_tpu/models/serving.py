@@ -190,7 +190,13 @@ class ServingEngine:
         top_p: float = 1.0,
         eos_id: Optional[int] = None,
         seed: int = 0,
+        mesh=None,
     ):
+        """``mesh``: lay the engine out over a dp x tp serving mesh —
+        params by ``decode.serving_shardings`` (tp shards heads/ff/vocab),
+        cache rows over dp, the compact kv-head axis over tp. The jitted
+        programs then run under GSPMD with XLA-inserted collectives;
+        max_batch must divide the dp axis."""
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -202,7 +208,34 @@ class ServingEngine:
         self._key = jax.random.PRNGKey(seed)
         self.cache = init_ragged_cache(cfg, max_batch, max_len)
         self.slots: List[Optional[Request]] = [None] * max_batch
-        self._last_token = jnp.zeros((max_batch,), jnp.int32)
+        # host-side staging for the per-row feedback tokens: slots emit into
+        # this array and ONE upload per decode step feeds the jitted program
+        # (per-slot device scatters would cost B dispatches per step)
+        self._last_host = [0] * max_batch
+        self._token_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from hivedscheduler_tpu.models.decode import serving_shardings
+            from hivedscheduler_tpu.models.transformer import is_quantized_leaf
+
+            quantized = is_quantized_leaf(params["lm_head"])
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            dp = sizes.get("dp", 1) * sizes.get("fsdp", 1)
+            if max_batch % dp:
+                raise ValueError(
+                    f"max_batch {max_batch} must divide the dp axis {dp}"
+                )
+            self.params = jax.device_put(
+                params, serving_shardings(cfg, mesh, quantized=quantized)
+            )
+            row = ("dp", "fsdp")
+            kv_sh = NamedSharding(mesh, P(None, row, None, "tp", None))
+            self.cache = jax.device_put(self.cache, RaggedCache(
+                k=kv_sh, v=kv_sh, lengths=NamedSharding(mesh, P(row)),
+            ))
+            self._token_sharding = NamedSharding(mesh, P(row))
         self.queue: List[Request] = []
         self._next_rid = 0
         self.steps = 0  # decode steps executed (for occupancy stats)
@@ -284,7 +317,7 @@ class ServingEngine:
 
     def _emit(self, req: Request, slot: int, tok: int) -> None:
         req.tokens_out.append(tok)
-        self._last_token = self._last_token.at[slot].set(tok)
+        self._last_host[slot] = tok
         if len(req.tokens_out) >= req.max_new_tokens or tok == self.eos_id:
             req.done = True
 
@@ -295,9 +328,10 @@ class ServingEngine:
         self._admit()
         active = [s for s in range(self.max_batch) if self.slots[s] is not None]
         if active:
-            logits, self.cache = self._decode(
-                self.params, self.cache, self._last_token
-            )
+            last = jnp.asarray(self._last_host, jnp.int32)
+            if self._token_sharding is not None:
+                last = jax.device_put(last, self._token_sharding)
+            logits, self.cache = self._decode(self.params, self.cache, last)
             self.steps += 1
             self.slot_steps += len(active)
             picked = self._pick_batch(logits)

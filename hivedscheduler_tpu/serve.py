@@ -43,6 +43,15 @@ def main(argv=None) -> int:
     parser.add_argument("--n-heads", type=int, default=8)
     parser.add_argument("--n-kv-heads", type=int, default=0)
     parser.add_argument("--d-ff", type=int, default=1408)
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel serving over a tp mesh axis")
+    parser.add_argument("--dp", type=int, default=1,
+                        help="shard engine slots over a dp mesh axis "
+                        "(--max-batch must divide it)")
+    parser.add_argument("--quantize", choices=["none", "int8"], default="none",
+                        help="weight-only int8 serving (halves weight HBM "
+                        "traffic; the engine's shared helpers dequantize "
+                        "into the consuming einsums)")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
@@ -72,14 +81,31 @@ def main(argv=None) -> int:
             log.error("%s", e)
             return 1
         log.info("restored params from step %s", step)
-    # serving streams weights every step: hold them in the compute dtype
-    params = tm.cast_params(params, cfg.dtype)
+    if args.quantize == "int8":
+        from hivedscheduler_tpu.models import quant
 
-    eng = serving.ServingEngine(
-        params, cfg, max_batch=args.max_batch, max_len=args.max_len,
-        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-        eos_id=None if args.eos_id < 0 else args.eos_id, seed=args.seed,
-    )
+        params = quant.quantize_params(params, cfg)
+        log.info("quantized weights to int8 (per-output-channel scales)")
+    else:
+        # serving streams weights every step: hold them in the compute dtype
+        params = tm.cast_params(params, cfg.dtype)
+
+    mesh = None
+    if args.tp > 1 or args.dp > 1:
+        from hivedscheduler_tpu.parallel import topology
+
+        axes = topology.MeshAxes(dp=args.dp, tp=args.tp)
+        mesh = topology.make_mesh(axes, topology.get_devices(axes.size))
+    try:
+        eng = serving.ServingEngine(
+            params, cfg, max_batch=args.max_batch, max_len=args.max_len,
+            temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+            eos_id=None if args.eos_id < 0 else args.eos_id, seed=args.seed,
+            mesh=mesh,
+        )
+    except ValueError as e:
+        log.error("%s", e)
+        return 1
     key = jax.random.PRNGKey(args.seed + 1)
     pending = []
     for i in range(args.requests):
@@ -93,8 +119,12 @@ def main(argv=None) -> int:
     reqs = []
     t0 = time.perf_counter()
     steps = 0
+    if args.arrival_every == 0:  # all up front
+        while pending:
+            prompt, budget = pending.pop(0)
+            reqs.append(eng.submit(prompt, budget))
     while pending or (reqs and not all(r.done for r in reqs)):
-        if pending and (args.arrival_every == 0 or steps % args.arrival_every == 0):
+        if pending and steps % args.arrival_every == 0:
             prompt, budget = pending.pop(0)
             reqs.append(eng.submit(prompt, budget))
             log.info("admitted request %s (prompt %s, budget %s)",

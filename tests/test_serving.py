@@ -110,6 +110,43 @@ class TestServingEngine:
                 jnp.zeros((1, 1), jnp.int32), moe_cfg,
             )
 
+    def test_quantized_params_serve_exactly(self, setup):
+        """int8 weight-only trees (models/quant.py) flow through the engine
+        unchanged — the shared quant-aware helpers (embed_tokens/load_weight)
+        serve them — and match single-request quantized generate exactly."""
+        from hivedscheduler_tpu.models import quant
+
+        cfg, params = setup
+        qparams = quant.quantize_params(params, cfg)
+        eng = serving.ServingEngine(qparams, cfg, max_batch=2, max_len=64)
+        a = eng.submit([5, 9, 2], 5)
+        b = eng.submit([17, 3, 88], 4)
+        eng.run_until_drained()
+        out = decode.generate(
+            qparams, jnp.asarray([[5, 9, 2]], jnp.int32), cfg, 5, max_len=8)
+        assert a.tokens_out == [int(t) for t in np.asarray(out)[0]]
+        assert b.done and len(b.tokens_out) == 4
+
+    def test_sharded_engine_matches_unsharded(self, setup):
+        """dp x tp engine layout: same greedy tokens as the single-device
+        engine (GSPMD inserts the collectives; content is unchanged)."""
+        from hivedscheduler_tpu.parallel import topology
+
+        cfg, params = setup
+        mesh = topology.make_mesh(
+            topology.MeshAxes(dp=2, tp=2), topology.get_devices(4)
+        )
+        ref = vanilla(params, cfg, [5, 9, 2], 5)
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                    mesh=mesh)
+        a = eng.submit([5, 9, 2], 5)
+        b = eng.submit([17, 3, 88, 41], 4)
+        eng.run_until_drained()
+        assert a.tokens_out == ref
+        assert b.tokens_out == vanilla(params, cfg, [17, 3, 88, 41], 4)
+        with pytest.raises(ValueError, match="max_batch"):
+            serving.ServingEngine(params, cfg, max_batch=3, mesh=mesh)
+
     def test_prefill_bucketing_bounds_compiles(self, setup):
         cfg, params = setup
         eng = serving.ServingEngine(params, cfg, max_batch=1, max_len=64)
