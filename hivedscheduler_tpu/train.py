@@ -142,9 +142,6 @@ def main(argv=None) -> int:
     )
     lora_mode = args.lora_rank > 0
     if lora_mode:
-        if args.pp > 1:
-            log.error("--lora-rank does not compose with --pp yet")
-            return 1
         step_fn, init_fn, token_sharding = make_sharded_lora_train_step(
             cfg, mesh, grad_accum=args.grad_accum
         )

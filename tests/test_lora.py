@@ -192,6 +192,37 @@ class TestLoRA:
         got = jax.jit(lambda p, t: tm.forward(p, t, cfg))(sp, st)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
+    def test_lora_inside_pipeline_matches_nonpipelined(self):
+        """LoRA adapters inside GPipe stages: one adapter update on a
+        dp x pp mesh equals the non-pipelined update exactly (the stage
+        body's manual-mode adapter einsums and the wo-adapter's shared
+        row-parallel psum were already correct; this pins it)."""
+        from hivedscheduler_tpu.parallel import topology
+        from hivedscheduler_tpu.parallel.train import make_sharded_lora_train_step
+
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+        for mlp in (False, True):
+            out = {}
+            for tag, kw, axes in (
+                # pp x tp + lora_mlp pins the manual-mode psum sharing of
+                # the wo/down adapter einsums inside the stage body
+                ("pp", dict(pipeline_microbatches=2),
+                 topology.MeshAxes(pp=2, tp=2)),
+                ("ref", {}, topology.MeshAxes(dp=2)),
+            ):
+                cfg = cfg_of(lora_rank=2, lora_mlp=mlp, **kw)
+                mesh = topology.make_mesh(axes, topology.get_devices(axes.size))
+                step, init_fn, tok_sh = make_sharded_lora_train_step(cfg, mesh)
+                base, lora, opt = init_fn(jax.random.PRNGKey(0))
+                lora2, opt, loss = step(base, lora, opt,
+                                        jax.device_put(tokens, tok_sh))
+                out[tag] = (float(loss), jax.tree.map(np.asarray, lora2))
+            assert abs(out["pp"][0] - out["ref"][0]) < 1e-5, mlp
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5),
+                out["pp"][1], out["ref"][1],
+            )
+
     def test_split_combine_roundtrip(self):
         cfg = cfg_of(lora_rank=2)
         params = tm.init_params(cfg, jax.random.PRNGKey(0))

@@ -147,6 +147,41 @@ class TestServingEngine:
         with pytest.raises(ValueError, match="max_batch"):
             serving.ServingEngine(params, cfg, max_batch=3, mesh=mesh)
 
+    def test_fuzz_random_interleavings(self, setup):
+        """Randomized schedule fuzz (same spirit as the scheduler's
+        invariant harness): random prompts/budgets submitted at random step
+        offsets against a small slot pool — every request's greedy output
+        must still equal its solo run."""
+        import random
+
+        cfg, params = setup
+        rng = random.Random(11)
+        for trial in range(2):
+            eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64)
+            plan = []  # (submit_at_step, prompt, budget)
+            for i in range(5):
+                plan.append((
+                    rng.randrange(0, 12),
+                    [rng.randrange(1, cfg.vocab_size) for _ in
+                     range(rng.randrange(1, 9))],
+                    rng.randrange(1, 7),
+                ))
+            plan.sort(key=lambda t: t[0])
+            live = []
+            step = 0
+            while plan or eng.queue or any(eng.slots) or not live:
+                while plan and plan[0][0] <= step:
+                    _, p, n = plan.pop(0)
+                    live.append((eng.submit(p, n), p, n))
+                if not eng.step() and not plan:
+                    break
+                step += 1
+            eng.run_until_drained()
+            for req, p, n in live:
+                assert req.done, (trial, req.rid)
+                assert req.tokens_out == vanilla(params, cfg, p, n), (
+                    trial, req.rid)
+
     def test_prefill_bucketing_bounds_compiles(self, setup):
         cfg, params = setup
         eng = serving.ServingEngine(params, cfg, max_batch=1, max_len=64)
