@@ -555,6 +555,8 @@ if __name__ == "__main__":
                 "model_train_tokens_per_sec": m["train_tokens_per_sec"],
                 "model_decode_tokens_per_sec": m["decode_tokens_per_sec"],
                 "model_decode_hbm_roofline_frac": m["decode_hbm_roofline_frac"],
+                "model_serve_tokens_per_sec": m.get("serve_tokens_per_sec"),
+                "model_serve_occupancy": m.get("serve_occupancy"),
                 "model_device": m["device"],
                 "model_metric_note": m["metric"],
             }

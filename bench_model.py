@@ -195,16 +195,23 @@ def bench_train(cfg, batch: int, seq: int, iters: int, mesh, grad_accum: int = 1
     return statistics.median(times), float(loss)
 
 
-def bench_decode(cfg, batch: int, prompt_len: int, new_tokens: int, iters: int):
+def serving_params(cfg):
+    """The one shared weight tree for the decode and serving benches: bf16
+    up front (both are HBM-bandwidth-bound; f32 master weights would stream
+    twice the bytes per step)."""
+    import jax
+
+    from hivedscheduler_tpu.models import transformer as tm
+
+    return tm.cast_params(tm.init_params(cfg, jax.random.PRNGKey(0)), cfg.dtype)
+
+
+def bench_decode(cfg, params, batch: int, prompt_len: int, new_tokens: int,
+                 iters: int):
     import jax
     import jax.numpy as jnp
 
     from hivedscheduler_tpu.models import decode as dec
-    from hivedscheduler_tpu.models import transformer as tm
-
-    # serving path: bf16 weights up front (decode is HBM-bandwidth-bound;
-    # f32 master weights would stream twice the bytes per step)
-    params = tm.cast_params(tm.init_params(cfg, jax.random.PRNGKey(0)), cfg.dtype)
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size, jnp.int32
     )
@@ -223,6 +230,47 @@ def bench_decode(cfg, batch: int, prompt_len: int, new_tokens: int, iters: int):
     return statistics.median(times)
 
 
+def bench_serving(cfg, params, n_requests: int, max_batch: int, budget: int):
+    """Continuous-batching engine under a staggered synthetic load:
+    returns (tokens/sec, occupancy over the measured load only). Shares
+    ``params`` with bench_decode so the static-batch number and the churn
+    number describe the same weights."""
+    import jax
+
+    from hivedscheduler_tpu.models import serving
+
+    eng = serving.ServingEngine(params, cfg, max_batch=max_batch,
+                                max_len=128 + budget)
+    rng = jax.random.PRNGKey(2)
+    prompts = []
+    for i in range(n_requests):
+        rng, k1, k2 = jax.random.split(rng, 3)
+        plen = int(jax.random.randint(k1, (), 4, 65))
+        prompts.append([int(t) for t in jax.random.randint(
+            k2, (plen,), 0, cfg.vocab_size)])
+    # warm every prefill bucket (4..64) and the decode step off the clock
+    warms = [eng.submit([1] * n, 2) for n in (4, 5, 9, 17, 33)]
+    eng.run_until_drained()
+    assert all(w.done for w in warms)
+    warm_steps, warm_slot_steps = eng.steps, eng.slot_steps
+    t0 = time.perf_counter()
+    reqs = []
+    step = 0
+    pending = list(prompts)
+    while pending or any(not r.done for r in reqs):
+        if pending and step % 2 == 0:  # staggered arrivals
+            reqs.append(eng.submit(pending.pop(0), budget))
+        eng.step()
+        step += 1
+    dt = time.perf_counter() - t0
+    total = sum(len(r.tokens_out) for r in reqs)
+    # occupancy over the measured load only (the warm-up traffic would
+    # otherwise blend into the paired metric)
+    steps = eng.steps - warm_steps
+    occ = (eng.slot_steps - warm_slot_steps) / (steps * max_batch) if steps else 0.0
+    return total / dt, occ
+
+
 def param_count(cfg) -> int:
     d, dh = cfg.d_model, cfg.head_dim
     attn = d * cfg.n_heads * dh * 2 + d * cfg.kv_heads * dh * 2
@@ -237,6 +285,8 @@ def main(argv=None) -> int:
     parser.add_argument("--smoke", action="store_true",
                         help="tiny shapes regardless of backend (CI)")
     parser.add_argument("--skip-decode", action="store_true")
+    parser.add_argument("--skip-serve", action="store_true",
+                        help="skip the continuous-batching throughput bench")
     parser.add_argument(
         "--acquire-timeout", type=float,
         default=float(os.environ.get("HIVED_TPU_ACQUIRE_TIMEOUT_S", "240")),
@@ -315,13 +365,25 @@ def main(argv=None) -> int:
 
     decode_tps = None
     decode_bw_frac = None
+    serve_tps = None
+    serve_occ = None
+    if not (args.skip_decode and args.skip_serve):
+        params = serving_params(cfg)
     if not args.skip_decode:
-        dec_s = bench_decode(cfg, dec_batch, dec_prompt, dec_new, max(1, iters // 2))
+        dec_s = bench_decode(cfg, params, dec_batch, dec_prompt, dec_new,
+                             max(1, iters // 2))
         decode_tps = dec_batch * dec_new / dec_s
         if peak_bw:
             # roofline: each decode step streams the full bf16 param bytes
             param_bytes = 2.0 * param_count(cfg)
             decode_bw_frac = (dec_new * param_bytes / dec_s) / peak_bw
+    if not args.skip_serve:
+        serve_tps, serve_occ = bench_serving(
+            cfg, params,
+            n_requests=16 if real else 3,
+            max_batch=dec_batch,
+            budget=32 if real else 4,
+        )
 
     result = {
         "metric": "train_step_mfu_1chip" if real else "train_step_mfu_1chip_smoke",
@@ -336,6 +398,8 @@ def main(argv=None) -> int:
         "peak_bf16_tflops_per_sec": round(peak_flops / 1e12, 1) if peak_flops else None,
         "decode_tokens_per_sec": round(decode_tps, 1) if decode_tps else None,
         "decode_hbm_roofline_frac": round(decode_bw_frac, 3) if decode_bw_frac else None,
+        "serve_tokens_per_sec": round(serve_tps, 1) if serve_tps else None,
+        "serve_occupancy": round(serve_occ, 3) if serve_occ else None,
         # null (not vacuously true) when no training ran
         "loss_finite": math.isfinite(loss) if not args.skip_train else None,
         "model": {

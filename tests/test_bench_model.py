@@ -19,9 +19,12 @@ def test_bench_model_smoke(capsys):
     m = json.loads(line)
     assert m["metric"].startswith("train_step_mfu_1chip")
     assert set(m) >= {"value", "unit", "vs_baseline", "train_tokens_per_sec",
-                      "decode_tokens_per_sec", "train_step_ms"}
+                      "decode_tokens_per_sec", "train_step_ms",
+                      "serve_tokens_per_sec", "serve_occupancy"}
     assert m["train_tokens_per_sec"] > 0
     assert m["decode_tokens_per_sec"] > 0
+    assert m["serve_tokens_per_sec"] > 0
+    assert 0.0 < m["serve_occupancy"] <= 1.0
     assert m["loss_finite"]
 
 
