@@ -165,14 +165,15 @@ def train_flops_per_step(cfg, batch: int, seq: int) -> float:
     return 3.0 * fwd
 
 
-def bench_train(cfg, batch: int, seq: int, iters: int, mesh, grad_accum: int = 1):
+def bench_train(cfg, batch: int, seq: int, iters: int, mesh,
+                grad_accum: int = 1, ce_chunk: int = 0):
     import jax
     import jax.numpy as jnp
 
     from hivedscheduler_tpu.parallel.train import make_sharded_train_step
 
     step, init_fn, token_sharding = make_sharded_train_step(
-        cfg, mesh, grad_accum=grad_accum
+        cfg, mesh, grad_accum=grad_accum, ce_chunk=ce_chunk
     )
     params, opt_state = init_fn(jax.random.PRNGKey(0))
     tokens = jax.device_put(
@@ -294,6 +295,10 @@ def main(argv=None) -> int:
     )
     # tuning knobs (defaults = the shipped flagship settings)
     parser.add_argument("--remat", choices=("full", "dots", "none"), default=None)
+    parser.add_argument("--ce-chunk", type=int, default=None,
+                        help="chunked CE size (default: 512 on the real "
+                             "config — the [B,T,vocab] f32 logits never "
+                             "materialize; 0 disables)")
     parser.add_argument("--block-q", type=int, default=None)
     parser.add_argument("--block-k", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None)
@@ -340,13 +345,15 @@ def main(argv=None) -> int:
     axes = topology.MeshAxes()  # all-1 axes: single chip
     mesh = topology.make_mesh(axes, jax.devices()[:1])
 
+    ce_chunk = args.ce_chunk if args.ce_chunk is not None else (512 if real else 0)
     if args.skip_train:
         step_s, loss = None, 0.0
         flops, achieved, mfu, train_tps = 0.0, None, None, None
     else:
         try:
             step_s, loss = bench_train(cfg, batch, seq, iters, mesh,
-                                       grad_accum=args.grad_accum)
+                                       grad_accum=args.grad_accum,
+                                       ce_chunk=ce_chunk)
         except Exception as e:
             # the tuned DEFAULT remat policy trades HBM for FLOPs; if it
             # doesn't fit this chip, fall back to full remat rather than
@@ -357,7 +364,8 @@ def main(argv=None) -> int:
                 raise
             cfg = dataclasses.replace(cfg, remat="full")
             step_s, loss = bench_train(cfg, batch, seq, iters, mesh,
-                                       grad_accum=args.grad_accum)
+                                       grad_accum=args.grad_accum,
+                                       ce_chunk=ce_chunk)
         flops = train_flops_per_step(cfg, batch, seq)
         achieved = flops / step_s
         mfu = achieved / peak_flops if peak_flops else None
@@ -409,6 +417,7 @@ def main(argv=None) -> int:
             "d_ff": cfg.d_ff, "batch": batch, "seq": seq,
             "attn_impl": cfg.attn_impl, "dtype": "bfloat16",
             "remat": cfg.remat, "grad_accum": args.grad_accum,
+            "ce_chunk": ce_chunk,
             "attn_block_q": cfg.attn_block_q, "attn_block_k": cfg.attn_block_k,
         },
         "vs_baseline_note": (

@@ -702,11 +702,16 @@ def forward_with_aux(
     tokens: jax.Array,
     cfg: TransformerConfig,
     mesh=None,
+    return_hidden: bool = False,
 ):
     """tokens [B, T] int32 -> (logits [B, T, vocab] f32, weighted MoE aux
     loss f32 — add it to the task loss directly).
 
-    ``mesh`` is required for ring/ulysses attention and for pipelining."""
+    ``mesh`` is required for ring/ulysses attention and for pipelining.
+    ``return_hidden=True`` returns the final-norm hidden states [B, T, D]
+    (model dtype) instead of logits — the chunked-cross-entropy loss path
+    applies the lm_head itself so the [B, T, vocab] f32 tensor is never
+    materialized."""
     dtype = cfg.dtype
     b, t = tokens.shape
     x = params["embed"].astype(dtype)[tokens]  # [B, T, D]
@@ -833,6 +838,8 @@ def forward_with_aux(
             _remat_wrap(scan_body, cfg), (x, aux_total), params["layers"]
         )
     x = _rms_norm(x, params["final_norm"])
+    if return_hidden:
+        return x, aux_total
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
     return logits.astype(jnp.float32), aux_total
 

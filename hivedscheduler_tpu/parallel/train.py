@@ -25,16 +25,58 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01) -> optax.Gradie
     return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
 
 
-def loss_fn(params, tokens, cfg: tm.TransformerConfig, mesh=None) -> jax.Array:
+def loss_fn(params, tokens, cfg: tm.TransformerConfig, mesh=None,
+            ce_chunk: int = 0) -> jax.Array:
     """Next-token LM loss (+ Switch load-balancing aux for MoE models):
     predict tokens[:, 1:] from tokens[:, :-1] with a full-length forward
-    (keeps sequence sharding uniform)."""
-    logits, moe_aux = tm.forward_with_aux(params, tokens, cfg, mesh=mesh)
+    (keeps sequence sharding uniform).
+
+    ``ce_chunk > 0`` computes the lm_head matmul + cross-entropy in
+    sequence chunks of that size under a ``lax.scan`` with per-chunk
+    rematerialization, so the [B, T, vocab] f32 logits tensor (2.1 GB for
+    the flagship bench config) never exists in HBM — mathematically
+    identical (per-position CE sums linearly; guard:
+    test_chunked_ce_matches_full). Best with sp == 1: chunking slices the
+    sequence axis, which costs gathers when it is sharded."""
     targets = jnp.roll(tokens, -1, axis=1)
-    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-    # the rolled-in last position is not a real target
-    mask = jnp.ones_like(per_tok).at[:, -1].set(0.0)
-    loss = jnp.sum(per_tok * mask) / jnp.sum(mask)
+    if ce_chunk <= 0:
+        logits, moe_aux = tm.forward_with_aux(params, tokens, cfg, mesh=mesh)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        # the rolled-in last position is not a real target
+        mask = jnp.ones_like(per_tok).at[:, -1].set(0.0)
+        loss = jnp.sum(per_tok * mask) / jnp.sum(mask)
+    else:
+        b, t = tokens.shape
+        if t % ce_chunk:
+            raise ValueError(
+                f"seq len {t} not divisible by ce_chunk {ce_chunk}"
+            )
+        hidden, moe_aux = tm.forward_with_aux(
+            params, tokens, cfg, mesh=mesh, return_hidden=True
+        )
+        n = t // ce_chunk
+        mask = jnp.ones((b, t), jnp.float32).at[:, -1].set(0.0)
+        # scan over [n, B, C, ...] chunks; checkpoint the body so backward
+        # recomputes each chunk's logits instead of saving them all
+        chunks = (
+            hidden.reshape(b, n, ce_chunk, -1).swapaxes(0, 1),
+            targets.reshape(b, n, ce_chunk).swapaxes(0, 1),
+            mask.reshape(b, n, ce_chunk).swapaxes(0, 1),
+        )
+        head = params["lm_head"]
+
+        def chunk_ce(total, xs):
+            h_c, t_c, m_c = xs
+            logits_c = jnp.einsum(
+                "bcd,dv->bcv", h_c, tm.load_weight(head, cfg.dtype)
+            ).astype(jnp.float32)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits_c, t_c)
+            return total + jnp.sum(ce * m_c), None
+
+        total, _ = jax.lax.scan(
+            jax.checkpoint(chunk_ce), jnp.zeros(()), chunks
+        )
+        loss = total / jnp.sum(mask)
     if cfg.n_experts > 0:
         # moe_aux arrives pre-weighted per layer (load-balance + router
         # z-loss, each with its own configured weight)
@@ -75,11 +117,12 @@ def _accumulated_value_and_grad(grad_fn, diff_params, tokens, grad_accum: int):
 
 
 def train_step(params, opt_state, tokens, cfg: tm.TransformerConfig, optimizer,
-               mesh=None, grad_accum: int = 1):
+               mesh=None, grad_accum: int = 1, ce_chunk: int = 0):
     """One optimizer update; see ``_accumulated_value_and_grad`` for the
-    ``grad_accum > 1`` semantics."""
+    ``grad_accum > 1`` semantics and ``loss_fn`` for ``ce_chunk``."""
     loss, grads = _accumulated_value_and_grad(
-        jax.value_and_grad(lambda p, t: loss_fn(p, t, cfg, mesh)),
+        jax.value_and_grad(lambda p, t: loss_fn(p, t, cfg, mesh,
+                                                ce_chunk=ce_chunk)),
         params, tokens, grad_accum,
     )
     updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -92,6 +135,7 @@ def make_sharded_train_step(
     mesh,
     optimizer: Optional[optax.GradientTransformation] = None,
     grad_accum: int = 1,
+    ce_chunk: int = 0,
 ):
     """Returns (jitted_step, init_fn, token_sharding).
 
@@ -148,7 +192,7 @@ def make_sharded_train_step(
 
     def step(params, opt_state, tokens):
         return train_step(params, opt_state, tokens, cfg, optimizer, mesh,
-                          grad_accum=grad_accum)
+                          grad_accum=grad_accum, ce_chunk=ce_chunk)
 
     jitted = jax.jit(step, donate_argnums=(0, 1))
     return jitted, init_fn, token_sharding
@@ -159,6 +203,7 @@ def make_sharded_lora_train_step(
     mesh,
     optimizer: Optional[optax.GradientTransformation] = None,
     grad_accum: int = 1,
+    ce_chunk: int = 0,
 ):
     """LoRA fine-tuning: the base weights are genuinely frozen — gradients
     are taken w.r.t. the adapter subtree only (no base grads computed, no
@@ -189,7 +234,8 @@ def make_sharded_lora_train_step(
         return base, lora, opt_state
 
     def lora_loss(lora, base, tokens):
-        return loss_fn(tm.combine_lora_params(base, lora), tokens, cfg, mesh)
+        return loss_fn(tm.combine_lora_params(base, lora), tokens, cfg, mesh,
+                       ce_chunk=ce_chunk)
 
     def step(base, lora, opt_state, tokens):
         loss, grads = _accumulated_value_and_grad(

@@ -63,6 +63,12 @@ def main(argv=None) -> int:
                         help="layer-scan remat policy: full recompute (HBM "
                              "O(1) layers), dots (save matmul outputs — the "
                              "MFU-tuned default of bench_model.py), none")
+    parser.add_argument("--ce-chunk", type=int, default=0,
+                        help="chunked cross-entropy: compute lm_head+CE in "
+                             "sequence chunks of this size so the "
+                             "[B,T,vocab] f32 logits never materialize "
+                             "(0 = off; must divide --seq-len; best with "
+                             "--sp 1)")
     parser.add_argument("--block-q", type=int, default=128,
                         help="flash-attention q tile (attn=flash)")
     parser.add_argument("--block-k", type=int, default=128,
@@ -143,13 +149,13 @@ def main(argv=None) -> int:
     lora_mode = args.lora_rank > 0
     if lora_mode:
         step_fn, init_fn, token_sharding = make_sharded_lora_train_step(
-            cfg, mesh, grad_accum=args.grad_accum
+            cfg, mesh, grad_accum=args.grad_accum, ce_chunk=args.ce_chunk
         )
         base_params, lora_params, opt_state = init_fn(jax.random.PRNGKey(0))
         params = tm.combine_lora_params(base_params, lora_params)
     else:
         step_fn, init_fn, token_sharding = make_sharded_train_step(
-            cfg, mesh, grad_accum=args.grad_accum
+            cfg, mesh, grad_accum=args.grad_accum, ce_chunk=args.ce_chunk
         )
         params, opt_state = init_fn(jax.random.PRNGKey(0))
 

@@ -487,6 +487,35 @@ class TestTrainStep:
         with pytest.raises(ValueError, match="remat"):
             loss_fn(params, tokens, dataclasses.replace(cfg0, remat="bogus"))
 
+    def test_chunked_ce_matches_full(self):
+        """ce_chunk computes the same loss AND gradients as the full
+        [B,T,V] logits path (per-position CE sums linearly; f32 model)."""
+        import functools
+
+        from hivedscheduler_tpu.models import transformer as tm
+        from hivedscheduler_tpu.parallel.train import loss_fn
+
+        cfg = tm.TransformerConfig(
+            vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+            max_seq_len=64, dtype=jnp.float32,
+        )
+        params = tm.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 128)
+        out = {}
+        for chunk in (0, 8, 32):
+            loss, grads = jax.value_and_grad(
+                functools.partial(loss_fn, cfg=cfg, ce_chunk=chunk)
+            )(params, tokens)
+            out[chunk] = (float(loss), jax.tree.map(np.asarray, grads))
+        for chunk in (8, 32):
+            assert abs(out[0][0] - out[chunk][0]) < 1e-5, chunk
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+                out[0][1], out[chunk][1],
+            )
+        with pytest.raises(ValueError, match="divisible"):
+            loss_fn(params, tokens, cfg, ce_chunk=7)
+
     def test_grad_accum_matches_full_batch(self):
         """One update with grad_accum=4 must equal the full-batch update
         (the LM loss is a mean over equal-size slices, so averaged gradients
