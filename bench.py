@@ -455,6 +455,111 @@ def run_trace(n_jobs: int = 300, seed: int = 11):
     }
 
 
+def parse_model_bench_output(returncode: int, stdout: str, stderr: str):
+    """Pure parse of a bench_model.py child run -> (artifact_fields,
+    stamped_result_or_None). The round-3 driver failure (bare "rc=1", all
+    diagnostics discarded) lived exactly here, so this is a plain function
+    with its own tests:
+
+    - the last JSON *dict* line of stdout is the result (stray scalar JSON
+      lines are skipped);
+    - any nonzero rc or an ``error`` field degrades to a
+      ``model_bench_error`` note carrying the child's own message plus a
+      stderr tail — never the headline;
+    - a ``*_smoke`` metric (the child saw no TPU) contributes nothing and
+      must never overwrite the durable BENCH_MODEL.json — the second
+      return value is non-None only for a real TPU result."""
+    last_json = None
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict):
+            last_json = parsed
+            break
+    if returncode != 0 or last_json is None or last_json.get("error"):
+        note = {"model_bench_error": f"rc={returncode}"}
+        if last_json is not None and last_json.get("error"):
+            note["model_bench_error"] = last_json["error"]
+        tail = stderr.strip()[-600:]
+        if tail:
+            note["model_bench_stderr_tail"] = tail
+        return note, None
+    m = last_json
+    if m.get("metric", "").endswith("_smoke"):
+        return {}, None
+    required = ("value", "train_tokens_per_sec", "decode_tokens_per_sec",
+                "decode_hbm_roofline_frac", "device", "metric")
+    missing = [k for k in required if k not in m]
+    if missing:
+        # a well-formed dict that isn't a result line still degrades to a
+        # note carrying the child's actual output, never an exception
+        return {
+            "model_bench_error": (
+                f"child result missing keys {missing}: {json.dumps(m)[:400]}"
+            ),
+        }, None
+    fields = {
+        "model_train_mfu_pct": m["value"],
+        "model_train_tokens_per_sec": m["train_tokens_per_sec"],
+        "model_decode_tokens_per_sec": m["decode_tokens_per_sec"],
+        "model_decode_hbm_roofline_frac": m["decode_hbm_roofline_frac"],
+        "model_serve_tokens_per_sec": m.get("serve_tokens_per_sec"),
+        "model_serve_occupancy": m.get("serve_occupancy"),
+        "model_device": m["device"],
+        "model_metric_note": m["metric"],
+    }
+    stamped = dict(m)
+    stamped["captured_at_utc"] = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+    )
+    stamped["captured_by"] = "bench.py driver path"
+    return fields, stamped
+
+
+def model_bench_fields():
+    """Fold the workload benchmark (bench_model.py) into the driver's
+    one-line artifact when a real TPU is attached: the scheduler p50 stays
+    the headline metric, the train-MFU / decode / serving numbers ride
+    along as extra fields; see ``parse_model_bench_output`` for the
+    degradation contract.
+
+    Deliberately NO subprocess timeout: killing the child mid-TPU-op wedges
+    the single-grant axon tunnel for every later process. The child bounds
+    its own TPU acquisition instead (bench_model.acquire_backend,
+    HIVED_TPU_ACQUIRE_TIMEOUT_S; rc=3 tunnel-busy, rc=4 backend-down, each
+    with a diagnostic JSON line)."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "bench_model.py", "--iters", "5"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        fields, stamped = parse_model_bench_output(
+            proc.returncode, proc.stdout, proc.stderr
+        )
+        if stamped is not None:
+            # refresh the durable artifact so a stale builder-local number
+            # can never stand in for a driver-captured one
+            try:
+                path = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_MODEL.json",
+                )
+                with open(path, "w") as f:
+                    f.write(json.dumps(stamped) + "\n")
+            except OSError:
+                pass  # read-only checkout: the inline fields still land
+        return fields
+    except Exception as e:  # pragma: no cover - defensive
+        return {"model_bench_error": f"{type(e).__name__}: {e}"}
+
+
 if __name__ == "__main__":
     import os
     import sys
@@ -489,80 +594,6 @@ if __name__ == "__main__":
             "max_ms": round(mx, 3),
         }))
         sys.exit(0)
-    def model_bench_fields():
-        """Fold the workload benchmark (bench_model.py) into the driver's
-        one-line artifact when a real TPU is attached: the scheduler p50
-        stays the headline metric, the train-MFU / decode numbers ride
-        along as extra fields. Any failure degrades to an error note that
-        names the actual cause (child stderr tail + its own JSON error line)
-        — never the headline.
-
-        Deliberately NO subprocess timeout: killing the child mid-TPU-op
-        wedges the single-grant axon tunnel for every later process. The
-        child bounds its own TPU acquisition instead
-        (bench_model.acquire_backend, HIVED_TPU_ACQUIRE_TIMEOUT_S, exits
-        rc=3 with a diagnostic JSON line while it still holds no grant)."""
-        import subprocess
-
-        try:
-            proc = subprocess.run(
-                [sys.executable, "bench_model.py", "--iters", "5"],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
-            last_json = None
-            for line in reversed(proc.stdout.strip().splitlines()):
-                try:
-                    parsed = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(parsed, dict):
-                    last_json = parsed
-                    break
-            if proc.returncode != 0 or last_json is None or last_json.get("error"):
-                note = {"model_bench_error": f"rc={proc.returncode}"}
-                if last_json is not None and last_json.get("error"):
-                    note["model_bench_error"] = last_json["error"]
-                tail = proc.stderr.strip()[-600:]
-                if tail:
-                    note["model_bench_stderr_tail"] = tail
-                return note
-            m = last_json
-            if m.get("metric", "").endswith("_smoke"):
-                # the child fell back to CPU: no TPU numbers — and never
-                # overwrite the durable artifact with a smoke run
-                return {}
-            # refresh the durable artifact so a stale builder-local number
-            # can never stand in for a driver-captured one
-            stamped = dict(m)
-            stamped["captured_at_utc"] = time.strftime(
-                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
-            )
-            stamped["captured_by"] = "bench.py driver path"
-            try:
-                with open(
-                    os.path.join(
-                        os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_MODEL.json",
-                    ),
-                    "w",
-                ) as f:
-                    f.write(json.dumps(stamped) + "\n")
-            except OSError:
-                pass  # read-only checkout: the inline fields still land
-            return {
-                "model_train_mfu_pct": m["value"],
-                "model_train_tokens_per_sec": m["train_tokens_per_sec"],
-                "model_decode_tokens_per_sec": m["decode_tokens_per_sec"],
-                "model_decode_hbm_roofline_frac": m["decode_hbm_roofline_frac"],
-                "model_serve_tokens_per_sec": m.get("serve_tokens_per_sec"),
-                "model_serve_occupancy": m.get("serve_occupancy"),
-                "model_device": m["device"],
-                "model_metric_note": m["metric"],
-            }
-        except Exception as e:  # pragma: no cover - defensive
-            return {"model_bench_error": f"{type(e).__name__}: {e}"}
-
     # Probe for a TPU via env only: importing jax here would acquire the
     # single-grant TPU in THIS process and starve the bench_model child of
     # it (the axon tunnel grants one client at a time). The driver/axon env

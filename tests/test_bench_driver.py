@@ -1,0 +1,74 @@
+"""Driver-artifact plumbing in bench.py (pure parts).
+
+The round-3 driver lost its workload number to an undiagnosable bare
+"rc=1" in exactly this code path, so the parse is a plain function with
+its degradation contract pinned here."""
+
+import json
+
+import bench
+
+
+def _result_line(**over):
+    m = {
+        "metric": "train_step_mfu_1chip", "value": 45.2, "unit": "%",
+        "vs_baseline": 1.13, "device": "TPU v5 lite",
+        "train_tokens_per_sec": 31000.0, "decode_tokens_per_sec": 11000.0,
+        "decode_hbm_roofline_frac": 0.81, "serve_tokens_per_sec": 9000.0,
+        "serve_occupancy": 0.9,
+    }
+    m.update(over)
+    return json.dumps(m)
+
+
+class TestParseModelBenchOutput:
+    def test_success_extracts_fields_and_stamps(self):
+        fields, stamped = bench.parse_model_bench_output(
+            0, _result_line() + "\n", "")
+        assert fields["model_train_mfu_pct"] == 45.2
+        assert fields["model_decode_hbm_roofline_frac"] == 0.81
+        assert fields["model_serve_tokens_per_sec"] == 9000.0
+        assert stamped["captured_by"] == "bench.py driver path"
+        assert stamped["captured_at_utc"].endswith("Z")
+
+    def test_stray_scalar_json_lines_are_skipped(self):
+        out = _result_line() + "\nNaN\nnull\n3\n"
+        fields, stamped = bench.parse_model_bench_output(0, out, "")
+        assert fields["model_train_mfu_pct"] == 45.2
+        assert stamped is not None
+
+    def test_smoke_result_contributes_nothing_and_never_stamps(self):
+        out = _result_line(metric="train_step_mfu_1chip_smoke")
+        fields, stamped = bench.parse_model_bench_output(0, out, "")
+        assert fields == {}
+        assert stamped is None  # must never overwrite BENCH_MODEL.json
+
+    def test_nonzero_rc_carries_child_error_and_stderr_tail(self):
+        err = json.dumps({"metric": "train_step_mfu_1chip", "value": None,
+                          "error": "tpu_acquire_timeout: tunnel busy"})
+        fields, stamped = bench.parse_model_bench_output(
+            3, err, "WARNING: Platform 'axon' is experimental\n")
+        assert stamped is None
+        assert "tpu_acquire_timeout" in fields["model_bench_error"]
+        assert "experimental" in fields["model_bench_stderr_tail"]
+
+    def test_bare_crash_still_reports_rc_and_stderr(self):
+        fields, stamped = bench.parse_model_bench_output(
+            1, "", "Traceback ...\nRuntimeError: boom\n")
+        assert stamped is None
+        assert fields["model_bench_error"] == "rc=1"
+        assert "boom" in fields["model_bench_stderr_tail"]
+
+    def test_non_result_dict_degrades_to_note_with_payload(self):
+        out = json.dumps({"metric": "train_step_mfu_1chip", "note": "odd"})
+        fields, stamped = bench.parse_model_bench_output(0, out, "")
+        assert stamped is None
+        assert "missing keys" in fields["model_bench_error"]
+        assert "odd" in fields["model_bench_error"]  # child payload kept
+
+    def test_error_field_wins_even_with_rc_zero(self):
+        out = _result_line() + "\n" + json.dumps(
+            {"error": "tpu_backend_unavailable: UNAVAILABLE"})
+        fields, stamped = bench.parse_model_bench_output(0, out, "")
+        assert stamped is None
+        assert "tpu_backend_unavailable" in fields["model_bench_error"]
