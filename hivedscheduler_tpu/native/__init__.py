@@ -1,9 +1,11 @@
-"""ctypes loader for the native placement search.
+"""ctypes loaders for the native runtime components.
 
-Compiles ``placement.cpp`` with g++ on first use (cached as ``_placement.so``
-next to the source) and exposes :func:`find_leaf_cells`. Import failure or a
-missing toolchain degrades silently to the pure-Python path — set
-``HIVED_NATIVE=0`` to force Python, ``HIVED_NATIVE=1`` to require native.
+Each .cpp next to this file compiles with g++ on first use (cached as a .so
+beside the source): ``placement.cpp`` (best-affinity placement search,
+:func:`find_leaf_cells`) and ``dataloader.cpp`` (token-window gather for the
+data loader, :func:`gather_windows`). Import failure or a missing toolchain
+degrades silently to the pure-Python paths — set ``HIVED_NATIVE=0`` to force
+Python, ``HIVED_NATIVE=1`` to require native.
 """
 
 from __future__ import annotations
@@ -24,6 +26,16 @@ _lib = None
 _tried = False
 
 
+def _build_and_load(src: str, so: str) -> ctypes.CDLL:
+    if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", so, src],
+            check=True,
+            capture_output=True,
+        )
+    return ctypes.CDLL(so)
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     if _tried:
@@ -32,13 +44,7 @@ def _load() -> Optional[ctypes.CDLL]:
     if os.environ.get("HIVED_NATIVE", "") == "0":
         return None
     try:
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
-                check=True,
-                capture_output=True,
-            )
-        lib = ctypes.CDLL(_SO)
+        lib = _build_and_load(_SRC, _SO)
         lib.hived_find_leaf_cells.restype = ctypes.c_int32
         lib.hived_find_leaf_cells.argtypes = [
             ctypes.POINTER(ctypes.c_int32),
@@ -55,6 +61,77 @@ def _load() -> Optional[ctypes.CDLL]:
         log.info("native placement unavailable, using Python path: %s", e)
         _lib = None
     return _lib
+
+
+_DL_SRC = os.path.join(_HERE, "dataloader.cpp")
+_DL_SO = os.path.join(_HERE, "_dataloader.so")
+
+_dl_lib = None
+_dl_tried = False
+
+
+def _load_dataloader() -> Optional[ctypes.CDLL]:
+    global _dl_lib, _dl_tried
+    if _dl_tried:
+        return _dl_lib
+    _dl_tried = True
+    if os.environ.get("HIVED_NATIVE", "") == "0":
+        return None
+    try:
+        lib = _build_and_load(_DL_SRC, _DL_SO)
+        lib.hived_gather_windows.restype = ctypes.c_int
+        lib.hived_gather_windows.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_longlong,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int,
+        ]
+        _dl_lib = lib
+    except Exception as e:  # toolchain missing / compile error
+        if os.environ.get("HIVED_NATIVE") == "1":
+            raise
+        log.info("native dataloader unavailable, using numpy path: %s", e)
+        _dl_lib = None
+    return _dl_lib
+
+
+def dataloader_available() -> bool:
+    return _load_dataloader() is not None
+
+
+def gather_windows(tokens, starts, seq_len: int, n_threads: int = 4):
+    """Native [rows, seq_len] int32 gather from a uint16/uint32 token array
+    (numpy or memmap), bit-identical to ``tokens[(starts[:,None]+arange(seq))
+    % n]``. The ctypes call releases the GIL, so a prefetch thread overlaps
+    the copy with compute. Returns None when the native lib is unavailable
+    or the dtype unsupported (callers fall back to numpy)."""
+    import numpy as np
+
+    lib = _load_dataloader()
+    if (lib is None or tokens.dtype.kind != "u"
+            or tokens.dtype.itemsize not in (2, 4)
+            or not tokens.dtype.isnative
+            or not tokens.flags["C_CONTIGUOUS"]):
+        # big-endian (user-supplied --data-dtype '>u2') or strided views
+        # would be read wrong through the raw pointer: numpy handles them
+        return None
+    starts64 = np.ascontiguousarray(starts, dtype=np.int64)
+    out = np.empty((len(starts64), seq_len), dtype=np.int32)
+    rc = lib.hived_gather_windows(
+        tokens.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_longlong(len(tokens)),
+        ctypes.c_int(tokens.dtype.itemsize),
+        starts64.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        ctypes.c_int(len(starts64)),
+        ctypes.c_int(seq_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int(n_threads),
+    )
+    return out if rc == 0 else None
 
 
 def available() -> bool:

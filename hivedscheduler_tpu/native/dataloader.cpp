@@ -1,0 +1,84 @@
+// Native token-window gather for the host-parallel data loader.
+//
+// The Python loader (parallel/data.py TokenFileDataset.sample) draws random
+// window starts and gathers [rows, seq_len] int32 batches from a memory-
+// mapped uint16/uint32 token stream. The gather is the bandwidth-heavy part
+// (page faults + widening copy on the training thread); this implementation
+// moves it native: per-row wraparound handled as at most two contiguous
+// widening copies (elementwise modulo only in the degenerate seq_len >
+// n_tokens case), rows split across threads, and — because it is entered
+// via a ctypes call — the GIL is released for the duration, so the Python
+// prefetch thread (parallel/data.prefetch) genuinely overlaps batch N+1
+// assembly with step N.
+//
+// Semantics are bit-identical to the numpy path:
+//   idx = (start + arange(seq_len)) % n ; out = int32(tokens[idx])
+// (guard: tests/test_data.py::test_native_gather_matches_numpy).
+
+#include <algorithm>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+template <typename T>
+void copy_row(const T* src, long long n, long long start, int seq_len,
+              int32_t* dst) {
+    long long s = start % n;
+    if (s < 0) s += n;
+    if (seq_len <= n) {
+        long long first = std::min<long long>(seq_len, n - s);
+        for (long long i = 0; i < first; ++i) dst[i] = (int32_t)src[s + i];
+        for (long long i = first; i < seq_len; ++i)
+            dst[i] = (int32_t)src[i - first];
+    } else {  // degenerate: window longer than the corpus
+        for (int i = 0; i < seq_len; ++i) dst[i] = (int32_t)src[(s + i) % n];
+    }
+}
+
+template <typename T>
+void gather_rows(const T* src, long long n, const long long* starts, int row0,
+                 int row1, int seq_len, int32_t* out) {
+    for (int r = row0; r < row1; ++r)
+        copy_row(src, n, starts[r], seq_len, out + (long long)r * seq_len);
+}
+
+}  // namespace
+
+extern "C" {
+
+// tokens: uint16 (in_dtype_bytes==2) or uint32 (==4) stream of n_tokens;
+// starts: n_rows window starts; out: [n_rows, seq_len] int32 row-major.
+// Returns 0 on success, -1 on bad dtype.
+int hived_gather_windows(const void* tokens, long long n_tokens,
+                         int in_dtype_bytes, const long long* starts,
+                         int n_rows, int seq_len, int32_t* out,
+                         int n_threads) {
+    if (in_dtype_bytes != 2 && in_dtype_bytes != 4) return -1;
+    if (n_tokens <= 0 || n_rows <= 0 || seq_len <= 0) return n_rows ? -1 : 0;
+    n_threads = std::max(1, std::min(n_threads, n_rows));
+    auto run = [&](int row0, int row1) {
+        if (in_dtype_bytes == 2)
+            gather_rows((const uint16_t*)tokens, n_tokens, starts, row0, row1,
+                        seq_len, out);
+        else
+            gather_rows((const uint32_t*)tokens, n_tokens, starts, row0, row1,
+                        seq_len, out);
+    };
+    if (n_threads == 1) {
+        run(0, n_rows);
+        return 0;
+    }
+    std::vector<std::thread> workers;
+    int per = (n_rows + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; ++t) {
+        int row0 = t * per, row1 = std::min(n_rows, row0 + per);
+        if (row0 >= row1) break;
+        workers.emplace_back(run, row0, row1);
+    }
+    for (auto& w : workers) w.join();
+    return 0;
+}
+
+}  // extern "C"

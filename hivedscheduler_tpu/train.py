@@ -75,6 +75,10 @@ def main(argv=None) -> int:
                         help="flash-attention k tile (attn=flash)")
     parser.add_argument("--attn", default=None,
                         help="xla|flash|ring|ring_zigzag|ulysses (default: ring when sp>1)")
+    parser.add_argument("--prefetch", type=int, default=2,
+                        help="data-loader prefetch depth (batches assembled "
+                             "in a background thread — the native gather "
+                             "releases the GIL; 0 disables)")
     parser.add_argument("--data", default="",
                         help="packed token file; synthetic corpus when omitted")
     parser.add_argument("--data-dtype", default="uint16",
@@ -193,10 +197,14 @@ def main(argv=None) -> int:
             )
     else:
         dataset = data_lib.synthetic_dataset(cfg.vocab_size)
-    batches = data_lib.host_batches(
-        dataset, args.batch, args.seq_len,
-        process_index=jax.process_index(), process_count=jax.process_count(),
-        start_step=start_step,
+    batches = data_lib.prefetch(
+        data_lib.host_batches(
+            dataset, args.batch, args.seq_len,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            start_step=start_step,
+        ),
+        depth=args.prefetch,
     )
     t0 = time.perf_counter()
     tokens_per_step = args.batch * args.seq_len

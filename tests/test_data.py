@@ -49,3 +49,85 @@ def test_determinism_across_restarts():
     b = [x for _, x in zip(range(3), data_lib.host_batches(ds, 4, 8, seed=3))]
     for x, y in zip(a, b):
         np.testing.assert_array_equal(x, y)
+
+
+def test_native_gather_matches_numpy():
+    """The C++ gather (native/dataloader.cpp) must be bit-identical to the
+    numpy expression for uint16 AND uint32, including wraparound starts and
+    the degenerate seq_len > corpus case."""
+    from hivedscheduler_tpu import native
+
+    if not native.dataloader_available():
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(0)
+    for dtype, vocab in ((np.uint16, 60000), (np.uint32, 200000)):
+        tokens = rng.integers(0, vocab, size=997).astype(dtype)  # odd length
+        for seq in (1, 16, 250, 1200):  # 1200 > 997: multi-wrap fallback
+            starts = np.concatenate([
+                rng.integers(0, 997, size=13),
+                [0, 996, 995],  # boundary starts
+            ])
+            got = native.gather_windows(tokens, starts, seq)
+            assert got is not None and got.dtype == np.int32
+            idx = (starts[:, None] + np.arange(seq)[None, :]) % 997
+            np.testing.assert_array_equal(got, tokens[idx].astype(np.int32))
+    # unsupported dtype degrades to None (callers fall back to numpy)
+    assert native.gather_windows(
+        rng.standard_normal(8).astype(np.float32), np.array([0]), 4) is None
+
+
+def test_sample_uses_native_and_matches_forced_numpy(tmp_path, monkeypatch):
+    """TokenFileDataset.sample must produce identical batches through the
+    native path and the HIVED_NATIVE=0 numpy path (same RNG plan)."""
+    import subprocess
+    import sys
+
+    from hivedscheduler_tpu import native
+
+    if not native.dataloader_available():
+        pytest.skip("native toolchain unavailable")  # else numpy-vs-numpy
+
+    tokens = (np.arange(5000, dtype=np.uint16) * 7) % 331
+    path = tmp_path / "tok.bin"
+    tokens.tofile(path)
+    ds = data_lib.TokenFileDataset(str(path))
+    got = ds.sample(np.random.default_rng(5), 6, 64)
+    # force-numpy in a subprocess (the native lib loads once per process)
+    code = (
+        "import numpy as np, sys\n"
+        "from hivedscheduler_tpu.parallel import data as data_lib\n"
+        f"ds = data_lib.TokenFileDataset({str(path)!r})\n"
+        "b = ds.sample(np.random.default_rng(5), 6, 64)\n"
+        "np.save(sys.argv[1], b)\n"
+    )
+    out_npy = tmp_path / "numpy_batch.npy"
+    env = {"HIVED_NATIVE": "0", "PATH": "/usr/bin:/bin",
+           "PYTHONPATH": ":".join(sys.path)}
+    subprocess.run([sys.executable, "-c", code, str(out_npy)], check=True,
+                   env=env)
+    np.testing.assert_array_equal(got, np.load(out_npy))
+
+
+def test_prefetch_preserves_order_and_values():
+    ds = data_lib.synthetic_dataset(100, size=4096, seed=1)
+    plain = [x for _, x in zip(range(5), data_lib.host_batches(ds, 4, 8, seed=3))]
+    pre = [x for _, x in zip(
+        range(5), data_lib.prefetch(data_lib.host_batches(ds, 4, 8, seed=3)))]
+    for x, y in zip(plain, pre):
+        np.testing.assert_array_equal(x, y)
+    # depth 0 = passthrough
+    off = [x for _, x in zip(
+        range(2), data_lib.prefetch(data_lib.host_batches(ds, 4, 8, seed=3),
+                                    depth=0))]
+    np.testing.assert_array_equal(off[0], plain[0])
+
+
+def test_prefetch_reraises_producer_errors():
+    def boom():
+        yield np.zeros((1, 1), np.int32)
+        raise RuntimeError("producer exploded")
+
+    it = data_lib.prefetch(boom(), depth=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="producer exploded"):
+        next(it)
