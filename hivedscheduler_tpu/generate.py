@@ -151,27 +151,18 @@ def main(argv=None) -> int:
         if args.gamma < 1:
             log.error("--gamma must be >= 1, got %s", args.gamma)
             return 1
-        import dataclasses
-
         from hivedscheduler_tpu.models.speculative import (
+            derive_draft_config,
             generate_speculative,
             make_sharded_speculative,
         )
 
-        # derived default width: ~half the target, rounded up so head_dim
-        # stays an even integer (RoPE rotates sin/cos pairs)
-        quantum = 2 * args.n_heads
-        d_model = args.draft_d_model or max(64, args.d_model // 2)
-        if not args.draft_d_model:
-            d_model = -(-d_model // quantum) * quantum
-        if d_model % quantum:
-            log.error("--draft-d-model %s must be a multiple of 2*--n-heads "
-                      "(%s): RoPE needs an even head_dim", d_model, quantum)
+        try:
+            dft_cfg = derive_draft_config(cfg, args.draft_layers,
+                                          args.draft_d_model)
+        except ValueError as e:
+            log.error("%s", e)
             return 1
-        dft_cfg = dataclasses.replace(
-            cfg, n_layers=args.draft_layers, d_model=d_model,
-            d_ff=2 * d_model, n_experts=0, n_kv_heads=0,
-        )
         dft_params = tm.cast_params(
             tm.init_params(dft_cfg, jax.random.PRNGKey(args.seed + 3)),
             dft_cfg.dtype,

@@ -36,6 +36,7 @@ from typing import Any, Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from hivedscheduler_tpu.models.decode import (
@@ -125,10 +126,16 @@ def advance_ragged(
         h = _rms_norm(x, lp["attn_norm"])
         q, k_new, v_new = qkv_proj(lp, h, positions, cfg.rope_theta, dtype)
         if row is None:
-            # decode: scatter each row's single token at its own length
+            # decode: scatter each row's S tokens at its own length offset
+            # (S=1 plain decode; S=gamma+1 speculative verify)
             rows = lax.iota(jnp.int32, n_rows)
-            ck = ck.at[rows, cache.lengths].set(k_new[:, 0].astype(ck.dtype))
-            cv = cv.at[rows, cache.lengths].set(v_new[:, 0].astype(cv.dtype))
+            if s_len == 1:
+                ck = ck.at[rows, cache.lengths].set(k_new[:, 0].astype(ck.dtype))
+                cv = cv.at[rows, cache.lengths].set(v_new[:, 0].astype(cv.dtype))
+            else:
+                # `positions` (built at entry) IS the scatter index set
+                ck = ck.at[rows[:, None], positions].set(k_new.astype(ck.dtype))
+                cv = cv.at[rows[:, None], positions].set(v_new.astype(cv.dtype))
             att_k, att_v = ck, cv
         else:
             # prefill: overwrite [row, 0:S]
@@ -153,7 +160,11 @@ def advance_ragged(
     )
     logits = final_logits(params, x, dtype)
     if row is None:
-        lengths = cache.lengths + 1
+        # all S tokens absorbed; a speculative verify caller rolls rows back
+        # to its per-row accepted counts afterwards (stale tail entries are
+        # rewritten by the next contiguous window before any query reaches
+        # them — see SpeculativeServingEngine)
+        lengths = cache.lengths + s_len
     else:
         lengths = cache.lengths  # caller sets the row's true prompt length
     return logits, RaggedCache(k=new_k, v=new_v, lengths=lengths)
@@ -293,9 +304,14 @@ class ServingEngine:
             self.cache = self.cache._replace(
                 lengths=self.cache.lengths.at[slot].set(len(req.prompt))
             )
+            self._on_prefill(slot, tokens, len(req.prompt))
             tok = self._pick(logits[len(req.prompt) - 1])
             self._emit(req, slot, tok)
             self.slots[slot] = None if req.done else req
+
+    def _on_prefill(self, slot: int, tokens, prompt_len: int) -> None:
+        """Hook for subclasses that keep auxiliary per-slot state (the
+        speculative engine prefills its draft cache here)."""
 
     def _pick(self, logits_row) -> int:
         if self.temperature == 0.0:
@@ -352,3 +368,140 @@ class ServingEngine:
     def occupancy(self) -> float:
         """Mean fraction of slots doing useful work per decode step."""
         return self.slot_steps / (self.steps * self.max_batch) if self.steps else 0.0
+
+
+class SpeculativeServingEngine(ServingEngine):
+    """Continuous batching + speculative decoding with PER-ROW acceptance.
+
+    ``models.speculative`` verifies a uniform batch and must advance every
+    sequence by the BATCH MINIMUM accepted prefix (one slow row drags all).
+    The ragged cache removes that barrier: each engine step drafts ``gamma``
+    greedy proposals per row (one scanned jit), verifies them in a single
+    S=gamma+1 target pass at per-row offsets, and each row keeps its OWN
+    accepted prefix + correction token — rows at different acceptance rates
+    emit 1..gamma+1 tokens per step independently.
+
+    Cache-consistency argument (per row, both caches): a round absorbs the
+    contiguous window [len, len+gamma] and rolls back to len+1+a; the stale
+    tail [len+1+a, len+gamma] is strictly inside the NEXT round's window
+    (which starts at the rolled-back length), and advance_ragged scatters
+    new k/v before attention in every layer, so no query ever attends a
+    stale entry — the same invariant models/speculative.py relies on,
+    applied per row. Greedy speculation is exact: every row's stream equals
+    vanilla greedy decode (guard: test_serving_speculative.py).
+
+    Greedy only (temperature must be 0): per-row residual resampling would
+    need per-row RNG bookkeeping; the uniform-batch sampled path remains in
+    models/speculative.py."""
+
+    def __init__(self, params, cfg, draft_params, draft_cfg, *, gamma: int = 4,
+                 **kw):
+        if kw.get("temperature", 0.0) != 0.0:
+            raise ValueError("SpeculativeServingEngine is greedy-only")
+        if cfg.vocab_size != draft_cfg.vocab_size:
+            raise ValueError("target and draft vocabs must match")
+        if gamma < 1:
+            raise ValueError(f"gamma must be >= 1, got {gamma}")
+        if kw.get("mesh") is not None:
+            raise ValueError("mesh serving of the speculative engine is not "
+                             "wired yet; use the plain ServingEngine")
+        super().__init__(params, cfg, **kw)
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.gamma = gamma
+        self.draft_cache = init_ragged_cache(draft_cfg, self.max_batch,
+                                             self.max_len)
+        self.drafted = 0
+        self.accepted = 0
+
+        def draft_prefill(dparams, dcache, tokens, row):
+            _, dcache = advance_ragged(dparams, dcache, tokens, draft_cfg,
+                                       row=row)
+            return dcache
+
+        def spec_round(tparams, dparams, tcache, dcache, last):
+            def draft_step(carry, _):
+                dc, tok = carry
+                logits, dc = advance_ragged(dparams, dc, tok[:, None], draft_cfg)
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                return (dc, nxt), nxt
+
+            (dcache, last_d), props = jax.lax.scan(
+                draft_step, (dcache, last), None, length=gamma
+            )
+            # extra absorb so the draft cache holds its last proposal when a
+            # row accepts everything (models/speculative.py:128-143)
+            _, dcache = advance_ragged(dparams, dcache, last_d[:, None],
+                                       draft_cfg)
+            props = jnp.swapaxes(props, 0, 1)  # [B, gamma]
+            tgt_in = jnp.concatenate([last[:, None], props], axis=1)
+            tlogits, tcache = advance_ragged(tparams, tcache, tgt_in, cfg)
+            emit = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # [B, g+1]
+            return tcache, dcache, props, emit
+
+        self._draft_prefill = jax.jit(draft_prefill, donate_argnums=(1,))
+        self._spec_round = jax.jit(spec_round, donate_argnums=(2, 3))
+
+    def _on_prefill(self, slot: int, tokens, prompt_len: int) -> None:
+        self.draft_cache = self._draft_prefill(
+            self.draft_params, self.draft_cache, tokens, jnp.int32(slot)
+        )
+        self.draft_cache = self.draft_cache._replace(
+            lengths=self.draft_cache.lengths.at[slot].set(prompt_len)
+        )
+
+    def submit(self, prompt, max_new_tokens: int) -> Request:
+        # a verify round writes up to gamma past the accepted prefix before
+        # rolling back: reserve that headroom in the arena
+        if prompt and len(prompt) + max_new_tokens + self.gamma + 1 > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new_tokens} + "
+                f"speculation headroom {self.gamma + 1} exceeds max_len "
+                f"{self.max_len}"
+            )
+        return super().submit(prompt, max_new_tokens)
+
+    def step(self) -> bool:
+        self._admit()
+        active = [s for s in range(self.max_batch) if self.slots[s] is not None]
+        if active:
+            last = jnp.asarray(self._last_host, jnp.int32)
+            lengths_before = jax.device_get(self.cache.lengths)
+            self.cache, self.draft_cache, props_d, emit_d = self._spec_round(
+                self.params, self.draft_params, self.cache, self.draft_cache,
+                last,
+            )
+            self.steps += 1
+            self.slot_steps += len(active)
+            props, emit = jax.device_get((props_d, emit_d))
+            # every slot's final length is derived from lengths_before below
+            # (active: +1+acc; idle: pinned), so no second device fetch
+            new_len = np.array(lengths_before)
+            for slot in active:
+                req = self.slots[slot]
+                acc = 0
+                while acc < self.gamma and props[slot, acc] == emit[slot, acc]:
+                    acc += 1
+                self.drafted += self.gamma
+                self.accepted += acc
+                # emit accepted prefix + correction, respecting budget/eos
+                for tok in emit[slot, : acc + 1]:
+                    self._emit(req, slot, int(tok))
+                    if req.done:
+                        break
+                # roll the row back to feedback + accepted prefix; idle rows
+                # keep lengths_before (their absorbed garbage never advances)
+                new_len[slot] = lengths_before[slot] + 1 + acc
+                if req.done:
+                    self.slots[slot] = None
+            # two distinct buffers: both caches are donated to the next
+            # round, and donating one shared lengths array twice is an error
+            self.cache = self.cache._replace(
+                lengths=jnp.array(new_len, jnp.int32))
+            self.draft_cache = self.draft_cache._replace(
+                lengths=jnp.array(new_len, jnp.int32))
+        return bool(self.queue) or any(s is not None for s in self.slots)
+
+    @property
+    def acceptance(self) -> float:
+        return self.accepted / self.drafted if self.drafted else 0.0

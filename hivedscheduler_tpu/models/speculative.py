@@ -58,6 +58,30 @@ class SpecStats(NamedTuple):
     accepted: jax.Array
 
 
+def derive_draft_config(
+    cfg: TransformerConfig, draft_layers: int, draft_d_model: int = 0
+) -> TransformerConfig:
+    """The CLIs' shared draft-model derivation: ~half the target width,
+    rounded up so head_dim stays an even integer (RoPE rotates sin/cos
+    pairs), dense MLP at 2x width, classic MHA. Raises ValueError when an
+    explicit ``draft_d_model`` breaks the even-head_dim requirement."""
+    import dataclasses
+
+    quantum = 2 * cfg.n_heads
+    d_model = draft_d_model or max(64, cfg.d_model // 2)
+    if not draft_d_model:
+        d_model = -(-d_model // quantum) * quantum
+    if d_model % quantum:
+        raise ValueError(
+            f"draft d_model {d_model} must be a multiple of 2*n_heads "
+            f"({quantum}): RoPE needs an even head_dim"
+        )
+    return dataclasses.replace(
+        cfg, n_layers=draft_layers, d_model=d_model, d_ff=2 * d_model,
+        n_experts=0, n_kv_heads=0,
+    )
+
+
 def generate_speculative(
     target_params: Dict[str, Any],
     draft_params: Dict[str, Any],

@@ -48,6 +48,15 @@ def main(argv=None) -> int:
     parser.add_argument("--dp", type=int, default=1,
                         help="shard engine slots over a dp mesh axis "
                         "(--max-batch must divide it)")
+    parser.add_argument("--draft-layers", type=int, default=0,
+                        help="speculative serving: draft-model layers "
+                        "(0 = off; greedy only; per-row acceptance — no "
+                        "batch-min barrier)")
+    parser.add_argument("--draft-d-model", type=int, default=0,
+                        help="draft width (default: half the target, "
+                        "rounded to an even head_dim)")
+    parser.add_argument("--gamma", type=int, default=4,
+                        help="draft tokens proposed per verify round")
     parser.add_argument("--quantize", choices=["none", "int8"], default="none",
                         help="weight-only int8 serving (halves weight HBM "
                         "traffic; the engine's shared helpers dequantize "
@@ -97,12 +106,26 @@ def main(argv=None) -> int:
         axes = topology.MeshAxes(dp=args.dp, tp=args.tp)
         mesh = topology.make_mesh(axes, topology.get_devices(axes.size))
     try:
-        eng = serving.ServingEngine(
-            params, cfg, max_batch=args.max_batch, max_len=args.max_len,
+        kw = dict(
+            max_batch=args.max_batch, max_len=args.max_len,
             temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
             eos_id=None if args.eos_id < 0 else args.eos_id, seed=args.seed,
             mesh=mesh,
         )
+        if args.draft_layers > 0:
+            from hivedscheduler_tpu.models.speculative import derive_draft_config
+
+            dft_cfg = derive_draft_config(cfg, args.draft_layers,
+                                          args.draft_d_model)
+            dft_params = tm.cast_params(
+                tm.init_params(dft_cfg, jax.random.PRNGKey(args.seed + 3)),
+                dft_cfg.dtype,
+            )
+            eng = serving.SpeculativeServingEngine(
+                params, cfg, dft_params, dft_cfg, gamma=args.gamma, **kw
+            )
+        else:
+            eng = serving.ServingEngine(params, cfg, **kw)
     except ValueError as e:
         log.error("%s", e)
         return 1
@@ -142,6 +165,9 @@ def main(argv=None) -> int:
         len(reqs), total_tokens, dt, total_tokens / dt,
         100.0 * eng.occupancy, eng.steps,
     )
+    if args.draft_layers > 0:
+        log.info("speculation: %s/%s draft tokens accepted (%.0f%%)",
+                 eng.accepted, eng.drafted, 100.0 * eng.acceptance)
     return 0
 
 
