@@ -97,6 +97,39 @@ class TestSpeculativeServing:
         assert a.tokens_out == vanilla(params, cfg, [5, 9, 2], 3)
         assert b.tokens_out == vanilla(params, cfg, [100, 22, 63, 4], 6)
 
+    def test_fuzz_random_interleavings(self, setup):
+        """Random prompts/budgets at random arrival offsets through the
+        speculative engine (weak draft): every request still equals its solo
+        vanilla run — the speculative analogue of the plain engine's fuzz."""
+        import random
+
+        cfg, params, dft_cfg, dft_params = setup
+        rng = random.Random(23)
+        eng = serving.SpeculativeServingEngine(
+            params, cfg, dft_params, dft_cfg, gamma=2, max_batch=2,
+            max_len=64,
+        )
+        plan = sorted(
+            ((rng.randrange(0, 8),
+              [rng.randrange(1, cfg.vocab_size) for _ in
+               range(rng.randrange(1, 7))],
+              rng.randrange(1, 7)) for _ in range(5)),
+            key=lambda t: t[0],
+        )
+        live = []
+        step = 0
+        while plan or eng.queue or any(eng.slots) or not live:
+            while plan and plan[0][0] <= step:
+                _, p, n = plan.pop(0)
+                live.append((eng.submit(p, n), p, n))
+            if not eng.step() and not plan:
+                break
+            step += 1
+        eng.run_until_drained()
+        for req, p, n in live:
+            assert req.done
+            assert req.tokens_out == vanilla(params, cfg, p, n), req.rid
+
     def test_validation(self, setup):
         cfg, params, dft_cfg, dft_params = setup
         with pytest.raises(ValueError, match="greedy"):
