@@ -510,6 +510,12 @@ def parse_model_bench_output(returncode: int, stdout: str, stderr: str):
         "model_device": m["device"],
         "model_metric_note": m["metric"],
     }
+    # per-stage degradation notes (bench_model isolates decode/serve
+    # failures so the train MFU survives): a null decode/serve field must
+    # arrive explained, not silently absent
+    for k in ("decode_error", "serve_error"):
+        if m.get(k):
+            fields[f"model_{k}"] = m[k]
     stamped = dict(m)
     stamped["captured_at_utc"] = time.strftime(
         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
