@@ -40,7 +40,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
-    # "xla" | "flash" | "ring" | "ring_zigzag" | "ulysses"
+    # "xla" | "flash" | "ring" | "ring_flash" | "ring_zigzag" | "ulysses"
     attn_impl: str = "xla"
     # switch-MoE: 0 = dense MLP; >0 = experts per MoE layer (ep-sharded)
     n_experts: int = 0
@@ -67,9 +67,11 @@ class TransformerConfig:
     # - "none": save everything (fastest when activations fit in HBM).
     remat: str = "full"
 
-    # Pallas flash-attention tile sizes (attn_impl="flash"); the sequence
-    # length must divide both. 128/128 matches the MXU systolic array;
-    # larger k blocks cut grid-loop overhead on long sequences.
+    # Pallas flash-attention tile sizes (attn_impl="flash" and
+    # "ring_flash", where they tile each per-shard ring block); the
+    # sequence length (per-shard for the ring) must divide both. 128/128
+    # matches the MXU systolic array; larger k blocks cut grid-loop
+    # overhead on long sequences.
     attn_block_q: int = 128
     attn_block_k: int = 128
 
@@ -566,7 +568,7 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
         # k/v head for its q-head group here, after RoPE so the rotation
         # runs on the small head count; contiguous grouping keeps groups
         # aligned with tp shards.
-        compact_ok = cfg.attn_impl in ("ring", "ring_zigzag", "flash")
+        compact_ok = cfg.attn_impl in ("ring", "ring_flash", "ring_zigzag", "flash")
         if compact_ok and manual_sp_axis is None and mesh is not None:
             tp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
             compact_ok = k.shape[2] % tp_size == 0
@@ -577,6 +579,7 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
     if manual_sp_axis is not None:
         from hivedscheduler_tpu.parallel.ring_attention import (
             _ring_attention_local,
+            _ring_flash_attention_local,
             _ulysses_local,
             _zigzag_ring_attention_local,
         )
@@ -586,6 +589,12 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
         elif cfg.attn_impl == "ring_zigzag":
             attn = _zigzag_ring_attention_local(
                 q, k, v, axis_name=manual_sp_axis, mesh_axes=manual_vma_axes,
+            )
+        elif cfg.attn_impl == "ring_flash":
+            attn = _ring_flash_attention_local(
+                q, k, v, axis_name=manual_sp_axis, causal=True,
+                mesh_axes=manual_vma_axes,
+                block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
             )
         else:
             attn = _ring_attention_local(
@@ -650,8 +659,8 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
     return x, aux
 
 
-ATTN_IMPLS = ("xla", "flash", "ring", "ring_zigzag", "ulysses")
-RING_FAMILY = ("ring", "ring_zigzag", "ulysses")  # need a mesh + sp axis
+ATTN_IMPLS = ("xla", "flash", "ring", "ring_flash", "ring_zigzag", "ulysses")
+RING_FAMILY = ("ring", "ring_flash", "ring_zigzag", "ulysses")  # need a mesh + sp axis
 
 
 def _remat_wrap(fn, cfg: TransformerConfig):
@@ -682,11 +691,19 @@ def _resolve_attn_fn(cfg: TransformerConfig):
     elif cfg.attn_impl in RING_FAMILY:
         from hivedscheduler_tpu.parallel import ring_attention as ra
 
-        attn_fn = {
-            "ring": ra.ring_attention,
-            "ring_zigzag": ra.zigzag_ring_attention,
-            "ulysses": ra.ulysses_attention,
-        }[cfg.attn_impl]
+        if cfg.attn_impl == "ring_flash":
+            import functools
+
+            attn_fn = functools.partial(
+                ra.ring_flash_attention,
+                block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
+            )
+        else:
+            attn_fn = {
+                "ring": ra.ring_attention,
+                "ring_zigzag": ra.zigzag_ring_attention,
+                "ulysses": ra.ulysses_attention,
+            }[cfg.attn_impl]
     elif cfg.attn_impl == "xla":
         from hivedscheduler_tpu.ops.attention import xla_attention as attn_fn
     else:
@@ -734,9 +751,9 @@ def forward_with_aux(
             shape = dict(zip(mesh.axis_names, mesh.devices.shape))
             if shape.get("sp", 1) > 1 and cfg.attn_impl not in RING_FAMILY:
                 raise ValueError(
-                    "pipeline with mesh sp > 1 requires attn_impl='ring', "
-                    f"'ring_zigzag' or 'ulysses' (got {cfg.attn_impl}): the sequence axis is "
-                    "sharded inside the stage"
+                    f"pipeline with mesh sp > 1 requires one of attn_impl "
+                    f"{RING_FAMILY} (got {cfg.attn_impl}): the sequence axis "
+                    "is sharded inside the stage"
                 )
             if cfg.attn_impl in RING_FAMILY and "sp" in shape:
                 # always run the manual attention body inside the stage (a

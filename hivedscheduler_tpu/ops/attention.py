@@ -221,12 +221,17 @@ def _unfold(x, b, h):
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret, vma=()):
+def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret, vma=(),
+                   out_dtype=None):
     """-> (o [B,T,H,D], lse [B*H, T, 128] f32). Accepts compact GQA k/v.
 
     ``vma``: mesh axes the data varies over when called inside a manual
     (shard_map) context with check_vma=True — stamped on the pallas
-    out_shape avals so the vma checker can type the outputs."""
+    out_shape avals so the vma checker can type the outputs.
+
+    ``out_dtype``: override the output dtype (default ``q.dtype``) — the
+    ring-attention schedules merge per-block partials across ring steps and
+    need them in f32 so accumulation precision matches the einsum ring."""
     svma = frozenset(vma) if vma else None
     b, t, h, d = q.shape
     h_kv = k.shape[2]
@@ -247,7 +252,7 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret, vma=()):
             pl.BlockSpec((None, block_q, _LANES), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), q.dtype, vma=svma),
+            jax.ShapeDtypeStruct((b * h, t, d), out_dtype or q.dtype, vma=svma),
             jax.ShapeDtypeStruct((b * h, t, _LANES), jnp.float32, vma=svma),
         ],
         interpret=interpret,
@@ -256,7 +261,10 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret, vma=()):
 
 
 def _flash_backward(q, k, v, o, lse, g, *, causal, block_q, block_k, interpret,
-                    vma=()):
+                    vma=(), grad_dtype=None):
+    """``grad_dtype``: override the dq/dk/dv dtype (default ``q.dtype`` /
+    ``k.dtype`` / ``v.dtype``) — the ring schedules accumulate per-block
+    gradient partials across ring steps and need them in f32."""
     svma = frozenset(vma) if vma else None
     b, t, h, d = q.shape
     h_kv = k.shape[2]
@@ -280,7 +288,9 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, block_q, block_k, interpret,
             pl.BlockSpec((None, block_q, _LANES), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype, vma=svma),
+        out_shape=jax.ShapeDtypeStruct(
+            (b * h, t, d), grad_dtype or q.dtype, vma=svma
+        ),
         interpret=interpret,
     )(qf, kf, vf, of, gf, lse)
 
@@ -319,7 +329,11 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, block_q, block_k, interpret,
     else:
         dk = _unfold(dkf, b, h)
         dv = _unfold(dvf, b, h)
-    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+    return (
+        dq,
+        dk.astype(grad_dtype or k.dtype),
+        dv.astype(grad_dtype or v.dtype),
+    )
 
 
 _FLASH_CORES = {}

@@ -261,24 +261,24 @@ _RING_CORES = {}
 
 def _make_vjp_core(cache: dict, key, forward_fn, backward_fn):
     """custom_vjp-wrapped flash-style core, cached per configuration.
-    ``forward_fn(q, k, v) -> (out, m, l)``;
-    ``backward_fn(q, k, v, out, m, l, g) -> (dq, dk, dv)``."""
+    ``forward_fn(q, k, v) -> (out, *stats)`` — stats are whatever softmax
+    residuals the matching backward needs ((m, l) for the einsum rings,
+    (lse,) for the flash-block ring);
+    ``backward_fn(q, k, v, out, *stats, g) -> (dq, dk, dv)``."""
     core = cache.get(key)
     if core is not None:
         return core
 
     @jax.custom_vjp
     def core(q, k, v):
-        out, _, _ = forward_fn(q, k, v)
-        return out
+        return forward_fn(q, k, v)[0]
 
     def fwd(q, k, v):
-        out, m, l = forward_fn(q, k, v)
-        return out, (q, k, v, out, m, l)
+        out, *stats = forward_fn(q, k, v)
+        return out, (q, k, v, out, *stats)
 
     def bwd(res, g):
-        q, k, v, out, m, l = res
-        return backward_fn(q, k, v, out, m, l, g)
+        return backward_fn(*res, g)
 
     core.defvjp(fwd, bwd)
     cache[key] = core
@@ -332,6 +332,252 @@ def ring_attention(
             axis_name=seq_axis,
             causal=causal,
             mesh_axes=vma_axes,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Ring schedule with Pallas flash-attention blocks
+# ---------------------------------------------------------------------------
+#
+# ``_ring_forward`` materializes a [B, H, T_loc, T_loc] f32 score block per
+# ring step in einsum. The flash variant instead runs each (local q) x
+# (traveling k/v) pair through the fused Pallas kernels (ops/attention.py):
+# scores never leave VMEM, so per-shard attention memory drops from
+# O(T_loc^2) to O(T_loc x D). Partials merge with the standard (o, lse)
+# combine, and the backward maps 1:1 onto the flash backward kernels because
+# they take the GLOBAL log-sum-exp: each ring step yields exact dq/dk/dv
+# partials that accumulate (dq stays put; dk/dv travel with their k/v,
+# exactly like ``_ring_backward``).
+#
+# Under a causal mask every ring block is either fully visible
+# (src < my_index: unmasked kernel) or the aligned diagonal (src == my_index:
+# causal kernel) — arbitrary-offset masks never arise, so the kernels need no
+# position plumbing.
+
+
+def _flash_block(q, k, v, diag: bool, block_q, block_k, interpret, vma):
+    """One ring block through the flash forward kernel -> (o [B,H,Tq,D] f32,
+    lse [B,H,Tq] f32). ``diag``: aligned causal diagonal vs fully visible."""
+    from hivedscheduler_tpu.ops import attention as fa
+
+    o, lse = fa._flash_forward(
+        q, k, v, causal=diag, block_q=block_q, block_k=block_k,
+        interpret=interpret, vma=vma, out_dtype=jnp.float32,
+    )
+    b, t_q, h, _ = q.shape
+    return jnp.einsum("bqhd->bhqd", o), lse[:, :, 0].reshape(b, h, t_q)
+
+
+def _merge_flash_partial(acc, blk):
+    """Merge (o, lse) online-softmax partials: each o is normalized within
+    its own blocks, so the combined output needs no final division."""
+    o_acc, lse_acc = acc
+    o_blk, lse_blk = blk
+    lse_new = jnp.logaddexp(lse_acc, lse_blk)
+    return (
+        o_acc * jnp.exp(lse_acc - lse_new)[..., None]
+        + o_blk * jnp.exp(lse_blk - lse_new)[..., None],
+        lse_new,
+    )
+
+
+def _ring_flash_forward(q, k, v, axis_name: str, causal: bool, mesh_axes,
+                        block_q: int, block_k: int, interpret: bool):
+    """Forward ring over flash blocks. Returns (out [B,Tq,H,D] in q.dtype,
+    lse [B,H,Tq] f32 — the only residual the backward kernels need)."""
+    axis_size = lax.psum(1, axis_name)
+    my_index = lax.axis_index(axis_name)
+    b, t_q, h, d = q.shape
+    kw = dict(block_q=block_q, block_k=block_k, interpret=interpret,
+              vma=mesh_axes)
+
+    o_acc = _varying(jnp.zeros((b, h, t_q, d), jnp.float32), mesh_axes)
+    lse_acc = _varying(jnp.full((b, h, t_q), NEG_INF, jnp.float32), mesh_axes)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def merge_block(step, o_acc, lse_acc, k_cur, v_cur):
+        src = (my_index - step) % axis_size
+
+        def attend(diag):
+            def f(args):
+                o_acc, lse_acc, k_cur, v_cur = args
+                return _merge_flash_partial(
+                    (o_acc, lse_acc),
+                    _flash_block(q, k_cur, v_cur, diag=diag, **kw),
+                )
+            return f
+
+        if not causal:
+            return attend(False)((o_acc, lse_acc, k_cur, v_cur))
+        return lax.cond(
+            src <= my_index,
+            lambda args: lax.cond(src == my_index, attend(True),
+                                  attend(False), args),
+            lambda args: (args[0], args[1]),
+            (o_acc, lse_acc, k_cur, v_cur),
+        )
+
+    def body(step, carry):
+        o_acc, lse_acc, k_cur, v_cur = carry
+        o_acc, lse_acc = merge_block(step, o_acc, lse_acc, k_cur, v_cur)
+        return (
+            o_acc, lse_acc,
+            lax.ppermute(k_cur, axis_name, perm),
+            lax.ppermute(v_cur, axis_name, perm),
+        )
+
+    # rotate axis_size-1 times; the final block attends outside the loop so
+    # no wasted ICI transfer trails the ring (same shape as _ring_forward)
+    o_acc, lse_acc, k_last, v_last = lax.fori_loop(
+        0, axis_size - 1, body, (o_acc, lse_acc, k, v)
+    )
+    o_acc, lse_acc = merge_block(axis_size - 1, o_acc, lse_acc, k_last, v_last)
+    return jnp.einsum("bhqd->bqhd", o_acc).astype(q.dtype), lse_acc
+
+
+def _ring_flash_backward(q, k, v, out, lse, g, axis_name: str, causal: bool,
+                         mesh_axes, block_q: int, block_k: int,
+                         interpret: bool):
+    """Backward ring over the flash backward kernels: q/do/out/lse stay put,
+    k/v travel with their f32 dk/dv accumulators; after a full rotation the
+    gradients take one last hop home (mirrors ``_ring_backward``)."""
+    from hivedscheduler_tpu.ops import attention as fa
+
+    axis_size = lax.psum(1, axis_name)
+    my_index = lax.axis_index(axis_name)
+    b, t_q, h, d = q.shape
+    t_k, h_kv = k.shape[1], k.shape[2]
+    # kernels take the global lse lane-broadcast as [B*H, Tq, 128]
+    lse_lanes = jnp.broadcast_to(
+        lse.reshape(b * h, t_q, 1), (b * h, t_q, fa._LANES)
+    )
+    kw = dict(block_q=block_q, block_k=block_k, interpret=interpret,
+              vma=mesh_axes, grad_dtype=jnp.float32)
+
+    dq = _varying(jnp.zeros((b, t_q, h, d), jnp.float32), mesh_axes)
+    dk0 = _varying(jnp.zeros((b, t_k, h_kv, d), jnp.float32), mesh_axes)
+    dv0 = _varying(jnp.zeros((b, t_k, h_kv, d), jnp.float32), mesh_axes)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def merge_grad(step, dq, dk_cur, dv_cur, k_cur, v_cur):
+        src = (my_index - step) % axis_size
+
+        def attend(diag):
+            def f(args):
+                dq, dk_cur, dv_cur, k_cur, v_cur = args
+                dq_blk, dk_blk, dv_blk = fa._flash_backward(
+                    q, k_cur, v_cur, out, lse_lanes, g, causal=diag, **kw
+                )
+                return dq + dq_blk, dk_cur + dk_blk, dv_cur + dv_blk
+            return f
+
+        if not causal:
+            return attend(False)((dq, dk_cur, dv_cur, k_cur, v_cur))
+        return lax.cond(
+            src <= my_index,
+            lambda args: lax.cond(src == my_index, attend(True),
+                                  attend(False), args),
+            lambda args: (args[0], args[1], args[2]),
+            (dq, dk_cur, dv_cur, k_cur, v_cur),
+        )
+
+    def body(step, carry):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        dq, dk_cur, dv_cur = merge_grad(step, dq, dk_cur, dv_cur, k_cur, v_cur)
+        return (
+            dq,
+            lax.ppermute(k_cur, axis_name, perm),
+            lax.ppermute(v_cur, axis_name, perm),
+            lax.ppermute(dk_cur, axis_name, perm),
+            lax.ppermute(dv_cur, axis_name, perm),
+        )
+
+    dq, k_last, v_last, dk_last, dv_last = lax.fori_loop(
+        0, axis_size - 1, body, (dq, k, v, dk0, dv0)
+    )
+    dq, dk_last, dv_last = merge_grad(
+        axis_size - 1, dq, dk_last, dv_last, k_last, v_last
+    )
+    dk = lax.ppermute(dk_last, axis_name, perm)
+    dv = lax.ppermute(dv_last, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_RING_FLASH_CORES = {}
+
+
+def _ring_flash_core(axis_name: str, causal: bool, mesh_axes, block_q: int,
+                     block_k: int, interpret: bool):
+    """custom_vjp core for the flash-block ring, cached per configuration
+    (the residual is (q, k, v, out, lse) — no O(T^2) score state)."""
+    kw = dict(axis_name=axis_name, causal=causal, mesh_axes=mesh_axes,
+              block_q=block_q, block_k=block_k, interpret=interpret)
+    return _make_vjp_core(
+        _RING_FLASH_CORES,
+        (axis_name, causal, tuple(mesh_axes), block_q, block_k, interpret),
+        functools.partial(_ring_flash_forward, **kw),
+        functools.partial(_ring_flash_backward, **kw),
+    )
+
+
+def _ring_flash_attention_local(q, k, v, axis_name: str, causal: bool = True,
+                                mesh_axes=(), block_q: int = 128,
+                                block_k: int = 128):
+    """Per-shard body (runs under shard_map): the ring schedule with every
+    block computed by the Pallas flash kernels. Falls back to the einsum
+    ring when the kernels can't run — no pallas, shapes that don't tile, or
+    interpret mode inside a vma-checked manual context (same rule as
+    ``ops.attention.flash_attention``: the HLO interpreter cannot type the
+    kernel's fresh accumulators under vma checking; on real TPU the compiled
+    kernel is opaque and the vma-stamped out_shapes type it)."""
+    from hivedscheduler_tpu.ops import attention as fa
+
+    b, t_loc, h, d = q.shape
+    h_kv = k.shape[2]
+    block_q = min(block_q, t_loc)
+    block_k = min(block_k, t_loc)
+    interpret = jax.default_backend() != "tpu"
+    if (fa.pl is None or t_loc % block_q or t_loc % block_k or d % 8
+            or (h_kv and h % h_kv) or (interpret and mesh_axes)):
+        return _ring_attention_local(q, k, v, axis_name, causal, mesh_axes)
+    return _ring_flash_core(
+        axis_name, causal, tuple(mesh_axes), block_q, block_k, interpret
+    )(q, k, v)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    seq_axis: str = "sp",
+    batch_axes=("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Exact ring attention whose per-step blocks run through the Pallas
+    flash kernels — same contract as :func:`ring_attention`, with per-shard
+    attention memory O(T_loc x D) instead of O(T_loc^2)."""
+    shard_map = _get_shard_map()
+
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    vma_axes = tuple(batch_axes) + (seq_axis,) + ((head_axis,) if head_axis else ())
+    fn = shard_map(
+        functools.partial(
+            _ring_flash_attention_local,
+            axis_name=seq_axis,
+            causal=causal,
+            mesh_axes=vma_axes,
+            block_q=block_q,
+            block_k=block_k,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -1,0 +1,158 @@
+"""Ring attention with Pallas flash blocks (attn_impl="ring_flash").
+
+The flash-block ring must be bit-for-bit the same *algorithm* as standard
+attention: every test here is a differential check against the dense einsum
+reference or the einsum ring. The Pallas kernels really execute on CPU via
+the interpreter — the tests drive the local body under a
+``check_vma=False`` shard_map, which is the one context where the
+interpreter can run inside a manual mesh (the production vma-checked path
+compiles the kernels on TPU and falls back to the einsum ring elsewhere;
+see ``_ring_flash_attention_local``).
+"""
+
+import functools
+
+import pytest
+
+pytest.importorskip("jax")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from hivedscheduler_tpu.ops.attention import xla_attention
+from hivedscheduler_tpu.parallel.ring_attention import (
+    _get_shard_map,
+    _ring_attention_local,
+    _ring_flash_attention_local,
+    ring_flash_attention,
+)
+
+B, T, H, D = 2, 32, 4, 8
+SP = 4
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices("cpu")[:SP]).reshape(SP), ("sp",))
+
+
+def _qkv(h_kv=H, dtype=jnp.float32, d=D):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    return (
+        jax.random.normal(ks[0], (B, T, H, d), dtype),
+        jax.random.normal(ks[1], (B, T, h_kv, d), dtype),
+        jax.random.normal(ks[2], (B, T, h_kv, d), dtype),
+    )
+
+
+def _ring_flash(mesh, causal=True, block=8, interpret_kernels=True):
+    """The local body under shard_map; check_vma=False + mesh_axes=() lets
+    the Pallas interpreter actually run the kernels on CPU."""
+    spec = P(None, "sp", None, None)
+    return _get_shard_map()(
+        functools.partial(
+            _ring_flash_attention_local, axis_name="sp", causal=causal,
+            mesh_axes=(), block_q=block, block_k=block,
+        ),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=not interpret_kernels,
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_dense(causal):
+    q, k, v = _qkv()
+    out = jax.jit(_ring_flash(_mesh(), causal=causal))(q, k, v)
+    ref = xla_attention(q, k, v, causal=causal)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-4
+
+
+@pytest.mark.parametrize("h_kv", [H, 2, 1])
+def test_gradients_match_dense(h_kv):
+    """Forward AND backward parity, incl. compact GQA/MQA k/v (the flash
+    kernels consume the shared head directly)."""
+    q, k, v = _qkv(h_kv=h_kv)
+    w = jax.random.normal(jax.random.PRNGKey(9), (B, T, H, D))
+    fn = _ring_flash(_mesh())
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(f(q, k, v).astype(jnp.float32) * w)
+
+    o_r, g_r = jax.value_and_grad(loss(jax.jit(fn)), (0, 1, 2))(q, k, v)
+    o_d, g_d = jax.value_and_grad(
+        loss(lambda q, k, v: xla_attention(q, k, v, causal=True)), (0, 1, 2)
+    )(q, k, v)
+    assert abs(float(o_r - o_d)) < 1e-3
+    for got, want in zip(g_r, g_d):
+        assert jnp.max(jnp.abs(got - want)) < 1e-4
+
+
+def test_bf16_matches_einsum_ring():
+    """Same schedule, same f32 accumulation: the flash-block ring tracks the
+    einsum ring to bf16 resolution on bf16 inputs."""
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    mesh = _mesh()
+    out = jax.jit(_ring_flash(mesh))(q, k, v)
+    spec = P(None, "sp", None, None)
+    ring = _get_shard_map()(
+        functools.partial(_ring_attention_local, axis_name="sp", causal=True,
+                          mesh_axes=()),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    ref = jax.jit(ring)(q, k, v)
+    assert jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))) < 0.02
+
+
+def test_nontiling_head_dim_falls_back():
+    """D not a multiple of 8 can't tile on the kernels: the local body must
+    degrade to the einsum ring, not crash (same contract as
+    flash_attention's xla fallback)."""
+    q, k, v = _qkv(d=6)
+    out = jax.jit(_ring_flash(_mesh()))(q, k, v)
+    ref = xla_attention(q, k, v, causal=True)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-4
+
+
+def test_vma_checked_context_falls_back():
+    """Under the production vma-checked shard_map on CPU the interpreter
+    cannot run the kernels; the public wrapper must still produce exact
+    ring-attention results via the einsum fallback."""
+    q, k, v = _qkv()
+    out = ring_flash_attention(
+        q, k, v, _mesh(), seq_axis="sp", batch_axes=(), head_axis=None,
+        block_q=8, block_k=8,
+    )
+    ref = xla_attention(q, k, v, causal=True)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-4
+
+
+def test_train_step_wiring():
+    """attn_impl="ring_flash" is reachable from the sharded train step and
+    optimizes the same loss as attn_impl="ring" (on CPU both resolve to the
+    einsum ring inside the vma-checked sp shard_map — this pins the config
+    plumbing; the kernel math is pinned by the differential tests above)."""
+    from hivedscheduler_tpu.models import transformer as tm
+    from hivedscheduler_tpu.parallel import topology
+    from hivedscheduler_tpu.parallel.train import make_sharded_train_step
+
+    losses = {}
+    for impl in ("ring", "ring_flash"):
+        cfg = tm.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=T, attn_impl=impl, attn_block_q=8, attn_block_k=8,
+        )
+        axes = topology.MeshAxes(sp=SP)
+        mesh = topology.make_mesh(axes, jax.devices("cpu")[:SP])
+        step, init_fn, token_sharding = make_sharded_train_step(cfg, mesh)
+        params, opt_state = init_fn(jax.random.PRNGKey(0))
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (2, T), 0, 64,
+                               jnp.int32),
+            token_sharding,
+        )
+        _, _, loss = step(params, opt_state, tokens)
+        losses[impl] = float(loss)
+    assert losses["ring"] == pytest.approx(losses["ring_flash"], abs=1e-5)
