@@ -40,7 +40,8 @@ class TransformerConfig:
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
-    # "xla" | "flash" | "ring" | "ring_flash" | "ring_zigzag" | "ulysses"
+    # "xla" | "flash" | "ring" | "ring_flash" | "ring_zigzag" |
+    # "ring_zigzag_flash" | "ulysses"
     attn_impl: str = "xla"
     # switch-MoE: 0 = dense MLP; >0 = experts per MoE layer (ep-sharded)
     n_experts: int = 0
@@ -568,7 +569,9 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
         # k/v head for its q-head group here, after RoPE so the rotation
         # runs on the small head count; contiguous grouping keeps groups
         # aligned with tp shards.
-        compact_ok = cfg.attn_impl in ("ring", "ring_flash", "ring_zigzag", "flash")
+        compact_ok = cfg.attn_impl in (
+            "ring", "ring_flash", "ring_zigzag", "ring_zigzag_flash", "flash",
+        )
         if compact_ok and manual_sp_axis is None and mesh is not None:
             tp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
             compact_ok = k.shape[2] % tp_size == 0
@@ -581,6 +584,7 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
             _ring_attention_local,
             _ring_flash_attention_local,
             _ulysses_local,
+            _zigzag_flash_attention_local,
             _zigzag_ring_attention_local,
         )
 
@@ -589,6 +593,11 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
         elif cfg.attn_impl == "ring_zigzag":
             attn = _zigzag_ring_attention_local(
                 q, k, v, axis_name=manual_sp_axis, mesh_axes=manual_vma_axes,
+            )
+        elif cfg.attn_impl == "ring_zigzag_flash":
+            attn = _zigzag_flash_attention_local(
+                q, k, v, axis_name=manual_sp_axis, mesh_axes=manual_vma_axes,
+                block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
             )
         elif cfg.attn_impl == "ring_flash":
             attn = _ring_flash_attention_local(
@@ -659,8 +668,11 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
     return x, aux
 
 
-ATTN_IMPLS = ("xla", "flash", "ring", "ring_flash", "ring_zigzag", "ulysses")
-RING_FAMILY = ("ring", "ring_flash", "ring_zigzag", "ulysses")  # need a mesh + sp axis
+ATTN_IMPLS = ("xla", "flash", "ring", "ring_flash", "ring_zigzag",
+              "ring_zigzag_flash", "ulysses")
+# need a mesh + sp axis
+RING_FAMILY = ("ring", "ring_flash", "ring_zigzag", "ring_zigzag_flash",
+               "ulysses")
 
 
 def _remat_wrap(fn, cfg: TransformerConfig):
@@ -691,11 +703,12 @@ def _resolve_attn_fn(cfg: TransformerConfig):
     elif cfg.attn_impl in RING_FAMILY:
         from hivedscheduler_tpu.parallel import ring_attention as ra
 
-        if cfg.attn_impl == "ring_flash":
+        if cfg.attn_impl in ("ring_flash", "ring_zigzag_flash"):
             import functools
 
             attn_fn = functools.partial(
-                ra.ring_flash_attention,
+                ra.ring_flash_attention if cfg.attn_impl == "ring_flash"
+                else ra.zigzag_ring_flash_attention,
                 block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
             )
         else:

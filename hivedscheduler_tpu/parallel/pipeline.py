@@ -12,7 +12,7 @@ Composition (validated in ``models.transformer.forward_with_aux``):
 - tensor parallelism composes — stage weights keep their tp sharding and
   ``_apply_layer`` inserts Megatron-style row-parallel psums;
 - sequence parallelism composes with ``attn_impl`` "ring", "ring_flash",
-  "ring_zigzag" or "ulysses" —
+  "ring_zigzag", "ring_zigzag_flash" or "ulysses" —
   ``seq_axis`` shards T into the stage and the manual attention body runs
   directly in the stage (sp > 1 with local attention is rejected);
 - MoE composes — expert weights stay ep-sharded, each device computes its

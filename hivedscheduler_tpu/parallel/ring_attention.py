@@ -897,6 +897,284 @@ def zigzag_ring_attention(
     return fn(q, k, v)
 
 
+# ---------------------------------------------------------------------------
+# Zigzag schedule with Pallas flash-attention blocks
+# ---------------------------------------------------------------------------
+#
+# Same 3-sub-block schedule as ``_zigzag_forward`` (see the layout comment
+# above), but every quarter-block runs through the fused Pallas kernels. The
+# schedule needs no position plumbing either: each visible quarter-block is
+# the aligned diagonal (src == i — both chunks are the same global chunk) or
+# fully visible (the k chunk lies entirely in the q chunk's past), so the
+# causal/unmasked kernel pair covers it:
+#   (hi_q, lo_k): always fully visible            -> unmasked
+#   (hi_q, hi_k): src == i diag | src > i visible -> causal | unmasked
+#   (lo_q, lo_k): src == i diag | i > src visible -> causal | unmasked
+#   (lo_q, hi_k): never visible                   -> never computed
+
+
+def _zigzag_flash_forward(q, k, v, axis_name: str, mesh_axes, block_q: int,
+                          block_k: int, interpret: bool):
+    """Forward zigzag over flash blocks. Returns (out [B,T,H,D] q.dtype,
+    lse [B,H,T] f32) with rows in the zigzag-local order [chunk i,
+    chunk 2n-1-i]."""
+    axis_size = lax.psum(1, axis_name)
+    i = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    half = t // 2
+    q_lo, q_hi = q[:, :half], q[:, half:]
+    kw = dict(block_q=block_q, block_k=block_k, interpret=interpret,
+              vma=mesh_axes)
+
+    def zeros():
+        return (
+            _varying(jnp.zeros((b, h, half, d), jnp.float32), mesh_axes),
+            _varying(jnp.full((b, h, half), NEG_INF, jnp.float32), mesh_axes),
+        )
+
+    acc_lo, acc_hi = zeros(), zeros()
+    perm = [(s, (s + 1) % axis_size) for s in range(axis_size)]
+
+    def merge_block(step, acc_lo, acc_hi, k_cur, v_cur):
+        src = (i - step) % axis_size
+        k_lo, k_hi = k_cur[:, :half], k_cur[:, half:]
+        v_lo, v_hi = v_cur[:, :half], v_cur[:, half:]
+
+        def attend(qh, kh, vh, diag):
+            def f(acc):
+                return _merge_flash_partial(
+                    acc, _flash_block(qh, kh, vh, diag=diag, **kw)
+                )
+            return f
+
+        # (hi_q, lo_k): always fully visible
+        acc_hi = attend(q_hi, k_lo, v_lo, False)(acc_hi)
+        # (hi_q, hi_k): diagonal at src == i, fully visible for src > i
+        acc_hi = lax.cond(
+            src >= i,
+            lambda acc: lax.cond(src == i, attend(q_hi, k_hi, v_hi, True),
+                                 attend(q_hi, k_hi, v_hi, False), acc),
+            lambda acc: acc,
+            acc_hi,
+        )
+        # (lo_q, lo_k): diagonal at src == i, fully visible for i > src
+        acc_lo = lax.cond(
+            i >= src,
+            lambda acc: lax.cond(src == i, attend(q_lo, k_lo, v_lo, True),
+                                 attend(q_lo, k_lo, v_lo, False), acc),
+            lambda acc: acc,
+            acc_lo,
+        )
+        return acc_lo, acc_hi
+
+    def body(step, carry):
+        acc_lo, acc_hi, k_cur, v_cur = carry
+        acc_lo, acc_hi = merge_block(step, acc_lo, acc_hi, k_cur, v_cur)
+        return (
+            acc_lo, acc_hi,
+            lax.ppermute(k_cur, axis_name, perm),
+            lax.ppermute(v_cur, axis_name, perm),
+        )
+
+    acc_lo, acc_hi, k_last, v_last = lax.fori_loop(
+        0, axis_size - 1, body, (acc_lo, acc_hi, k, v)
+    )
+    acc_lo, acc_hi = merge_block(axis_size - 1, acc_lo, acc_hi, k_last, v_last)
+    # flash partials are block-normalized: the (o, lse) merge already yields
+    # the final rows, no closing division
+    out = jnp.concatenate(
+        [jnp.einsum("bhqd->bqhd", acc_lo[0]),
+         jnp.einsum("bhqd->bqhd", acc_hi[0])], axis=1,
+    ).astype(q.dtype)
+    lse = jnp.concatenate([acc_lo[1], acc_hi[1]], axis=2)
+    return out, lse
+
+
+def _zigzag_flash_backward(q, k, v, out, lse, g, axis_name: str, mesh_axes,
+                           block_q: int, block_k: int, interpret: bool):
+    """Backward zigzag over the flash backward kernels: the same quarter-block
+    schedule; dk/dv accumulate in f32 on the traveling k/v and take the last
+    hop home (mirrors ``_zigzag_backward``)."""
+    from hivedscheduler_tpu.ops import attention as fa
+
+    axis_size = lax.psum(1, axis_name)
+    i = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    t_k, h_kv = k.shape[1], k.shape[2]
+    half = t // 2
+    kw = dict(block_q=block_q, block_k=block_k, interpret=interpret,
+              vma=mesh_axes, grad_dtype=jnp.float32)
+
+    lo_s, hi_s = slice(0, half), slice(half, t)
+
+    def lanes(x):  # [B,H,half] -> [B*H, half, 128] for the kernels
+        return jnp.broadcast_to(
+            x.reshape(b * h, half, 1), (b * h, half, fa._LANES)
+        )
+
+    halves = {
+        0: (q[:, lo_s], out[:, lo_s], lanes(lse[:, :, :half]), g[:, lo_s], lo_s),
+        1: (q[:, hi_s], out[:, hi_s], lanes(lse[:, :, half:]), g[:, hi_s], hi_s),
+    }
+
+    dq = _varying(jnp.zeros((b, t, h, d), jnp.float32), mesh_axes)
+    dk0 = _varying(jnp.zeros((b, t_k, h_kv, d), jnp.float32), mesh_axes)
+    dv0 = _varying(jnp.zeros((b, t_k, h_kv, d), jnp.float32), mesh_axes)
+    perm = [(s, (s + 1) % axis_size) for s in range(axis_size)]
+
+    def sub_grad(q_half, k_cur, v_cur, k_slice, diag):
+        def f(args):
+            dq, dk_cur, dv_cur = args
+            qh, oh, lseh, gh, q_slice = q_half
+            dq_blk, dk_blk, dv_blk = fa._flash_backward(
+                qh, k_cur[:, k_slice], v_cur[:, k_slice], oh, lseh, gh,
+                causal=diag, **kw
+            )
+            return (
+                dq.at[:, q_slice].add(dq_blk),
+                dk_cur.at[:, k_slice].add(dk_blk),
+                dv_cur.at[:, k_slice].add(dv_blk),
+            )
+        return f
+
+    def merge_grad(step, dq, dk_cur, dv_cur, k_cur, v_cur):
+        src = (i - step) % axis_size
+        args = (dq, dk_cur, dv_cur)
+        # (hi_q, lo_k): always fully visible
+        args = sub_grad(halves[1], k_cur, v_cur, lo_s, False)(args)
+        # (hi_q, hi_k)
+        args = lax.cond(
+            src >= i,
+            lambda a: lax.cond(src == i,
+                               sub_grad(halves[1], k_cur, v_cur, hi_s, True),
+                               sub_grad(halves[1], k_cur, v_cur, hi_s, False),
+                               a),
+            lambda a: a,
+            args,
+        )
+        # (lo_q, lo_k)
+        args = lax.cond(
+            i >= src,
+            lambda a: lax.cond(src == i,
+                               sub_grad(halves[0], k_cur, v_cur, lo_s, True),
+                               sub_grad(halves[0], k_cur, v_cur, lo_s, False),
+                               a),
+            lambda a: a,
+            args,
+        )
+        return args
+
+    def body(step, carry):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        dq, dk_cur, dv_cur = merge_grad(step, dq, dk_cur, dv_cur, k_cur, v_cur)
+        return (
+            dq,
+            lax.ppermute(k_cur, axis_name, perm),
+            lax.ppermute(v_cur, axis_name, perm),
+            lax.ppermute(dk_cur, axis_name, perm),
+            lax.ppermute(dv_cur, axis_name, perm),
+        )
+
+    dq, k_last, v_last, dk_last, dv_last = lax.fori_loop(
+        0, axis_size - 1, body, (dq, k, v, dk0, dv0)
+    )
+    dq, dk_last, dv_last = merge_grad(
+        axis_size - 1, dq, dk_last, dv_last, k_last, v_last
+    )
+    dk = lax.ppermute(dk_last, axis_name, perm)
+    dv = lax.ppermute(dv_last, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ZIGZAG_FLASH_CORES = {}
+
+
+def _zigzag_flash_core(axis_name: str, mesh_axes, block_q: int, block_k: int,
+                       interpret: bool):
+    kw = dict(axis_name=axis_name, mesh_axes=mesh_axes, block_q=block_q,
+              block_k=block_k, interpret=interpret)
+    return _make_vjp_core(
+        _ZIGZAG_FLASH_CORES,
+        (axis_name, tuple(mesh_axes), block_q, block_k, interpret),
+        functools.partial(_zigzag_flash_forward, **kw),
+        functools.partial(_zigzag_flash_backward, **kw),
+    )
+
+
+def _zigzag_flash_attention_local(q, k, v, axis_name: str, mesh_axes=(),
+                                  block_q: int = 128, block_k: int = 128):
+    """Per-shard body: relayout to zigzag, run the flash-block balanced ring,
+    relayout back. Falls back to the einsum zigzag under the same conditions
+    as ``_ring_flash_attention_local`` (tiles are per half-chunk)."""
+    from hivedscheduler_tpu.ops import attention as fa
+
+    if q.shape[1] % 2:
+        raise ValueError(
+            f"zigzag ring attention needs an even per-shard block to split "
+            f"into two chunks; got {q.shape[1]} rows per shard "
+            f"(require T % (2 * sp) == 0)"
+        )
+    b, t_loc, h, d = q.shape
+    h_kv = k.shape[2]
+    half = t_loc // 2
+    block_q = min(block_q, half)
+    block_k = min(block_k, half)
+    interpret = jax.default_backend() != "tpu"
+    if (fa.pl is None or half % block_q or half % block_k or d % 8
+            or (h_kv and h % h_kv) or (interpret and mesh_axes)):
+        return _zigzag_ring_attention_local(
+            q, k, v, axis_name=axis_name, mesh_axes=mesh_axes
+        )
+    axis_size = lax.psum(1, axis_name)
+    qz = _zigzag_relayout(q, axis_name, axis_size, inverse=False)
+    kz = _zigzag_relayout(k, axis_name, axis_size, inverse=False)
+    vz = _zigzag_relayout(v, axis_name, axis_size, inverse=False)
+    out = _zigzag_flash_core(
+        axis_name, tuple(mesh_axes), block_q, block_k, interpret
+    )(qz, kz, vz)
+    return _zigzag_relayout(out, axis_name, axis_size, inverse=True)
+
+
+def zigzag_ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    seq_axis: str = "sp",
+    batch_axes=("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Zigzag-balanced causal ring attention whose quarter-blocks run through
+    the Pallas flash kernels — :func:`zigzag_ring_attention`'s schedule with
+    :func:`ring_flash_attention`'s O(T_loc x D) per-shard attention memory."""
+    if not causal:
+        raise ValueError(
+            "the zigzag schedule balances the CAUSAL skip; use "
+            "ring_flash_attention for non-causal attention"
+        )
+    shard_map = _get_shard_map()
+
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    vma_axes = tuple(batch_axes) + (seq_axis,) + ((head_axis,) if head_axis else ())
+    fn = shard_map(
+        functools.partial(
+            _zigzag_flash_attention_local,
+            axis_name=seq_axis,
+            mesh_axes=vma_axes,
+            block_q=block_q,
+            block_k=block_k,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
 def _ulysses_local(q, k, v, axis_name: str, causal: bool):
     """All-to-all swap: [B, T/sp, H, D] -> [B, T, H/sp, D], local attention,
     swap back. Requires H % sp == 0."""

@@ -70,11 +70,14 @@ def main(argv=None) -> int:
                              "(0 = off; must divide --seq-len; best with "
                              "--sp 1)")
     parser.add_argument("--block-q", type=int, default=128,
-                        help="flash-attention q tile (attn=flash|ring_flash)")
+                        help="flash-attention q tile (flash/ring_flash/"
+                             "ring_zigzag_flash)")
     parser.add_argument("--block-k", type=int, default=128,
-                        help="flash-attention k tile (attn=flash|ring_flash)")
+                        help="flash-attention k tile (flash/ring_flash/"
+                             "ring_zigzag_flash)")
     parser.add_argument("--attn", default=None,
-                        help="xla|flash|ring|ring_flash|ring_zigzag|ulysses "
+                        help="xla|flash|ring|ring_flash|ring_zigzag|"
+                             "ring_zigzag_flash|ulysses "
                              "(default: ring when sp>1)")
     parser.add_argument("--prefetch", type=int, default=2,
                         help="data-loader prefetch depth (batches assembled "
