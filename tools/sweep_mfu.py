@@ -72,7 +72,8 @@ def main() -> int:
     def run_decode(tag, dec_batch=16, prompt=128, new=64):
         cfg = tm.TransformerConfig(**base)
         try:
-            dec_s = bm.bench_decode(cfg, dec_batch, prompt, new,
+            params = bm.serving_params(cfg)
+            dec_s = bm.bench_decode(cfg, params, dec_batch, prompt, new,
                                     max(1, args.iters // 2))
             param_bytes = 2.0 * bm.param_count(cfg)
             rec = {
