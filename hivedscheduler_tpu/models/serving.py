@@ -21,6 +21,11 @@ continuous-batching pattern (the core of modern LLM servers) TPU-first:
   immediately; the next queued request prefills into it while the other
   rows keep decoding — chip occupancy tracks offered load, not the
   slowest request of a static batch.
+- **Prefix caching** (``prefix_cache_size > 0``): the KV of recent prompts
+  stays device-resident in an LRU; a new prompt that extends a cached one
+  restores the prefix KV with one dynamic_update_slice and prefills only
+  the tail — shared system prompts skip their prefill FLOPs entirely,
+  bit-exactly (restored KV is identical to recomputation).
 
 No paging indirection: a TPU gets no benefit from non-contiguous KV blocks
 (there is no per-block allocator to appease, unlike GPU VRAM heaps); the
@@ -95,6 +100,7 @@ def advance_ragged(
     tokens: jax.Array,
     cfg: TransformerConfig,
     row: Optional[jax.Array] = None,
+    start: Optional[jax.Array] = None,
 ) -> tuple:
     """Absorb ``tokens`` and return (logits [B_t, S, vocab] f32, cache).
 
@@ -103,10 +109,12 @@ def advance_ragged(
     - decode (``row is None``): tokens [B, 1], every row advances at its own
       ``cache.lengths[b]`` (rows are masked/ignored by the caller if idle);
     - prefill (``row`` given): tokens [1, S] written into cache row ``row``
-      starting at position 0 (the row's previous content is dead — its
-      length is reset to the real prompt length by the caller; padded tail
-      positions write garbage past ``lengths`` that the causal mask never
-      reads).
+      starting at position ``start`` (0 when omitted — a fresh prompt; a
+      prefix-cache hit restores the prefix KV and prefills only the tail
+      from ``start=prefix_len``). The row's previous content past the
+      restored prefix is dead — its length is reset to the real prompt
+      length by the caller; padded tail positions write garbage past
+      ``lengths`` that the causal mask never reads.
     """
     dtype = cfg.dtype
     if cfg.n_experts > 0:
@@ -115,7 +123,8 @@ def advance_ragged(
     if row is None:
         positions = cache.lengths[:, None] + lax.iota(jnp.int32, s_len)[None, :]
     else:
-        positions = lax.iota(jnp.int32, s_len)[None, :]
+        offset = jnp.int32(0) if start is None else start
+        positions = offset + lax.iota(jnp.int32, s_len)[None, :]
 
     x = embed_tokens(params, tokens, dtype)
     scale = 1.0 / math.sqrt(cfg.head_dim)
@@ -138,12 +147,15 @@ def advance_ragged(
                 cv = cv.at[rows[:, None], positions].set(v_new.astype(cv.dtype))
             att_k, att_v = ck, cv
         else:
-            # prefill: overwrite [row, 0:S]
+            # prefill: overwrite [row, start:start+S] (start is 0 for a
+            # fresh prompt; the prefix-cache tail prefill offsets past the
+            # restored prefix)
+            off = jnp.int32(0) if start is None else start
             ck = lax.dynamic_update_slice(
-                ck, k_new.astype(ck.dtype), (row, 0, 0, 0)
+                ck, k_new.astype(ck.dtype), (row, off, 0, 0)
             )
             cv = lax.dynamic_update_slice(
-                cv, v_new.astype(cv.dtype), (row, 0, 0, 0)
+                cv, v_new.astype(cv.dtype), (row, off, 0, 0)
             )
             att_k = lax.dynamic_slice_in_dim(ck, row, 1, axis=0)
             att_v = lax.dynamic_slice_in_dim(cv, row, 1, axis=0)
@@ -202,12 +214,20 @@ class ServingEngine:
         eos_id: Optional[int] = None,
         seed: int = 0,
         mesh=None,
+        prefix_cache_size: int = 0,
     ):
         """``mesh``: lay the engine out over a dp x tp serving mesh —
         params by ``decode.serving_shardings`` (tp shards heads/ff/vocab),
         cache rows over dp, the compact kv-head axis over tp. The jitted
         programs then run under GSPMD with XLA-inserted collectives;
-        max_batch must divide the dp axis."""
+        max_batch must divide the dp axis.
+
+        ``prefix_cache_size``: keep the KV of up to this many past prompts
+        (device-resident, LRU) and, when a new prompt starts with a cached
+        one, restore that prefix and prefill only the tail — the standard
+        shared-system-prompt win. 0 disables (no extra HBM). Exactness is
+        unaffected: restored KV is bit-identical to recomputation (guard:
+        tests/test_serving_prefix.py)."""
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -256,13 +276,42 @@ class ServingEngine:
             logits, cache = advance_ragged(params, cache, last_tokens[:, None], cfg)
             return logits[:, 0], cache
 
-        def prefill(params, cache, tokens, row):
-            logits, cache = advance_ragged(params, cache, tokens, cfg, row=row)
+        def prefill(params, cache, tokens, row, start):
+            logits, cache = advance_ragged(params, cache, tokens, cfg, row=row,
+                                           start=start)
             return logits[0], cache
 
         self._decode = jax.jit(decode_step, donate_argnums=(1,))
         # one compile per prompt bucket (tokens' S is static per call shape)
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
+
+        # -- prompt prefix cache (LRU over device-resident KV rows) --------
+        from collections import OrderedDict
+
+        self.prefix_cache_size = max(0, prefix_cache_size)
+        # prompt tuple -> (k [L, Pb, H_kv, D], v, true_len); Pb is the
+        # prompt's prefill bucket, so restores compile once per bucket
+        self._prefix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_tokens_reused = 0
+
+        def restore_prefix(cache, pk, pv, row):
+            """Write a cached prefix row into slot ``row`` at [0:Pb]."""
+            k = lax.dynamic_update_slice(cache.k, pk[:, None], (0, row, 0, 0, 0))
+            v = lax.dynamic_update_slice(cache.v, pv[:, None], (0, row, 0, 0, 0))
+            return cache._replace(k=k, v=v)
+
+        def extract_prefix(cache, row, pb):
+            """Copy slot ``row``'s [0:pb] KV out as a standalone prefix row."""
+            l_, _, _, h_kv, hd = cache.k.shape
+            k = lax.dynamic_slice(cache.k, (0, row, 0, 0, 0),
+                                  (l_, 1, pb, h_kv, hd))[:, 0]
+            v = lax.dynamic_slice(cache.v, (0, row, 0, 0, 0),
+                                  (l_, 1, pb, h_kv, hd))[:, 0]
+            return k, v
+
+        self._restore_prefix = jax.jit(restore_prefix, donate_argnums=(0,))
+        self._extract_prefix = jax.jit(extract_prefix, static_argnums=(2,))
 
     # -- request lifecycle -------------------------------------------------
     def submit(self, prompt: List[int], max_new_tokens: int) -> Request:
@@ -285,6 +334,52 @@ class ServingEngine:
     def _bucket(self, n: int) -> int:
         return min(self.max_len, 1 << max(1, (n - 1).bit_length()))
 
+    def _match_prefix(self, prompt: List[int]):
+        """Longest cached prompt that strictly prefixes ``prompt`` (strict:
+        the tail prefill needs >= 1 token to produce the next-token logits).
+        The offset tail write must also stay inside the arena — a bucketed
+        tail that would clamp against max_len falls back to a full prefill."""
+        best = None
+        for key, entry in self._prefix_cache.items():
+            plen = entry[2]
+            if plen >= len(prompt) or (best is not None and plen <= best[1][2]):
+                continue
+            if list(key) == prompt[:len(key)]:
+                if plen + self._bucket(len(prompt) - plen) > self.max_len:
+                    continue
+                best = (key, entry)
+        if best is not None:
+            self._prefix_cache.move_to_end(best[0])  # LRU touch
+        return best
+
+    def _store_prefix(self, slot: int, prompt: List[int]) -> None:
+        """Cache the row's KV under the full prompt AND every power-of-two
+        boundary below it: two prompts sharing only a system prompt never
+        prefix each other wholly, but they match at block granularity —
+        the same reason paged prefix caches hash block-aligned chunks.
+        ``prefix_cache_size`` counts entries (a prompt inserts up to
+        log2(len) of them)."""
+        pl = len(prompt)
+        lens = {pl}
+        pb = 2
+        while pb < pl:
+            lens.add(pb)
+            pb <<= 1
+        # ascending, capped at capacity: the LONGEST prefixes insert last so
+        # LRU eviction discards the short (least valuable) entries first,
+        # and entries this very batch would evict are never extracted (each
+        # extraction is a real [L, Pb, H_kv, D] x2 device copy)
+        for plen in sorted(lens)[-self.prefix_cache_size:]:
+            key = tuple(prompt[:plen])
+            if key in self._prefix_cache:
+                self._prefix_cache.move_to_end(key)
+                continue
+            pk, pv = self._extract_prefix(self.cache, jnp.int32(slot),
+                                          self._bucket(plen))
+            self._prefix_cache[key] = (pk, pv, plen)
+        while len(self._prefix_cache) > self.prefix_cache_size:
+            self._prefix_cache.popitem(last=False)  # evict LRU; frees HBM
+
     def _admit(self) -> None:
         for slot in range(self.max_batch):
             if not self.queue:
@@ -292,20 +387,36 @@ class ServingEngine:
             if self.slots[slot] is not None:
                 continue
             req = self.queue.pop(0)
+            hit = self._match_prefix(req.prompt) if self._prefix_cache else None
+            if hit is not None:
+                pk, pv, plen = hit[1]
+                self.prefix_hits += 1
+                self.prefix_tokens_reused += plen
+                self.cache = self._restore_prefix(
+                    self.cache, pk, pv, jnp.int32(slot)
+                )
+                tail = req.prompt[plen:]
+            else:
+                plen, tail = 0, req.prompt
             tokens = jnp.asarray(
-                req.prompt + [0] * (self._bucket(len(req.prompt)) - len(req.prompt)),
-                jnp.int32,
+                tail + [0] * (self._bucket(len(tail)) - len(tail)), jnp.int32
             )[None, :]
             logits, self.cache = self._prefill(
-                self.params, self.cache, tokens, jnp.int32(slot)
+                self.params, self.cache, tokens, jnp.int32(slot),
+                jnp.int32(plen)
             )
             # the row's true length is the unpadded prompt (padded tail
             # positions are never attended: mask keys > length-1)
             self.cache = self.cache._replace(
                 lengths=self.cache.lengths.at[slot].set(len(req.prompt))
             )
+            if self.prefix_cache_size > 0:
+                # store even on a hit: the row now holds valid KV for the
+                # FULL prompt, so a future prompt extending it further can
+                # reuse more than the shorter cached entry
+                self._store_prefix(slot, req.prompt)
             self._on_prefill(slot, tokens, len(req.prompt))
-            tok = self._pick(logits[len(req.prompt) - 1])
+            tok = self._pick(logits[len(tail) - 1])
             self._emit(req, slot, tok)
             self.slots[slot] = None if req.done else req
 
@@ -405,6 +516,9 @@ class SpeculativeServingEngine(ServingEngine):
         if kw.get("mesh") is not None:
             raise ValueError("mesh serving of the speculative engine is not "
                              "wired yet; use the plain ServingEngine")
+        if kw.get("prefix_cache_size", 0) > 0:
+            raise ValueError("prefix caching isn't wired to the draft cache "
+                             "yet; use the plain ServingEngine")
         super().__init__(params, cfg, **kw)
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg

@@ -57,6 +57,14 @@ def main(argv=None) -> int:
                         "rounded to an even head_dim)")
     parser.add_argument("--gamma", type=int, default=4,
                         help="draft tokens proposed per verify round")
+    parser.add_argument("--prefix-cache", type=int, default=0,
+                        help="prompt prefix cache entries (0 = off): reuse "
+                        "the KV of cached prompt prefixes instead of "
+                        "re-prefilling them — the synthetic load then "
+                        "shares a system prompt so hits occur")
+    parser.add_argument("--system-prompt-len", type=int, default=24,
+                        help="shared prompt prefix length for the synthetic "
+                        "load (only with --prefix-cache)")
     parser.add_argument("--quantize", choices=["none", "int8"], default="none",
                         help="weight-only int8 serving (halves weight HBM "
                         "traffic; the engine's shared helpers dequantize "
@@ -64,6 +72,16 @@ def main(argv=None) -> int:
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
+    if args.prefix_cache > 0:
+        # synthetic prompts are system + up to 16 tokens; fail fast instead
+        # of letting a mid-run submit() raise past the engine guard
+        worst = args.system_prompt_len + 16 + args.max_new_tokens
+        if worst > args.max_len:
+            parser.error(
+                f"--system-prompt-len {args.system_prompt_len} + prompt tail "
+                f"(16) + --max-new-tokens {args.max_new_tokens} = {worst} "
+                f"exceeds --max-len {args.max_len}"
+            )
 
     common.init_all(logging.DEBUG if args.verbose else logging.INFO)
     import jax
@@ -110,7 +128,7 @@ def main(argv=None) -> int:
             max_batch=args.max_batch, max_len=args.max_len,
             temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
             eos_id=None if args.eos_id < 0 else args.eos_id, seed=args.seed,
-            mesh=mesh,
+            mesh=mesh, prefix_cache_size=args.prefix_cache,
         )
         if args.draft_layers > 0:
             from hivedscheduler_tpu.models.speculative import derive_draft_config
@@ -130,12 +148,17 @@ def main(argv=None) -> int:
         log.error("%s", e)
         return 1
     key = jax.random.PRNGKey(args.seed + 1)
+    system = []
+    if args.prefix_cache > 0 and args.system_prompt_len > 0:
+        key, ks = jax.random.split(key)
+        system = [int(t) for t in jax.random.randint(
+            ks, (args.system_prompt_len,), 0, cfg.vocab_size)]
     pending = []
     for i in range(args.requests):
         key, k1, k2, k3 = jax.random.split(key, 4)
         plen = int(jax.random.randint(k1, (), 2, 17))
         budget = int(jax.random.randint(k2, (), 4, args.max_new_tokens + 1))
-        prompt = [int(t) for t in jax.random.randint(
+        prompt = system + [int(t) for t in jax.random.randint(
             k3, (plen,), 0, cfg.vocab_size)]
         pending.append((prompt, budget))
 
@@ -168,6 +191,11 @@ def main(argv=None) -> int:
     if args.draft_layers > 0:
         log.info("speculation: %s/%s draft tokens accepted (%.0f%%)",
                  eng.accepted, eng.drafted, 100.0 * eng.acceptance)
+    if args.prefix_cache > 0:
+        log.info("prefix cache: %s hits, %s prompt tokens reused "
+                 "(%s entries held)",
+                 eng.prefix_hits, eng.prefix_tokens_reused,
+                 len(eng._prefix_cache))
     return 0
 
 
