@@ -289,7 +289,9 @@ class ServingEngine:
         from collections import OrderedDict
 
         self.prefix_cache_size = max(0, prefix_cache_size)
-        # prompt tuple -> (k [L, Pb, H_kv, D], v, true_len); Pb is the
+        # prompt tuple -> (payload, true_len); payload is whatever
+        # _prefix_extract returns ((k [L, Pb, H_kv, D], v) here; the
+        # speculative engine nests target+draft pairs); Pb is the
         # prompt's prefill bucket, so restores compile once per bucket
         self._prefix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self.prefix_hits = 0
@@ -341,8 +343,8 @@ class ServingEngine:
         tail that would clamp against max_len falls back to a full prefill."""
         best = None
         for key, entry in self._prefix_cache.items():
-            plen = entry[2]
-            if plen >= len(prompt) or (best is not None and plen <= best[1][2]):
+            plen = entry[1]
+            if plen >= len(prompt) or (best is not None and plen <= best[1][1]):
                 continue
             if list(key) == prompt[:len(key)]:
                 if plen + self._bucket(len(prompt) - plen) > self.max_len:
@@ -351,6 +353,16 @@ class ServingEngine:
         if best is not None:
             self._prefix_cache.move_to_end(best[0])  # LRU touch
         return best
+
+    def _prefix_extract(self, slot: int, pb: int):
+        """Copy slot ``slot``'s [0:pb] KV out as an opaque prefix payload
+        (subclasses with auxiliary caches extract those too)."""
+        return self._extract_prefix(self.cache, jnp.int32(slot), pb)
+
+    def _prefix_restore(self, slot: int, payload) -> None:
+        """Write a cached payload back into slot ``slot``."""
+        pk, pv = payload
+        self.cache = self._restore_prefix(self.cache, pk, pv, jnp.int32(slot))
 
     def _store_prefix(self, slot: int, prompt: List[int]) -> None:
         """Cache the row's KV under the full prompt AND every power-of-two
@@ -374,9 +386,8 @@ class ServingEngine:
             if key in self._prefix_cache:
                 self._prefix_cache.move_to_end(key)
                 continue
-            pk, pv = self._extract_prefix(self.cache, jnp.int32(slot),
-                                          self._bucket(plen))
-            self._prefix_cache[key] = (pk, pv, plen)
+            payload = self._prefix_extract(slot, self._bucket(plen))
+            self._prefix_cache[key] = (payload, plen)
         while len(self._prefix_cache) > self.prefix_cache_size:
             self._prefix_cache.popitem(last=False)  # evict LRU; frees HBM
 
@@ -389,12 +400,10 @@ class ServingEngine:
             req = self.queue.pop(0)
             hit = self._match_prefix(req.prompt) if self._prefix_cache else None
             if hit is not None:
-                pk, pv, plen = hit[1]
+                payload, plen = hit[1]
                 self.prefix_hits += 1
                 self.prefix_tokens_reused += plen
-                self.cache = self._restore_prefix(
-                    self.cache, pk, pv, jnp.int32(slot)
-                )
+                self._prefix_restore(slot, payload)
                 tail = req.prompt[plen:]
             else:
                 plen, tail = 0, req.prompt
@@ -410,19 +419,22 @@ class ServingEngine:
             self.cache = self.cache._replace(
                 lengths=self.cache.lengths.at[slot].set(len(req.prompt))
             )
+            self._on_prefill(slot, tokens, len(req.prompt), plen)
             if self.prefix_cache_size > 0:
                 # store even on a hit: the row now holds valid KV for the
                 # FULL prompt, so a future prompt extending it further can
-                # reuse more than the shorter cached entry
+                # reuse more than the shorter cached entry. Runs after
+                # _on_prefill so subclass caches are populated for extraction
                 self._store_prefix(slot, req.prompt)
-            self._on_prefill(slot, tokens, len(req.prompt))
             tok = self._pick(logits[len(tail) - 1])
             self._emit(req, slot, tok)
             self.slots[slot] = None if req.done else req
 
-    def _on_prefill(self, slot: int, tokens, prompt_len: int) -> None:
+    def _on_prefill(self, slot: int, tokens, prompt_len: int,
+                    start: int = 0) -> None:
         """Hook for subclasses that keep auxiliary per-slot state (the
-        speculative engine prefills its draft cache here)."""
+        speculative engine prefills its draft cache here). On a prefix-cache
+        hit ``tokens`` is the bucketed TAIL only and ``start`` its offset."""
 
     def _pick(self, logits_row) -> int:
         if self.temperature == 0.0:
@@ -516,9 +528,6 @@ class SpeculativeServingEngine(ServingEngine):
         if kw.get("mesh") is not None:
             raise ValueError("mesh serving of the speculative engine is not "
                              "wired yet; use the plain ServingEngine")
-        if kw.get("prefix_cache_size", 0) > 0:
-            raise ValueError("prefix caching isn't wired to the draft cache "
-                             "yet; use the plain ServingEngine")
         super().__init__(params, cfg, **kw)
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
@@ -528,9 +537,9 @@ class SpeculativeServingEngine(ServingEngine):
         self.drafted = 0
         self.accepted = 0
 
-        def draft_prefill(dparams, dcache, tokens, row):
+        def draft_prefill(dparams, dcache, tokens, row, start):
             _, dcache = advance_ragged(dparams, dcache, tokens, draft_cfg,
-                                       row=row)
+                                       row=row, start=start)
             return dcache
 
         def spec_round(tparams, dparams, tcache, dcache, last):
@@ -556,12 +565,29 @@ class SpeculativeServingEngine(ServingEngine):
         self._draft_prefill = jax.jit(draft_prefill, donate_argnums=(1,))
         self._spec_round = jax.jit(spec_round, donate_argnums=(2, 3))
 
-    def _on_prefill(self, slot: int, tokens, prompt_len: int) -> None:
+    def _on_prefill(self, slot: int, tokens, prompt_len: int,
+                    start: int = 0) -> None:
         self.draft_cache = self._draft_prefill(
-            self.draft_params, self.draft_cache, tokens, jnp.int32(slot)
+            self.draft_params, self.draft_cache, tokens, jnp.int32(slot),
+            jnp.int32(start)
         )
         self.draft_cache = self.draft_cache._replace(
             lengths=self.draft_cache.lengths.at[slot].set(prompt_len)
+        )
+
+    def _prefix_extract(self, slot: int, pb: int):
+        """Target AND draft KV travel together in one payload: a restored
+        prefix must leave both caches exactly as a full prefill would."""
+        return (
+            super()._prefix_extract(slot, pb),
+            self._extract_prefix(self.draft_cache, jnp.int32(slot), pb),
+        )
+
+    def _prefix_restore(self, slot: int, payload) -> None:
+        tgt, (dk, dv) = payload
+        super()._prefix_restore(slot, tgt)
+        self.draft_cache = self._restore_prefix(
+            self.draft_cache, dk, dv, jnp.int32(slot)
         )
 
     def submit(self, prompt, max_new_tokens: int) -> Request:

@@ -145,13 +145,25 @@ def test_staggered_mixed_traffic_exact(setup):
             assert eng.prefix_hits >= 4
 
 
-def test_speculative_engine_rejects_prefix_cache(setup):
+def test_speculative_engine_prefix_exact(setup):
+    """Prefix caching composes with speculative serving: the payload carries
+    target AND draft KV, so restored rows verify identically — the greedy
+    stream must equal the uncached speculative engine's (itself pinned
+    bit-exact to vanilla greedy by test_serving_speculative)."""
     cfg, params = setup
     from hivedscheduler_tpu.models.serving import SpeculativeServingEngine
 
     dcfg = tiny_cfg(n_layers=1)
     dparams = tm.cast_params(tm.init_params(dcfg, jax.random.PRNGKey(1)),
                              dcfg.dtype)
-    with pytest.raises(ValueError, match="prefix caching"):
-        SpeculativeServingEngine(params, cfg, dparams, dcfg,
-                                 prefix_cache_size=2)
+    prompts = [SYSTEM + [7, 8], SYSTEM + [9], SYSTEM + [7, 8, 3]]
+    outs = {}
+    for size in (0, 16):
+        eng = SpeculativeServingEngine(params, cfg, dparams, dcfg, gamma=3,
+                                       max_batch=2, max_len=96,
+                                       prefix_cache_size=size)
+        reqs = [eng.submit(p, 6) for p in prompts]
+        eng.run_until_drained()
+        outs[size] = [r.tokens_out for r in reqs]
+    assert outs[16] == outs[0]
+    assert eng.prefix_hits == 2
