@@ -244,6 +244,7 @@ class ServingEngine:
         # (per-slot device scatters would cost B dispatches per step)
         self._last_host = [0] * max_batch
         self._token_sharding = None
+        self._len_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
@@ -263,10 +264,12 @@ class ServingEngine:
             )
             row = ("dp", "fsdp")
             kv_sh = NamedSharding(mesh, P(None, row, None, "tp", None))
+            self._len_sharding = NamedSharding(mesh, P(row))
             self.cache = jax.device_put(self.cache, RaggedCache(
-                k=kv_sh, v=kv_sh, lengths=NamedSharding(mesh, P(row)),
+                k=kv_sh, v=kv_sh, lengths=self._len_sharding,
             ))
             self._token_sharding = NamedSharding(mesh, P(row))
+        self.mesh = mesh
         self.queue: List[Request] = []
         self._next_rid = 0
         self.steps = 0  # decode steps executed (for occupancy stats)
@@ -525,15 +528,33 @@ class SpeculativeServingEngine(ServingEngine):
             raise ValueError("target and draft vocabs must match")
         if gamma < 1:
             raise ValueError(f"gamma must be >= 1, got {gamma}")
-        if kw.get("mesh") is not None:
-            raise ValueError("mesh serving of the speculative engine is not "
-                             "wired yet; use the plain ServingEngine")
         super().__init__(params, cfg, **kw)
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.gamma = gamma
         self.draft_cache = init_ragged_cache(draft_cfg, self.max_batch,
                                              self.max_len)
+        if self.mesh is not None:
+            # one shared policy with make_sharded_speculative (see
+            # draft_serving_shardings for the shard-vs-replicate trade-off).
+            # Cache rows always shard over dp; the kv-head axis only when
+            # the draft itself shards.
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from hivedscheduler_tpu.models.speculative import (
+                draft_serving_shardings,
+            )
+
+            dsh, sharded = draft_serving_shardings(draft_cfg, self.mesh)
+            head_ax = "tp" if sharded else None
+            self.draft_params = jax.device_put(draft_params, dsh)
+            dkv_sh = NamedSharding(
+                self.mesh, P(None, ("dp", "fsdp"), None, head_ax, None)
+            )
+            self.draft_cache = jax.device_put(self.draft_cache, RaggedCache(
+                k=dkv_sh, v=dkv_sh, lengths=self._len_sharding,
+            ))
         self.drafted = 0
         self.accepted = 0
 
@@ -606,6 +627,8 @@ class SpeculativeServingEngine(ServingEngine):
         active = [s for s in range(self.max_batch) if self.slots[s] is not None]
         if active:
             last = jnp.asarray(self._last_host, jnp.int32)
+            if self._token_sharding is not None:
+                last = jax.device_put(last, self._token_sharding)
             lengths_before = jax.device_get(self.cache.lengths)
             self.cache, self.draft_cache, props_d, emit_d = self._spec_round(
                 self.params, self.draft_params, self.cache, self.draft_cache,
@@ -636,10 +659,15 @@ class SpeculativeServingEngine(ServingEngine):
                     self.slots[slot] = None
             # two distinct buffers: both caches are donated to the next
             # round, and donating one shared lengths array twice is an error
-            self.cache = self.cache._replace(
-                lengths=jnp.array(new_len, jnp.int32))
+            def upload(arr):
+                arr = jnp.array(arr, jnp.int32)
+                if self._len_sharding is not None:
+                    arr = jax.device_put(arr, self._len_sharding)
+                return arr
+
+            self.cache = self.cache._replace(lengths=upload(new_len))
             self.draft_cache = self.draft_cache._replace(
-                lengths=jnp.array(new_len, jnp.int32))
+                lengths=upload(new_len))
         return bool(self.queue) or any(s is not None for s in self.slots)
 
     @property

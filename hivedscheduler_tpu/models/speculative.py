@@ -235,6 +235,29 @@ def generate_speculative(
     return buf[:, :max_new_tokens].astype(prompt.dtype), stats
 
 
+def draft_serving_shardings(draft_cfg, mesh):
+    """The one home of the draft shard-or-replicate policy: the (small)
+    draft shards tensor-parallel when its head counts divide tp and is
+    replicated otherwise — a replicated draft costs its tiny weights per
+    device but keeps every draft step collective-free (a sharded draft pays
+    GSPMD all-reduces per step like any tp model). Returns
+    (shardings, sharded: bool)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from hivedscheduler_tpu.models import transformer as tm
+    from hivedscheduler_tpu.models.decode import serving_shardings
+
+    shardings = serving_shardings(draft_cfg, mesh, require=False)
+    if shardings is not None:
+        return shardings, True
+    replicated = NamedSharding(mesh, P())
+    return jax.tree.map(
+        lambda spec: replicated, tm.sharding_specs(draft_cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    ), False
+
+
 def make_sharded_speculative(
     target_cfg: TransformerConfig,
     draft_cfg: TransformerConfig,
@@ -267,13 +290,7 @@ def make_sharded_speculative(
     target_shardings = serving_shardings(
         target_cfg, mesh, quantized=quantized_target
     )
-    draft_shardings = serving_shardings(draft_cfg, mesh, require=False)
-    if draft_shardings is None:
-        replicated = NamedSharding(mesh, P())
-        draft_shardings = jax.tree.map(
-            lambda spec: replicated, tm.sharding_specs(draft_cfg),
-            is_leaf=lambda x: isinstance(x, P),
-        )
+    draft_shardings, _ = draft_serving_shardings(draft_cfg, mesh)
     prompt_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
 
     run = functools.partial(

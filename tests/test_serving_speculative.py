@@ -130,6 +130,38 @@ class TestSpeculativeServing:
             assert req.done
             assert req.tokens_out == vanilla(params, cfg, p, n), req.rid
 
+    def test_mesh_sharded_engine_exact(self, setup):
+        """Speculative serving over a dp x tp mesh: the target shards
+        tensor-parallel, the draft shards when its kv heads divide tp
+        (tp=1 here) and is replicated otherwise (tp=2: draft kv 1 % 2) —
+        greedy streams must equal vanilla decode either way."""
+        cfg, params, dft_cfg, dft_params = setup
+        from hivedscheduler_tpu.parallel import topology
+
+        # a draft whose kv heads DO divide tp=2, so the genuinely
+        # tensor-parallel draft branch (sharded params + tp-sharded draft
+        # cache head axis) runs, not just the replicated fallback
+        tp_dft_cfg = cfg_of(d_model=32, n_heads=2, n_kv_heads=2, d_ff=64)
+        tp_dft_params = tm.init_params(tp_dft_cfg, jax.random.PRNGKey(8))
+
+        prompts = [[5, 9, 2], [17, 3, 88, 41, 7], [1]]
+        budgets = [6, 4, 7]
+        for tp, dcfg, dparams in (
+            (1, dft_cfg, dft_params),        # trivially sharded
+            (2, dft_cfg, dft_params),        # kv 1 % 2 -> replicated draft
+            (2, tp_dft_cfg, tp_dft_params),  # kv 2 % 2 -> tp-sharded draft
+        ):
+            axes = topology.MeshAxes(dp=2, tp=tp)
+            mesh = topology.make_mesh(axes, jax.devices("cpu")[:axes.size])
+            eng = serving.SpeculativeServingEngine(
+                params, cfg, dparams, dcfg, gamma=3, max_batch=2,
+                max_len=64, mesh=mesh,
+            )
+            reqs = [eng.submit(p, n) for p, n in zip(prompts, budgets)]
+            eng.run_until_drained()
+            for req, p, n in zip(reqs, prompts, budgets):
+                assert req.tokens_out == vanilla(params, cfg, p, n), (tp, req.rid)
+
     def test_validation(self, setup):
         cfg, params, dft_cfg, dft_params = setup
         with pytest.raises(ValueError, match="greedy"):
