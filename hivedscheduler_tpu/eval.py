@@ -1,0 +1,161 @@
+"""Held-out evaluation: token-level loss and perplexity of a checkpoint.
+
+Completes the workload triad (train / eval / generate-serve). Unlike
+training's random windows, evaluation walks the corpus in SEQUENTIAL
+non-overlapping windows, so two runs over the same file agree bit-for-bit
+(each window scores batch x (seq_len - 1) positions — the row-leading
+tokens have no preceding context and are not targets):
+
+    python -m hivedscheduler_tpu.eval --checkpoint-dir /ckpt/run1 \
+        --data heldout.bin --tp 2 --sp 2
+
+Model/mesh flags mirror ``hivedscheduler_tpu.train``; the forward runs
+under the same shardings via ``parallel.train.make_sharded_eval_step``
+(MoE training regularizers excluded — the reported loss is pure next-token
+cross-entropy, so perplexity is ``exp(loss)``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import math
+import sys
+import time
+
+from hivedscheduler_tpu.common import utils as common
+
+log = logging.getLogger(__name__)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tpu-hive-eval")
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=512)
+    parser.add_argument("--vocab-size", type=int, default=32000)
+    parser.add_argument("--d-model", type=int, default=512)
+    parser.add_argument("--n-layers", type=int, default=8)
+    parser.add_argument("--n-heads", type=int, default=8)
+    parser.add_argument("--n-kv-heads", type=int, default=0)
+    parser.add_argument("--d-ff", type=int, default=1408)
+    parser.add_argument("--n-experts", type=int, default=0)
+    parser.add_argument("--moe-top-k", type=int, default=1)
+    parser.add_argument("--attn", default=None,
+                        help="xla|flash|ring|ring_flash|ring_zigzag|"
+                             "ring_zigzag_flash|ulysses "
+                             "(default: ring when sp>1)")
+    parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--sp", type=int, default=1)
+    parser.add_argument("--fsdp", type=int, default=1)
+    parser.add_argument("--ep", type=int, default=1)
+    parser.add_argument("--ce-chunk", type=int, default=0,
+                        help="chunked cross-entropy (as in train)")
+    parser.add_argument("--data", default="",
+                        help="packed token file; synthetic corpus when "
+                             "omitted (smoke only — perplexity of random "
+                             "tokens is ~vocab size)")
+    parser.add_argument("--data-dtype", default="uint16",
+                        choices=["uint16", "uint32"])
+    parser.add_argument("--max-steps", type=int, default=0,
+                        help="cap evaluated windows (0 = whole corpus)")
+    parser.add_argument("--checkpoint-dir", default="",
+                        help="checkpoint to evaluate (random init when "
+                             "omitted — smoke only)")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    common.init_all(logging.DEBUG if args.verbose else logging.INFO)
+
+    from hivedscheduler_tpu.parallel.distributed import initialize_from_gang
+
+    rank, world = initialize_from_gang()
+
+    import jax
+    import numpy as np
+
+    from hivedscheduler_tpu.models import transformer as tm
+    from hivedscheduler_tpu.parallel import checkpoint as ckpt
+    from hivedscheduler_tpu.parallel import data as data_lib
+    from hivedscheduler_tpu.parallel import topology
+    from hivedscheduler_tpu.parallel.train import make_sharded_eval_step
+
+    n_devices = len(jax.devices())
+    axes = topology.infer_axes(n_devices, tp=args.tp, sp=args.sp,
+                               fsdp=args.fsdp, ep=args.ep)
+    mesh = topology.make_mesh(axes)
+    log.info("rank %s/%s: %s devices, mesh %s", rank, world, n_devices, axes)
+
+    cfg = tm.TransformerConfig(
+        vocab_size=args.vocab_size,
+        d_model=args.d_model,
+        n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
+        n_layers=args.n_layers,
+        d_ff=args.d_ff,
+        max_seq_len=args.seq_len,
+        attn_impl=args.attn or ("ring" if axes.sp > 1 else "xla"),
+        n_experts=args.n_experts,
+        moe_top_k=args.moe_top_k,
+    )
+    eval_step, init_fn, token_sharding = make_sharded_eval_step(
+        cfg, mesh, ce_chunk=args.ce_chunk
+    )
+    params = init_fn(jax.random.PRNGKey(0))
+    if args.checkpoint_dir:
+        step, params = ckpt.restore_params(args.checkpoint_dir, params)
+        log.info("restored params from step %s", step)
+    else:
+        log.warning("no --checkpoint-dir: evaluating RANDOM init (smoke)")
+
+    if args.data:
+        dataset = data_lib.TokenFileDataset(args.data, dtype=args.data_dtype)
+    else:
+        dataset = data_lib.synthetic_dataset(cfg.vocab_size)
+    corpus = dataset.tokens
+    window = args.batch * args.seq_len
+    n_steps = len(corpus) // window
+    if args.max_steps > 0:
+        n_steps = min(n_steps, args.max_steps)
+    if n_steps == 0:
+        log.error("corpus too small: %s tokens < one %s-token batch window",
+                  len(corpus), window)
+        return 1
+    # multi-host: device_put_global takes each process's LOCAL rows (same
+    # contract as the train CLI's host_batches)
+    proc, n_proc = jax.process_index(), jax.process_count()
+    if args.batch % n_proc:
+        log.error("--batch %s must divide the process count %s",
+                  args.batch, n_proc)
+        return 1
+    rows = args.batch // n_proc
+
+    t0 = time.perf_counter()
+    # accumulate on device; one host sync at the end (float() per window
+    # would serialize batch prep with device compute)
+    total_loss = None
+    for i in range(n_steps):
+        batch_np = np.asarray(
+            corpus[i * window: (i + 1) * window], dtype=np.int32
+        ).reshape(args.batch, args.seq_len)[proc * rows: (proc + 1) * rows]
+        tokens = data_lib.device_put_global(batch_np, token_sharding,
+                                            args.batch)
+        step_loss = eval_step(params, tokens)
+        total_loss = step_loss if total_loss is None else total_loss + step_loss
+        if args.verbose and (i + 1) % 10 == 0:
+            log.info("window %s/%s running loss %.4f", i + 1, n_steps,
+                     float(total_loss) / (i + 1))
+    dt = time.perf_counter() - t0
+    # every window contributes batch*(seq-1) scored positions, so the mean
+    # of per-window means IS the corpus token-level mean over scored targets
+    loss = float(total_loss) / n_steps
+    ppl = math.exp(min(loss, 30.0))
+    log.info(
+        "%s windows (%s tokens) in %.2fs (%.0f tok/s)",
+        n_steps, n_steps * window, dt, n_steps * window / max(dt, 1e-9),
+    )
+    print(f"loss {loss:.4f}  perplexity {ppl:.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

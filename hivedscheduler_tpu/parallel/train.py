@@ -26,7 +26,7 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01) -> optax.Gradie
 
 
 def loss_fn(params, tokens, cfg: tm.TransformerConfig, mesh=None,
-            ce_chunk: int = 0) -> jax.Array:
+            ce_chunk: int = 0, include_aux: bool = True) -> jax.Array:
     """Next-token LM loss (+ Switch load-balancing aux for MoE models):
     predict tokens[:, 1:] from tokens[:, :-1] with a full-length forward
     (keeps sequence sharding uniform).
@@ -77,9 +77,11 @@ def loss_fn(params, tokens, cfg: tm.TransformerConfig, mesh=None,
             jax.checkpoint(chunk_ce), jnp.zeros(()), chunks
         )
         loss = total / jnp.sum(mask)
-    if cfg.n_experts > 0:
+    if cfg.n_experts > 0 and include_aux:
         # moe_aux arrives pre-weighted per layer (load-balance + router
-        # z-loss, each with its own configured weight)
+        # z-loss, each with its own configured weight); held-out evaluation
+        # excludes these training regularizers (include_aux=False) so
+        # perplexity is exp(pure LM loss)
         loss = loss + moe_aux
     return loss
 
@@ -130,6 +132,25 @@ def train_step(params, opt_state, tokens, cfg: tm.TransformerConfig, optimizer,
     return params, opt_state, loss
 
 
+def _shardings(cfg: tm.TransformerConfig, mesh):
+    """(param_shardings, token_sharding) for `cfg` over `mesh` — the one
+    home of the sharding setup shared by the train/eval step factories."""
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tp = mesh_shape.get("tp", 1)
+    if cfg.n_heads % tp or cfg.kv_heads % tp:
+        # fail here with a clear message instead of deep inside pjit when
+        # the head axis of wq/wk/wv cannot shard evenly
+        raise ValueError(
+            f"head counts must divide the tp axis: n_heads={cfg.n_heads}, "
+            f"kv_heads={cfg.kv_heads}, tp={tp}"
+        )
+    param_shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), tm.sharding_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return param_shardings, NamedSharding(mesh, tm.activation_spec())
+
+
 def make_sharded_train_step(
     cfg: tm.TransformerConfig,
     mesh,
@@ -146,21 +167,7 @@ def make_sharded_train_step(
     batch into that many gradient-accumulation slices (see train_step).
     """
     optimizer = optimizer or make_optimizer()
-    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-    tp = mesh_shape.get("tp", 1)
-    if cfg.n_heads % tp or cfg.kv_heads % tp:
-        # fail here with a clear message instead of deep inside pjit when
-        # the head axis of wq/wk/wv cannot shard evenly
-        raise ValueError(
-            f"head counts must divide the tp axis: n_heads={cfg.n_heads}, "
-            f"kv_heads={cfg.kv_heads}, tp={tp}"
-        )
-    param_specs = tm.sharding_specs(cfg)
-    param_shardings = jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec), param_specs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
-    token_sharding = NamedSharding(mesh, tm.activation_spec())
+    param_shardings, token_sharding = _shardings(cfg, mesh)
 
     def init_fn(key: jax.Array):
         init = jax.jit(
@@ -198,6 +205,27 @@ def make_sharded_train_step(
     return jitted, init_fn, token_sharding
 
 
+def make_sharded_eval_step(cfg: tm.TransformerConfig, mesh, ce_chunk: int = 0):
+    """Forward-only LM loss under the training shardings — held-out
+    evaluation (the ``eval`` CLI). Returns (jitted_eval, init_fn,
+    token_sharding): ``init_fn(key) -> params`` placed per the sharding
+    specs (a checkpoint-restore template), ``jitted_eval(params, tokens) ->
+    mean next-token CE`` excluding MoE training regularizers, so
+    ``exp(loss)`` is the model's perplexity."""
+    param_shardings, token_sharding = _shardings(cfg, mesh)
+
+    def init_fn(key: jax.Array):
+        return jax.jit(
+            lambda k: tm.init_params(cfg, k), out_shardings=param_shardings
+        )(key)
+
+    def eval_step(params, tokens):
+        return loss_fn(params, tokens, cfg, mesh, ce_chunk=ce_chunk,
+                       include_aux=False)
+
+    return jax.jit(eval_step), init_fn, token_sharding
+
+
 def make_sharded_lora_train_step(
     cfg: tm.TransformerConfig,
     mesh,
@@ -218,12 +246,7 @@ def make_sharded_lora_train_step(
     and exactness argument as ``train_step``)."""
     assert cfg.lora_rank > 0, "set cfg.lora_rank to use the LoRA step"
     optimizer = optimizer or make_optimizer()
-    param_specs = tm.sharding_specs(cfg)
-    param_shardings = jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec), param_specs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
-    token_sharding = NamedSharding(mesh, tm.activation_spec())
+    param_shardings, token_sharding = _shardings(cfg, mesh)
 
     def init_fn(key: jax.Array):
         init = jax.jit(
