@@ -1505,6 +1505,28 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     log.warning("[%s]: Cannot find virtual cell: %s",
                                 internal_utils.key(pod), message)
                     return p_leaf_cell, None, True
+                if v_leaf_cell.vc != s.virtual_cluster:
+                    # map_physical_cell_to_virtual returns an existing leaf
+                    # binding verbatim (reference: mapPhysicalCellToVirtual,
+                    # cell_allocation.go:320-346, which corrupts
+                    # vcFreeCellNum at hived_algorithm.go:1356-1427 via Go
+                    # map auto-vivification); when an ANOTHER-VC doomed-bad binding
+                    # survived the reclaim guard above (its held cell already
+                    # hosts guaranteed users, so reclaiming is illegal), that
+                    # binding belongs to the wrong VC and allocating through
+                    # it would charge this pod to the other VC's books
+                    # (deviation documented in PARITY.md, found by the
+                    # multi-chain invariant fuzz). Tolerance ladder: no
+                    # usable virtual placement -> lazy preempt.
+                    log.warning(
+                        "[%s]: Recovered leaf %s maps to virtual cell %s of "
+                        "VC %s, not this pod's VC %s (cross-VC doomed-bad "
+                        "binding); lazy-preempting the group",
+                        internal_utils.key(pod), p_leaf_cell.address,
+                        v_leaf_cell.address, v_leaf_cell.vc,
+                        s.virtual_cluster,
+                    )
+                    return p_leaf_cell, None, True
                 # Recovery starts with every uninformed node bad, so
                 # init-time doomed-bad binds can sit exactly where a
                 # replayed pod must allocate — either holding the pod's own

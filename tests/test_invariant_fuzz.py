@@ -59,8 +59,10 @@ def _mute_algorithm_logs():
 
 
 def build_config() -> Config:
-    """A v5p-64 mesh chain (4x4x4, 2x2x1 hosts) + a generic 16-chip chain,
-    three VCs with mixed quotas."""
+    """A v5p-64 mesh chain (4x4x4, 2x2x1 hosts) + a second, smaller v5p-32
+    chain of the SAME chip type (so oversize gangs exercise multi-chain
+    relaxation under fuzz) + a generic 16-chip chain, three VCs with mixed
+    quotas."""
     mesh = MeshSpec(
         topology=(4, 4, 4), chip_type="v5p-chip", host_shape=(2, 2, 1),
         levels=[
@@ -69,6 +71,15 @@ def build_config() -> Config:
             MeshLevelSpec(name="v5p-4x2x2", shape=(4, 2, 2)),
             MeshLevelSpec(name="v5p-4x4x2", shape=(4, 4, 2)),
             MeshLevelSpec(name="v5p-4x4x4", shape=(4, 4, 4)),
+        ],
+    )
+    mesh_b = MeshSpec(
+        topology=(4, 4, 2), chip_type="v5p-chip", host_shape=(2, 2, 1),
+        levels=[
+            MeshLevelSpec(name="v5p32-2x2x1", shape=(2, 2, 1)),
+            MeshLevelSpec(name="v5p32-2x2x2", shape=(2, 2, 2)),
+            MeshLevelSpec(name="v5p32-4x2x2", shape=(4, 2, 2)),
+            MeshLevelSpec(name="v5p32-4x4x2", shape=(4, 4, 2)),
         ],
     )
     generic = CellTypeSpec(
@@ -81,17 +92,20 @@ def build_config() -> Config:
         physical_cluster=PhysicalClusterSpec(
             cell_types={
                 "v5p-64": CellTypeSpec(mesh=mesh),
+                "v5p-32": CellTypeSpec(mesh=mesh_b),
                 "v4-pool": generic,
                 "v4-node": v4_node,
             },
             physical_cells=[
                 PhysicalCellSpec(cell_type="v5p-64", cell_address="pod0"),
+                PhysicalCellSpec(cell_type="v5p-32", cell_address="pod1"),
                 PhysicalCellSpec(cell_type="v4-pool", cell_address="pool0"),
             ],
         ),
         virtual_clusters={
             "vc-a": VirtualClusterSpec(virtual_cells=[
                 VirtualCellSpec(cell_number=1, cell_type="v5p-64.v5p-4x4x2"),
+                VirtualCellSpec(cell_number=1, cell_type="v5p-32.v5p32-4x2x2"),
                 VirtualCellSpec(cell_number=2, cell_type="v4-pool.v4-node"),
             ]),
             "vc-b": VirtualClusterSpec(virtual_cells=[
@@ -140,7 +154,12 @@ class Harness:
         vc = rng.choice(["vc-a", "vc-b", "vc-c"])
         prio = rng.choice([-1, -1, 0, 1, 5, 10])
         leaf_type = rng.choice(["v5p-chip", "v5p-chip", "v4-chip"])
-        pods, chips = rng.choice([(1, 1), (1, 2), (1, 4), (2, 4), (4, 4), (2, 8)])
+        # (12, 4) = 48 chips exceeds vc-a's per-chain v5p quota (32 on
+        # the big chain + 16 on the small one), so a GUARANTEED vc-a draw
+        # can only be satisfied by a multi-chain-relaxed split; other draws
+        # exercise the rejection/opportunistic paths
+        pods, chips = rng.choice([(1, 1), (1, 2), (1, 4), (2, 4), (4, 4),
+                                  (2, 8), (8, 4), (12, 4)])
         name = f"g{self.gid}"
         self.gid += 1
         spec = {
