@@ -360,10 +360,13 @@ def _group_view(algo, with_lazy):
     return out
 
 
-def _replay(h):
-    """The runtime's recovery barrier: fresh algorithm, healthy nodes
-    informed, every bound pod replayed from its annotations."""
-    fresh = HivedAlgorithm(build_config())
+def _replay(h, config=None):
+    """The runtime's recovery barrier: fresh algorithm (optionally built
+    from a reconfigured ``config``), healthy nodes informed, every bound
+    pod replayed from its annotations. A node unknown to the new config
+    (decommissioned chain) is a silent add_node no-op, matching the
+    runtime's informer behavior."""
+    fresh = HivedAlgorithm(config if config is not None else build_config())
     for n in h.nodes:
         if n not in h.bad_nodes:
             fresh.add_node(Node(name=n))
@@ -412,3 +415,91 @@ def test_recovery_replay_under_bad_nodes(seed):
     fresh, h2 = _replay(h)
     h2.check_invariants(f"seed {seed} after replay")
     assert _group_view(fresh, with_lazy=False) == before
+
+
+def _mutated_config(kind: str) -> Config:
+    """A config that differs from build_config() the way production
+    reconfigurations do (the reference's testReconfiguration family,
+    hived_algorithm_test.go:1042-1092, at fuzz scale)."""
+    cfg = build_config()
+    if kind == "drop_chain":
+        # the v5p-32 chain is decommissioned: its physical cell and every
+        # VC quota on it disappear
+        cfg.physical_cluster.physical_cells = [
+            pc for pc in cfg.physical_cluster.physical_cells
+            if pc.cell_type != "v5p-32"
+        ]
+        del cfg.physical_cluster.cell_types["v5p-32"]
+        for vc in cfg.virtual_clusters.values():
+            vc.virtual_cells = [
+                v for v in vc.virtual_cells
+                if not v.cell_type.startswith("v5p-32.")
+            ]
+    elif kind == "shrink_vc":
+        # vc-b loses half its quota
+        for v in cfg.virtual_clusters["vc-b"].virtual_cells:
+            if v.cell_type == "v5p-64.v5p-2x2x2":
+                v.cell_number = 1
+    elif kind == "swap_quota":
+        # vc-c's v5p quota moves to vc-b (same physical capacity)
+        cfg.virtual_clusters["vc-c"].virtual_cells = [
+            v for v in cfg.virtual_clusters["vc-c"].virtual_cells
+            if v.cell_type != "v5p-64.v5p-2x2x1"
+        ]
+        cfg.virtual_clusters["vc-b"].virtual_cells.append(
+            VirtualCellSpec(cell_number=2, cell_type="v5p-64.v5p-2x2x1")
+        )
+    else:
+        raise AssertionError(kind)
+    # no second new_config(): address inference is not idempotent (it would
+    # re-prefix the generic chain's already-inferred addresses), and the
+    # mutations above only touch fields defaulting never derives from
+    return cfg
+
+
+@pytest.mark.parametrize("kind", ["drop_chain", "shrink_vc", "swap_quota"])
+@pytest.mark.parametrize("seed", [0, 3])
+def test_reconfig_replay_fuzz(seed, kind):
+    """Work-preserving reconfiguration at fuzz scale: run random churn,
+    then replay every bound pod into an algorithm built from a MUTATED
+    config (dropped chain / shrunk VC / quota moved between VCs). The
+    tolerance ladder must absorb every inconsistency — placements on
+    vanished chains are ignored or cross-chain-recovered, unsafe or
+    unmappable placements lazy-preempt — and the books must be consistent
+    afterwards. No panic, no silent corruption."""
+    h = Harness(seed)
+    for i in range(150):
+        h.rng.choice(
+            [h.op_schedule_gang, h.op_schedule_gang, h.op_schedule_gang,
+             h.op_delete_gang, h.op_flip_node]
+        )()
+    fresh, h2 = _replay(h, config=_mutated_config(kind))
+    h2.check_invariants(f"seed {seed} kind {kind} after reconfig replay")
+    # every replayed pod must be ABSORBED (registered in its group's slots)
+    # — the ladder may demote or ignore placements, never lose pods
+    absorbed = sum(
+        sum(1 for pods in g.allocated_pods.values()
+            for p in pods if p is not None)
+        for g in fresh.affinity_groups.values()
+    )
+    assert absorbed == sum(len(pods) for pods in h.groups.values())
+    # deleting everything must restore the mutated config's PRISTINE state
+    # (the testDeletePods invariant against a freshly built instance)
+    for name in sorted(h.groups):
+        for bp in h.groups[name]:
+            if name in fresh.affinity_groups:
+                fresh.delete_allocated_pod(bp)
+    h2.check_invariants(f"seed {seed} kind {kind} after full delete")
+    # heal everything before the pristine comparison: doomed-bad binding
+    # choices are path-dependent, so only the all-healthy end state is
+    # deterministic (same reason test_full_delete_restores_pristine_state
+    # heals first)
+    empty = Harness.__new__(Harness)
+    empty.algo = HivedAlgorithm(_mutated_config(kind))
+    for algo in (fresh, empty.algo):
+        for n in h.nodes:
+            algo.add_node(Node(name=n))  # unknown (dropped-chain) = no-op
+    assert h2.snapshot() == empty.snapshot(), (
+        f"seed {seed} kind {kind}: state after full delete differs from a "
+        f"pristine mutated-config instance"
+    )
