@@ -156,14 +156,21 @@ class TestServingEngine:
 
         cfg, params = setup
         rng = random.Random(11)
-        for trial in range(2):
-            eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64)
+        shared = [rng.randrange(1, cfg.vocab_size) for _ in range(12)]
+        for trial in range(3):
+            # trials cover the prefix cache off, tiny (evicting) and ample;
+            # roughly half the prompts extend a shared prefix so hits occur
+            eng = serving.ServingEngine(
+                params, cfg, max_batch=2, max_len=64,
+                prefix_cache_size=(0, 3, 16)[trial],
+            )
             plan = []  # (submit_at_step, prompt, budget)
             for i in range(5):
+                base = shared[:rng.randrange(4, 13)] if rng.random() < 0.5 else []
                 plan.append((
                     rng.randrange(0, 12),
-                    [rng.randrange(1, cfg.vocab_size) for _ in
-                     range(rng.randrange(1, 9))],
+                    base + [rng.randrange(1, cfg.vocab_size) for _ in
+                            range(rng.randrange(1, 9))],
                     rng.randrange(1, 7),
                 ))
             plan.sort(key=lambda t: t[0])
@@ -181,6 +188,11 @@ class TestServingEngine:
                 assert req.done, (trial, req.rid)
                 assert req.tokens_out == vanilla(params, cfg, p, n), (
                     trial, req.rid)
+            if trial > 0:
+                # the cached trials must actually exercise the prefix path
+                # (seed-11 draws guarantee shared-base prompts), else a
+                # silent matching regression degrades them to no-cache runs
+                assert eng.prefix_hits > 0, trial
 
     def test_prefill_bucketing_bounds_compiles(self, setup):
         cfg, params = setup
