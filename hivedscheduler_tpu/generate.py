@@ -101,28 +101,23 @@ def main(argv=None) -> int:
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,
     )
-    init_cfg = cfg
-    if args.lora_rank > 0:
-        import dataclasses
+    from hivedscheduler_tpu.parallel import checkpoint as ckpt
 
-        init_cfg = dataclasses.replace(
-            cfg, lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
+    # params-only restore (restore_serving_params): inference needs no
+    # optimizer moments, and a LoRA run's adapter-only optimizer tree
+    # wouldn't match anyway; adapters merge into the base at load
+    try:
+        params, step = ckpt.restore_serving_params(
+            cfg, args.checkpoint_dir, jax.random.PRNGKey(args.seed),
+            lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
             lora_mlp=args.lora_mlp,
         )
-    params = tm.init_params(init_cfg, jax.random.PRNGKey(args.seed))
-    if args.checkpoint_dir:
-        from hivedscheduler_tpu.parallel import checkpoint as ckpt
-
-        # params-only restore: inference needs no optimizer moments, and a
-        # LoRA run's adapter-only optimizer tree wouldn't match anyway
-        try:
-            step, params = ckpt.restore_params(args.checkpoint_dir, params)
-        except FileNotFoundError as e:
-            log.error("%s", e)
-            return 1
+    except FileNotFoundError as e:
+        log.error("%s", e)
+        return 1
+    if step is not None:
         log.info("restored params from step %s", step)
     if args.lora_rank > 0:
-        params = tm.merge_lora(params, init_cfg)
         log.info("merged rank-%s LoRA adapters into the base weights",
                  args.lora_rank)
     quantized = args.quantize == "int8"

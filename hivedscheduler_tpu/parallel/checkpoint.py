@@ -87,6 +87,39 @@ def restore_params(
     return step, params
 
 
+def restore_serving_params(
+    cfg: Any,
+    checkpoint_dir: str,
+    key: jax.Array,
+    *,
+    lora_rank: int = 0,
+    lora_alpha: float = 16.0,
+    lora_mlp: bool = False,
+) -> Tuple[Any, Optional[int]]:
+    """The generate/serve CLIs' one shared loading path: init a param tree
+    (LoRA-shaped when ``lora_rank > 0`` so a fine-tune checkpoint restores),
+    restore from ``checkpoint_dir`` when given, then merge the adapters into
+    the base weights for serving. Returns (params, restored_step_or_None);
+    raises FileNotFoundError like :func:`restore_params`."""
+    import dataclasses
+
+    from hivedscheduler_tpu.models import transformer as tm
+
+    init_cfg = cfg
+    if lora_rank > 0:
+        init_cfg = dataclasses.replace(
+            cfg, lora_rank=lora_rank, lora_alpha=lora_alpha,
+            lora_mlp=lora_mlp,
+        )
+    params = tm.init_params(init_cfg, key)
+    step = None
+    if checkpoint_dir:
+        step, params = restore_params(checkpoint_dir, params)
+    if lora_rank > 0:
+        params = tm.merge_lora(params, init_cfg)
+    return params, step
+
+
 def restore(
     directory: str,
     params_template: Any,

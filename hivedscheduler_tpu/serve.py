@@ -69,6 +69,13 @@ def main(argv=None) -> int:
                         help="weight-only int8 serving (halves weight HBM "
                         "traffic; the engine's shared helpers dequantize "
                         "into the consuming einsums)")
+    parser.add_argument("--lora-rank", type=int, default=0,
+                        help="serve a LoRA fine-tune checkpoint: adapters "
+                        "are merged into the base weights at load (as in "
+                        "generate.py)")
+    parser.add_argument("--lora-alpha", type=float, default=16.0)
+    parser.add_argument("--lora-mlp", action="store_true",
+                        help="the checkpoint carries MLP adapters too")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
@@ -98,16 +105,22 @@ def main(argv=None) -> int:
         d_ff=args.d_ff,
         max_seq_len=args.max_len,
     )
-    params = tm.init_params(cfg, jax.random.PRNGKey(args.seed))
-    if args.checkpoint_dir:
-        from hivedscheduler_tpu.parallel import checkpoint as ckpt
+    from hivedscheduler_tpu.parallel import checkpoint as ckpt
 
-        try:
-            step, params = ckpt.restore_params(args.checkpoint_dir, params)
-        except FileNotFoundError as e:
-            log.error("%s", e)
-            return 1
+    try:
+        params, step = ckpt.restore_serving_params(
+            cfg, args.checkpoint_dir, jax.random.PRNGKey(args.seed),
+            lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
+            lora_mlp=args.lora_mlp,
+        )
+    except FileNotFoundError as e:
+        log.error("%s", e)
+        return 1
+    if step is not None:
         log.info("restored params from step %s", step)
+    if args.lora_rank > 0:
+        log.info("merged rank-%s LoRA adapters into the base weights",
+                 args.lora_rank)
     if args.quantize == "int8":
         from hivedscheduler_tpu.models import quant
 
