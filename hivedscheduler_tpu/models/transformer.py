@@ -561,16 +561,18 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     if k.shape[2] != q.shape[2]:
-        # GQA. The ring schedules consume compact k/v directly via grouped
-        # einsums — their ppermute rotation then ships H_kv/H of the bytes —
-        # when the compact head count still shards evenly over tp (the
-        # manual pipeline path rejects indivisible kv/tp upfront). All other
-        # impls (and the indivisible GSPMD case) materialize each shared
-        # k/v head for its q-head group here, after RoPE so the rotation
-        # runs on the small head count; contiguous grouping keeps groups
-        # aligned with tp shards.
+        # GQA. The ring schedules and Ulysses consume compact k/v directly
+        # via grouped einsums — the ppermute rotation / k,v all_to_all then
+        # ships H_kv/H of the bytes — when the compact head count still
+        # shards evenly over tp (the manual pipeline path rejects
+        # indivisible kv/tp upfront; Ulysses expands locally if H_kv
+        # doesn't split over sp). All other impls (and the indivisible
+        # GSPMD case) materialize each shared k/v head for its q-head
+        # group here, after RoPE so the rotation runs on the small head
+        # count; contiguous grouping keeps groups aligned with tp shards.
         compact_ok = cfg.attn_impl in (
-            "ring", "ring_flash", "ring_zigzag", "ring_zigzag_flash", "flash",
+            "ring", "ring_flash", "ring_zigzag", "ring_zigzag_flash",
+            "ulysses", "flash",
         )
         if compact_ok and manual_sp_axis is None and mesh is not None:
             tp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)

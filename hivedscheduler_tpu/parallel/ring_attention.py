@@ -1177,9 +1177,24 @@ def zigzag_ring_flash_attention(
 
 def _ulysses_local(q, k, v, axis_name: str, causal: bool):
     """All-to-all swap: [B, T/sp, H, D] -> [B, T, H/sp, D], local attention,
-    swap back. Requires H % sp == 0."""
+    swap back. Requires H % sp == 0.
+
+    Compact GQA k/v all_to_all on their own H_kv axis when H_kv % sp == 0,
+    shipping H_kv/H of the k/v bytes (the ring schedules' compact-transport
+    win, applied to the all_to_all): contiguous head grouping survives the
+    split — device s gets q heads [s*H/sp, (s+1)*H/sp) and k/v heads
+    [s*H_kv/sp, (s+1)*H_kv/sp), and since H/sp is a multiple of the group
+    size H/H_kv, the local mapping is again j -> j // (H/H_kv), which is
+    exactly how xla_attention consumes compact k/v. Shared heads are
+    expanded first only when H_kv doesn't split evenly."""
     from hivedscheduler_tpu.ops.attention import xla_attention
 
+    sp = lax.psum(1, axis_name)
+    h, h_kv = q.shape[2], k.shape[2]
+    if h_kv != h and h_kv % sp:
+        rep = h // h_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     # concat_axis=T (1), split_axis=H (2): gather full sequence, split heads
     q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)

@@ -181,6 +181,24 @@ class TestRingAttention:
         out = ulysses_attention(q, k, v, mesh, head_axis=None)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
+    @pytest.mark.parametrize("h_kv", [2, 1])
+    def test_ulysses_compact_gqa_matches_reference(self, h_kv):
+        """Compact GQA k/v through the all_to_all: H_kv % sp == 0 ships the
+        small head count (h_kv=2, sp=2); h_kv=1 with sp=2 can't split and
+        must take the expand-locally fallback — both exact vs dense."""
+        key = jax.random.PRNGKey(3)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            q = jax.random.normal(key, (2, 32, 4, 8), jnp.float32)
+            k = jax.random.normal(jax.random.fold_in(key, 1),
+                                  (2, 32, h_kv, 8), jnp.float32)
+            v = jax.random.normal(jax.random.fold_in(key, 2),
+                                  (2, 32, h_kv, 8), jnp.float32)
+            ref = xla_attention(q, k, v, causal=True)
+        mesh = cpu_mesh(topology.MeshAxes(dp=2, sp=2))
+        out = ulysses_attention(q, k, v, mesh, head_axis=None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
 
 class TestFlashAttention:
     def test_flash_matches_reference(self):
@@ -364,11 +382,12 @@ class TestGQA:
             np.asarray(out_gqa), np.asarray(out_mha), atol=1e-5
         )
 
-    def test_gqa_tp_sharded_train_step(self):
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_gqa_tp_sharded_train_step(self, impl):
         from hivedscheduler_tpu.models import transformer as tm
         from hivedscheduler_tpu.parallel.train import make_sharded_train_step
 
-        cfg = self._cfg(n_kv_heads=2, attn_impl="ring")
+        cfg = self._cfg(n_kv_heads=2, attn_impl=impl)
         mesh = cpu_mesh(topology.MeshAxes(dp=2, tp=2, sp=2))
         step, init_fn, token_sharding = make_sharded_train_step(cfg, mesh)
         params, opt_state = init_fn(jax.random.PRNGKey(0))
@@ -382,13 +401,14 @@ class TestGQA:
             losses.append(float(loss))
         assert all(np.isfinite(losses)) and losses[-1] < losses[0]
 
-    def test_gqa_in_sp_pipeline_matches_dense(self):
-        """GQA composes with pp x sp: pipelined ring-attention logits equal
-        the dense forward."""
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_gqa_in_sp_pipeline_matches_dense(self, impl):
+        """GQA composes with pp x sp: pipelined ring/Ulysses-attention
+        logits equal the dense forward."""
         from hivedscheduler_tpu.models import transformer as tm
 
         cfg_pp = self._cfg(n_kv_heads=2, pipeline_microbatches=2,
-                           attn_impl="ring", n_layers=4)
+                           attn_impl=impl, n_layers=4)
         cfg_ref = self._cfg(n_kv_heads=2, n_layers=4)
         mesh = cpu_mesh(topology.MeshAxes(dp=2, pp=2, sp=2))
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
@@ -398,13 +418,15 @@ class TestGQA:
         out = jax.jit(lambda p, t: tm.forward(p, t, cfg_pp, mesh=mesh))(params, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
-    def test_mqa_gspmd_ring_with_indivisible_tp_falls_back_to_repeat(self):
-        """Non-pipeline GSPMD ring with kv_heads=1 and tp=2: the compact-kv
-        path cannot shard 1 head over tp=2, so the model must fall back to
-        repeat-expanded k/v and still produce correct logits."""
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_mqa_gspmd_ring_with_indivisible_tp_falls_back_to_repeat(self, impl):
+        """Non-pipeline GSPMD ring/Ulysses with kv_heads=1 and tp=2: the
+        compact-kv path cannot shard 1 head over tp=2, so the model must
+        fall back to repeat-expanded k/v and still produce correct
+        logits."""
         from hivedscheduler_tpu.models import transformer as tm
 
-        cfg = self._cfg(n_kv_heads=1, attn_impl="ring")
+        cfg = self._cfg(n_kv_heads=1, attn_impl=impl)
         mesh = cpu_mesh(topology.MeshAxes(dp=2, tp=2, sp=2))
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
             params = tm.init_params(cfg, jax.random.PRNGKey(0))
