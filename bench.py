@@ -507,13 +507,14 @@ def parse_model_bench_output(returncode: int, stdout: str, stderr: str):
         "model_decode_hbm_roofline_frac": m["decode_hbm_roofline_frac"],
         "model_serve_tokens_per_sec": m.get("serve_tokens_per_sec"),
         "model_serve_occupancy": m.get("serve_occupancy"),
+        "model_serve_prefix_speedup": m.get("serve_prefix_speedup"),
         "model_device": m["device"],
         "model_metric_note": m["metric"],
     }
     # per-stage degradation notes (bench_model isolates decode/serve
     # failures so the train MFU survives): a null decode/serve field must
     # arrive explained, not silently absent
-    for k in ("decode_error", "serve_error"):
+    for k in ("decode_error", "serve_error", "serve_prefix_error"):
         if m.get(k):
             fields[f"model_{k}"] = m[k]
     stamped = dict(m)

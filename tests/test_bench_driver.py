@@ -15,7 +15,7 @@ def _result_line(**over):
         "vs_baseline": 1.13, "device": "TPU v5 lite",
         "train_tokens_per_sec": 31000.0, "decode_tokens_per_sec": 11000.0,
         "decode_hbm_roofline_frac": 0.81, "serve_tokens_per_sec": 9000.0,
-        "serve_occupancy": 0.9,
+        "serve_occupancy": 0.9, "serve_prefix_speedup": 1.4,
     }
     m.update(over)
     return json.dumps(m)
@@ -28,6 +28,7 @@ class TestParseModelBenchOutput:
         assert fields["model_train_mfu_pct"] == 45.2
         assert fields["model_decode_hbm_roofline_frac"] == 0.81
         assert fields["model_serve_tokens_per_sec"] == 9000.0
+        assert fields["model_serve_prefix_speedup"] == 1.4
         assert stamped["captured_by"] == "bench.py driver path"
         assert stamped["captured_at_utc"].endswith("Z")
 

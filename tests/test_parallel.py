@@ -181,6 +181,40 @@ class TestRingAttention:
         out = ulysses_attention(q, k, v, mesh, head_axis=None)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
+    def test_ulysses_compact_gqa_exact_gradients(self):
+        """Gradient parity for the COMPACT transport (h_kv=2, sp=2: the k/v
+        all_to_all runs on the small head axis, no expand fallback): dq/dk/
+        dv must equal autodiff through the dense reference with
+        repeat-expanded k/v — the same discipline the ring schedules got."""
+        key = jax.random.PRNGKey(5)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            q = jax.random.normal(key, (2, 32, 4, 8), jnp.float32)
+            k = jax.random.normal(jax.random.fold_in(key, 1),
+                                  (2, 32, 2, 8), jnp.float32)
+            v = jax.random.normal(jax.random.fold_in(key, 2),
+                                  (2, 32, 2, 8), jnp.float32)
+            cot = jax.random.normal(jax.random.fold_in(key, 3), q.shape)
+        mesh = cpu_mesh(topology.MeshAxes(dp=2, sp=2))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(xla_attention(
+                q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2),
+                causal=True,
+            ) * cot)
+
+        def loss_uly(q, k, v):
+            return jnp.sum(
+                ulysses_attention(q, k, v, mesh, head_axis=None) * cot)
+
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            ref_grads = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        uly_grads = jax.grad(loss_uly, argnums=(0, 1, 2))(q, k, v)
+        for g_ref, g_uly, name in zip(ref_grads, uly_grads, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(g_uly), np.asarray(g_ref), atol=5e-5,
+                err_msg=f"d{name} mismatch",
+            )
+
     @pytest.mark.parametrize("h_kv", [2, 1])
     def test_ulysses_compact_gqa_matches_reference(self, h_kv):
         """Compact GQA k/v through the all_to_all: H_kv % sp == 0 ships the
