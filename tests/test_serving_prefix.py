@@ -145,6 +145,22 @@ def test_staggered_mixed_traffic_exact(setup):
             assert eng.prefix_hits >= 4
 
 
+def test_int8_engine_prefix_exact(setup):
+    """Prefix caching composes with weight-only int8 serving: the cache
+    stores KV (activations), not weights, so quantization is orthogonal —
+    streams must match the uncached int8 engine exactly."""
+    cfg, params_bf16 = setup
+    from hivedscheduler_tpu.models import quant
+
+    qparams = quant.quantize_params(params_bf16, cfg)
+    prompts = [SYSTEM + [7], SYSTEM + [9, 9], SYSTEM + [7, 5]]
+    _, plain = run_engine(cfg, qparams, prompts, budget=5)
+    eng, cached = run_engine(cfg, qparams, prompts, budget=5,
+                             prefix_cache_size=16)
+    assert cached == plain
+    assert eng.prefix_hits >= 2
+
+
 def test_speculative_engine_prefix_exact(setup):
     """Prefix caching composes with speculative serving: the payload carries
     target AND draft KV, so restored rows verify identically — the greedy
