@@ -508,6 +508,7 @@ def parse_model_bench_output(returncode: int, stdout: str, stderr: str):
         "model_serve_tokens_per_sec": m.get("serve_tokens_per_sec"),
         "model_serve_occupancy": m.get("serve_occupancy"),
         "model_serve_prefix_speedup": m.get("serve_prefix_speedup"),
+        "model_serve_prefix_ttft_speedup": m.get("serve_prefix_ttft_speedup"),
         "model_device": m["device"],
         "model_metric_note": m["metric"],
     }

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 from typing import Any, Dict, List, NamedTuple, Optional
 
 import jax
@@ -191,6 +192,16 @@ class Request:
     max_new_tokens: int
     tokens_out: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # wall-clock bookkeeping: time-to-first-token = queue wait + prefill
+    # (the latency prefix caching attacks)
+    submitted_at: float = 0.0
+    first_token_at: Optional[float] = None
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
 
 
 class ServingEngine:
@@ -331,7 +342,8 @@ class ServingEngine:
                 f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
                 f"max_len {self.max_len}"
             )
-        req = Request(self._next_rid, list(prompt), max_new_tokens)
+        req = Request(self._next_rid, list(prompt), max_new_tokens,
+                      submitted_at=time.perf_counter())
         self._next_rid += 1
         self.queue.append(req)
         return req
@@ -458,6 +470,8 @@ class ServingEngine:
         ))
 
     def _emit(self, req: Request, slot: int, tok: int) -> None:
+        if req.first_token_at is None:
+            req.first_token_at = time.perf_counter()
         req.tokens_out.append(tok)
         self._last_host[slot] = tok
         if len(req.tokens_out) >= req.max_new_tokens or tok == self.eos_id:

@@ -195,6 +195,10 @@ def main(argv=None) -> int:
     total_tokens = sum(len(r.tokens_out) for r in reqs)
     for r in reqs:
         print(f"[{r.rid}] " + " ".join(str(t) for t in r.tokens_out))
+    ttfts = sorted(r.ttft_s for r in reqs if r.ttft_s is not None)
+    if ttfts:
+        log.info("time-to-first-token: p50 %.0f ms, max %.0f ms",
+                 1e3 * ttfts[len(ttfts) // 2], 1e3 * ttfts[-1])
     log.info(
         "%s requests, %s tokens in %.2fs (%.1f tok/s), occupancy %.0f%% "
         "over %s decode steps",

@@ -16,6 +16,7 @@ def _result_line(**over):
         "train_tokens_per_sec": 31000.0, "decode_tokens_per_sec": 11000.0,
         "decode_hbm_roofline_frac": 0.81, "serve_tokens_per_sec": 9000.0,
         "serve_occupancy": 0.9, "serve_prefix_speedup": 1.4,
+        "serve_prefix_ttft_speedup": 2.1,
     }
     m.update(over)
     return json.dumps(m)
@@ -29,6 +30,7 @@ class TestParseModelBenchOutput:
         assert fields["model_decode_hbm_roofline_frac"] == 0.81
         assert fields["model_serve_tokens_per_sec"] == 9000.0
         assert fields["model_serve_prefix_speedup"] == 1.4
+        assert fields["model_serve_prefix_ttft_speedup"] == 2.1
         assert stamped["captured_by"] == "bench.py driver path"
         assert stamped["captured_at_utc"].endswith("Z")
 
