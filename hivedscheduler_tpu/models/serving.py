@@ -21,6 +21,10 @@ continuous-batching pattern (the core of modern LLM servers) TPU-first:
   immediately; the next queued request prefills into it while the other
   rows keep decoding — chip occupancy tracks offered load, not the
   slowest request of a static batch.
+- **Chunked prefill** (``prefill_chunk > 0``): prompts absorb at most
+  that many tokens per engine step via offset prefills, so one long
+  prompt's prefill interleaves with everyone else's decode steps instead
+  of stalling them — bounded work per step, bit-exact streams.
 - **Prefix caching** (``prefix_cache_size > 0``): the KV of recent prompts
   stays device-resident in an LRU; a new prompt that extends a cached one
   restores the prefix KV with one dynamic_update_slice and prefills only
@@ -226,6 +230,7 @@ class ServingEngine:
         seed: int = 0,
         mesh=None,
         prefix_cache_size: int = 0,
+        prefill_chunk: int = 0,
     ):
         """``mesh``: lay the engine out over a dp x tp serving mesh —
         params by ``decode.serving_shardings`` (tp shards heads/ff/vocab),
@@ -238,7 +243,14 @@ class ServingEngine:
         one, restore that prefix and prefill only the tail — the standard
         shared-system-prompt win. 0 disables (no extra HBM). Exactness is
         unaffected: restored KV is bit-identical to recomputation (guard:
-        tests/test_serving_prefix.py)."""
+        tests/test_serving_prefix.py).
+
+        ``prefill_chunk``: absorb prompts at most this many tokens per
+        engine step (0 = whole prompt at admission). A long prompt then
+        cannot stall the decoding rows for its full prefill: each step runs
+        one bounded chunk (offset prefill into the row) and one decode —
+        the chunked-prefill fairness pattern. Exact: chunks write the same
+        KV a monolithic prefill would (guard: tests/test_serving_chunked.py)."""
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -285,6 +297,11 @@ class ServingEngine:
         self._next_rid = 0
         self.steps = 0  # decode steps executed (for occupancy stats)
         self.slot_steps = 0  # sum of active slots over decode steps
+        self.prefill_chunk = max(0, prefill_chunk)
+        # slot -> (tail tokens, plen offset, pos absorbed): in-flight
+        # chunked prefills; these slots are occupied but not yet decoding
+        self._prefilling: Dict[int, tuple] = {}
+        self.prefill_chunks_done = 0
 
         def decode_step(params, cache, last_tokens):
             logits, cache = advance_ragged(params, cache, last_tokens[:, None], cfg)
@@ -422,6 +439,20 @@ class ServingEngine:
                 tail = req.prompt[plen:]
             else:
                 plen, tail = 0, req.prompt
+            if self.prefill_chunk > 0 and len(tail) > self.prefill_chunk:
+                # chunked admission: the slot is occupied but decodes only
+                # after its chunks complete (one per step). Park the device
+                # length at max_len-1: the shared decode step scatters k/v
+                # at lengths[row] for EVERY row, and the parked position is
+                # (a) outside any prompt region (prompt <= max_len - budget)
+                # and (b) rewritten by the row's own scatter before any
+                # query can attend it, so the garbage is never read.
+                self.slots[slot] = req
+                self._prefilling[slot] = (tail, plen, 0)
+                self.cache = self.cache._replace(
+                    lengths=self.cache.lengths.at[slot].set(self.max_len - 1)
+                )
+                continue
             tokens = jnp.asarray(
                 tail + [0] * (self._bucket(len(tail)) - len(tail)), jnp.int32
             )[None, :]
@@ -429,21 +460,64 @@ class ServingEngine:
                 self.params, self.cache, tokens, jnp.int32(slot),
                 jnp.int32(plen)
             )
+            self._on_prefill(slot, tokens, len(req.prompt), plen)
             # the row's true length is the unpadded prompt (padded tail
             # positions are never attended: mask keys > length-1)
-            self.cache = self.cache._replace(
-                lengths=self.cache.lengths.at[slot].set(len(req.prompt))
-            )
-            self._on_prefill(slot, tokens, len(req.prompt), plen)
-            if self.prefix_cache_size > 0:
-                # store even on a hit: the row now holds valid KV for the
-                # FULL prompt, so a future prompt extending it further can
-                # reuse more than the shorter cached entry. Runs after
-                # _on_prefill so subclass caches are populated for extraction
-                self._store_prefix(slot, req.prompt)
-            tok = self._pick(logits[len(tail) - 1])
-            self._emit(req, slot, tok)
-            self.slots[slot] = None if req.done else req
+            self.slots[slot] = req
+            self._finish_prefill(req, slot, logits, len(tail) - 1)
+
+    def _finish_prefill(self, req: Request, slot: int, logits,
+                        last_idx: int) -> None:
+        """Shared post-prefill tail of the monolithic and chunked paths:
+        set the row's true length, store the prefix (after _on_prefill has
+        populated subclass caches), pick + emit the first token."""
+        self.cache = self.cache._replace(
+            lengths=self.cache.lengths.at[slot].set(len(req.prompt))
+        )
+        if self.prefix_cache_size > 0:
+            # store even on a hit: the row now holds valid KV for the FULL
+            # prompt, so a future prompt extending it further can reuse
+            # more than the shorter cached entry
+            self._store_prefix(slot, req.prompt)
+        tok = self._pick(logits[last_idx])
+        self._emit(req, slot, tok)
+        if req.done:
+            self.slots[slot] = None
+
+    def _prefill_chunk_tick(self) -> None:
+        """Advance ONE in-flight chunked prefill by one chunk — the per-step
+        prefill budget that keeps decode latency bounded."""
+        if not self._prefilling:
+            return
+        slot = next(iter(self._prefilling))  # insertion order = true FIFO
+        tail, plen, pos = self._prefilling[slot]
+        req = self.slots[slot]
+        # the padded bucket write [off, off+bucket) must stay inside the
+        # arena: dynamic_update_slice CLAMPS an out-of-bounds start, which
+        # would silently shift the chunk over earlier KV. Shrink the chunk
+        # so its bucket fits (room >= 2 always: prompt+budget <= max_len).
+        off = plen + pos
+        room = self.max_len - off
+        size = min(self.prefill_chunk, len(tail) - pos)
+        while self._bucket(size) > room:
+            size = self._bucket(size) // 2
+        chunk = tail[pos: pos + size]
+        tokens = jnp.asarray(
+            chunk + [0] * (self._bucket(len(chunk)) - len(chunk)), jnp.int32
+        )[None, :]
+        logits, self.cache = self._prefill(
+            self.params, self.cache, tokens, jnp.int32(slot), jnp.int32(off)
+        )
+        self._on_prefill(slot, tokens, len(req.prompt), off)
+        self.prefill_chunks_done += 1
+        pos += len(chunk)
+        if pos < len(tail):
+            self._prefilling[slot] = (tail, plen, pos)
+            return
+        del self._prefilling[slot]
+        # the final chunk holds the prompt's last position: its logits row
+        # len(chunk)-1 is exactly what a monolithic prefill would pick from
+        self._finish_prefill(req, slot, logits, len(chunk) - 1)
 
     def _on_prefill(self, slot: int, tokens, prompt_len: int,
                     start: int = 0) -> None:
@@ -479,10 +553,13 @@ class ServingEngine:
 
     # -- engine ticks ------------------------------------------------------
     def step(self) -> bool:
-        """Admit + one decode step for all active slots. Returns whether any
-        work remains (active slots or queued requests)."""
+        """Admit + at most one prefill chunk + one decode step for all
+        decoding slots. Returns whether any work remains (active slots,
+        in-flight chunked prefills, or queued requests)."""
         self._admit()
-        active = [s for s in range(self.max_batch) if self.slots[s] is not None]
+        self._prefill_chunk_tick()
+        active = [s for s in range(self.max_batch)
+                  if self.slots[s] is not None and s not in self._prefilling]
         if active:
             last = jnp.asarray(self._last_host, jnp.int32)
             if self._token_sharding is not None:
@@ -542,6 +619,9 @@ class SpeculativeServingEngine(ServingEngine):
             raise ValueError("target and draft vocabs must match")
         if gamma < 1:
             raise ValueError(f"gamma must be >= 1, got {gamma}")
+        if kw.get("prefill_chunk", 0) > 0:
+            raise ValueError("chunked prefill isn't wired to the draft "
+                             "cache yet; use the plain ServingEngine")
         super().__init__(params, cfg, **kw)
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg

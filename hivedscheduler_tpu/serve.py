@@ -65,6 +65,10 @@ def main(argv=None) -> int:
     parser.add_argument("--system-prompt-len", type=int, default=24,
                         help="shared prompt prefix length for the synthetic "
                         "load (only with --prefix-cache)")
+    parser.add_argument("--prefill-chunk", type=int, default=0,
+                        help="absorb prompts at most this many tokens per "
+                        "engine step (0 = whole prompt at admission): a "
+                        "long prompt then cannot stall decoding rows")
     parser.add_argument("--quantize", choices=["none", "int8"], default="none",
                         help="weight-only int8 serving (halves weight HBM "
                         "traffic; the engine's shared helpers dequantize "
@@ -142,6 +146,7 @@ def main(argv=None) -> int:
             temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
             eos_id=None if args.eos_id < 0 else args.eos_id, seed=args.seed,
             mesh=mesh, prefix_cache_size=args.prefix_cache,
+            prefill_chunk=args.prefill_chunk,
         )
         if args.draft_layers > 0:
             from hivedscheduler_tpu.models.speculative import derive_draft_config
