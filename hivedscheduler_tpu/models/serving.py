@@ -453,9 +453,7 @@ class ServingEngine:
                     lengths=self.cache.lengths.at[slot].set(self.max_len - 1)
                 )
                 continue
-            tokens = jnp.asarray(
-                tail + [0] * (self._bucket(len(tail)) - len(tail)), jnp.int32
-            )[None, :]
+            tokens = self._padded_tokens(tail)
             logits, self.cache = self._prefill(
                 self.params, self.cache, tokens, jnp.int32(slot),
                 jnp.int32(plen)
@@ -484,12 +482,20 @@ class ServingEngine:
         if req.done:
             self.slots[slot] = None
 
-    def _prefill_chunk_tick(self) -> None:
-        """Advance ONE in-flight chunked prefill by one chunk — the per-step
+    def _padded_tokens(self, toks: List[int]):
+        """Right-pad to the prefill bucket — ONE home for the padding rule
+        so the monolithic and chunked paths cannot drift."""
+        return jnp.asarray(
+            toks + [0] * (self._bucket(len(toks)) - len(toks)), jnp.int32
+        )[None, :]
+
+    def _prefill_chunk_tick(self, slot: Optional[int] = None) -> None:
+        """Advance one in-flight chunked prefill by one chunk — the per-step
         prefill budget that keeps decode latency bounded."""
         if not self._prefilling:
             return
-        slot = next(iter(self._prefilling))  # insertion order = true FIFO
+        if slot is None:
+            slot = next(iter(self._prefilling))  # insertion order: true FIFO
         tail, plen, pos = self._prefilling[slot]
         req = self.slots[slot]
         # the padded bucket write [off, off+bucket) must stay inside the
@@ -502,9 +508,7 @@ class ServingEngine:
         while self._bucket(size) > room:
             size = self._bucket(size) // 2
         chunk = tail[pos: pos + size]
-        tokens = jnp.asarray(
-            chunk + [0] * (self._bucket(len(chunk)) - len(chunk)), jnp.int32
-        )[None, :]
+        tokens = self._padded_tokens(chunk)
         logits, self.cache = self._prefill(
             self.params, self.cache, tokens, jnp.int32(slot), jnp.int32(off)
         )
@@ -557,7 +561,19 @@ class ServingEngine:
         decoding slots. Returns whether any work remains (active slots,
         in-flight chunked prefills, or queued requests)."""
         self._admit()
-        self._prefill_chunk_tick()
+        decoding = any(
+            s is not None and i not in self._prefilling
+            for i, s in enumerate(self.slots)
+        )
+        if decoding:
+            self._prefill_chunk_tick()  # bounded: protect decode latency
+        else:
+            # no decoders to protect: advance EVERY in-flight prefill a
+            # chunk so a burst of long prompts doesn't serialize against a
+            # fairness budget with nothing to be fair to
+            for slot in list(self._prefilling):
+                if slot in self._prefilling:
+                    self._prefill_chunk_tick(slot)
         active = [s for s in range(self.max_batch)
                   if self.slots[s] is not None and s not in self._prefilling]
         if active:
