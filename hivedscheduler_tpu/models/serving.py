@@ -449,9 +449,7 @@ class ServingEngine:
                 # query can attend it, so the garbage is never read.
                 self.slots[slot] = req
                 self._prefilling[slot] = (tail, plen, 0)
-                self.cache = self.cache._replace(
-                    lengths=self.cache.lengths.at[slot].set(self.max_len - 1)
-                )
+                self._park(slot)
                 continue
             tokens = self._padded_tokens(tail)
             logits, self.cache = self._prefill(
@@ -464,6 +462,17 @@ class ServingEngine:
             self.slots[slot] = req
             self._finish_prefill(req, slot, logits, len(tail) - 1)
 
+    def _park(self, slot: int) -> None:
+        """Pin the slot's device length at the parked sentinel while its
+        chunked prefill is in flight (see the invariant note in _admit).
+        Subclasses with auxiliary caches park those rows too — an unparked
+        auxiliary row would let concurrent decode/verify scatters land at
+        the slot's STALE length, possibly inside the prompt region being
+        chunked in."""
+        self.cache = self.cache._replace(
+            lengths=self.cache.lengths.at[slot].set(self.max_len - 1)
+        )
+
     def _finish_prefill(self, req: Request, slot: int, logits,
                         last_idx: int) -> None:
         """Shared post-prefill tail of the monolithic and chunked paths:
@@ -472,6 +481,7 @@ class ServingEngine:
         self.cache = self.cache._replace(
             lengths=self.cache.lengths.at[slot].set(len(req.prompt))
         )
+        self._on_ready(slot, len(req.prompt))
         if self.prefix_cache_size > 0:
             # store even on a hit: the row now holds valid KV for the FULL
             # prompt, so a future prompt extending it further can reuse
@@ -527,7 +537,16 @@ class ServingEngine:
                     start: int = 0) -> None:
         """Hook for subclasses that keep auxiliary per-slot state (the
         speculative engine prefills its draft cache here). On a prefix-cache
-        hit ``tokens`` is the bucketed TAIL only and ``start`` its offset."""
+        hit ``tokens`` is the bucketed TAIL only and ``start`` its offset.
+        Called once per monolithic prefill and once per CHUNK on the
+        chunked path — implementations must only write KV at [start, ...)
+        and leave length bookkeeping to ``_on_ready``."""
+
+    def _on_ready(self, slot: int, prompt_len: int) -> None:
+        """Hook: the slot's prefill just completed (its true length is set
+        and it will decode from the next step). Subclasses sync auxiliary
+        cache lengths here — NOT in _on_prefill, which fires mid-chunking
+        while the slot must stay parked."""
 
     def _pick(self, logits_row) -> int:
         if self.temperature == 0.0:
@@ -556,26 +575,32 @@ class ServingEngine:
             req.done = True
 
     # -- engine ticks ------------------------------------------------------
-    def step(self) -> bool:
-        """Admit + at most one prefill chunk + one decode step for all
-        decoding slots. Returns whether any work remains (active slots,
-        in-flight chunked prefills, or queued requests)."""
-        self._admit()
+    def _tick_prefills(self) -> List[int]:
+        """Shared per-step chunk scheduling: one bounded chunk while any
+        row is decoding (fairness budget protects decode latency), ALL
+        in-flight prefills when nothing is (a burst of long prompts must
+        not serialize against a budget with nothing to be fair to).
+        Returns the slots ready to decode/speculate this step."""
         decoding = any(
             s is not None and i not in self._prefilling
             for i, s in enumerate(self.slots)
         )
         if decoding:
-            self._prefill_chunk_tick()  # bounded: protect decode latency
+            self._prefill_chunk_tick()
         else:
-            # no decoders to protect: advance EVERY in-flight prefill a
-            # chunk so a burst of long prompts doesn't serialize against a
-            # fairness budget with nothing to be fair to
             for slot in list(self._prefilling):
-                if slot in self._prefilling:
+                if slot in self._prefilling:  # a tick may finish the slot
                     self._prefill_chunk_tick(slot)
-        active = [s for s in range(self.max_batch)
-                  if self.slots[s] is not None and s not in self._prefilling]
+        return [s for s in range(self.max_batch)
+                if self.slots[s] is not None and s not in self._prefilling]
+
+    def step(self) -> bool:
+        """Admit + tick chunked prefills (one bounded chunk while anyone
+        is decoding, else all — see _tick_prefills) + one decode step for
+        all decoding slots. Returns whether any work remains (active
+        slots, in-flight chunked prefills, or queued requests)."""
+        self._admit()
+        active = self._tick_prefills()
         if active:
             last = jnp.asarray(self._last_host, jnp.int32)
             if self._token_sharding is not None:
@@ -625,7 +650,15 @@ class SpeculativeServingEngine(ServingEngine):
 
     Greedy only (temperature must be 0): per-row residual resampling would
     need per-row RNG bookkeeping; the uniform-batch sampled path remains in
-    models/speculative.py."""
+    models/speculative.py.
+
+    Composes with chunked prefill (``prefill_chunk > 0``): prompt chunks
+    absorb into BOTH caches per engine step (the shared chunk tick's
+    ``_on_prefill`` hook mirrors every chunk into the draft), while the
+    other rows keep speculating. Both rows are parked at max_len-1 during
+    chunking (see ``_park``) so concurrent verify/draft scatters never
+    touch the prompt region being built. Exactness guard:
+    tests/test_serving_chunked.py + the chunked speculative fuzz."""
 
     def __init__(self, params, cfg, draft_params, draft_cfg, *, gamma: int = 4,
                  **kw):
@@ -635,9 +668,6 @@ class SpeculativeServingEngine(ServingEngine):
             raise ValueError("target and draft vocabs must match")
         if gamma < 1:
             raise ValueError(f"gamma must be >= 1, got {gamma}")
-        if kw.get("prefill_chunk", 0) > 0:
-            raise ValueError("chunked prefill isn't wired to the draft "
-                             "cache yet; use the plain ServingEngine")
         super().__init__(params, cfg, **kw)
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
@@ -696,12 +726,28 @@ class SpeculativeServingEngine(ServingEngine):
         self._draft_prefill = jax.jit(draft_prefill, donate_argnums=(1,))
         self._spec_round = jax.jit(spec_round, donate_argnums=(2, 3))
 
+    def _park(self, slot: int) -> None:
+        # park the draft row too: while the slot's chunks are in flight,
+        # concurrent spec rounds scatter draft k/v at lengths[slot] — left
+        # at the previous occupant's stale length that write could land
+        # INSIDE the prompt region being chunked in. The parked sentinel
+        # sends it to max_len-1, which no query ever attends (spec queries
+        # top out at max_len-2: submit reserves gamma+1 headroom).
+        super()._park(slot)
+        self.draft_cache = self.draft_cache._replace(
+            lengths=self.draft_cache.lengths.at[slot].set(self.max_len - 1)
+        )
+
     def _on_prefill(self, slot: int, tokens, prompt_len: int,
                     start: int = 0) -> None:
+        # KV only; the draft length stays parked until _on_ready (chunked
+        # path) — setting it early would unpark the row mid-chunking
         self.draft_cache = self._draft_prefill(
             self.draft_params, self.draft_cache, tokens, jnp.int32(slot),
             jnp.int32(start)
         )
+
+    def _on_ready(self, slot: int, prompt_len: int) -> None:
         self.draft_cache = self.draft_cache._replace(
             lengths=self.draft_cache.lengths.at[slot].set(prompt_len)
         )
@@ -734,7 +780,7 @@ class SpeculativeServingEngine(ServingEngine):
 
     def step(self) -> bool:
         self._admit()
-        active = [s for s in range(self.max_batch) if self.slots[s] is not None]
+        active = self._tick_prefills()
         if active:
             last = jnp.asarray(self._last_host, jnp.int32)
             if self._token_sharding is not None:

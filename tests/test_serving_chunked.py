@@ -104,13 +104,78 @@ def test_arena_edge_chunks_shrink_not_clamp(setup):
         assert eng.prefill_chunks_done >= 2
 
 
-def test_speculative_engine_rejects_chunked(setup):
-    cfg, params = setup
-    from hivedscheduler_tpu.models.serving import SpeculativeServingEngine
+class TestSpeculativeComposition:
+    """Chunked prefill x speculative decoding: the two features a serving
+    stack wants simultaneously (long prompts that can't stall decode AND
+    accelerated decode). Chunking must stay a pure scheduling change for
+    the speculative engine too."""
 
-    dcfg = tiny_cfg(n_layers=1)
-    dparams = tm.cast_params(tm.init_params(dcfg, jax.random.PRNGKey(1)),
-                             dcfg.dtype)
-    with pytest.raises(ValueError, match="chunked prefill"):
-        SpeculativeServingEngine(params, cfg, dparams, dcfg,
-                                 prefill_chunk=8)
+    @pytest.fixture(scope="class")
+    def spec_setup(self, setup):
+        cfg, params = setup
+        dcfg = tiny_cfg(n_layers=1, d_model=32, n_heads=2, n_kv_heads=1,
+                        d_ff=64)
+        dparams = tm.cast_params(tm.init_params(dcfg, jax.random.PRNGKey(1)),
+                                 dcfg.dtype)
+        return cfg, params, dcfg, dparams
+
+    def run_spec(self, spec_setup, prompts, budget=5, **kw):
+        from hivedscheduler_tpu.models.serving import SpeculativeServingEngine
+
+        cfg, params, dcfg, dparams = spec_setup
+        eng = SpeculativeServingEngine(params, cfg, dparams, dcfg, gamma=2,
+                                       max_batch=2, max_len=96, **kw)
+        reqs = [eng.submit(p, budget) for p in prompts]
+        eng.run_until_drained()
+        return eng, [r.tokens_out for r in reqs]
+
+    @pytest.mark.parametrize("chunk", [4, 16])
+    def test_chunked_speculative_matches_unchunked(self, spec_setup, chunk):
+        prompts = [LONG, [7, 8, 9], LONG + [5], list(range(80))]
+        _, plain = self.run_spec(spec_setup, prompts)
+        eng, chunked = self.run_spec(spec_setup, prompts,
+                                     prefill_chunk=chunk)
+        assert chunked == plain
+        assert eng.prefill_chunks_done > 0
+
+    def test_chunked_speculative_matches_plain_engine(self, spec_setup):
+        """Chunked + speculative still equals the plain greedy engine —
+        the full exactness chain (speculation is an acceleration, chunking
+        is a scheduling change; together still bit-exact)."""
+        cfg, params, _, _ = spec_setup
+        prompts = [LONG, [3, 4], LONG + [9, 9]]
+        _, plain = run_all(cfg, params, prompts)
+        eng, both = self.run_spec(spec_setup, prompts, prefill_chunk=8)
+        assert both == plain
+        assert eng.prefill_chunks_done > 0 and eng.drafted > 0
+
+    def test_no_spec_stall_during_chunked_prefill(self, spec_setup):
+        """A speculating row keeps emitting while another slot's long
+        prompt absorbs chunk-by-chunk."""
+        from hivedscheduler_tpu.models.serving import SpeculativeServingEngine
+
+        cfg, params, dcfg, dparams = spec_setup
+        eng = SpeculativeServingEngine(params, cfg, dparams, dcfg, gamma=2,
+                                       max_batch=2, max_len=96,
+                                       prefill_chunk=4)
+        short = eng.submit([3, 4], 24)
+        eng.step()
+        assert len(short.tokens_out) >= 1
+        long_req = eng.submit(list(range(60)), 3)
+        emitted_during_prefill = 0
+        while long_req.first_token_at is None:
+            before = len(short.tokens_out)
+            eng.step()
+            if not short.done:
+                emitted_during_prefill += len(short.tokens_out) - before
+        assert emitted_during_prefill > 0
+        eng.run_until_drained()
+        assert long_req.done
+
+    def test_chunked_speculative_with_prefix_cache(self, spec_setup):
+        prompts = [LONG + [1], LONG + [2, 3], LONG + [1, 4]]
+        _, plain = self.run_spec(spec_setup, prompts)
+        eng, chunked = self.run_spec(spec_setup, prompts, prefill_chunk=8,
+                                     prefix_cache_size=16)
+        assert chunked == plain
+        assert eng.prefix_hits >= 1

@@ -97,17 +97,19 @@ class TestSpeculativeServing:
         assert a.tokens_out == vanilla(params, cfg, [5, 9, 2], 3)
         assert b.tokens_out == vanilla(params, cfg, [100, 22, 63, 4], 6)
 
-    def test_fuzz_random_interleavings(self, setup):
+    @pytest.mark.parametrize("prefill_chunk", [0, 3])
+    def test_fuzz_random_interleavings(self, setup, prefill_chunk):
         """Random prompts/budgets at random arrival offsets through the
         speculative engine (weak draft): every request still equals its solo
-        vanilla run — the speculative analogue of the plain engine's fuzz."""
+        vanilla run — the speculative analogue of the plain engine's fuzz.
+        Runs monolithic AND chunked (prefill_chunk + gamma both active)."""
         import random
 
         cfg, params, dft_cfg, dft_params = setup
         rng = random.Random(23)
         eng = serving.SpeculativeServingEngine(
             params, cfg, dft_params, dft_cfg, gamma=2, max_batch=2,
-            max_len=64,
+            max_len=64, prefill_chunk=prefill_chunk,
         )
         plan = sorted(
             ((rng.randrange(0, 8),
