@@ -180,8 +180,16 @@ def advance_ragged(
         # all S tokens absorbed; a speculative verify caller rolls rows back
         # to its per-row accepted counts afterwards (stale tail entries are
         # rewritten by the next contiguous window before any query reaches
-        # them — see SpeculativeServingEngine)
-        lengths = cache.lengths + s_len
+        # them — see SpeculativeServingEngine). Clamp at the arena size:
+        # idle rows (retired slots, parked chunked prefills at max_len-1)
+        # advance with every shared step too, and without the clamp their
+        # lengths — and hence their RoPE positions and scatter indices —
+        # would drift unboundedly past the arena. Rows pinned AT the clamp
+        # still scatter at index max_len each step, which relies on JAX
+        # dropping exactly that one out-of-bounds index (don't "harden"
+        # these scatters with mode='promise_in_bounds'); the clamp bounds
+        # the drift, it does not eliminate the drop-OOB reliance.
+        lengths = jnp.minimum(cache.lengths + s_len, cache.k.shape[2])
     else:
         lengths = cache.lengths  # caller sets the row's true prompt length
     return logits, RaggedCache(k=new_k, v=new_v, lengths=lengths)
@@ -214,7 +222,10 @@ class ServingEngine:
     ``submit()`` enqueues requests at any time; each ``step()`` admits
     queued requests into free slots (bucketed prefill) and advances every
     active slot by one token. ``run_until_drained()`` loops until every
-    submitted request finished. Greedy or temperature/top-k/top-p sampling.
+    submitted request finished. Greedy or temperature/top-k/top-p sampling;
+    sampled streams use counter-based keys (seed x rid x position), so they
+    are reproducible across batch interleavings and arrival churn — greedy
+    remains the bit-exact-vs-vanilla mode.
     """
 
     def __init__(
@@ -255,11 +266,33 @@ class ServingEngine:
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_len = max_len
+        # read-only after construction: the jitted sampler closes over
+        # them (mutating these attributes would NOT change sampling)
         self.temperature = temperature
         self.top_k = top_k
         self.top_p = top_p
         self.eos_id = eos_id
-        self._key = jax.random.PRNGKey(seed)
+        # Counter-based sampling keys: each sampled token uses
+        # fold_in(fold_in(seed_key, rid), n_emitted), so a request's
+        # sampled stream is a pure function of (seed, rid, prompt) —
+        # independent of batch interleaving, slot assignment, and arrival
+        # order (a split-per-step chain would make sampled output depend
+        # on scheduling churn). Greedy (temperature=0) stays the bit-exact
+        # mode either way.
+        base_key = jax.random.PRNGKey(seed)
+
+        def sample_rows(logits, rids, counts):
+            filtered = filter_logits(
+                logits / temperature if temperature > 0.0 else logits,
+                top_k, top_p,
+            )
+            keys = jax.vmap(
+                lambda r, c: jax.random.fold_in(
+                    jax.random.fold_in(base_key, r), c)
+            )(rids, counts)
+            return jax.vmap(jax.random.categorical)(keys, filtered)
+
+        self._sample = jax.jit(sample_rows)
         self.cache = init_ragged_cache(cfg, max_batch, max_len)
         self.slots: List[Optional[Request]] = [None] * max_batch
         # host-side staging for the per-row feedback tokens: slots emit into
@@ -487,7 +520,7 @@ class ServingEngine:
             # prompt, so a future prompt extending it further can reuse
             # more than the shorter cached entry
             self._store_prefix(slot, req.prompt)
-        tok = self._pick(logits[last_idx])
+        tok = self._pick(logits[last_idx], req)
         self._emit(req, slot, tok)
         if req.done:
             self.slots[slot] = None
@@ -548,23 +581,28 @@ class ServingEngine:
         cache lengths here — NOT in _on_prefill, which fires mid-chunking
         while the slot must stay parked."""
 
-    def _pick(self, logits_row) -> int:
+    def _sample_coords(self, reqs):
+        """Per-row (rid, emitted-count) arrays for the keyed sampler; idle
+        rows get zeros (their sampled values are never read)."""
+        rids = np.zeros(len(reqs), np.uint32)
+        counts = np.zeros(len(reqs), np.uint32)
+        for i, r in enumerate(reqs):
+            if r is not None:
+                rids[i], counts[i] = r.rid, len(r.tokens_out)
+        return jnp.asarray(rids), jnp.asarray(counts)
+
+    def _pick(self, logits_row, req: Request) -> int:
         if self.temperature == 0.0:
             return int(jnp.argmax(logits_row))
-        self._key, sub = jax.random.split(self._key)
-        return int(jax.random.categorical(
-            sub, filter_logits(logits_row / self.temperature, self.top_k, self.top_p)
-        ))
+        rids, counts = self._sample_coords([req])
+        return int(self._sample(logits_row[None], rids, counts)[0])
 
-    def _pick_batch(self, logits):
-        """Pick for every row with ONE host transfer per decode step."""
+    def _pick_batch(self, logits, reqs):
+        """Pick for every row with ONE host transfer per decode step.
+        ``reqs``: the slot->Request list aligned with logits rows."""
         if self.temperature == 0.0:
             return jax.device_get(jnp.argmax(logits, axis=-1))
-        self._key, sub = jax.random.split(self._key)
-        return jax.device_get(jax.random.categorical(
-            sub, filter_logits(logits / self.temperature, self.top_k, self.top_p),
-            axis=-1,
-        ))
+        return jax.device_get(self._sample(logits, *self._sample_coords(reqs)))
 
     def _emit(self, req: Request, slot: int, tok: int) -> None:
         if req.first_token_at is None:
@@ -608,7 +646,7 @@ class ServingEngine:
             logits, self.cache = self._decode(self.params, self.cache, last)
             self.steps += 1
             self.slot_steps += len(active)
-            picked = self._pick_batch(logits)
+            picked = self._pick_batch(logits, self.slots)
             for slot in active:
                 req = self.slots[slot]
                 self._emit(req, slot, int(picked[slot]))

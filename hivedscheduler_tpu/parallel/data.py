@@ -90,9 +90,12 @@ def prefetch(batches: Iterator[np.ndarray], depth: int = 2) -> Iterator[np.ndarr
     """Background-thread prefetch: batch N+1 assembles (page faults + the
     native gather, which releases the GIL) while step N computes. ``depth``
     bounds the queue so a fast producer cannot run ahead unbounded;
-    ``depth <= 0`` is a no-op passthrough. The worker is a daemon thread —
-    an abandoned iterator does not block interpreter exit — and a producer
-    exception is re-raised at the consumer's next pull."""
+    ``depth <= 0`` is a no-op passthrough. A producer exception is
+    re-raised at the consumer's next pull. Abandoning the iterator early
+    (generator close / GC — e.g. the train CLI exiting after --steps)
+    signals the worker, which exits within one poll slice instead of
+    blocking forever on the bounded queue and leaking the thread plus its
+    staged batches for the process lifetime."""
     if depth <= 0:
         yield from batches
         return
@@ -101,23 +104,38 @@ def prefetch(batches: Iterator[np.ndarray], depth: int = 2) -> Iterator[np.ndarr
 
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     stop = object()
+    closed = threading.Event()
+
+    def put(item) -> bool:
+        while not closed.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for b in batches:
-                q.put(b)
-            q.put(stop)
+                if not put(b):
+                    return
+            put(stop)
         except BaseException as e:  # surface in the consumer, not the log
-            q.put(e)
+            put(e)
 
-    threading.Thread(target=worker, daemon=True).start()
-    while True:
-        item = q.get()
-        if item is stop:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    thread = threading.Thread(target=worker, daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is stop:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        closed.set()
 
 
 def device_put_global(local_batch: np.ndarray, sharding, global_batch: int):

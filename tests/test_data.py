@@ -131,3 +131,31 @@ def test_prefetch_reraises_producer_errors():
     next(it)
     with pytest.raises(RuntimeError, match="producer exploded"):
         next(it)
+
+
+def test_prefetch_abandoned_iterator_stops_worker():
+    """Closing the consumer early (the train CLI's normal exit after
+    --steps) must signal the producer thread to exit instead of leaving it
+    blocked forever on the bounded queue (thread + staged-batch leak)."""
+    import threading
+    import time
+
+    produced = []
+
+    def infinite():
+        i = 0
+        while True:
+            produced.append(i)
+            yield np.full((2, 2), i, np.int32)
+            i += 1
+
+    before = threading.active_count()
+    it = data_lib.prefetch(infinite(), depth=2)
+    next(it)
+    it.close()  # GeneratorExit -> finally -> closed.set()
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+    # the producer stopped near where it was abandoned, not unbounded
+    assert len(produced) <= 6

@@ -110,6 +110,42 @@ class TestServingEngine:
                 jnp.zeros((1, 1), jnp.int32), moe_cfg,
             )
 
+    def test_sampled_streams_reproducible_under_interleaving(self, setup):
+        """Counter-based sampling keys (fold_in(seed, rid, n_emitted)):
+        a request's sampled stream is a function of (seed, rid, prompt)
+        only — batch interleaving and arrival order must not change it."""
+        cfg, params = setup
+        kw = dict(max_batch=2, max_len=32, temperature=0.8, top_k=20,
+                  top_p=0.9, seed=11)
+        # engine A: both requests arrive together
+        a = serving.ServingEngine(params, cfg, **kw)
+        a0 = a.submit([4, 8], 5)
+        a1 = a.submit([9, 1, 7], 6)
+        a.run_until_drained()
+        # engine B: same submission ORDER (same rids) but the second
+        # request arrives mid-decode of the first — different interleaving
+        b = serving.ServingEngine(params, cfg, **kw)
+        b0 = b.submit([4, 8], 5)
+        b.step()
+        b.step()
+        b1 = b.submit([9, 1, 7], 6)
+        b.run_until_drained()
+        assert a0.tokens_out == b0.tokens_out
+        assert a1.tokens_out == b1.tokens_out
+
+    def test_idle_row_lengths_clamp_at_arena(self, setup):
+        """Retired/parked rows advance with every shared decode step; the
+        clamp keeps their lengths (=> RoPE positions, scatter indices)
+        inside the arena instead of drifting unboundedly (ADVICE r4)."""
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=32)
+        short = eng.submit([5], 1)
+        long_req = eng.submit([5, 9, 2], 25)
+        eng.run_until_drained()
+        assert short.done and long_req.done
+        lengths = np.asarray(jax.device_get(eng.cache.lengths))
+        assert (lengths <= 32).all()
+
     def test_quantized_params_serve_exactly(self, setup):
         """int8 weight-only trees (models/quant.py) flow through the engine
         unchanged — the shared quant-aware helpers (embed_tokens/load_weight)
