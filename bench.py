@@ -341,50 +341,147 @@ def run_recovery(n_target_pods: int = 500, seed: int = 13):
     )
 
 
-def run_trace(n_jobs: int = 300, seed: int = 11):
-    """Trace-driven evaluation in the style of HiveD's OSDI'20 methodology
-    (the paper evaluates on a production trace; the repo ships none, so this
-    replays a deterministic synthetic multi-tenant trace). Run:
-    ``python bench.py --trace``.
+# -- trace replay: HiveD vs a topology-unaware strawman ----------------------
+#
+# HiveD's OSDI'20 evaluation justifies buddy-allocated contiguous slices by
+# comparing against topology-UNAWARE scheduling on the same trace
+# (/root/reference/README.md:17-23: sharing "without topology-awareness ...
+# can considerably affect training performance"). This section reproduces
+# that comparison in miniature: the same synthetic multi-tenant trace runs
+# through (a) the real HivedAlgorithm cluster and (b) NaiveCluster, a
+# first-fit host scheduler with no buddy hierarchy, no cell model and no VC
+# quotas. The headline delta is ICI contiguity: every HiveD gang is a
+# compact sub-mesh (bounding-box volume == chip count), while first-fit
+# scatters gangs across whatever hosts are free — the allocation a TPU
+# training job cannot ride ICI on.
 
-    Event-driven simulation on the v5p-1024 cluster: jobs arrive over virtual
-    time with exponential inter-arrivals, sized from a mixed gang
-    distribution, split across three VCs with guaranteed and opportunistic
-    priorities; completions free their gangs; guaranteed jobs may preempt
-    opportunistic ones. Reports scheduling-latency percentiles (wall-clock of
-    the real algorithm), queueing stats, preemption counts, and chip
-    utilization over the trace.
-    """
-    import heapq
+TRACE_TOPOLOGY = (8, 8, 16)
+TRACE_HOST_SHAPE = (2, 2, 1)
 
+
+def _parse_node_origin(node_name: str):
+    """'pod0/x-y-z' -> the host's origin chip coordinate."""
+    x, y, z = node_name.rsplit("/", 1)[1].split("-")
+    return int(x), int(y), int(z)
+
+
+def _host_chip_coords(origin):
+    """Chip coordinates covered by the host at ``origin``, leaf-index
+    (row-major) order — the TPU_VISIBLE_CHIPS contract."""
+    ox, oy, oz = origin
+    return [
+        (ox + dx, oy + dy, oz + dz)
+        for dx in range(TRACE_HOST_SHAPE[0])
+        for dy in range(TRACE_HOST_SHAPE[1])
+        for dz in range(TRACE_HOST_SHAPE[2])
+    ]
+
+
+def _gang_geometry(chips):
+    """(contiguous, bbox_inflation): a gang is ICI-contiguous iff its chips
+    exactly fill their bounding box; inflation is bbox volume / chip count
+    (1.0 = perfect sub-mesh, higher = the ICI detour factor)."""
+    xs, ys, zs = zip(*chips)
+    vol = (
+        (max(xs) - min(xs) + 1)
+        * (max(ys) - min(ys) + 1)
+        * (max(zs) - min(zs) + 1)
+    )
+    return vol == len(chips), vol / len(chips)
+
+
+def hived_gang_chips(cluster, name):
+    """Chip coordinates of a scheduled gang from the algorithm's own
+    placement record (node -> leaf indices)."""
+    g = cluster.algo.get_affinity_group(name)
+    chips = []
+    for node, idxs in g.status.physical_placement.items():
+        host = _host_chip_coords(_parse_node_origin(node))
+        chips.extend(host[i] for i in idxs)
+    return chips
+
+
+def naive_gang_chips(cluster, name):
+    """Multiple pods of one gang packed onto the same host take
+    SUCCESSIVE chip slices (tracked per host within the gang) — without
+    the offset the same leading chips would repeat, corrupting the
+    geometry metrics for sub-host gangs."""
+    chips = []
+    offset = {}
+    for host, used in cluster.groups[name]:
+        start = offset.get(host, 0)
+        chips.extend(_host_chip_coords(host)[start:start + used])
+        offset[host] = start + used
+    return chips
+
+
+class NaiveCluster:
+    """Topology-unaware strawman: first-fit over hosts in address order.
+
+    No buddy hierarchy, no cell model, no VC quotas — the scheduler HiveD's
+    evaluation compares against. Gang atomicity and priority preemption are
+    kept (a gang either fully places or fully fails; a guaranteed job may
+    kill strictly-lower-priority gangs to make room), so the delta vs
+    ``Cluster`` isolates topology-awareness, not gang semantics."""
+
+    def __init__(self):
+        self.host_free = {}
+        for x in range(0, TRACE_TOPOLOGY[0], TRACE_HOST_SHAPE[0]):
+            for y in range(0, TRACE_TOPOLOGY[1], TRACE_HOST_SHAPE[1]):
+                for z in range(0, TRACE_TOPOLOGY[2], TRACE_HOST_SHAPE[2]):
+                    self.host_free[(x, y, z)] = (
+                        TRACE_HOST_SHAPE[0] * TRACE_HOST_SHAPE[1]
+                        * TRACE_HOST_SHAPE[2]
+                    )
+        self.hosts = sorted(self.host_free)
+        self.groups = {}  # name -> [(host, chips_used)]
+        self.prio = {}
+
+    def _place(self, pods, chips):
+        placement = []
+        for h in self.hosts:
+            free = self.host_free[h]
+            while free >= chips and len(placement) < pods:
+                placement.append(h)
+                free -= chips
+            if len(placement) == pods:
+                return placement
+        return None
+
+    def schedule_gang(self, vc, priority, group, pods, chips,
+                      allow_preempt=False):
+        t0 = time.perf_counter()
+        preempted = False
+        placement = self._place(pods, chips)
+        while placement is None and allow_preempt and priority >= 0:
+            victim = min(
+                (g for g, p in self.prio.items() if p < priority),
+                key=lambda g: self.prio[g], default=None,
+            )
+            if victim is None:
+                break
+            self.free_gang(victim)
+            preempted = True
+            placement = self._place(pods, chips)
+        if placement is None:
+            return False, time.perf_counter() - t0, preempted
+        for h in placement:
+            self.host_free[h] -= chips
+        self.groups[group] = [(h, chips) for h in placement]
+        self.prio[group] = priority
+        return True, time.perf_counter() - t0, preempted
+
+    def free_gang(self, group):
+        for h, used in self.groups.pop(group):
+            self.host_free[h] += used
+        self.prio.pop(group, None)
+
+
+def make_trace_jobs(n_jobs: int, seed: int):
     rng = random.Random(seed)
-    cluster = Cluster()
-    total_chips = 1024
-
     sizes = [(1, 4), (2, 4), (4, 4), (8, 4), (16, 4), (32, 4), (64, 4)]
     size_weights = [30, 22, 18, 12, 9, 6, 3]
     vcs = ["vc-a", "vc-b", "vc-c"]
-
-    clock = 0.0
-    events = []  # completion heap: (time, seq, job)
-    seq = 0
-    waiting = []  # jobs awaiting capacity, FIFO retry on completions
-    latencies = []
-    waits = []
-    preempt_events = 0
-    busy_chip_time = 0.0
-    last_t = 0.0
-    chips_of = {}  # live group name -> chips (preempted gangs leave it)
-    scheduled = 0
-
-    def advance(to):
-        nonlocal busy_chip_time, last_t
-        # busy = currently allocated gangs only (a preempted gang stops
-        # counting the moment its cells are freed)
-        busy = sum(chips_of.get(name, 0) for name in cluster.groups)
-        busy_chip_time += busy * (to - last_t)
-        last_t = to
-
     jobs = []
     t = 0.0
     for j in range(n_jobs):
@@ -397,9 +494,58 @@ def run_trace(n_jobs: int = 300, seed: int = 11):
             "pods": pods, "chips": chips,
             "duration": rng.expovariate(1 / 120.0) + 20.0,
         })
+    return jobs
+
+
+def replay_trace(cluster, jobs, gang_chips_fn):
+    """Event-driven replay of ``jobs`` through ``cluster``; shared between
+    the HiveD run and the strawman so the comparison is apples-to-apples.
+
+    Beyond the headline stats, decomposes where utilization goes:
+
+    - waiting chip-time split by blocking reason — ``capacity`` (fewer free
+      chips than the gang needs anywhere: pure queueing, no scheduler can
+      help) vs ``packing`` (enough free chips exist but the gang could not
+      be placed: shape/quota/fragmentation — the part a scheduler owns);
+    - ``wasted`` chip-time: work preempted gangs had accrued when killed
+      (they produce no completed job, but occupied chips);
+    - offered load, for reading utilization against what arrived.
+    """
+    import heapq
+
+    total_chips = 1024
+    clock = 0.0
+    events = []  # completion heap: (time, seq, job)
+    seq = 0
+    waiting = []  # jobs awaiting capacity, FIFO retry on completions
+    latencies = []
+    waits = []
+    preempt_events = 0
+    busy_chip_time = 0.0
+    last_t = 0.0
+    chips_of = {}  # live group name -> chips (preempted gangs leave it)
+    busy_of = {}  # group name -> chip-time accrued while allocated
+    scheduled = 0
+    contiguous = 0
+    inflations = []
+    wait_chip_time = {"capacity": 0.0, "packing": 0.0}
+    wasted_chip_time = 0.0
+
+    def advance(to):
+        nonlocal busy_chip_time, last_t
+        # busy = currently allocated gangs only (a preempted gang stops
+        # counting the moment its cells are freed)
+        dt = to - last_t
+        for name in cluster.groups:
+            c = chips_of.get(name, 0)
+            busy_chip_time += c * dt
+            busy_of[name] = busy_of.get(name, 0.0) + c * dt
+        for w in waiting:
+            wait_chip_time[w["block_reason"]] += w["pods"] * w["chips"] * dt
+        last_t = to
 
     def try_schedule(job):
-        nonlocal seq, preempt_events, scheduled
+        nonlocal seq, preempt_events, scheduled, contiguous
         ok, dt, preempted = cluster.schedule_gang(
             job["vc"], job["priority"], job["name"], job["pods"], job["chips"],
             allow_preempt=job["priority"] >= 0,
@@ -407,10 +553,19 @@ def run_trace(n_jobs: int = 300, seed: int = 11):
         # victims die even when the preemptor ultimately fails to place
         preempt_events += 1 if preempted else 0
         if not ok:
+            free = total_chips - sum(
+                chips_of.get(name, 0) for name in cluster.groups
+            )
+            job["block_reason"] = (
+                "capacity" if free < job["pods"] * job["chips"] else "packing"
+            )
             return False
         latencies.append(dt)
         waits.append(clock - job["arrival"])
         chips_of[job["name"]] = job["pods"] * job["chips"]
+        is_contig, infl = _gang_geometry(gang_chips_fn(cluster, job["name"]))
+        contiguous += 1 if is_contig else 0
+        inflations.append(infl)
         seq += 1
         heapq.heappush(events, (clock + job["duration"], seq, job))
         scheduled += 1
@@ -433,6 +588,9 @@ def run_trace(n_jobs: int = 300, seed: int = 11):
             _, _, job = heapq.heappop(events)
             if job["name"] in cluster.groups:
                 cluster.free_gang(job["name"])
+            else:
+                # preempted away mid-run: everything it accrued is wasted
+                wasted_chip_time += busy_of.get(job["name"], 0.0)
             chips_of.pop(job["name"], None)
             # retry FIFO waiters
             still = []
@@ -443,16 +601,54 @@ def run_trace(n_jobs: int = 300, seed: int = 11):
     lat_ms = sorted(x * 1000.0 for x in latencies)
     p50 = statistics.median(lat_ms) if lat_ms else 0.0
     p99 = lat_ms[max(0, int(len(lat_ms) * 0.99) - 1)] if lat_ms else 0.0
+    span = last_t * total_chips
+    offered = sum(j["pods"] * j["chips"] * j["duration"] for j in jobs)
+    total_wait = sum(wait_chip_time.values())
     return {
-        "jobs": n_jobs,
+        "jobs": len(jobs),
         "scheduled": scheduled,
         "preemption_events": preempt_events,
         "sched_p50_ms": round(p50, 3),
         "sched_p99_ms": round(p99, 3),
         "wait_p50_t": round(statistics.median(waits), 2) if waits else 0.0,
-        "utilization_pct": round(100.0 * busy_chip_time / (last_t * total_chips), 1)
-        if last_t else 0.0,
+        "utilization_pct": round(100.0 * busy_chip_time / span, 1)
+        if span else 0.0,
+        # -- the decomposition + placement-quality fields ------------------
+        "offered_pct": round(100.0 * offered / span, 1) if span else 0.0,
+        "contiguous_pct": round(100.0 * contiguous / max(1, scheduled), 1),
+        "bbox_inflation": round(
+            statistics.mean(inflations), 3) if inflations else None,
+        "wait_chip_time_pct": round(100.0 * total_wait / span, 1)
+        if span else 0.0,
+        "wait_capacity_share": round(
+            wait_chip_time["capacity"] / total_wait, 3) if total_wait else 0.0,
+        "wait_packing_share": round(
+            wait_chip_time["packing"] / total_wait, 3) if total_wait else 0.0,
+        "preempt_wasted_pct": round(100.0 * wasted_chip_time / span, 1)
+        if span else 0.0,
     }
+
+
+def run_trace(n_jobs: int = 300, seed: int = 11, baseline: bool = False):
+    """Trace-driven evaluation in the style of HiveD's OSDI'20 methodology
+    (the paper evaluates on a production trace; the repo ships none, so this
+    replays a deterministic synthetic multi-tenant trace). Run:
+    ``python bench.py --trace``.
+
+    Event-driven simulation on the v5p-1024 cluster: jobs arrive over virtual
+    time with exponential inter-arrivals, sized from a mixed gang
+    distribution, split across three VCs with guaranteed and opportunistic
+    priorities; completions free their gangs; guaranteed jobs may preempt
+    opportunistic ones. Reports scheduling-latency percentiles (wall-clock of
+    the real algorithm), queueing stats, preemption counts, chip utilization,
+    ICI-contiguity of every placement, and the utilization-gap decomposition
+    (see replay_trace). ``baseline=True`` replays the SAME trace through the
+    topology-unaware NaiveCluster strawman instead.
+    """
+    jobs = make_trace_jobs(n_jobs, seed)
+    if baseline:
+        return replay_trace(NaiveCluster(), jobs, naive_gang_chips)
+    return replay_trace(Cluster(), jobs, hived_gang_chips)
 
 
 def parse_model_bench_output(returncode: int, stdout: str, stderr: str):
@@ -574,12 +770,14 @@ if __name__ == "__main__":
 
     if "--trace" in sys.argv:
         stats = run_trace()
+        naive = run_trace(baseline=True)
         print(json.dumps({
             "metric": "trace_sched_p50_ms_v5p1024",
             "value": stats["sched_p50_ms"], "unit": "ms",
             "vs_baseline": round(50.0 / stats["sched_p50_ms"], 3)
             if stats["sched_p50_ms"] else None,
             **stats,
+            **{f"naive_{k}": v for k, v in naive.items()},
         }))
         sys.exit(0)
     if "--recovery" in sys.argv:
@@ -636,9 +834,30 @@ if __name__ == "__main__":
             fields.update(trace_sched_p50_ms=t["sched_p50_ms"],
                           trace_sched_p99_ms=t["sched_p99_ms"],
                           trace_utilization_pct=t["utilization_pct"],
-                          trace_preemption_events=t["preemption_events"])
+                          trace_preemption_events=t["preemption_events"],
+                          # placement quality + utilization-gap decomposition
+                          trace_offered_pct=t["offered_pct"],
+                          trace_contiguous_pct=t["contiguous_pct"],
+                          trace_bbox_inflation=t["bbox_inflation"],
+                          trace_wait_chip_time_pct=t["wait_chip_time_pct"],
+                          trace_wait_capacity_share=t["wait_capacity_share"],
+                          trace_wait_packing_share=t["wait_packing_share"],
+                          trace_preempt_wasted_pct=t["preempt_wasted_pct"])
         except Exception as e:  # pragma: no cover - defensive
             fields["trace_error"] = f"{type(e).__name__}: {e}"
+        try:
+            # the OSDI'20-style strawman comparison: same trace, first-fit
+            # host scheduler with no buddy hierarchy (NaiveCluster)
+            b = run_trace(baseline=True)
+            fields.update(
+                trace_baseline_contiguous_pct=b["contiguous_pct"],
+                trace_baseline_bbox_inflation=b["bbox_inflation"],
+                trace_baseline_utilization_pct=b["utilization_pct"],
+                trace_baseline_wait_p50_t=b["wait_p50_t"],
+                trace_baseline_preemption_events=b["preemption_events"],
+            )
+        except Exception as e:  # pragma: no cover - defensive
+            fields["trace_baseline_error"] = f"{type(e).__name__}: {e}"
         return fields
 
     p50, p99, frag_pct = run()

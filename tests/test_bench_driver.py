@@ -75,3 +75,82 @@ class TestParseModelBenchOutput:
         fields, stamped = bench.parse_model_bench_output(0, out, "")
         assert stamped is None
         assert "tpu_backend_unavailable" in fields["model_bench_error"]
+
+
+class TestTraceStrawman:
+    """The OSDI'20-style comparison (bench.run_trace baseline=True): the
+    topology-unaware first-fit strawman must replay the same trace with the
+    same gang semantics, and the geometry/decomposition fields must expose
+    HiveD's placement advantage."""
+
+    def test_gang_geometry(self):
+        # a 2x2x1 block is contiguous; punch a hole and it isn't
+        block = [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)]
+        contig, infl = bench._gang_geometry(block)
+        assert contig and infl == 1.0
+        holed = [(0, 0, 0), (0, 1, 0), (1, 0, 0), (3, 1, 0)]
+        contig, infl = bench._gang_geometry(holed)
+        assert not contig and infl == 2.0  # bbox 4x2x1=8 over 4 chips
+
+    def test_naive_cluster_gang_semantics(self):
+        c = bench.NaiveCluster()
+        ok, _, pre = c.schedule_gang("vc", 0, "a", 4, 4)
+        assert ok and not pre
+        assert sum(f for f in c.host_free.values()) == 1024 - 16
+        # gang atomicity: an impossible gang changes nothing
+        ok, _, _ = c.schedule_gang("vc", -1, "big", 300, 4)
+        assert not ok and "big" not in c.groups
+        assert sum(f for f in c.host_free.values()) == 1024 - 16
+        c.free_gang("a")
+        assert sum(f for f in c.host_free.values()) == 1024
+
+    def test_naive_preemption_kills_lower_priority_only(self):
+        c = bench.NaiveCluster()
+        # fill the cluster with opportunistic gangs
+        for i in range(4):
+            ok, _, _ = c.schedule_gang("vc", -1, f"ot-{i}", 64, 4)
+            assert ok
+        # a guaranteed gang preempts; an equal-priority one cannot
+        ok, _, pre = c.schedule_gang("vc", 5, "guar", 64, 4,
+                                     allow_preempt=True)
+        assert ok and pre
+        ok, _, pre = c.schedule_gang("vc", 5, "guar2", 300, 4,
+                                     allow_preempt=True)
+        assert not ok  # only 3 OT gangs left = 192 hosts short anyway
+        before = dict(c.prio)
+        ok, _, pre = c.schedule_gang("vc", -1, "ot-new", 64, 4,
+                                     allow_preempt=True)
+        # opportunistic (prio<0) never preempts
+        assert c.prio.keys() >= before.keys()
+
+    def test_replay_decomposition_fields(self):
+        jobs = bench.make_trace_jobs(40, seed=3)
+        out = bench.replay_trace(bench.NaiveCluster(), jobs,
+                                 bench.naive_gang_chips)
+        for k in ("contiguous_pct", "bbox_inflation", "offered_pct",
+                  "wait_chip_time_pct", "wait_capacity_share",
+                  "wait_packing_share", "preempt_wasted_pct"):
+            assert k in out, k
+        assert out["scheduled"] <= out["jobs"]
+        if out["wait_chip_time_pct"] > 0:
+            assert 0.999 <= (out["wait_capacity_share"]
+                             + out["wait_packing_share"]) <= 1.001
+
+    def test_hived_beats_strawman_on_placement_quality(self):
+        """The reason-to-exist assertion: same trace, HiveD's placements
+        are strictly better-shaped than first-fit's (more contiguous gangs,
+        lower bounding-box inflation)."""
+        hived = bench.run_trace(n_jobs=120, seed=11)
+        naive = bench.run_trace(n_jobs=120, seed=11, baseline=True)
+        assert hived["contiguous_pct"] > naive["contiguous_pct"]
+        assert hived["bbox_inflation"] < naive["bbox_inflation"]
+
+    def test_same_host_multi_pod_gang_chips_distinct(self):
+        """Sub-host gangs: two pods packed onto one host must take
+        successive chip slices, not the same leading chips twice."""
+        c = bench.NaiveCluster()
+        ok, _, _ = c.schedule_gang("vc", 0, "g", 2, 2)
+        assert ok
+        chips = bench.naive_gang_chips(c, "g")
+        assert len(set(chips)) == 4
+        assert bench._gang_geometry(chips) == (True, 1.0)
