@@ -357,6 +357,7 @@ def run_recovery(n_target_pods: int = 500, seed: int = 13):
 
 TRACE_TOPOLOGY = (8, 8, 16)
 TRACE_HOST_SHAPE = (2, 2, 1)
+TRACE_TOTAL_CHIPS = TRACE_TOPOLOGY[0] * TRACE_TOPOLOGY[1] * TRACE_TOPOLOGY[2]
 
 
 def _parse_node_origin(node_name: str):
@@ -513,7 +514,7 @@ def replay_trace(cluster, jobs, gang_chips_fn):
     """
     import heapq
 
-    total_chips = 1024
+    total_chips = TRACE_TOTAL_CHIPS
     clock = 0.0
     events = []  # completion heap: (time, seq, job)
     seq = 0

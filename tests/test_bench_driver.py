@@ -116,12 +116,20 @@ class TestTraceStrawman:
         assert ok and pre
         ok, _, pre = c.schedule_gang("vc", 5, "guar2", 300, 4,
                                      allow_preempt=True)
-        assert not ok  # only 3 OT gangs left = 192 hosts short anyway
+        assert not ok
+        # refill: guaranteed gangs occupy everything...
+        while c.schedule_gang("vc", 5, f"fill-{len(c.groups)}", 16, 4)[0]:
+            pass
         before = dict(c.prio)
+        # ...an opportunistic arrival with allow_preempt must NOT kill
+        # anyone (prio < 0 never preempts), and an equal-priority
+        # guaranteed arrival must not either (strictly-lower only)
         ok, _, pre = c.schedule_gang("vc", -1, "ot-new", 64, 4,
                                      allow_preempt=True)
-        # opportunistic (prio<0) never preempts
-        assert c.prio.keys() >= before.keys()
+        assert not ok and not pre and c.prio == before
+        ok, _, pre = c.schedule_gang("vc", 5, "guar3", 64, 4,
+                                     allow_preempt=True)
+        assert not ok and not pre and c.prio == before
 
     def test_replay_decomposition_fields(self):
         jobs = bench.make_trace_jobs(40, seed=3)

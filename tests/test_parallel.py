@@ -416,17 +416,39 @@ class TestGQA:
             np.asarray(out_gqa), np.asarray(out_mha), atol=1e-5
         )
 
-    @pytest.mark.parametrize("impl", [
-        "ring",
-        pytest.param("ulysses", marks=pytest.mark.skip(
-            reason="XLA:CPU SIGABRT flake: this full train step (GSPMD "
-                   "all_to_all + transpose under a dp x tp x sp CPU mesh) "
-                   "passes in isolation but aborts natively once ~35 "
-                   "earlier tests ran in-process; ulysses grads/forward "
-                   "are pinned op-level (see "
-                   "test_ulysses_compact_gqa_exact_gradients)")),
-    ])
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
     def test_gqa_tp_sharded_train_step(self, impl):
+        import os
+
+        if impl == "ulysses" and not os.environ.get("HIVED_ULYSSES_TRAIN_TEST"):
+            # Why this one test is opt-in on the canonical 1-core dev box
+            # (investigated round 5; both failure modes reproduced):
+            # - in-process: passes in a fresh interpreter but SIGABRTs
+            #   natively once ~35 earlier tests ran (XLA:CPU runtime state
+            #   poisoning around GSPMD all_to_all + transpose under a
+            #   dp x tp x sp mesh);
+            # - subprocess-under-pytest: the child's 8-virtual-device
+            #   collectives trip XLA's hardcoded 40 s rendezvous
+            #   termination timeout ("Expected 2 threads to join ... only
+            #   1 arrived") because the parent's spinning Eigen pools
+            #   timeshare the single core.
+            # The step itself is correct: it passes standalone (command
+            # below — verified, though a COLD XLA compile on the 1-core
+            # box can still trip the same 40 s rendezvous timeout; the
+            # second run rides the compile cache and finishes in ~20 s),
+            # and ulysses forward/grads are pinned op-level by
+            # test_ulysses_compact_gqa_exact_gradients.
+            pytest.skip(
+                "needs a fresh interpreter + an uncontended core; run "
+                "standalone: HIVED_ULYSSES_TRAIN_TEST=1 "
+                "PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+                "python -m pytest 'tests/test_parallel.py::TestGQA::"
+                "test_gqa_tp_sharded_train_step[ulysses]'"
+            )
+        self._train_step_body(impl)
+
+    def _train_step_body(self, impl):
         from hivedscheduler_tpu.models import transformer as tm
         from hivedscheduler_tpu.parallel.train import make_sharded_train_step
 
