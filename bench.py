@@ -704,6 +704,10 @@ def parse_model_bench_output(returncode: int, stdout: str, stderr: str):
         "model_decode_hbm_roofline_frac": m["decode_hbm_roofline_frac"],
         "model_serve_tokens_per_sec": m.get("serve_tokens_per_sec"),
         "model_serve_occupancy": m.get("serve_occupancy"),
+        # serving bars (BASELINE.md): pass/fail travels with the numbers
+        "model_decode_roofline_pass": m.get("decode_roofline_pass"),
+        "model_serve_slot_efficiency": m.get("serve_slot_efficiency"),
+        "model_serve_slot_efficiency_pass": m.get("serve_slot_efficiency_pass"),
         "model_serve_prefix_speedup": m.get("serve_prefix_speedup"),
         "model_serve_prefix_ttft_speedup": m.get("serve_prefix_ttft_speedup"),
         "model_device": m["device"],

@@ -17,6 +17,8 @@ def _result_line(**over):
         "decode_hbm_roofline_frac": 0.81, "serve_tokens_per_sec": 9000.0,
         "serve_occupancy": 0.9, "serve_prefix_speedup": 1.4,
         "serve_prefix_ttft_speedup": 2.1,
+        "decode_roofline_pass": True, "serve_slot_efficiency": 0.85,
+        "serve_slot_efficiency_pass": True,
     }
     m.update(over)
     return json.dumps(m)
@@ -31,6 +33,10 @@ class TestParseModelBenchOutput:
         assert fields["model_serve_tokens_per_sec"] == 9000.0
         assert fields["model_serve_prefix_speedup"] == 1.4
         assert fields["model_serve_prefix_ttft_speedup"] == 2.1
+        # the serving bars' pass/fail travels with the numbers
+        assert fields["model_decode_roofline_pass"] is True
+        assert fields["model_serve_slot_efficiency"] == 0.85
+        assert fields["model_serve_slot_efficiency_pass"] is True
         assert stamped["captured_by"] == "bench.py driver path"
         assert stamped["captured_at_utc"].endswith("Z")
 
