@@ -24,6 +24,9 @@ class MiniApiServer:
         self.rv = 1
         self.watchers = []  # queues of event dicts
         self.lock = threading.Lock()
+        # wire-request log (method, path-with-query) — the kind-e2e dry-run
+        # derives the client's required RBAC verbs from this
+        self.requests = []
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -31,6 +34,13 @@ class MiniApiServer:
 
             def log_message(self, *a):
                 pass
+
+            def parse_request(self):
+                ok = super().parse_request()
+                if ok:
+                    with outer.lock:
+                        outer.requests.append((self.command, self.path))
+                return ok
 
             def _json(self, code, obj):
                 body = json.dumps(obj).encode()
