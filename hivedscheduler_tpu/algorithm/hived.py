@@ -793,6 +793,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
             suggested_nodes=suggested_nodes,
             ignore_suggested_nodes=s.ignore_k8s_suggested_nodes,
             multi_chain_relax=s.multi_chain_relax_enable,
+            multi_chain_relax_policy=s.multi_chain_relax_policy,
         )
         for m in s.affinity_group.members:
             sr.affinity_group_pod_nums[m.leaf_cell_number] = (
@@ -919,35 +920,50 @@ class HivedAlgorithm(SchedulerAlgorithm):
         runs the normal per-chain path, so VC-safety accounting is preserved
         chain by chain. All-or-nothing: if pods remain after the last chain,
         every committed lazy preemption is reverted and the group waits.
+
+        ``multiChainRelaxPolicy: balanced`` keeps the same minimal chain
+        set but water-fills the gang's chips across it (bounded by each
+        chain's largest AVAILABLE cell — a sub-request is buddy-enclosed in
+        one cell), minimizing the largest sub-gang: a hierarchical
+        (ICI-then-DCN) collective is then paced by comparable-size ICI
+        phases instead of one oversized sub-gang. Targets are enforced as
+        CUMULATIVE allowances, so a shortfall on one chain rolls forward
+        into the next chain's budget in the same single pass — each chain
+        is still probed at most once (a re-probe would hand out the same
+        uncommitted cells twice).
         Per-pod cell chains are recorded in the bind info, and recovery
         relies on find_physical_leaf_cell's cross-chain fallback.
         """
         guaranteed_req = sr.priority >= MIN_GUARANTEED_PRIORITY
 
+        def root_available(chain: CellChain) -> List[int]:
+            """Per-preassigned-root available leaf counts for a guaranteed
+            request: quota minus same-or-higher-priority usage, so
+            lazily-preemptible lower-priority cells count — free cells
+            alone would under-rank chains full of preemptible pods and
+            smear the gang across more chains. Roots at every level (a VC
+            may mix whole-pod and sub-cell quotas in one chain);
+            descendants are skipped to avoid double counting. ONE home for
+            this accounting: the chain ranking sums it, the balanced
+            policy's contiguity estimate maxes it."""
+            full = self.vc_schedulers[sr.vc].non_pinned_full_cell_list.get(chain)
+            if not full:
+                return []
+            return [
+                c.total_leaf_cell_num
+                - sum(
+                    n
+                    for q, n in c.used_leaf_cell_num_at_priorities.items()
+                    if q >= sr.priority
+                )
+                for level in full
+                for c in full[level]
+                if c.preassigned_cell is c
+            ]
+
         def free_leaf_capacity(chain: CellChain) -> int:
             if guaranteed_req:
-                # a guaranteed request can also take lazily-preemptible
-                # capacity (anything its VC holds below sr.priority), so
-                # count quota minus same-or-higher-priority usage — free
-                # cells alone would under-rank chains full of preemptible
-                # pods and smear the gang across more chains
-                full = self.vc_schedulers[sr.vc].non_pinned_full_cell_list.get(chain)
-                if not full:
-                    return 0
-                # sum over preassigned roots at every level (a VC may mix
-                # whole-pod and sub-cell quotas in one chain); descendants
-                # are skipped to avoid double counting
-                return sum(
-                    c.total_leaf_cell_num
-                    - sum(
-                        n
-                        for q, n in c.used_leaf_cell_num_at_priorities.items()
-                        if q >= sr.priority
-                    )
-                    for level in full
-                    for c in full[level]
-                    if c.preassigned_cell is c
-                )
+                return sum(root_available(chain))
             leaf_num = self.leaf_cell_nums[chain]
             return sum(
                 len(cells) * leaf_num[l]
@@ -961,62 +977,151 @@ class HivedAlgorithm(SchedulerAlgorithm):
         flat: List[int] = []
         for ln in sorted(sr.affinity_group_pod_nums, reverse=True):
             flat.extend([ln] * sr.affinity_group_pod_nums[ln])
-        merged_phys: GroupPhysicalPlacement = {}
-        merged_virt: GroupVirtualPlacement = {}
-        committed_lazy: Dict[str, GroupVirtualPlacement] = {}
-        original_pod_nums = sr.affinity_group_pod_nums
-        idx = 0
-        try:
-            for chain in chains:
-                if idx >= len(flat):
+
+        def contiguous_capacity(chain: CellChain) -> int:
+            """Largest single sub-gang this chain could host contiguously —
+            a sub-request is buddy-enclosed in ONE cell, so this is the
+            largest available cell, not the capacity sum. Optimistic
+            estimate only: the probe loop verifies with real placements."""
+            if guaranteed_req:
+                return max(root_available(chain), default=0)
+            leaf_num = self.leaf_cell_nums[chain]
+            return max(
+                (leaf_num[l] for l, cells in self.free_cell_list[chain].items()
+                 if cells),
+                default=0,
+            )
+
+        # Cumulative chip allowance per chain position. INVARIANT: each
+        # chain is probed at most ONCE per relax call — probes compute
+        # placements from uncommitted cell state, so a second probe of the
+        # same chain would hand out the SAME physical cells again
+        # (double-booking). "fewest" allows every chain the whole gang;
+        # "balanced" water-fills the gang's chips over the minimal chain
+        # set whose contiguous capacities cover it (minimizing the largest
+        # sub-gang: every sub-gang then runs its ICI collective phase at a
+        # comparable size instead of one oversized sub-gang straggling the
+        # hierarchical ICI-then-DCN collective), and any shortfall against
+        # the estimated targets rolls FORWARD into later chains' allowance
+        # — feasibility degrades gracefully without ever re-probing.
+        total = sum(flat)
+        allowance = [total] * len(chains)
+        if sr.multi_chain_relax_policy == "balanced":
+            caps = [contiguous_capacity(c) for c in chains]
+            k, acc = 0, 0
+            for cap in caps:
+                if acc >= total:
                     break
-                # chip-count upper bound: no point probing prefixes that hold
-                # more chips than the whole chain (keeps the descent linear
-                # overall instead of O(pods) probes per small chain)
-                chain_chips = sum(
-                    c.total_leaf_cell_num
-                    for c in self.full_cell_list[chain][max(self.full_cell_list[chain])]
-                )
-                max_take = 0
-                chips = 0
-                for ln in flat[idx:]:
-                    if chips + ln > chain_chips:
+                k += 1
+                acc += cap
+            if acc >= total:
+                # minimize the max target subject to target_i <= cap_i
+                # (smallest caps pinned first, remainder over the rest);
+                # caps are true per-probe upper bounds (a sub-request is
+                # enclosed in one cell <= the largest available), so when
+                # even their sum can't cover the gang we keep the plain
+                # fewest allowances and let the round fail honestly
+                targets = {}
+                remaining, left = total, k
+                for i in sorted(range(k), key=lambda i: caps[i]):
+                    targets[i] = min(caps[i], -(-remaining // left))
+                    remaining -= targets[i]
+                    left -= 1
+                cum = 0
+                for i in range(len(chains)):
+                    # chains beyond the chosen k carry the full remaining
+                    # allowance (pure fallback: they only see pods the
+                    # chosen set failed to absorb)
+                    cum = cum + targets[i] if i < k else total
+                    allowance[i] = cum
+
+        def run_pass(allow: List[int]):
+            """One partition attempt under cumulative allowances ``allow``.
+            Probes commit nothing to cell state except lazy preemptions
+            (returned for the caller to keep or revert), so a failed pass
+            leaves the cluster exactly as found once those are reverted."""
+            merged_phys: GroupPhysicalPlacement = {}
+            merged_virt: GroupVirtualPlacement = {}
+            committed_lazy: Dict[str, GroupVirtualPlacement] = {}
+            idx = 0
+            placed_chips = 0
+            try:
+                for pos, chain in enumerate(chains):
+                    if idx >= len(flat):
                         break
-                    chips += ln
-                    max_take += 1
-                for take in range(max_take, 0, -1):
-                    if idx == 0 and take == len(flat):
-                        # the whole-group attempt on this chain already ran
-                        # (and failed, self-reverting) in the single-chain
-                        # pass; re-probing it verbatim is pure waste
-                        continue
-                    counts: Dict[int, int] = {}
-                    for ln in flat[idx:idx + take]:
-                        counts[ln] = counts.get(ln, 0) + 1
-                    sr.chain = chain
-                    sr.affinity_group_pod_nums = counts
-                    physical, virtual, _ = self._handle_scheduling_request(
-                        sr, collect_lazy=committed_lazy
+                    # chip-count upper bound: no point probing prefixes
+                    # that hold more chips than the whole chain (keeps the
+                    # descent linear overall instead of O(pods) probes per
+                    # small chain); the balanced policy further caps it at
+                    # this chain's cumulative allowance minus what's
+                    # already placed
+                    chain_chips = sum(
+                        c.total_leaf_cell_num
+                        for c in self.full_cell_list[chain][max(self.full_cell_list[chain])]
                     )
-                    if physical is not None:
-                        for ln, podps in physical.items():
-                            merged_phys.setdefault(ln, []).extend(podps)
-                        if virtual is not None:
-                            for ln, podps in virtual.items():
-                                merged_virt.setdefault(ln, []).extend(podps)
-                        idx += take
-                        log.info(
-                            "Relaxed %s pod(s) of group %s onto chain %s",
-                            take, sr.affinity_group_name, chain,
+                    limit = min(chain_chips, allow[pos] - placed_chips)
+                    max_take = 0
+                    chips = 0
+                    for ln in flat[idx:]:
+                        if chips + ln > limit:
+                            break
+                        chips += ln
+                        max_take += 1
+                    for take in range(max_take, 0, -1):
+                        if idx == 0 and take == len(flat):
+                            # the whole-group attempt on this chain already
+                            # ran (and failed, self-reverting) in the
+                            # single-chain pass; re-probing it verbatim is
+                            # pure waste
+                            continue
+                        counts: Dict[int, int] = {}
+                        for ln in flat[idx:idx + take]:
+                            counts[ln] = counts.get(ln, 0) + 1
+                        sr.chain = chain
+                        sr.affinity_group_pod_nums = counts
+                        physical, virtual, _ = self._handle_scheduling_request(
+                            sr, collect_lazy=committed_lazy
                         )
-                        break
-        finally:
-            sr.affinity_group_pod_nums = original_pod_nums
-        if idx < len(flat):
+                        if physical is not None:
+                            for ln, podps in physical.items():
+                                merged_phys.setdefault(ln, []).extend(podps)
+                            if virtual is not None:
+                                for ln, podps in virtual.items():
+                                    merged_virt.setdefault(ln, []).extend(podps)
+                            placed_chips += sum(flat[idx:idx + take])
+                            idx += take
+                            log.info(
+                                "Relaxed %s pod(s) of group %s onto chain %s",
+                                take, sr.affinity_group_name, chain,
+                            )
+                            break
+            finally:
+                sr.affinity_group_pod_nums = original_pod_nums
+            return idx, merged_phys, merged_virt, committed_lazy
+
+        def revert_lazy(committed_lazy: Dict[str, GroupVirtualPlacement]):
             for group_name, placement in committed_lazy.items():
                 g = self.affinity_groups.get(group_name)
                 if g is not None:
                     self._revert_lazy_preempt(g, placement)
+
+        original_pod_nums = sr.affinity_group_pod_nums
+        idx, merged_phys, merged_virt, committed_lazy = run_pass(allowance)
+        if idx < len(flat) and any(a != total for a in allowance):
+            # the balanced targets are optimistic ESTIMATES (a chain's
+            # achievable contiguous take can undershoot root_available —
+            # e.g. higher-priority chips scattered across its cells): when
+            # the balanced partition comes up short, revert its lazy
+            # commits and rerun the whole pass under plain fewest-chains
+            # allowances so feasibility never regresses vs `fewest`.
+            # Probes committed nothing else, so the retry sees pristine
+            # state — no cell is ever handed out twice.
+            revert_lazy(committed_lazy)
+            idx, merged_phys, merged_virt, committed_lazy = run_pass(
+                [total] * len(chains)
+            )
+        if idx < len(flat):
+            revert_lazy(committed_lazy)
             return None, None, (
                 "insufficient capacity even after relaxing the affinity group "
                 "across cell chains"

@@ -96,6 +96,8 @@ class SchedulingRequest:
     suggested_nodes: Set[str] = field(default_factory=set)
     ignore_suggested_nodes: bool = False
     multi_chain_relax: bool = True
+    # "fewest" | "balanced" — see api.types.PodSchedulingSpec
+    multi_chain_relax_policy: str = "fewest"
 
 
 # placements: leafCellNum -> list over pods -> list of leaf cells of the pod

@@ -334,6 +334,13 @@ class PodSchedulingSpec:
     # with False the group waits (reference behavior) instead of being
     # split across same-leaf-type chains when no single chain fits
     multi_chain_relax_enable: bool = True
+    # how a relaxed gang is partitioned across chains: "fewest" (default)
+    # takes the largest prefix each chain accepts — fewest cross-chain
+    # (DCN) boundaries; "balanced" equalizes sub-gang chip counts over the
+    # minimal chain set — the per-sub-gang ICI phase of a hierarchical
+    # collective is balanced instead of straggled by one oversized
+    # sub-gang
+    multi_chain_relax_policy: str = "fewest"
     affinity_group: Optional[AffinityGroupSpec] = None
 
     @staticmethod
@@ -350,6 +357,7 @@ class PodSchedulingSpec:
             lazy_preemption_enable=bool(d.get("lazyPreemptionEnable", False)),
             ignore_k8s_suggested_nodes=bool(d.get("ignoreK8sSuggestedNodes", True)),
             multi_chain_relax_enable=bool(d.get("multiChainRelaxEnable", True)),
+            multi_chain_relax_policy=d.get("multiChainRelaxPolicy", "fewest"),
             affinity_group=(
                 AffinityGroupSpec.from_dict(d["affinityGroup"]) if d.get("affinityGroup") else None
             ),
@@ -366,6 +374,8 @@ class PodSchedulingSpec:
             "ignoreK8sSuggestedNodes": self.ignore_k8s_suggested_nodes,
             "multiChainRelaxEnable": self.multi_chain_relax_enable,
         }
+        if self.multi_chain_relax_policy != "fewest":
+            out["multiChainRelaxPolicy"] = self.multi_chain_relax_policy
         if self.pinned_cell_id:
             out["pinnedCellId"] = self.pinned_cell_id
         if self.affinity_group is not None:

@@ -293,6 +293,10 @@ def extract_pod_scheduling_spec(pod: Pod) -> api.PodSchedulingSpec:
         )
     if spec.leaf_cell_number <= 0:
         raise api.as_bad_request(err_pfx + "LeafCellNumber is non-positive")
+    if spec.multi_chain_relax_policy not in ("fewest", "balanced"):
+        raise api.as_bad_request(
+            err_pfx + "MultiChainRelaxPolicy must be fewest or balanced"
+        )
     if not spec.affinity_group.name:
         raise api.as_bad_request(err_pfx + "AffinityGroup.Name is empty")
     is_pod_in_group = False

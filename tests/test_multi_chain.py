@@ -348,3 +348,152 @@ class TestMultiChainRelaxation:
             chains_used.add(r.pod_bind_info.cell_chain)
             algo.add_allocated_pod(new_binding_pod(pod, r.pod_bind_info))
         assert chains_used == {"podA", "podB"}
+
+
+def build_two_big_chain_config():
+    """Two 16-chip chains wholly owned by vc1 — the balanced-vs-fewest
+    partition fixture (a 24-chip gang fits on neither alone)."""
+    big = MeshSpec(topology=(4, 2, 2), chip_type="v5p-chip",
+                   host_shape=(2, 2, 1), levels=[])
+    return new_config(Config(
+        physical_cluster=PhysicalClusterSpec(
+            cell_types={
+                "podA": CellTypeSpec(mesh=big),
+                "podB": CellTypeSpec(mesh=big),
+            },
+            physical_cells=[
+                PhysicalCellSpec(cell_type="podA", cell_address="a0"),
+                PhysicalCellSpec(cell_type="podB", cell_address="b0"),
+            ],
+        ),
+        virtual_clusters={
+            "vc1": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=1, cell_type="podA"),
+                VirtualCellSpec(cell_number=1, cell_type="podB"),
+            ]),
+        },
+    ))
+
+
+class TestBalancedRelaxPolicy:
+    """multiChainRelaxPolicy: balanced — equalize sub-gang chip counts
+    over the minimal chain set (per-sub-gang ICI collective phases pace
+    evenly) instead of largest-prefix-first (one oversized sub-gang
+    straggles the hierarchical collective)."""
+
+    def run_gang(self, policy, name):
+        return self.run_gang_pods(6, policy, name)[0]
+
+    def test_balanced_beats_fewest_on_max_subgang(self):
+        """The golden delta on the adversarial fixture: same 2 chains,
+        fewest-chains takes 16+8 chips (max sub-gang 16 — its ICI phase
+        paces the whole collective), balanced takes 12+12."""
+        fewest = self.run_gang(None, "fw")
+        balanced = self.run_gang("balanced", "bl")
+        assert sorted(fewest.values()) == [2, 4], fewest
+        assert sorted(balanced.values()) == [3, 3], balanced
+        assert max(balanced.values()) < max(fewest.values())
+        assert len(balanced) == len(fewest) == 2  # same chain count
+
+    def test_balanced_feasibility_never_regresses(self):
+        """A gang that doesn't split evenly (5 pods over two 16-chip
+        chains) must still fully place under balanced — the shortfall on
+        the first chain rolls forward into the next chain's allowance —
+        and every pod must hold DISJOINT physical chips (round 5 review
+        caught a fallback re-probe double-booking the same leaf cells;
+        this pins the fix)."""
+        per_chain, placements = self.run_gang_pods(5, "balanced", "odd")
+        assert sum(per_chain.values()) == 5
+        assert len(placements) == len(set(placements)) == 5
+        chips_used = set()
+        for node, iso in placements:
+            for chip in iso.split(","):
+                assert (node, chip) not in chips_used, (node, chip)
+                chips_used.add((node, chip))
+
+    def run_gang_pods(self, pods, policy, name, algo=None):
+        from hivedscheduler_tpu.api import constants as C
+
+        random.seed(0)
+        h = algo or HivedAlgorithm(build_two_big_chain_config())
+        nodes = sorted({n for ccl in h.full_cell_list.values()
+                        for c in ccl[max(ccl)] for n in c.nodes})
+        for n in nodes:
+            h.add_node(Node(name=n))
+        spec = gang_spec(pods, name=name)
+        if policy:
+            spec["multiChainRelaxPolicy"] = policy
+        per_chain = {}
+        placements = []
+        for i in range(pods):
+            pod = make_pod(f"{name}-{i}", spec)
+            r = h.schedule(pod, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None, (i, r.pod_wait_info)
+            per_chain[r.pod_bind_info.cell_chain] = (
+                per_chain.get(r.pod_bind_info.cell_chain, 0) + 1
+            )
+            bp = new_binding_pod(pod, r.pod_bind_info)
+            placements.append((r.pod_bind_info.node,
+                               bp.annotations[C.ANNOTATION_POD_CHIP_ISOLATION]))
+            h.add_allocated_pod(bp)
+        return per_chain, placements
+
+    def test_balanced_falls_back_when_caps_overestimate(self):
+        """root_available is an optimistic estimate: chain B's 14
+        available chips hide that only two clean 4-cells (8 chips) are
+        achievable once higher-priority chips sit scattered across its
+        hosts. The balanced targets (12+12) then come up short, and the
+        policy must rerun under fewest allowances (16+8) instead of
+        leaving the gang waiting — with all placements disjoint."""
+        random.seed(0)
+        h = HivedAlgorithm(build_two_big_chain_config())
+        nodes = sorted({n for ccl in h.full_cell_list.values()
+                        for c in ccl[max(ccl)] for n in c.nodes})
+        for n in nodes:
+            h.add_node(Node(name=n))
+        # two priority-10 single-chip blockers on DIFFERENT hosts of podB
+        blocker = {"virtualCluster": "vc1", "priority": 10,
+                   "chipType": "v5p-chip", "chipNumber": 1,
+                   "ignoreK8sSuggestedNodes": False,
+                   "affinityGroup": None}
+        placed_hosts = set()
+        for i in range(2):
+            spec = dict(blocker)
+            spec["affinityGroup"] = {
+                "name": f"blk-{i}",
+                "members": [{"podNumber": 1, "chipNumber": 1}]}
+            pod = make_pod(f"blk-{i}", spec)
+            b_nodes = [n for n in nodes if n.startswith("b0")
+                       and n not in placed_hosts]
+            r = h.schedule(pod, b_nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None, r.pod_wait_info
+            placed_hosts.add(r.pod_bind_info.node)
+            h.add_allocated_pod(new_binding_pod(pod, r.pod_bind_info))
+        assert len(placed_hosts) == 2  # scattered: two hosts each lose a chip
+
+        per_chain, placements = self.run_gang_pods(6, "balanced", "cap",
+                                                   algo=h)
+        assert sum(per_chain.values()) == 6
+        assert per_chain == {"podA": 4, "podB": 2}, per_chain
+        chips_used = set()
+        for node, iso in placements:
+            for chip in iso.split(","):
+                assert (node, chip) not in chips_used, (node, chip)
+                chips_used.add((node, chip))
+
+    def test_unknown_policy_rejected(self):
+        random.seed(0)
+        h = HivedAlgorithm(build_two_big_chain_config())
+        nodes = sorted({n for ccl in h.full_cell_list.values()
+                        for c in ccl[max(ccl)] for n in c.nodes})
+        for n in nodes:
+            h.add_node(Node(name=n))
+        spec = gang_spec(2, name="bad")
+        spec["multiChainRelaxPolicy"] = "balenced"
+        import pytest as _pytest
+
+        from hivedscheduler_tpu.api.types import WebServerError
+
+        with _pytest.raises(WebServerError,
+                            match="MultiChainRelaxPolicy"):
+            h.schedule(make_pod("bad-0", spec), nodes, FILTERING_PHASE)
