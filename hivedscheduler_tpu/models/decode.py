@@ -124,6 +124,19 @@ def _cached_attention(q, ck, cv, pos0, scale):
     return o.reshape(b, s_len, h, d).astype(q.dtype)
 
 
+def inference_moe_cfg(cfg: TransformerConfig) -> TransformerConfig:
+    """No-drop inference capacity: ceil(S*k*E/E) = S*k slots per expert
+    covers the worst-case routing skew (see module docstring), so every
+    inference path routes exactly — a dropped token would silently change
+    the stream. ONE home for the rule: decode.advance and
+    serving.advance_ragged must stay routing-identical."""
+    if cfg.n_experts <= 0:
+        return cfg
+    return dataclasses.replace(
+        cfg, expert_capacity_factor=float(max(cfg.n_experts, 1))
+    )
+
+
 def advance(
     params: Dict[str, Any],
     cache: KVCache,
@@ -139,12 +152,7 @@ def advance(
     x = embed_tokens(params, tokens, dtype)  # [B, S, D]
     positions = (pos0 + lax.iota(jnp.int32, s_len))[None, :]
     scale = 1.0 / math.sqrt(cfg.head_dim)
-    if cfg.n_experts > 0:
-        # no-drop inference capacity: ceil(S*k*E/E) = S*k slots per expert
-        # covers the worst-case routing skew (see module docstring)
-        cfg = dataclasses.replace(
-            cfg, expert_capacity_factor=float(max(cfg.n_experts, 1))
-        )
+    cfg = inference_moe_cfg(cfg)
 
     def layer(x, scanned):
         lp, ck, cv = scanned

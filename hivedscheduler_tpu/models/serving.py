@@ -54,10 +54,12 @@ from hivedscheduler_tpu.models.decode import (
     embed_tokens,
     filter_logits,
     final_logits,
+    inference_moe_cfg,
     qkv_proj,
 )
 from hivedscheduler_tpu.models.transformer import (
     TransformerConfig,
+    _moe_mlp,
     _rms_norm,
     load_weight,
 )
@@ -122,8 +124,7 @@ def advance_ragged(
       ``lengths`` that the causal mask never reads.
     """
     dtype = cfg.dtype
-    if cfg.n_experts > 0:
-        raise NotImplementedError("continuous batching serves dense models")
+    cfg = inference_moe_cfg(cfg)  # routing-exact: no-drop capacity
     b_t, s_len = tokens.shape
     if row is None:
         positions = cache.lengths[:, None] + lax.iota(jnp.int32, s_len)[None, :]
@@ -167,7 +168,11 @@ def advance_ragged(
         attn = _ragged_attention(q, att_k, att_v, positions, scale)
         x = x + jnp.einsum("bshk,hkd->bsd", attn, load_weight(lp["wo"], dtype))
         h = _rms_norm(x, lp["mlp_norm"])
-        x = x + dense_mlp(lp, h, dtype)
+        if cfg.n_experts > 0:
+            moe_out, _ = _moe_mlp(h, lp, cfg, dtype)
+            x = x + moe_out
+        else:
+            x = x + dense_mlp(lp, h, dtype)
         return x, (ck, cv)
 
     x, (new_k, new_v) = lax.scan(

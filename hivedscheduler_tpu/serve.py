@@ -43,6 +43,10 @@ def main(argv=None) -> int:
     parser.add_argument("--n-heads", type=int, default=8)
     parser.add_argument("--n-kv-heads", type=int, default=0)
     parser.add_argument("--d-ff", type=int, default=1408)
+    parser.add_argument("--n-experts", type=int, default=0,
+                        help="serve a MoE model (routing-exact: no-drop "
+                        "inference capacity)")
+    parser.add_argument("--moe-top-k", type=int, default=1)
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel serving over a tp mesh axis")
     parser.add_argument("--dp", type=int, default=1,
@@ -108,6 +112,8 @@ def main(argv=None) -> int:
         n_layers=args.n_layers,
         d_ff=args.d_ff,
         max_seq_len=args.max_len,
+        n_experts=args.n_experts,
+        moe_top_k=args.moe_top_k,
     )
     from hivedscheduler_tpu.parallel import checkpoint as ckpt
 

@@ -102,13 +102,6 @@ class TestServingEngine:
             eng.submit([1, 2], 0)
         with pytest.raises(ValueError, match="exceeds"):
             eng.submit([1, 2], 64)
-        with pytest.raises(NotImplementedError):
-            moe_cfg = cfg_of(n_experts=2)
-            serving.advance_ragged(
-                tm.init_params(moe_cfg, jax.random.PRNGKey(0)),
-                serving.init_ragged_cache(moe_cfg, 1, 8),
-                jnp.zeros((1, 1), jnp.int32), moe_cfg,
-            )
 
     def test_sampled_streams_reproducible_under_interleaving(self, setup):
         """Counter-based sampling keys (fold_in(seed, rid, n_emitted)):
