@@ -66,6 +66,19 @@ from hivedscheduler_tpu.models.transformer import (
 from hivedscheduler_tpu.ops.attention import NEG_INF
 
 
+def _stream_key(base_key, rid, count, tag: int = 0):
+    """The engine's counter-based sampling key for (request, emitted
+    position): fold_in(fold_in(base, rid), count), optionally folded with
+    a purpose ``tag``. ONE home on purpose: the plain sampler (tag 0) and
+    the speculative engine's proposal (0) / accept (1) / residual (2)
+    draws MUST derive keys identically — the perfect-draft bit-exactness
+    guarantee (a proposal is drawn with the very key the plain engine
+    would use at that position) is structural only while they share this
+    function."""
+    k = jax.random.fold_in(jax.random.fold_in(base_key, rid), count)
+    return jax.random.fold_in(k, tag) if tag else k
+
+
 class RaggedCache(NamedTuple):
     """KV cache with a per-row length: k/v [L, B, M, H_kv, D], lengths [B]."""
 
@@ -285,6 +298,7 @@ class ServingEngine:
         # on scheduling churn). Greedy (temperature=0) stays the bit-exact
         # mode either way.
         base_key = jax.random.PRNGKey(seed)
+        self._base_key = base_key  # subclasses derive per-row keys from it
 
         def sample_rows(logits, rids, counts):
             filtered = filter_logits(
@@ -292,9 +306,7 @@ class ServingEngine:
                 top_k, top_p,
             )
             keys = jax.vmap(
-                lambda r, c: jax.random.fold_in(
-                    jax.random.fold_in(base_key, r), c)
-            )(rids, counts)
+                lambda r, c: _stream_key(base_key, r, c))(rids, counts)
             return jax.vmap(jax.random.categorical)(keys, filtered)
 
         self._sample = jax.jit(sample_rows)
@@ -691,9 +703,20 @@ class SpeculativeServingEngine(ServingEngine):
     applied per row. Greedy speculation is exact: every row's stream equals
     vanilla greedy decode (guard: test_serving_speculative.py).
 
-    Greedy only (temperature must be 0): per-row residual resampling would
-    need per-row RNG bookkeeping; the uniform-batch sampled path remains in
-    models/speculative.py.
+    Sampled speculation (temperature > 0) does per-row residual
+    resampling (accept x_i ~ q with prob min(1, p(x_i)/q(x_i)); on reject
+    sample from norm(max(p-q, 0)); on full acceptance a bonus token from
+    p), so sampled output is distributed exactly as the target model's —
+    the standard speculative-sampling guarantee — while each row still
+    advances independently. All draws use the engine's counter-based keys
+    (seed x rid x emitted-position, tagged per purpose), which makes
+    sampled speculative streams reproducible across batch interleavings
+    AND makes a perfect draft (draft == target) reproduce the plain
+    sampled engine's stream bit-exactly: every proposal is drawn with the
+    SAME key the plain engine would use at that position, acceptance is
+    then certain, and the bonus token uses the plain key too (guard:
+    test_serving_speculative_sampled.py). Greedy (temperature 0) remains
+    bit-exact vs vanilla greedy decode.
 
     Composes with chunked prefill (``prefill_chunk > 0``): prompt chunks
     absorb into BOTH caches per engine step (the shared chunk tick's
@@ -705,8 +728,6 @@ class SpeculativeServingEngine(ServingEngine):
 
     def __init__(self, params, cfg, draft_params, draft_cfg, *, gamma: int = 4,
                  **kw):
-        if kw.get("temperature", 0.0) != 0.0:
-            raise ValueError("SpeculativeServingEngine is greedy-only")
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError("target and draft vocabs must match")
         if gamma < 1:
@@ -769,6 +790,95 @@ class SpeculativeServingEngine(ServingEngine):
         self._draft_prefill = jax.jit(draft_prefill, donate_argnums=(1,))
         self._spec_round = jax.jit(spec_round, donate_argnums=(2, 3))
 
+        if self.temperature > 0.0:
+            temp, topk, topp = self.temperature, self.top_k, self.top_p
+            base_key = self._base_key
+
+            def row_key(r, c, tag):
+                # shared _stream_key: tag 0 is BIT-IDENTICAL to the plain
+                # engine's sampling key (perfect-draft exactness); tags
+                # 1/2 are independent streams for accept/residual draws
+                return _stream_key(base_key, r, c, tag)
+
+            def spec_round_sampled(tparams, dparams, tcache, dcache, last,
+                                   rids, counts):
+                def fdist(logits):
+                    return filter_logits(logits / temp, topk, topp)
+
+                def draft_step(carry, i):
+                    dc, tok = carry
+                    logits, dc = advance_ragged(dparams, dc, tok[:, None],
+                                                draft_cfg)
+                    f = fdist(logits[:, 0])
+                    keys = jax.vmap(
+                        lambda r, c: row_key(r, c + i, 0))(rids, counts)
+                    nxt = jax.vmap(jax.random.categorical)(keys, f)
+                    return (dc, nxt.astype(jnp.int32)), (nxt, f)
+
+                (dcache, last_d), (props, qf) = jax.lax.scan(
+                    draft_step, (dcache, last), jnp.arange(gamma)
+                )
+                # extra absorb so the draft cache holds its last proposal
+                # when a row accepts everything (greedy round does the same)
+                _, dcache = advance_ragged(dparams, dcache, last_d[:, None],
+                                           draft_cfg)
+                props = jnp.swapaxes(props, 0, 1).astype(jnp.int32)  # [B,g]
+                qf = jnp.swapaxes(qf, 0, 1)                      # [B,g,V]
+                tgt_in = jnp.concatenate([last[:, None], props], axis=1)
+                tlogits, tcache = advance_ragged(tparams, tcache, tgt_in, cfg)
+                pf = fdist(tlogits)                              # [B,g+1,V]
+                p = jax.nn.softmax(pf, axis=-1)
+                q = jax.nn.softmax(qf, axis=-1)
+                b_rows = props.shape[0]
+                rows = jnp.arange(b_rows)
+                gidx = jnp.arange(gamma)
+                # accept proposal i iff u_i < p_i(x_i)/q_i(x_i)
+                px = p[rows[:, None], gidx[None, :], props]
+                qx = q[rows[:, None], gidx[None, :], props]
+                u = jax.vmap(
+                    lambda r, c: jax.vmap(
+                        lambda i: jax.random.uniform(row_key(r, c + i, 1))
+                    )(gidx)
+                )(rids, counts)
+                accept = u * qx < px
+                acc = jnp.sum(
+                    jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+                )
+                # the token at position acc: residual resample on a reject,
+                # bonus sample from the target's extra position on full
+                # acceptance (with the PLAIN tag-0 key and the RAW filtered
+                # logits — bit-matching the plain engine's categorical)
+                p_at = p[rows, acc]
+                q_at = jnp.where(
+                    (acc < gamma)[:, None],
+                    q[rows, jnp.minimum(acc, gamma - 1)], 0.0,
+                )
+                resid = jnp.maximum(p_at - q_at, 0.0)
+                degenerate = jnp.sum(resid, axis=-1, keepdims=True) <= 0.0
+                resid = jnp.where(degenerate, p_at, resid)
+                res_keys = jax.vmap(
+                    lambda r, c, a: row_key(r, c + a, 2))(rids, counts, acc)
+                corr_res = jax.vmap(jax.random.categorical)(
+                    res_keys, jnp.log(jnp.maximum(resid, 1e-30)))
+                bonus_keys = jax.vmap(
+                    lambda r, c, a: row_key(r, c + a, 0))(rids, counts, acc)
+                corr_bonus = jax.vmap(jax.random.categorical)(
+                    bonus_keys, pf[rows, acc])
+                corr = jnp.where(acc == gamma, corr_bonus,
+                                 corr_res).astype(jnp.int32)
+                # accepted proposals with the correction spliced at `acc`
+                # (positions past acc are never read by the host)
+                emit = jnp.where(
+                    jnp.arange(gamma + 1)[None, :] == acc[:, None],
+                    corr[:, None],
+                    jnp.concatenate([props, props[:, -1:]], axis=1),
+                )
+                return tcache, dcache, emit, acc
+
+            self._spec_round_sampled = jax.jit(
+                spec_round_sampled, donate_argnums=(2, 3)
+            )
+
     def _park(self, slot: int) -> None:
         # park the draft row too: while the slot's chunks are in flight,
         # concurrent spec rounds scatter draft k/v at lengths[slot] — left
@@ -829,21 +939,38 @@ class SpeculativeServingEngine(ServingEngine):
             if self._token_sharding is not None:
                 last = jax.device_put(last, self._token_sharding)
             lengths_before = jax.device_get(self.cache.lengths)
-            self.cache, self.draft_cache, props_d, emit_d = self._spec_round(
-                self.params, self.draft_params, self.cache, self.draft_cache,
-                last,
-            )
+            if self.temperature > 0.0:
+                rids, counts = self._sample_coords(self.slots)
+                if self._token_sharding is not None:
+                    rids = jax.device_put(rids, self._token_sharding)
+                    counts = jax.device_put(counts, self._token_sharding)
+                self.cache, self.draft_cache, emit_d, acc_d = (
+                    self._spec_round_sampled(
+                        self.params, self.draft_params, self.cache,
+                        self.draft_cache, last, rids, counts,
+                    ))
+                emit, acc_row = jax.device_get((emit_d, acc_d))
+                props = None  # device already resolved per-row acceptance
+            else:
+                self.cache, self.draft_cache, props_d, emit_d = (
+                    self._spec_round(
+                        self.params, self.draft_params, self.cache,
+                        self.draft_cache, last,
+                    ))
+                props, emit = jax.device_get((props_d, emit_d))
             self.steps += 1
             self.slot_steps += len(active)
-            props, emit = jax.device_get((props_d, emit_d))
             # every slot's final length is derived from lengths_before below
             # (active: +1+acc; idle: pinned), so no second device fetch
             new_len = np.array(lengths_before)
             for slot in active:
                 req = self.slots[slot]
-                acc = 0
-                while acc < self.gamma and props[slot, acc] == emit[slot, acc]:
-                    acc += 1
+                if props is None:
+                    acc = int(acc_row[slot])
+                else:
+                    acc = 0
+                    while acc < self.gamma and props[slot, acc] == emit[slot, acc]:
+                        acc += 1
                 self.drafted += self.gamma
                 self.accepted += acc
                 # emit accepted prefix + correction, respecting budget/eos

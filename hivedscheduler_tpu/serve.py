@@ -54,8 +54,9 @@ def main(argv=None) -> int:
                         "(--max-batch must divide it)")
     parser.add_argument("--draft-layers", type=int, default=0,
                         help="speculative serving: draft-model layers "
-                        "(0 = off; greedy only; per-row acceptance — no "
-                        "batch-min barrier)")
+                        "(0 = off; per-row acceptance — no batch-min "
+                        "barrier; greedy is bit-exact, sampled does "
+                        "per-row residual resampling)")
     parser.add_argument("--draft-d-model", type=int, default=0,
                         help="draft width (default: half the target, "
                         "rounded to an even head_dim)")

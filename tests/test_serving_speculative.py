@@ -166,9 +166,11 @@ class TestSpeculativeServing:
 
     def test_validation(self, setup):
         cfg, params, dft_cfg, dft_params = setup
-        with pytest.raises(ValueError, match="greedy"):
-            serving.SpeculativeServingEngine(
-                params, cfg, dft_params, dft_cfg, temperature=0.5)
+        # temperature > 0 is supported since round 5 (sampled speculation
+        # with per-row residual resampling; test_serving_speculative_sampled)
+        eng = serving.SpeculativeServingEngine(
+            params, cfg, dft_params, dft_cfg, temperature=0.5)
+        assert eng._spec_round_sampled is not None
         with pytest.raises(ValueError, match="gamma"):
             serving.SpeculativeServingEngine(
                 params, cfg, dft_params, dft_cfg, gamma=0)
