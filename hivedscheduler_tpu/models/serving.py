@@ -840,7 +840,14 @@ class SpeculativeServingEngine(ServingEngine):
                         lambda i: jax.random.uniform(row_key(r, c + i, 1))
                     )(gidx)
                 )(rids, counts)
-                accept = u * qx < px
+                # u < p/q, NOT u*q < p: with a perfect draft (px == qx
+                # bitwise) the ratio is exactly 1.0 and u in [0,1) always
+                # accepts, whereas fl(u*qx) can round UP to qx for u near
+                # 1 and spuriously reject — breaking the perfect-draft
+                # bit-exactness guarantee through the residual path. qx>0
+                # is guaranteed: the proposal was sampled from q (filtered
+                # logits keep their top token, so no -inf argmax).
+                accept = u < px / qx
                 acc = jnp.sum(
                     jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
                 )

@@ -272,3 +272,22 @@ class TestFeatureWalkthroughs:
         gang2 = place_gang(algo, healthy, load_job_pods("job-bad-hw.yaml"))
         assert gang2 is not None
         assert all(bp.node_name != dead for bp in gang2)
+
+
+class TestMultiChainWalkthrough:
+    def test_multichain_relaxes_across_chains(self):
+        algo, nodes = boot("config-multichain.yaml")
+        gang = place_gang(algo, nodes, load_job_pods("job-multichain.yaml"))
+        assert gang is not None and len(gang) == 6
+        chains = {bp.node_name.split("/")[0] for bp in gang}
+        assert chains == {"a0", "b0"}  # no single 16-chip chain fits 24
+
+    def test_multichain_balanced_policy(self):
+        from collections import Counter
+
+        algo, nodes = boot("config-multichain.yaml")
+        gang = place_gang(algo, nodes,
+                          load_job_pods("job-multichain-balanced.yaml"))
+        assert gang is not None and len(gang) == 6
+        per_chain = Counter(bp.node_name.split("/")[0] for bp in gang)
+        assert sorted(per_chain.values()) == [3, 3], per_chain
