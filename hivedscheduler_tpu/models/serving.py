@@ -80,15 +80,46 @@ def _stream_key(base_key, rid, count, tag: int = 0):
 
 
 class RaggedCache(NamedTuple):
-    """KV cache with a per-row length: k/v [L, B, M, H_kv, D], lengths [B]."""
+    """KV cache with a per-row length: k/v [L, B, M, H_kv, D], lengths [B].
+
+    With int8 KV (``init_ragged_cache(kv_dtype="int8")``) k/v hold the
+    quantized values and ``k_scale``/``v_scale`` [L, B, M, H_kv] the
+    per-(position, head) symmetric absmax scales — decode then streams
+    half the KV bytes from HBM (the long-context decode bottleneck), and
+    the scales factor OUT of both attention einsums (score rows and
+    probability columns), so no dequantized cache copy ever materializes
+    — the int8->compute-dtype convert fuses into the cache read.
+    Quantization happens once at scatter time; every engine composition
+    (chunking, prefix cache, speculation) re-reads the same quantized
+    entries, so int8 engines are BIT-EXACT among themselves — only the
+    int8-vs-float comparison is approximate (bounded by absmax/127 per
+    element; guard: tests/test_serving_int8kv.py)."""
 
     k: jax.Array
     v: jax.Array
     lengths: jax.Array  # int32 [B] — tokens absorbed per row
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
-def init_ragged_cache(cfg: TransformerConfig, max_batch: int, max_len: int) -> RaggedCache:
+def init_ragged_cache(cfg: TransformerConfig, max_batch: int, max_len: int,
+                      kv_dtype: Optional[str] = None) -> RaggedCache:
     shape = (cfg.n_layers, max_batch, max_len, cfg.kv_heads, cfg.head_dim)
+    if kv_dtype == "int8":
+        sshape = shape[:-1]
+        return RaggedCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            lengths=jnp.zeros((max_batch,), jnp.int32),
+            k_scale=jnp.zeros(sshape, jnp.float32),
+            v_scale=jnp.zeros(sshape, jnp.float32),
+        )
+    if kv_dtype is not None:
+        raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
     return RaggedCache(
         k=jnp.zeros(shape, cfg.dtype),
         v=jnp.zeros(shape, cfg.dtype),
@@ -96,20 +127,39 @@ def init_ragged_cache(cfg: TransformerConfig, max_batch: int, max_len: int) -> R
     )
 
 
-def _ragged_attention(q, ck, cv, positions, scale):
+def _quant_kv(x):
+    """Symmetric per-(token, head) absmax int8: x [..., H_kv, D] ->
+    (int8 values, f32 scales [..., H_kv])."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _ragged_attention(q, ck, cv, positions, scale, ck_scale=None,
+                      cv_scale=None):
     """q [B,S,H,D] at absolute per-row positions [B,S]; ck/cv [B,M,H_kv,D].
-    Causal mask per row: key_pos <= position."""
+    Causal mask per row: key_pos <= position. With int8 KV the per-key
+    scales multiply the score rows (k) and weight the probability columns
+    (v) — algebraically identical to dequantizing the cache, without ever
+    materializing a dequantized copy."""
     b, s_len, h, d = q.shape
     m_len, h_kv = ck.shape[1], ck.shape[2]
     gsz = h // h_kv
     qg = q.reshape(b, s_len, h_kv, gsz, d)
     s = jnp.einsum(
-        "bshgd,bmhd->bhgsm", qg, ck, preferred_element_type=jnp.float32
+        "bshgd,bmhd->bhgsm", qg, ck.astype(qg.dtype),
+        preferred_element_type=jnp.float32
     ) * scale
+    if ck_scale is not None:
+        s = s * jnp.transpose(ck_scale, (0, 2, 1))[:, :, None, None, :]
     key_pos = lax.iota(jnp.int32, m_len)
     mask = key_pos[None, None, :] <= positions[:, :, None]  # [B, S, M]
     s = jnp.where(mask[:, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if cv_scale is not None:
+        p = p * jnp.transpose(cv_scale, (0, 2, 1))[:, :, None, None, :]
     o = jnp.einsum("bhgsm,bmhd->bshgd", p, cv.astype(jnp.float32))
     return o.reshape(b, s_len, h, d).astype(q.dtype)
 
@@ -148,37 +198,60 @@ def advance_ragged(
     x = embed_tokens(params, tokens, dtype)
     scale = 1.0 / math.sqrt(cfg.head_dim)
     n_rows = cache.k.shape[1]
+    quantized = cache.quantized  # static: fixed by the cache's pytree shape
 
     def layer(x, scanned):
-        lp, ck, cv = scanned  # ck/cv [B_rows, M, H_kv, D]
+        if quantized:
+            lp, ck, cv, cks, cvs = scanned  # + scales [B_rows, M, H_kv]
+        else:
+            lp, ck, cv = scanned  # ck/cv [B_rows, M, H_kv, D]
+            cks = cvs = None
         h = _rms_norm(x, lp["attn_norm"])
         q, k_new, v_new = qkv_proj(lp, h, positions, cfg.rope_theta, dtype)
+        if quantized:
+            k_q, k_s = _quant_kv(k_new)
+            v_q, v_s = _quant_kv(v_new)
+        else:
+            k_q, v_q = k_new, v_new
         if row is None:
             # decode: scatter each row's S tokens at its own length offset
             # (S=1 plain decode; S=gamma+1 speculative verify)
             rows = lax.iota(jnp.int32, n_rows)
             if s_len == 1:
-                ck = ck.at[rows, cache.lengths].set(k_new[:, 0].astype(ck.dtype))
-                cv = cv.at[rows, cache.lengths].set(v_new[:, 0].astype(cv.dtype))
+                ck = ck.at[rows, cache.lengths].set(k_q[:, 0].astype(ck.dtype))
+                cv = cv.at[rows, cache.lengths].set(v_q[:, 0].astype(cv.dtype))
+                if quantized:
+                    cks = cks.at[rows, cache.lengths].set(k_s[:, 0])
+                    cvs = cvs.at[rows, cache.lengths].set(v_s[:, 0])
             else:
                 # `positions` (built at entry) IS the scatter index set
-                ck = ck.at[rows[:, None], positions].set(k_new.astype(ck.dtype))
-                cv = cv.at[rows[:, None], positions].set(v_new.astype(cv.dtype))
-            att_k, att_v = ck, cv
+                ck = ck.at[rows[:, None], positions].set(k_q.astype(ck.dtype))
+                cv = cv.at[rows[:, None], positions].set(v_q.astype(cv.dtype))
+                if quantized:
+                    cks = cks.at[rows[:, None], positions].set(k_s)
+                    cvs = cvs.at[rows[:, None], positions].set(v_s)
+            att_k, att_v, att_ks, att_vs = ck, cv, cks, cvs
         else:
             # prefill: overwrite [row, start:start+S] (start is 0 for a
             # fresh prompt; the prefix-cache tail prefill offsets past the
             # restored prefix)
             off = jnp.int32(0) if start is None else start
             ck = lax.dynamic_update_slice(
-                ck, k_new.astype(ck.dtype), (row, off, 0, 0)
+                ck, k_q.astype(ck.dtype), (row, off, 0, 0)
             )
             cv = lax.dynamic_update_slice(
-                cv, v_new.astype(cv.dtype), (row, off, 0, 0)
+                cv, v_q.astype(cv.dtype), (row, off, 0, 0)
             )
             att_k = lax.dynamic_slice_in_dim(ck, row, 1, axis=0)
             att_v = lax.dynamic_slice_in_dim(cv, row, 1, axis=0)
-        attn = _ragged_attention(q, att_k, att_v, positions, scale)
+            att_ks = att_vs = None
+            if quantized:
+                cks = lax.dynamic_update_slice(cks, k_s, (row, off, 0))
+                cvs = lax.dynamic_update_slice(cvs, v_s, (row, off, 0))
+                att_ks = lax.dynamic_slice_in_dim(cks, row, 1, axis=0)
+                att_vs = lax.dynamic_slice_in_dim(cvs, row, 1, axis=0)
+        attn = _ragged_attention(q, att_k, att_v, positions, scale,
+                                 att_ks, att_vs)
         x = x + jnp.einsum("bshk,hkd->bsd", attn, load_weight(lp["wo"], dtype))
         h = _rms_norm(x, lp["mlp_norm"])
         if cfg.n_experts > 0:
@@ -186,13 +259,23 @@ def advance_ragged(
             x = x + moe_out
         else:
             x = x + dense_mlp(lp, h, dtype)
+        if quantized:
+            return x, (ck, cv, cks, cvs)
         return x, (ck, cv)
 
-    x, (new_k, new_v) = lax.scan(
-        lambda carry, scanned: layer(carry, scanned),
-        x,
-        (params["layers"], cache.k, cache.v),
+    if quantized:
+        xs = (params["layers"], cache.k, cache.v, cache.k_scale,
+              cache.v_scale)
+    else:
+        xs = (params["layers"], cache.k, cache.v)
+    x, scanned_out = lax.scan(
+        lambda carry, scanned: layer(carry, scanned), x, xs
     )
+    if quantized:
+        new_k, new_v, new_ks, new_vs = scanned_out
+    else:
+        new_k, new_v = scanned_out
+        new_ks = new_vs = None
     logits = final_logits(params, x, dtype)
     if row is None:
         # all S tokens absorbed; a speculative verify caller rolls rows back
@@ -210,7 +293,8 @@ def advance_ragged(
         lengths = jnp.minimum(cache.lengths + s_len, cache.k.shape[2])
     else:
         lengths = cache.lengths  # caller sets the row's true prompt length
-    return logits, RaggedCache(k=new_k, v=new_v, lengths=lengths)
+    return logits, RaggedCache(k=new_k, v=new_v, lengths=lengths,
+                               k_scale=new_ks, v_scale=new_vs)
 
 
 @dataclasses.dataclass
@@ -260,6 +344,7 @@ class ServingEngine:
         mesh=None,
         prefix_cache_size: int = 0,
         prefill_chunk: int = 0,
+        kv_dtype: Optional[str] = None,
     ):
         """``mesh``: lay the engine out over a dp x tp serving mesh —
         params by ``decode.serving_shardings`` (tp shards heads/ff/vocab),
@@ -279,7 +364,13 @@ class ServingEngine:
         cannot stall the decoding rows for its full prefill: each step runs
         one bounded chunk (offset prefill into the row) and one decode —
         the chunked-prefill fairness pattern. Exact: chunks write the same
-        KV a monolithic prefill would (guard: tests/test_serving_chunked.py)."""
+        KV a monolithic prefill would (guard: tests/test_serving_chunked.py).
+
+        ``kv_dtype``: ``"int8"`` stores the KV cache quantized (symmetric
+        per-token-per-head absmax scales) — decode streams half the KV
+        bytes from HBM; see RaggedCache. int8 engines are bit-exact among
+        themselves under every composition; int8-vs-float differs by the
+        bounded quantization error."""
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -310,7 +401,9 @@ class ServingEngine:
             return jax.vmap(jax.random.categorical)(keys, filtered)
 
         self._sample = jax.jit(sample_rows)
-        self.cache = init_ragged_cache(cfg, max_batch, max_len)
+        self.kv_dtype = kv_dtype
+        self.cache = init_ragged_cache(cfg, max_batch, max_len,
+                                       kv_dtype=kv_dtype)
         self.slots: List[Optional[Request]] = [None] * max_batch
         # host-side staging for the per-row feedback tokens: slots emit into
         # this array and ONE upload per decode step feeds the jitted program
@@ -338,9 +431,8 @@ class ServingEngine:
             row = ("dp", "fsdp")
             kv_sh = NamedSharding(mesh, P(None, row, None, "tp", None))
             self._len_sharding = NamedSharding(mesh, P(row))
-            self.cache = jax.device_put(self.cache, RaggedCache(
-                k=kv_sh, v=kv_sh, lengths=self._len_sharding,
-            ))
+            self.cache = jax.device_put(self.cache, self._cache_shardings(
+                kv_sh, self._len_sharding))
             self._token_sharding = NamedSharding(mesh, P(row))
         self.mesh = mesh
         self.queue: List[Request] = []
@@ -378,11 +470,22 @@ class ServingEngine:
         self.prefix_hits = 0
         self.prefix_tokens_reused = 0
 
-        def restore_prefix(cache, pk, pv, row):
-            """Write a cached prefix row into slot ``row`` at [0:Pb]."""
+        quant_kv = kv_dtype == "int8"
+
+        def restore_prefix(cache, payload, row):
+            """Write a cached prefix payload into slot ``row`` at [0:Pb]
+            (values + scales for a quantized cache — a restored quantized
+            prefix is bit-identical to the stored one)."""
+            pk, pv = payload[0], payload[1]
             k = lax.dynamic_update_slice(cache.k, pk[:, None], (0, row, 0, 0, 0))
             v = lax.dynamic_update_slice(cache.v, pv[:, None], (0, row, 0, 0, 0))
-            return cache._replace(k=k, v=v)
+            upd = dict(k=k, v=v)
+            if quant_kv:
+                upd["k_scale"] = lax.dynamic_update_slice(
+                    cache.k_scale, payload[2][:, None], (0, row, 0, 0))
+                upd["v_scale"] = lax.dynamic_update_slice(
+                    cache.v_scale, payload[3][:, None], (0, row, 0, 0))
+            return cache._replace(**upd)
 
         def extract_prefix(cache, row, pb):
             """Copy slot ``row``'s [0:pb] KV out as a standalone prefix row."""
@@ -391,10 +494,29 @@ class ServingEngine:
                                   (l_, 1, pb, h_kv, hd))[:, 0]
             v = lax.dynamic_slice(cache.v, (0, row, 0, 0, 0),
                                   (l_, 1, pb, h_kv, hd))[:, 0]
+            if quant_kv:
+                ks = lax.dynamic_slice(cache.k_scale, (0, row, 0, 0),
+                                       (l_, 1, pb, h_kv))[:, 0]
+                vs = lax.dynamic_slice(cache.v_scale, (0, row, 0, 0),
+                                       (l_, 1, pb, h_kv))[:, 0]
+                return k, v, ks, vs
             return k, v
 
         self._restore_prefix = jax.jit(restore_prefix, donate_argnums=(0,))
         self._extract_prefix = jax.jit(extract_prefix, static_argnums=(2,))
+
+    def _cache_shardings(self, kv_sh, len_sh):
+        """Sharding pytree matching this engine's cache structure. The
+        scale sharding is the kv spec with the head-dim axis dropped, so
+        target and draft caches stay on one layout rule."""
+        if self.kv_dtype != "int8":
+            return RaggedCache(k=kv_sh, v=kv_sh, lengths=len_sh)
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        scale_sh = NamedSharding(kv_sh.mesh, P(*kv_sh.spec[:-1]))
+        return RaggedCache(k=kv_sh, v=kv_sh, lengths=len_sh,
+                           k_scale=scale_sh, v_scale=scale_sh)
 
     # -- request lifecycle -------------------------------------------------
     def submit(self, prompt: List[int], max_new_tokens: int) -> Request:
@@ -443,8 +565,7 @@ class ServingEngine:
 
     def _prefix_restore(self, slot: int, payload) -> None:
         """Write a cached payload back into slot ``slot``."""
-        pk, pv = payload
-        self.cache = self._restore_prefix(self.cache, pk, pv, jnp.int32(slot))
+        self.cache = self._restore_prefix(self.cache, payload, jnp.int32(slot))
 
     def _store_prefix(self, slot: int, prompt: List[int]) -> None:
         """Cache the row's KV under the full prompt AND every power-of-two
@@ -737,7 +858,8 @@ class SpeculativeServingEngine(ServingEngine):
         self.draft_cfg = draft_cfg
         self.gamma = gamma
         self.draft_cache = init_ragged_cache(draft_cfg, self.max_batch,
-                                             self.max_len)
+                                             self.max_len,
+                                             kv_dtype=self.kv_dtype)
         if self.mesh is not None:
             # one shared policy with make_sharded_speculative (see
             # draft_serving_shardings for the shard-vs-replicate trade-off).
@@ -756,9 +878,10 @@ class SpeculativeServingEngine(ServingEngine):
             dkv_sh = NamedSharding(
                 self.mesh, P(None, ("dp", "fsdp"), None, head_ax, None)
             )
-            self.draft_cache = jax.device_put(self.draft_cache, RaggedCache(
-                k=dkv_sh, v=dkv_sh, lengths=self._len_sharding,
-            ))
+            self.draft_cache = jax.device_put(
+                self.draft_cache,
+                self._cache_shardings(dkv_sh, self._len_sharding),
+            )
         self.drafted = 0
         self.accepted = 0
 
@@ -921,10 +1044,10 @@ class SpeculativeServingEngine(ServingEngine):
         )
 
     def _prefix_restore(self, slot: int, payload) -> None:
-        tgt, (dk, dv) = payload
+        tgt, dft = payload
         super()._prefix_restore(slot, tgt)
         self.draft_cache = self._restore_prefix(
-            self.draft_cache, dk, dv, jnp.int32(slot)
+            self.draft_cache, dft, jnp.int32(slot)
         )
 
     def submit(self, prompt, max_new_tokens: int) -> Request:

@@ -78,6 +78,11 @@ def main(argv=None) -> int:
                         help="weight-only int8 serving (halves weight HBM "
                         "traffic; the engine's shared helpers dequantize "
                         "into the consuming einsums)")
+    parser.add_argument("--kv-quantize", choices=["none", "int8"],
+                        default="none",
+                        help="int8 KV cache (per-token-per-head scales; "
+                        "halves decode KV bytes from HBM — the "
+                        "long-context decode bottleneck)")
     parser.add_argument("--lora-rank", type=int, default=0,
                         help="serve a LoRA fine-tune checkpoint: adapters "
                         "are merged into the base weights at load (as in "
@@ -154,6 +159,7 @@ def main(argv=None) -> int:
             eos_id=None if args.eos_id < 0 else args.eos_id, seed=args.seed,
             mesh=mesh, prefix_cache_size=args.prefix_cache,
             prefill_chunk=args.prefill_chunk,
+            kv_dtype=None if args.kv_quantize == "none" else args.kv_quantize,
         )
         if args.draft_layers > 0:
             from hivedscheduler_tpu.models.speculative import derive_draft_config

@@ -1,0 +1,138 @@
+"""int8 KV cache in the continuous-batching engine (kv_dtype="int8").
+
+Exactness contract: quantization happens ONCE at scatter time, and every
+engine composition re-reads the same quantized entries — so int8 engines
+are BIT-EXACT among themselves (chunked == monolithic, prefix-cache ==
+plain, greedy speculation == plain int8 decode). Only int8-vs-float is
+approximate, bounded by the symmetric absmax step (absmax/127 per
+element) — asserted on logits, not streams (random tiny models argmax
+near ties)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from hivedscheduler_tpu.models import serving, transformer as tm  # noqa: E402
+
+
+def cfg_of(**kw):
+    base = dict(vocab_size=96, d_model=48, n_heads=4, n_kv_heads=2,
+                n_layers=2, d_ff=96, max_seq_len=128, dtype=jnp.float32)
+    base.update(kw)
+    return tm.TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = cfg_of()
+    params = tm.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+LONG = list(range(20, 52))
+
+
+def run_all(cfg, params, prompts, budget=5, **kw):
+    eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=96,
+                                kv_dtype="int8", **kw)
+    reqs = [eng.submit(p, budget) for p in prompts]
+    eng.run_until_drained()
+    return eng, [r.tokens_out for r in reqs]
+
+
+def test_quantization_error_bound():
+    """dequant(quant(x)) is within one quantization step (absmax/127)."""
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 3, 2, 16)) * 5.0
+    q, scale = serving._quant_kv(x)
+    deq = q.astype(jnp.float32) * scale[..., None]
+    step = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
+    assert bool(jnp.all(jnp.abs(deq - x) <= step + 1e-6))
+    assert q.dtype == jnp.int8 and scale.dtype == jnp.float32
+
+
+def test_invalid_kv_dtype_rejected(setup):
+    cfg, params = setup
+    with pytest.raises(ValueError, match="kv_dtype"):
+        serving.ServingEngine(params, cfg, kv_dtype="fp8")
+
+
+def test_int8_vs_float_logits_bounded(setup):
+    """One prefill + one decode step: quantized-cache logits stay close
+    to the float-cache logits (the only approximate comparison)."""
+    cfg, params = setup
+    toks = jnp.asarray([[5, 9, 2, 44, 17, 8, 30, 2]], jnp.int32)
+    c8 = serving.init_ragged_cache(cfg, 1, 32, kv_dtype="int8")
+    cf = serving.init_ragged_cache(cfg, 1, 32)
+    l8, c8 = serving.advance_ragged(params, c8, toks, cfg, row=jnp.int32(0),
+                                    start=jnp.int32(0))
+    lf, cf = serving.advance_ragged(params, cf, toks, cfg, row=jnp.int32(0),
+                                    start=jnp.int32(0))
+    c8 = c8._replace(lengths=c8.lengths.at[0].set(8))
+    cf = cf._replace(lengths=cf.lengths.at[0].set(8))
+    np.testing.assert_allclose(np.asarray(l8), np.asarray(lf), atol=0.25)
+    nxt = jnp.asarray([int(jnp.argmax(lf[0, -1]))], jnp.int32)
+    d8, _ = serving.advance_ragged(params, c8, nxt[:, None], cfg)
+    df, _ = serving.advance_ragged(params, cf, nxt[:, None], cfg)
+    np.testing.assert_allclose(np.asarray(d8), np.asarray(df), atol=0.25)
+
+
+def test_int8_chunked_matches_int8_monolithic(setup):
+    """Chunking is still a pure scheduling change inside the int8 world:
+    the chunks quantize the same values in the same positions."""
+    cfg, params = setup
+    prompts = [LONG, [7, 8, 9], LONG + [5]]
+    _, plain = run_all(cfg, params, prompts)
+    eng, chunked = run_all(cfg, params, prompts, prefill_chunk=8)
+    assert chunked == plain
+    assert eng.prefill_chunks_done > 0
+
+
+def test_int8_prefix_cache_matches_int8_plain(setup):
+    """A restored quantized prefix (values + scales travel together) is
+    bit-identical to the stored row."""
+    cfg, params = setup
+    prompts = [LONG + [1], LONG + [2, 3], LONG + [1, 4]]
+    _, plain = run_all(cfg, params, prompts)
+    eng, cached = run_all(cfg, params, prompts, prefix_cache_size=16)
+    assert cached == plain
+    assert eng.prefix_hits >= 1
+
+
+def test_int8_speculation_matches_int8_plain_greedy(setup):
+    """Greedy speculation inside the int8 world equals plain int8 decode:
+    the verify window quantizes and attends the same entries step-by-step
+    decode would."""
+    cfg, params = setup
+    dcfg = cfg_of(n_layers=1, d_model=24, n_heads=2, n_kv_heads=1, d_ff=48)
+    dparams = tm.init_params(dcfg, jax.random.PRNGKey(5))
+    prompts = [[5, 9, 2], [17, 3, 88, 41], [1, 2]]
+    _, plain = run_all(cfg, params, prompts)
+    eng = serving.SpeculativeServingEngine(
+        params, cfg, dparams, dcfg, gamma=2, max_batch=2, max_len=96,
+        kv_dtype="int8",
+    )
+    reqs = [eng.submit(p, 5) for p in prompts]
+    eng.run_until_drained()
+    assert [r.tokens_out for r in reqs] == plain
+    assert eng.drafted > 0
+
+
+def test_int8_mesh_sharded_matches_unsharded(setup):
+    """dp x tp layout with quantized cache (scales shard alongside the
+    kv-head axis): same int8 streams as the single-device int8 engine."""
+    from hivedscheduler_tpu.parallel import topology
+
+    cfg, params = setup
+    prompts = [[5, 9, 2], [17, 3, 88, 41]]
+    _, plain = run_all(cfg, params, prompts)
+    mesh = topology.make_mesh(topology.MeshAxes(dp=2, tp=2),
+                              topology.get_devices(4))
+    eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=96,
+                                kv_dtype="int8", mesh=mesh)
+    reqs = [eng.submit(p, 5) for p in prompts]
+    eng.run_until_drained()
+    assert [r.tokens_out for r in reqs] == plain
