@@ -710,13 +710,15 @@ def parse_model_bench_output(returncode: int, stdout: str, stderr: str):
         "model_serve_slot_efficiency_pass": m.get("serve_slot_efficiency_pass"),
         "model_serve_prefix_speedup": m.get("serve_prefix_speedup"),
         "model_serve_prefix_ttft_speedup": m.get("serve_prefix_ttft_speedup"),
+        "model_serve_kv_int8_speedup": m.get("serve_kv_int8_speedup"),
         "model_device": m["device"],
         "model_metric_note": m["metric"],
     }
     # per-stage degradation notes (bench_model isolates decode/serve
     # failures so the train MFU survives): a null decode/serve field must
     # arrive explained, not silently absent
-    for k in ("decode_error", "serve_error", "serve_prefix_error"):
+    for k in ("decode_error", "serve_error", "serve_prefix_error",
+              "serve_kv_int8_error"):
         if m.get(k):
             fields[f"model_{k}"] = m[k]
     stamped = dict(m)

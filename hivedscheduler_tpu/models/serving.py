@@ -463,9 +463,10 @@ class ServingEngine:
 
         self.prefix_cache_size = max(0, prefix_cache_size)
         # prompt tuple -> (payload, true_len); payload is whatever
-        # _prefix_extract returns ((k [L, Pb, H_kv, D], v) here; the
-        # speculative engine nests target+draft pairs); Pb is the
-        # prompt's prefill bucket, so restores compile once per bucket
+        # _prefix_extract returns — treat it as OPAQUE: (k [L, Pb, H_kv,
+        # D], v) for a float cache, (k, v, k_scale, v_scale) for int8,
+        # and the speculative engine nests target+draft payloads. Pb is
+        # the prompt's prefill bucket, so restores compile once per bucket
         self._prefix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self.prefix_hits = 0
         self.prefix_tokens_reused = 0
