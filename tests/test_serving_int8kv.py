@@ -136,3 +136,27 @@ def test_int8_mesh_sharded_matches_unsharded(setup):
     reqs = [eng.submit(p, 5) for p in prompts]
     eng.run_until_drained()
     assert [r.tokens_out for r in reqs] == plain
+
+
+def test_kitchen_sink_composition(setup):
+    """Every serving feature at once — MoE target, int8 KV, chunked
+    prefill, prefix cache, greedy speculation with a dense draft — must
+    equal the plain int8-KV MoE engine bit for bit (each feature is a
+    scheduling/representation change below the routing/attention math)."""
+    moe_cfg = cfg_of(n_experts=4, moe_top_k=2)
+    params = tm.init_params(moe_cfg, jax.random.PRNGKey(11))
+    dcfg = cfg_of(n_layers=1, d_model=24, n_heads=2, n_kv_heads=1, d_ff=48)
+    dparams = tm.init_params(dcfg, jax.random.PRNGKey(12))
+    prompts = [LONG + [1], [7, 8], LONG + [1, 9], LONG + [2]]
+
+    _, refs = run_all(moe_cfg, params, prompts)
+
+    eng = serving.SpeculativeServingEngine(
+        params, moe_cfg, dparams, dcfg, gamma=2, max_batch=2, max_len=96,
+        kv_dtype="int8", prefill_chunk=8, prefix_cache_size=16,
+    )
+    reqs = [eng.submit(p, 5) for p in prompts]
+    eng.run_until_drained()
+    assert [r.tokens_out for r in reqs] == refs
+    assert eng.prefill_chunks_done > 0 and eng.drafted > 0
+    assert eng.prefix_hits >= 1
