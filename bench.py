@@ -646,6 +646,10 @@ def run_trace(n_jobs: int = 300, seed: int = 11, baseline: bool = False):
     (see replay_trace). ``baseline=True`` replays the SAME trace through the
     topology-unaware NaiveCluster strawman instead.
     """
+    # the algorithm's internal victim selection draws from the global
+    # random module (one-random-node victims); seed it so the driver
+    # artifact's trace fields are run-to-run deterministic
+    random.seed(seed)
     jobs = make_trace_jobs(n_jobs, seed)
     if baseline:
         return replay_trace(NaiveCluster(), jobs, naive_gang_chips)
