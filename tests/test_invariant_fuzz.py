@@ -165,6 +165,11 @@ class Harness:
         spec = {
             "virtualCluster": vc, "priority": prio, "leafCellType": leaf_type,
             "leafCellNumber": chips,
+            # fuzz BOTH relaxation partitions: the balanced water-fill's
+            # cumulative-allowance pass (and its fewest-allowance rerun on
+            # estimate shortfall) must uphold every invariant the greedy
+            # partition does, under churn, bad nodes and recovery replay
+            "multiChainRelaxPolicy": rng.choice(["fewest", "balanced"]),
             "affinityGroup": {
                 "name": name,
                 "members": [{"podNumber": pods, "leafCellNumber": chips}],
