@@ -497,3 +497,64 @@ class TestBalancedRelaxPolicy:
         with _pytest.raises(WebServerError,
                             match="MultiChainRelaxPolicy"):
             h.schedule(make_pod("bad-0", spec), nodes, FILTERING_PHASE)
+
+
+def test_balanced_three_chain_water_fill():
+    """Water-fill over three heterogeneous chains: 36 chips across caps
+    16/16/8 needs k=3; the smallest cap pins first (8), the remainder
+    splits 14/14 over the big chains (pod granularity: 3/4 + 3/4 + 2).
+    Fewest-chains greedy would take 16+16+4 (4/4/1)."""
+    random.seed(0)
+    big = MeshSpec(topology=(4, 2, 2), chip_type="v5p-chip",
+                   host_shape=(2, 2, 1), levels=[])
+    small = MeshSpec(topology=(2, 2, 2), chip_type="v5p-chip",
+                     host_shape=(2, 2, 1), levels=[])
+    cfg = new_config(Config(
+        physical_cluster=PhysicalClusterSpec(
+            cell_types={
+                "podA": CellTypeSpec(mesh=big),
+                "podB": CellTypeSpec(mesh=big),
+                "podC": CellTypeSpec(mesh=small),
+            },
+            physical_cells=[
+                PhysicalCellSpec(cell_type="podA", cell_address="a0"),
+                PhysicalCellSpec(cell_type="podB", cell_address="b0"),
+                PhysicalCellSpec(cell_type="podC", cell_address="c0"),
+            ],
+        ),
+        virtual_clusters={
+            "vc1": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=1, cell_type="podA"),
+                VirtualCellSpec(cell_number=1, cell_type="podB"),
+                VirtualCellSpec(cell_number=1, cell_type="podC"),
+            ]),
+        },
+    ))
+
+    def run(policy):
+        random.seed(0)
+        h = HivedAlgorithm(cfg)
+        nodes = sorted({n for ccl in h.full_cell_list.values()
+                        for c in ccl[max(ccl)] for n in c.nodes})
+        for n in nodes:
+            h.add_node(Node(name=n))
+        spec = gang_spec(9, name=f"tri-{policy}")
+        if policy:
+            spec["multiChainRelaxPolicy"] = policy
+        per_chain = {}
+        for i in range(9):
+            pod = make_pod(f"tri-{policy}-{i}", spec)
+            r = h.schedule(pod, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None, (i, r.pod_wait_info)
+            per_chain[r.pod_bind_info.cell_chain] = (
+                per_chain.get(r.pod_bind_info.cell_chain, 0) + 1
+            )
+            h.add_allocated_pod(new_binding_pod(pod, r.pod_bind_info))
+        return per_chain
+
+    fewest = run(None)
+    balanced = run("balanced")
+    assert sorted(fewest.values()) == [1, 4, 4], fewest
+    assert sorted(balanced.values()) == [2, 3, 4], balanced
+    assert max(balanced.values()) <= max(fewest.values())
+    assert min(balanced.values()) > min(fewest.values())  # no lonely pod
