@@ -306,6 +306,12 @@ class Request:
     max_new_tokens: int
     tokens_out: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # admission priority: higher jumps the queue (FIFO within a level) —
+    # the engine-level analogue of the scheduler's guaranteed-vs-
+    # opportunistic ordering. Scheduling-only: a request's STREAM is
+    # unaffected (greedy exactness and the counter-based sampled keys
+    # depend on rid/prompt, not admission order).
+    priority: int = 0
     # wall-clock bookkeeping: time-to-first-token = queue wait + prefill
     # (the latency prefix caching attacks)
     submitted_at: float = 0.0
@@ -520,7 +526,12 @@ class ServingEngine:
                            k_scale=scale_sh, v_scale=scale_sh)
 
     # -- request lifecycle -------------------------------------------------
-    def submit(self, prompt: List[int], max_new_tokens: int) -> Request:
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               priority: int = 0) -> Request:
+        """Enqueue a request. ``priority``: higher is admitted first when
+        slots free up (FIFO within a level; running rows are never
+        preempted — admission ordering only, so every request's stream is
+        unchanged)."""
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -533,9 +544,16 @@ class ServingEngine:
                 f"max_len {self.max_len}"
             )
         req = Request(self._next_rid, list(prompt), max_new_tokens,
-                      submitted_at=time.perf_counter())
+                      priority=priority, submitted_at=time.perf_counter())
         self._next_rid += 1
-        self.queue.append(req)
+        # stable insertion keeps FIFO within a priority level: insert
+        # before the first strictly-lower-priority waiter
+        at = len(self.queue)
+        for i, w in enumerate(self.queue):
+            if w.priority < priority:
+                at = i
+                break
+        self.queue.insert(at, req)
         return req
 
     def _bucket(self, n: int) -> int:
@@ -1051,7 +1069,8 @@ class SpeculativeServingEngine(ServingEngine):
             self.draft_cache, dft, jnp.int32(slot)
         )
 
-    def submit(self, prompt, max_new_tokens: int) -> Request:
+    def submit(self, prompt, max_new_tokens: int,
+               priority: int = 0) -> Request:
         # a verify round writes up to gamma past the accepted prefix before
         # rolling back: reserve that headroom in the arena
         if prompt and len(prompt) + max_new_tokens + self.gamma + 1 > self.max_len:
@@ -1060,7 +1079,7 @@ class SpeculativeServingEngine(ServingEngine):
                 f"speculation headroom {self.gamma + 1} exceeds max_len "
                 f"{self.max_len}"
             )
-        return super().submit(prompt, max_new_tokens)
+        return super().submit(prompt, max_new_tokens, priority=priority)
 
     def step(self) -> bool:
         self._admit()

@@ -32,6 +32,10 @@ def main(argv=None) -> int:
     parser.add_argument("--arrival-every", type=int, default=3,
                         help="admit a new request every N engine steps "
                         "(0 = all up front)")
+    parser.add_argument("--high-priority-every", type=int, default=0,
+                        help="submit every Nth request at priority 10 "
+                        "(0 = all priority 0); high-priority waiters jump "
+                        "the admission queue — per-class TTFT is reported")
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--top-k", type=int, default=0)
     parser.add_argument("--top-p", type=float, default=1.0)
@@ -193,19 +197,23 @@ def main(argv=None) -> int:
             k3, (plen,), 0, cfg.vocab_size)]
         pending.append((prompt, budget))
 
+    def prio_of(i: int) -> int:
+        hp = args.high_priority_every
+        return 10 if hp > 0 and (i + 1) % hp == 0 else 0
+
     reqs = []
     t0 = time.perf_counter()
     steps = 0
     if args.arrival_every == 0:  # all up front
         while pending:
             prompt, budget = pending.pop(0)
-            reqs.append(eng.submit(prompt, budget))
+            reqs.append(eng.submit(prompt, budget, priority=prio_of(len(reqs))))
     while pending or (reqs and not all(r.done for r in reqs)):
         if pending and steps % args.arrival_every == 0:
             prompt, budget = pending.pop(0)
-            reqs.append(eng.submit(prompt, budget))
-            log.info("admitted request %s (prompt %s, budget %s)",
-                     reqs[-1].rid, len(prompt), budget)
+            reqs.append(eng.submit(prompt, budget, priority=prio_of(len(reqs))))
+            log.info("admitted request %s (prompt %s, budget %s, prio %s)",
+                     reqs[-1].rid, len(prompt), budget, reqs[-1].priority)
         eng.step()
         steps += 1
     dt = time.perf_counter() - t0
@@ -217,6 +225,16 @@ def main(argv=None) -> int:
     if ttfts:
         log.info("time-to-first-token: p50 %.0f ms, max %.0f ms",
                  1e3 * ttfts[len(ttfts) // 2], 1e3 * ttfts[-1])
+        if args.high_priority_every > 0:
+            # derive the classes from the requests themselves so the
+            # report stays correct if the priority values change
+            for cls in sorted({r.priority for r in reqs}, reverse=True):
+                cl = sorted(r.ttft_s for r in reqs
+                            if r.priority == cls and r.ttft_s is not None)
+                if cl:
+                    log.info("  priority-%s TTFT: p50 %.0f ms over %s "
+                             "requests", cls, 1e3 * cl[len(cl) // 2],
+                             len(cl))
     log.info(
         "%s requests, %s tokens in %.2fs (%.1f tok/s), occupancy %.0f%% "
         "over %s decode steps",

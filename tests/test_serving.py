@@ -231,3 +231,48 @@ class TestServingEngine:
         assert eng._bucket(3) == 4
         assert eng._bucket(33) == 64
         assert eng._bucket(64) == 64
+
+
+class TestPriorityAdmission:
+    """submit(priority=...): higher priority jumps the queue when a slot
+    frees; FIFO within a level; running rows are never preempted and no
+    request's stream changes (scheduling-only, like chunked prefill)."""
+
+    def test_high_priority_jumps_queue(self, setup):
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=1, max_len=32)
+        first = eng.submit([5, 9, 2], 3)
+        eng.step()                                  # first occupies the slot
+        low_a = eng.submit([1, 2], 3)               # waits, prio 0
+        low_b = eng.submit([3, 4], 3)               # waits, prio 0
+        high = eng.submit([7, 8], 3, priority=5)    # arrives LAST
+        assert [r.rid for r in eng.queue] == [high.rid, low_a.rid, low_b.rid]
+        eng.run_until_drained()
+        # the running row was never preempted; high got the slot next
+        assert first.first_token_at < high.first_token_at
+        assert high.first_token_at < low_a.first_token_at < low_b.first_token_at
+
+    def test_fifo_within_priority_level(self, setup):
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=1, max_len=32)
+        eng.submit([5], 2)
+        eng.step()  # admit the slot-holder
+        a = eng.submit([1, 2], 2, priority=3)
+        b = eng.submit([3, 4], 2, priority=3)
+        c = eng.submit([6, 7], 2, priority=9)
+        assert [r.rid for r in eng.queue] == [c.rid, a.rid, b.rid]
+
+    def test_priority_does_not_change_streams(self, setup):
+        """Admission order is the ONLY effect: each request's tokens equal
+        its run in a plain FIFO engine."""
+        cfg, params = setup
+        prompts = [[5, 9, 2], [17, 3, 88], [1, 4], [22, 60]]
+        plain = serving.ServingEngine(params, cfg, max_batch=2, max_len=32)
+        refs = [plain.submit(p, 4) for p in prompts]
+        plain.run_until_drained()
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=32)
+        reqs = [eng.submit(p, 4, priority=pr)
+                for p, pr in zip(prompts, [0, 7, 0, 7])]
+        eng.run_until_drained()
+        for req, ref in zip(reqs, refs):
+            assert req.tokens_out == ref.tokens_out, req.rid
