@@ -80,6 +80,7 @@ from hivedscheduler_tpu.algorithm.utils import (
     set_cell_state,
 )
 from hivedscheduler_tpu.k8s.types import Node, Pod
+from hivedscheduler_tpu.obs import decisions as obs_decisions
 from hivedscheduler_tpu.runtime import types as internal
 from hivedscheduler_tpu.runtime import utils as internal_utils
 from hivedscheduler_tpu.runtime.types import PodScheduleResult, SchedulerAlgorithm
@@ -121,6 +122,10 @@ class HivedAlgorithm(SchedulerAlgorithm):
         # the annotation-driven slow path.
         self._op_seq = 0
         self._live_stash: Optional[tuple] = None
+        # In-flight decision trace (obs.decisions): non-None only inside
+        # schedule() when recording is enabled. Single-threaded by the
+        # algorithm-lock contract, so a plain attribute is safe.
+        self._decision: Optional[obs_decisions.Decision] = None
 
         for vc_name in parsed.virtual_non_pinned_full:
             self.vc_schedulers[vc_name] = IntraVCScheduler(
@@ -474,11 +479,54 @@ class HivedAlgorithm(SchedulerAlgorithm):
     def schedule(
         self, pod: Pod, suggested_nodes: List[str], phase: str
     ) -> PodScheduleResult:
-        """Reference: Schedule, hived_algorithm.go:180-224."""
+        """Reference: Schedule, hived_algorithm.go:180-224.
+
+        When decision recording is enabled (``obs.decisions``), every call
+        additionally produces a structured explanation of the placement
+        attempts made — the disabled path pays one bool check."""
+        with self.algorithm_lock:
+            rec = obs_decisions.RECORDER
+            if not rec.enabled:
+                return self._schedule_locked(pod, suggested_nodes, phase)
+            dec = rec.begin(internal_utils.key(pod), phase)
+            self._decision = dec
+            try:
+                result = self._schedule_locked(pod, suggested_nodes, phase)
+            except Exception as e:
+                dec.finish("error", reason=str(e))
+                rec.commit(dec)
+                raise
+            finally:
+                self._decision = None
+            if result.pod_bind_info is not None:
+                dec.finish("bind", node=result.pod_bind_info.node)
+            elif result.pod_preempt_info is not None:
+                dec.finish(
+                    "preempt",
+                    victims=[internal_utils.key(v)
+                             for v in result.pod_preempt_info.victim_pods],
+                )
+            else:
+                dec.finish(
+                    "wait",
+                    reason=(result.pod_wait_info.reason
+                            if result.pod_wait_info is not None else ""),
+                )
+            rec.commit(dec)
+            return result
+
+    def _schedule_locked(
+        self, pod: Pod, suggested_nodes: List[str], phase: str
+    ) -> PodScheduleResult:
         with self.algorithm_lock:
             self._op_seq += 1
             log.info("[%s]: Scheduling pod in %s phase...", internal_utils.key(pod), phase)
             s = internal_utils.extract_pod_scheduling_spec(pod)
+            if self._decision is not None:
+                self._decision.group = s.affinity_group.name
+                self._decision.vc = s.virtual_cluster
+                self._decision.priority = s.priority
+                self._decision.suggested_nodes = len(suggested_nodes)
             suggested_node_set = set(suggested_nodes)
             group_physical: Optional[GroupPhysicalPlacement] = None
             group_virtual: Optional[GroupVirtualPlacement] = None
@@ -693,6 +741,9 @@ class HivedAlgorithm(SchedulerAlgorithm):
         if g.state == GROUP_ALLOCATED:
             log.info("[%s]: Pod is from an affinity group that is already allocated: %s",
                      internal_utils.key(pod), s.affinity_group.name)
+            if self._decision is not None:
+                self._decision.attempt(
+                    f"group {g.name}", "existing-allocated", "placed")
             group_physical = g.physical_leaf_cell_placement
             group_virtual = g.virtual_leaf_cell_placement
             if bad_or_non_suggested:
@@ -721,8 +772,17 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     "is no longer fully healthy and within Preempting-phase suggested "
                     "nodes: %s", internal_utils.key(pod), g.name, bad_or_non_suggested,
                 )
+                if self._decision is not None:
+                    self._decision.attempt(
+                        f"group {g.name}", "existing-preempting", "failed",
+                        "preemption canceled: placement no longer healthy "
+                        "and within suggested nodes",
+                    )
                 self._delete_preempting_affinity_group(g, pod)
             else:
+                if self._decision is not None:
+                    self._decision.attempt(
+                        f"group {g.name}", "existing-preempting", "placed")
                 group_physical = g.physical_leaf_cell_placement
                 group_virtual = g.virtual_leaf_cell_placement
                 preemption_victims, _ = collect_preemption_victims(group_physical)
@@ -1120,14 +1180,22 @@ class HivedAlgorithm(SchedulerAlgorithm):
             idx, merged_phys, merged_virt, committed_lazy = run_pass(
                 [total] * len(chains)
             )
+        relax_where = "relax[" + ",".join(str(c) for c in chains) + "]"
         if idx < len(flat):
             revert_lazy(committed_lazy)
+            if self._decision is not None:
+                self._decision.attempt(
+                    relax_where, "multi-chain-relax", "failed",
+                    f"placed {idx}/{len(flat)} pods before running out of chains",
+                )
             return None, None, (
                 "insufficient capacity even after relaxing the affinity group "
                 "across cell chains"
             )
         log.info("Affinity group %s relaxed across chains: %s pods placed",
                  sr.affinity_group_name, len(flat))
+        if self._decision is not None:
+            self._decision.attempt(relax_where, "multi-chain-relax", "placed")
         return merged_phys, (merged_virt if guaranteed_req else None), ""
 
     def _validate_scheduling_request(self, sr: SchedulingRequest, pod: Pod) -> None:
@@ -1155,12 +1223,19 @@ class HivedAlgorithm(SchedulerAlgorithm):
         log.info("Processing scheduling request: %s, leaf cell numbers %s, priority %s",
                  where, sr.affinity_group_pod_nums, sr.priority)
         if sr.priority >= MIN_GUARANTEED_PRIORITY:
+            path = "guaranteed"
             physical, virtual, failed_reason = self._schedule_guaranteed_affinity_group(
                 sr, collect_lazy
             )
         else:
+            path = "opportunistic"
             physical, failed_reason = self._schedule_opportunistic_affinity_group(sr)
             virtual = None
+        if self._decision is not None:
+            self._decision.attempt(
+                where, path, "failed" if physical is None else "placed",
+                failed_reason if physical is None else "",
+            )
         if physical is None:
             log.info("Cannot find placement in %s: %s", where, failed_reason)
             return None, None, failed_reason

@@ -46,6 +46,11 @@ AFFINITY_GROUPS_PATH = INSPECT_PATH + "/affinitygroups/"
 CLUSTER_STATUS_PATH = INSPECT_PATH + "/clusterstatus"
 PHYSICAL_CLUSTER_PATH = CLUSTER_STATUS_PATH + "/physicalcluster"
 VIRTUAL_CLUSTERS_PATH = CLUSTER_STATUS_PATH + "/virtualclusters/"
+# tpu-hive additions (no reference analogue — klog-only, SURVEY.md §5):
+# the last-N scheduler decision traces and the Chrome-trace/Perfetto export
+# of the shared obs timeline (doc/design/observability.md)
+TRACES_PATH = INSPECT_PATH + "/traces"
+TRACES_CHROME_PATH = TRACES_PATH + "/chrome"
 
 # --- Config (reference: constants.go:65) ------------------------------------
 ENV_CONFIG_FILE = "CONFIG"

@@ -40,10 +40,36 @@ def main(argv=None) -> int:
         help="serve against an in-memory cluster with all config nodes healthy "
         "(demo / development mode)",
     )
+    parser.add_argument(
+        "--explain",
+        action="store_true",
+        help="log a one-line explanation of every scheduling decision "
+        "(chains probed, path, outcome); decisions are always served at "
+        "GET /v1/inspect/traces",
+    )
+    parser.add_argument(
+        "--trace-file",
+        default="",
+        help="write the Chrome-trace/Perfetto JSON of the run to this path "
+        "on shutdown (also served live at GET /v1/inspect/traces/chrome)",
+    )
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
     common.init_all(logging.DEBUG if args.verbose else logging.INFO)
+
+    # observability: the server always records decision traces (bounded
+    # ring; the /v1/inspect/traces endpoint must answer "why did this gang
+    # land there?") and the shared span tracer (bounded ring, served at
+    # /v1/inspect/traces/chrome). Library/bench users stay on the
+    # zero-overhead disabled path — only this entry point opts in.
+    from hivedscheduler_tpu.obs import decisions as obs_decisions
+    from hivedscheduler_tpu.obs import trace as obs_trace
+
+    obs_decisions.RECORDER.enable()
+    obs_trace.enable()
+    if args.explain:
+        obs_decisions.RECORDER.on_commit = lambda d: log.info("%s", d.explain())
     config = api_config.load_config(args.config)
     api_config.watch_config(args.config, config)
 
@@ -82,6 +108,10 @@ def main(argv=None) -> int:
     stop = common.new_stop_event()
     stop.wait()
     server.stop()
+    if args.trace_file:
+        obs_trace.write_chrome_trace(args.trace_file)
+        log.info("Chrome trace written to %s (open in https://ui.perfetto.dev)",
+                 args.trace_file)
     return 0
 
 

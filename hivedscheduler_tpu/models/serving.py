@@ -35,6 +35,12 @@ No paging indirection: a TPU gets no benefit from non-contiguous KV blocks
 (there is no per-block allocator to appease, unlike GPU VRAM heaps); the
 fixed per-slot arena + recycling achieves the same utilization with dense,
 layout-friendly slices.
+
+Observability: every finished request publishes per-priority-class
+queue-wait/TTFT/TPOT histograms into the shared Prometheus registry, and —
+when ``obs.trace`` is enabled — queued/prefill/decode spans on its own
+timeline lane (tid = rid) for the Perfetto export. See
+doc/design/observability.md.
 """
 
 from __future__ import annotations
@@ -63,7 +69,9 @@ from hivedscheduler_tpu.models.transformer import (
     _rms_norm,
     load_weight,
 )
+from hivedscheduler_tpu.obs import trace as obs_trace
 from hivedscheduler_tpu.ops.attention import NEG_INF
+from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
 
 
 def _stream_key(base_key, rid, count, tag: int = 0):
@@ -311,17 +319,46 @@ class Request:
     # opportunistic ordering. Scheduling-only: a request's STREAM is
     # unaffected (greedy exactness and the counter-based sampled keys
     # depend on rid/prompt, not admission order).
+    #
+    # STARVATION CAVEAT: this is strict priority with no aging. A sustained
+    # stream of higher-priority submissions keeps inserting ahead of
+    # priority-0 waiters, which then never reach the queue head — there is
+    # no bounded-wait guarantee for low-priority traffic. Callers that need
+    # one must bound the high-priority offered load themselves (or
+    # periodically resubmit aged work at a boosted priority); the per-class
+    # TTFT/queue-wait histograms (tpu_hive_serve_*_seconds{priority=...})
+    # make starvation visible.
     priority: int = 0
-    # wall-clock bookkeeping: time-to-first-token = queue wait + prefill
-    # (the latency prefix caching attacks)
+    # wall-clock bookkeeping (perf_counter): queue wait = admitted - submitted;
+    # time-to-first-token = queue wait + prefill (the latency prefix caching
+    # attacks); time-per-output-token = decode span / (tokens - 1)
     submitted_at: float = 0.0
+    admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
+    done_at: Optional[float] = None
 
     @property
     def ttft_s(self) -> Optional[float]:
         if self.first_token_at is None:
             return None
         return self.first_token_at - self.submitted_at
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.admitted_at is None:
+            return None
+        return self.admitted_at - self.submitted_at
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Mean seconds per output token after the first (None until done
+        or when only one token was emitted)."""
+        if self.done_at is None or self.first_token_at is None:
+            return None
+        n = len(self.tokens_out) - 1
+        if n <= 0:
+            return None
+        return (self.done_at - self.first_token_at) / n
 
 
 class ServingEngine:
@@ -531,7 +568,13 @@ class ServingEngine:
         """Enqueue a request. ``priority``: higher is admitted first when
         slots free up (FIFO within a level; running rows are never
         preempted — admission ordering only, so every request's stream is
-        unchanged)."""
+        unchanged).
+
+        Strict priority, NO aging: a sustained stream of higher-priority
+        submissions starves lower-priority waiters indefinitely (each new
+        high-priority request inserts ahead of them). If bounded wait
+        matters, cap the high-priority offered load or re-submit aged
+        requests at a boosted priority — see ``Request.priority``."""
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -620,6 +663,7 @@ class ServingEngine:
             if self.slots[slot] is not None:
                 continue
             req = self.queue.pop(0)
+            req.admitted_at = time.perf_counter()
             hit = self._match_prefix(req.prompt) if self._prefix_cache else None
             if hit is not None:
                 payload, plen = hit[1]
@@ -768,6 +812,46 @@ class ServingEngine:
         self._last_host[slot] = tok
         if len(req.tokens_out) >= req.max_new_tokens or tok == self.eos_id:
             req.done = True
+            req.done_at = time.perf_counter()
+            self._observe_request(req)
+
+    def _observe_request(self, req: Request) -> None:
+        """Publish one finished request's lifecycle: per-priority-class
+        histograms into the Prometheus registry and (when tracing is on)
+        queued -> admitted -> prefill -> decode spans on the request's own
+        timeline lane (tid = rid). Registry and tracer are both locked —
+        safe when engines run on worker threads."""
+        prio = str(req.priority)
+        metrics.inc("tpu_hive_serve_requests_total", priority=prio)
+        if req.queue_wait_s is not None:
+            metrics.observe("tpu_hive_serve_queue_wait_seconds",
+                            req.queue_wait_s, priority=prio)
+        if req.ttft_s is not None:
+            metrics.observe("tpu_hive_serve_ttft_seconds", req.ttft_s,
+                            priority=prio)
+        if req.tpot_s is not None:
+            metrics.observe("tpu_hive_serve_tpot_seconds", req.tpot_s,
+                            priority=prio)
+        if not obs_trace.enabled():
+            return
+        args = {"rid": req.rid, "priority": req.priority,
+                "prompt_tokens": len(req.prompt),
+                "new_tokens": len(req.tokens_out)}
+        tid = req.rid
+        if req.admitted_at is not None:
+            obs_trace.TRACER.complete("request/queued", req.submitted_at,
+                                      req.admitted_at, cat="serving",
+                                      tid=tid, args=args)
+            if req.first_token_at is not None:
+                obs_trace.TRACER.complete("request/prefill", req.admitted_at,
+                                          req.first_token_at, cat="serving",
+                                          tid=tid, args=args)
+        if req.first_token_at is not None and req.done_at is not None:
+            obs_trace.TRACER.complete("request/decode", req.first_token_at,
+                                      req.done_at, cat="serving",
+                                      tid=tid, args=args)
+        obs_trace.TRACER.instant("request/done", cat="serving", tid=tid,
+                                 at=req.done_at, args=args)
 
     # -- engine ticks ------------------------------------------------------
     def _tick_prefills(self) -> List[int]:

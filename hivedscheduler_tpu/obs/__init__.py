@@ -1,0 +1,15 @@
+"""Unified observability layer: tracing, decision traces, and the glue to
+the Prometheus registry (``runtime/metrics.py``).
+
+- ``obs.trace`` — dependency-free span/event tracer (bounded ring,
+  Chrome-trace/Perfetto JSON export, module-level no-op fast path).
+- ``obs.decisions`` — structured scheduler decision traces ("why did this
+  gang land on these cells?"), served at ``GET /v1/inspect/traces``.
+
+See ``doc/design/observability.md`` for the full catalogue of metric
+names, trace event schemas, and the Perfetto workflow.
+"""
+
+from hivedscheduler_tpu.obs import decisions, trace  # noqa: F401
+
+__all__ = ["trace", "decisions"]

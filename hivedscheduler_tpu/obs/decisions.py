@@ -1,0 +1,177 @@
+"""Scheduler decision traces: "why did this gang land on these cells?".
+
+Every ``HivedAlgorithm.schedule`` call, when recording is enabled, produces
+one ``Decision``: the request's identity (pod, group, VC, priority, phase),
+every placement **attempt** the ``_schedule_*`` ladder made (which chain or
+pinned cell was probed, on which path — within-VC guaranteed, opportunistic,
+or multi-chain relaxation — and why it failed if it did), the final outcome
+(bind / preempt / wait / error) and its explanation, preemption victims,
+and the wall time spent deciding. The last N decisions live in a bounded
+ring served at ``GET /v1/inspect/traces`` and printed by the demo CLI's
+``--explain`` flag.
+
+Threading contract: a ``Decision`` is mutated only inside
+``HivedAlgorithm.schedule`` under the algorithm lock (the layer is
+single-threaded by design — CLAUDE.md architecture rules); the ring itself
+is locked because the webserver reads it from handler threads.
+
+Like ``obs.trace``, recording is OFF by default and every instrumentation
+site is gated on one cheap check (``RECORDER.enabled`` or the decision
+object being non-None), so ``bench.py``'s schedule hot path is unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from hivedscheduler_tpu.obs import trace
+
+_DEFAULT_CAPACITY = 256
+
+
+@dataclass
+class Attempt:
+    """One placement probe: a (chain | pinned cell) x scheduling-path pair."""
+
+    where: str  # "chain v5p-1024" | "pinned cell pc1" | "relax[a,b]"
+    path: str  # "guaranteed" | "opportunistic" | "multi-chain-relax" | ...
+    outcome: str  # "placed" | "failed"
+    reason: str = ""  # failure explanation, verbatim from the ladder
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"where": self.where, "path": self.path,
+                "outcome": self.outcome, "reason": self.reason}
+
+
+@dataclass
+class Decision:
+    """One ``schedule()`` call, beginning to outcome."""
+
+    pod: str
+    phase: str
+    group: str = ""
+    vc: str = ""
+    priority: Optional[int] = None
+    suggested_nodes: int = 0
+    attempts: List[Attempt] = field(default_factory=list)
+    outcome: str = ""  # "bind" | "preempt" | "wait" | "error"
+    node: str = ""  # bind target (outcome == "bind")
+    victims: List[str] = field(default_factory=list)  # outcome == "preempt"
+    reason: str = ""  # wait reason / error message
+    started_at: float = field(default_factory=time.time)  # wall epoch
+    elapsed_ms: float = 0.0
+    _t0: float = field(default_factory=time.perf_counter, repr=False)
+
+    def attempt(self, where: str, path: str, outcome: str,
+                reason: str = "") -> None:
+        self.attempts.append(Attempt(where, path, outcome, reason))
+
+    def finish(self, outcome: str, node: str = "", victims=(),
+               reason: str = "") -> None:
+        self.outcome = outcome
+        self.node = node
+        self.victims = list(victims)
+        self.reason = reason
+        self.elapsed_ms = (time.perf_counter() - self._t0) * 1e3
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "pod": self.pod,
+            "group": self.group,
+            "vc": self.vc,
+            "priority": self.priority,
+            "phase": self.phase,
+            "suggestedNodes": self.suggested_nodes,
+            "attempts": [a.to_dict() for a in self.attempts],
+            "outcome": self.outcome,
+            "node": self.node,
+            "victims": self.victims,
+            "reason": self.reason,
+            "startedAt": self.started_at,
+            "elapsedMs": round(self.elapsed_ms, 3),
+        }
+
+    def explain(self) -> str:
+        """One human line: the --explain rendering."""
+        probes = "; ".join(
+            f"{a.where}/{a.path}: {a.outcome}"
+            + (f" ({a.reason})" if a.reason else "")
+            for a in self.attempts
+        ) or "no placement probes"
+        tail = {
+            "bind": f"-> bind {self.node}",
+            "preempt": f"-> preempt {len(self.victims)} victim(s)",
+            "wait": f"-> wait: {self.reason}",
+            "error": f"-> error: {self.reason}",
+        }.get(self.outcome, f"-> {self.outcome}")
+        return (f"[{self.pod}] {self.phase} prio={self.priority} "
+                f"vc={self.vc}: {probes} {tail} "
+                f"({self.elapsed_ms:.1f} ms)")
+
+
+class DecisionRecorder:
+    """Bounded ring of the last N decisions + optional commit callback."""
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self.enabled = False
+        self.on_commit: Optional[Callable[[Decision], None]] = None
+
+    def enable(self, capacity: Optional[int] = None) -> None:
+        with self._lock:
+            if capacity is not None:
+                self._ring = deque(self._ring, maxlen=capacity)
+            self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def begin(self, pod: str, phase: str) -> Optional[Decision]:
+        """Start a decision (None when disabled — instrumentation sites gate
+        on the returned object, keeping the disabled path one check)."""
+        if not self.enabled:
+            return None
+        return Decision(pod=pod, phase=phase)
+
+    def commit(self, decision: Decision) -> None:
+        with self._lock:
+            self._ring.append(decision)
+        # mirror into the shared timeline so the Perfetto export shows
+        # schedule decisions alongside extender/serving spans
+        if trace.enabled():
+            trace.TRACER.complete(
+                f"schedule {decision.pod}",
+                decision._t0,
+                decision._t0 + decision.elapsed_ms / 1e3,
+                cat="scheduler",
+                args={"outcome": decision.outcome,
+                      "attempts": len(decision.attempts),
+                      "vc": decision.vc},
+            )
+        cb = self.on_commit
+        if cb is not None:
+            try:
+                cb(decision)
+            except Exception:  # a broken callback must never fail schedule()
+                pass
+
+    def last(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Most-recent-first dicts of the last ``n`` (default: all held)."""
+        with self._lock:
+            items = list(self._ring)
+        items.reverse()
+        if n is not None:
+            items = items[: max(0, n)]
+        return [d.to_dict() for d in items]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+RECORDER = DecisionRecorder()

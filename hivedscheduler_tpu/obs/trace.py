@@ -1,0 +1,262 @@
+"""Dependency-free span/event tracer with Chrome-trace (Perfetto) export.
+
+The reference is klog-only (SURVEY.md §5): nothing answers "where did this
+request's time go?". This tracer is the shared timeline substrate for the
+whole stack — scheduler decisions (``obs.decisions``), extender routines
+(``runtime/scheduler.py``), serving request lifecycles
+(``models/serving.py``), and train step timelines (``train.py``) all emit
+into one bounded in-memory ring buffer that exports as Chrome trace event
+JSON (the format Perfetto / ``chrome://tracing`` / TensorBoard's trace
+viewer load directly).
+
+Design constraints, in order:
+
+- **Zero overhead when disabled** (the default). Every emit path starts
+  with one module-level bool check; ``span()`` returns a shared no-op
+  context manager without allocating. ``python bench.py`` must not move.
+- **Thread-safe**: the serving engine emits from worker threads and the
+  webserver reads concurrently; the ring is locked. (The algorithm layer
+  is single-threaded under the scheduler lock by contract — its events
+  need the lock only because OTHER components share the ring.)
+- **Bounded**: a ``deque(maxlen=capacity)`` ring — long-lived servers
+  keep the most recent events, never grow.
+
+Enable programmatically (``trace.enable()``) or via ``HIVED_TRACE=1`` in
+the environment. Export with ``trace.to_chrome_trace()`` /
+``trace.write_chrome_trace(path)``, or over HTTP at
+``GET /v1/inspect/traces/chrome`` on the scheduler webserver.
+
+Event schema (Chrome trace event format, the subset we emit):
+
+- ``ph="X"`` complete events: ``name, cat, ts, dur, pid, tid, args``
+- ``ph="i"`` instant events:  ``name, cat, ts, s="t", pid, tid, args``
+- ``ph="M"`` metadata: process/thread names (emitted on ``enable()``)
+
+``ts``/``dur`` are microseconds on the process-wide ``perf_counter``
+clock, re-based to the tracer's start; callers that timestamp with
+``time.perf_counter()`` themselves (the serving engine's request
+bookkeeping) can hand those values to ``complete()`` verbatim.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+_DEFAULT_CAPACITY = 65536
+
+
+class Tracer:
+    """Bounded ring of Chrome-trace events. Instantiable for tests; the
+    module-level singleton ``TRACER`` is what the stack shares."""
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+        # perf_counter anchor: all ts are relative to tracer creation so
+        # callers' own perf_counter timestamps convert with one subtraction
+        self._t0 = time.perf_counter()
+        self.dropped = 0  # events displaced by the ring bound
+
+    # -- emit ------------------------------------------------------------
+    def _ts_us(self, at: Optional[float] = None) -> float:
+        return ((time.perf_counter() if at is None else at) - self._t0) * 1e6
+
+    def _emit(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(ev)
+
+    def complete(
+        self,
+        name: str,
+        start: float,
+        end: float,
+        cat: str = "",
+        tid: Optional[int] = None,
+        args: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Record a finished span from explicit ``perf_counter`` seconds —
+        the path for callers that already keep their own timestamps."""
+        self._emit({
+            "name": name,
+            "ph": "X",
+            "cat": cat or "default",
+            "ts": self._ts_us(start),
+            "dur": max(0.0, (end - start) * 1e6),
+            "pid": 1,
+            "tid": threading.get_ident() & 0x7FFFFFFF if tid is None else tid,
+            "args": args or {},
+        })
+
+    def instant(
+        self,
+        name: str,
+        cat: str = "",
+        tid: Optional[int] = None,
+        at: Optional[float] = None,
+        args: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self._emit({
+            "name": name,
+            "ph": "i",
+            "s": "t",  # thread-scoped instant
+            "cat": cat or "default",
+            "ts": self._ts_us(at),
+            "pid": 1,
+            "tid": threading.get_ident() & 0x7FFFFFFF if tid is None else tid,
+            "args": args or {},
+        })
+
+    def metadata(self, name: str, value: str, tid: int = 0) -> None:
+        """``M`` event naming a pid/tid lane in the viewer."""
+        key = "process_name" if name == "process" else "thread_name"
+        self._emit({
+            "name": key,
+            "ph": "M",
+            "pid": 1,
+            "tid": tid,
+            "ts": 0,
+            "args": {"name": value},
+        })
+
+    def span(self, name: str, cat: str = "", **args: Any) -> "_Span":
+        return _Span(self, name, cat, args)
+
+    # -- read ------------------------------------------------------------
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """The dict form of the Chrome trace JSON object (Perfetto loads
+        ``json.dumps`` of this verbatim)."""
+        return {
+            "traceEvents": self.snapshot(),
+            "displayTimeUnit": "ms",
+            "otherData": {"producer": "tpu-hive obs.trace",
+                          "dropped_events": self.dropped},
+        }
+
+    def write_chrome_trace(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+
+
+class _Span:
+    """Context manager recording one complete ("X") event on exit.
+    ``add(**kw)`` attaches args mid-flight (e.g. the outcome)."""
+
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "_start")
+
+    def __init__(self, tracer: Tracer, name: str, cat: str, args: Dict[str, Any]):
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._args = args
+        self._start = 0.0
+
+    def add(self, **kw: Any) -> None:
+        self._args.update(kw)
+
+    def __enter__(self) -> "_Span":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None and "error" not in self._args:
+            self._args["error"] = exc_type.__name__
+        self._tracer.complete(self._name, self._start, time.perf_counter(),
+                              cat=self._cat, args=self._args)
+        return False
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled fast path allocates nothing."""
+
+    __slots__ = ()
+
+    def add(self, **kw: Any) -> None:
+        pass
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NOOP = _NoopSpan()
+
+# Module state: ONE bool gates every emit path. Disabled by default so the
+# instrumented hot paths (schedule ladder, serving steps) pay a single
+# attribute load; HIVED_TRACE=1 opts in at import for ad-hoc runs.
+_enabled = False
+TRACER = Tracer()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(capacity: Optional[int] = None) -> None:
+    """Turn tracing on (optionally resizing the ring; resets its content)."""
+    global _enabled, TRACER
+    if capacity is not None:
+        TRACER = Tracer(capacity)
+    _enabled = True
+    TRACER.metadata("process", "tpu-hive")
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def span(name: str, cat: str = "", **args: Any):
+    """``with trace.span("filter_routine", cat="extender") as sp: ...`` —
+    a shared no-op object when tracing is off (no allocation)."""
+    if not _enabled:
+        return _NOOP
+    return TRACER.span(name, cat, **args)
+
+
+def instant(name: str, cat: str = "", tid: Optional[int] = None,
+            **args: Any) -> None:
+    if not _enabled:
+        return
+    TRACER.instant(name, cat, tid=tid, args=args)
+
+
+def complete(name: str, start: float, end: float, cat: str = "",
+             tid: Optional[int] = None, **args: Any) -> None:
+    """Record a finished span from caller-held perf_counter timestamps."""
+    if not _enabled:
+        return
+    TRACER.complete(name, start, end, cat=cat, tid=tid, args=args)
+
+
+def to_chrome_trace() -> Dict[str, Any]:
+    return TRACER.to_chrome_trace()
+
+
+def write_chrome_trace(path: str) -> None:
+    TRACER.write_chrome_trace(path)
+
+
+if os.environ.get("HIVED_TRACE") == "1":  # ad-hoc opt-in without code changes
+    enable()

@@ -20,8 +20,11 @@ class Registry:
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._gauges: Dict[str, float] = {}
-        # name -> (bucket counts, sum, count)
-        self._histograms: Dict[str, Tuple[List[int], float, int]] = {}
+        # (name, labels) -> (bucket counts, sum, count)
+        self._histograms: Dict[
+            Tuple[str, Tuple[Tuple[str, str], ...]],
+            Tuple[List[int], float, int],
+        ] = {}
         self._help: Dict[str, str] = {}
 
     def describe(self, name: str, help_text: str) -> None:
@@ -36,10 +39,14 @@ class Registry:
         with self._lock:
             self._gauges[name] = value
 
-    def observe(self, name: str, seconds: float) -> None:
+    def observe(self, name: str, seconds: float, **labels: str) -> None:
+        """Record one histogram sample. ``labels`` mirror ``inc`` (e.g. the
+        serving histograms split by priority class); each label set keeps
+        its own buckets/sum/count, rendered as separate series."""
+        key = (name, tuple(sorted(labels.items())))
         with self._lock:
             buckets, total, count = self._histograms.get(
-                name, ([0] * (len(_LATENCY_BUCKETS) + 1), 0.0, 0)
+                key, ([0] * (len(_LATENCY_BUCKETS) + 1), 0.0, 0)
             )
             buckets = list(buckets)
             for i, bound in enumerate(_LATENCY_BUCKETS):
@@ -48,7 +55,7 @@ class Registry:
                     break
             else:
                 buckets[-1] += 1
-            self._histograms[name] = (buckets, total + seconds, count + 1)
+            self._histograms[key] = (buckets, total + seconds, count + 1)
 
     @staticmethod
     def _fmt(value: float) -> str:
@@ -81,18 +88,31 @@ class Registry:
                     out.append(f"# HELP {name} {self._help[name]}")
                 out.append(f"# TYPE {name} gauge")
                 out.append(f"{name} {self._fmt(value)}")
-            for name, (buckets, total, count) in sorted(self._histograms.items()):
+            hist_names = sorted({n for n, _ in self._histograms})
+            for name in hist_names:
                 if name in self._help:
                     out.append(f"# HELP {name} {self._help[name]}")
                 out.append(f"# TYPE {name} histogram")
-                cumulative = 0
-                for i, bound in enumerate(_LATENCY_BUCKETS):
-                    cumulative += buckets[i]
-                    out.append(f'{name}_bucket{{le="{bound}"}} {cumulative}')
-                cumulative += buckets[-1]
-                out.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
-                out.append(f"{name}_sum {self._fmt(total)}")
-                out.append(f"{name}_count {count}")
+                for (n, labels), (buckets, total, count) in sorted(
+                    self._histograms.items()
+                ):
+                    if n != name:
+                        continue
+                    base = ",".join(f'{k}="{v}"' for k, v in labels)
+                    sep = "," if base else ""
+                    series = "{" + base + "}" if base else ""
+                    cumulative = 0
+                    for i, bound in enumerate(_LATENCY_BUCKETS):
+                        cumulative += buckets[i]
+                        out.append(
+                            f'{name}_bucket{{{base}{sep}le="{bound}"}} {cumulative}'
+                        )
+                    cumulative += buckets[-1]
+                    out.append(
+                        f'{name}_bucket{{{base}{sep}le="+Inf"}} {cumulative}'
+                    )
+                    out.append(f"{name}_sum{series} {self._fmt(total)}")
+                    out.append(f"{name}_count{series} {count}")
         return "\n".join(out) + "\n"
 
 
@@ -106,3 +126,13 @@ REGISTRY.describe("tpu_hive_force_binds_total", "Force-bind escalations")
 REGISTRY.describe("tpu_hive_bad_nodes", "Nodes currently considered bad")
 REGISTRY.describe("tpu_hive_filter_latency_seconds", "filterRoutine latency")
 REGISTRY.describe("tpu_hive_preempt_latency_seconds", "preemptRoutine latency")
+# serving-engine request lifecycle (models/serving.py), split by priority
+# class via observe() labels
+REGISTRY.describe("tpu_hive_serve_queue_wait_seconds",
+                  "Serving request wait from submit to slot admission")
+REGISTRY.describe("tpu_hive_serve_ttft_seconds",
+                  "Serving time-to-first-token (queue wait + prefill)")
+REGISTRY.describe("tpu_hive_serve_tpot_seconds",
+                  "Serving time-per-output-token after the first token")
+REGISTRY.describe("tpu_hive_serve_requests_total",
+                  "Serving requests completed by priority class")

@@ -15,6 +15,7 @@ import time
 from typing import Dict, List, Optional
 
 from hivedscheduler_tpu.api.constants import COMPONENT_NAME as _COMPONENT
+from hivedscheduler_tpu.obs import trace
 from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
 
 from hivedscheduler_tpu.api import config as api_config
@@ -255,18 +256,21 @@ class HivedScheduler:
     def filter_routine(self, args: ei.ExtenderArgs) -> ei.ExtenderFilterResult:
         """Reference: filterRoutine, scheduler.go:485-587."""
         t0 = time.perf_counter()
-        try:
-            result, outcome = self._filter_routine(args)
-            metrics.inc("tpu_hive_extender_requests_total",
-                        routine="filter", outcome=outcome)
-            return result
-        except Exception:
-            metrics.inc("tpu_hive_extender_requests_total",
-                        routine="filter", outcome="error")
-            raise
-        finally:
-            metrics.observe("tpu_hive_filter_latency_seconds",
-                            time.perf_counter() - t0)
+        with trace.span("filter_routine", cat="extender",
+                        pod=internal_utils.key(args.pod)) as sp:
+            try:
+                result, outcome = self._filter_routine(args)
+                sp.add(outcome=outcome)
+                metrics.inc("tpu_hive_extender_requests_total",
+                            routine="filter", outcome=outcome)
+                return result
+            except Exception:
+                metrics.inc("tpu_hive_extender_requests_total",
+                            routine="filter", outcome="error")
+                raise
+            finally:
+                metrics.observe("tpu_hive_filter_latency_seconds",
+                                time.perf_counter() - t0)
 
     def _filter_routine(self, args: ei.ExtenderArgs):
         """Returns (result, metric outcome); each return site knows its own
@@ -352,15 +356,18 @@ class HivedScheduler:
 
     def bind_routine(self, args: ei.ExtenderBindingArgs) -> ei.ExtenderBindingResult:
         """Idempotent bind executor (reference: bindRoutine, scheduler.go:594-627)."""
-        try:
-            result = self._bind_routine(args)
-            metrics.inc("tpu_hive_extender_requests_total",
-                        routine="bind", outcome="ok")
-            return result
-        except Exception:
-            metrics.inc("tpu_hive_extender_requests_total",
-                        routine="bind", outcome="error")
-            raise
+        with trace.span("bind_routine", cat="extender",
+                        pod=f"{args.pod_namespace}/{args.pod_name}",
+                        node=args.node):
+            try:
+                result = self._bind_routine(args)
+                metrics.inc("tpu_hive_extender_requests_total",
+                            routine="bind", outcome="ok")
+                return result
+            except Exception:
+                metrics.inc("tpu_hive_extender_requests_total",
+                            routine="bind", outcome="error")
+                raise
 
     def _bind_routine(self, args: ei.ExtenderBindingArgs) -> ei.ExtenderBindingResult:
         with self.scheduler_lock:
@@ -395,20 +402,22 @@ class HivedScheduler:
     def preempt_routine(self, args: ei.ExtenderPreemptionArgs) -> ei.ExtenderPreemptionResult:
         """Reference: preemptRoutine, scheduler.go:629-721."""
         t0 = time.perf_counter()
-        try:
-            result = self._preempt_routine(args)
-            metrics.inc(
-                "tpu_hive_extender_requests_total", routine="preempt",
-                outcome="victims" if result.node_name_to_meta_victims else "none",
-            )
-            return result
-        except Exception:
-            metrics.inc("tpu_hive_extender_requests_total",
-                        routine="preempt", outcome="error")
-            raise
-        finally:
-            metrics.observe("tpu_hive_preempt_latency_seconds",
-                            time.perf_counter() - t0)
+        with trace.span("preempt_routine", cat="extender",
+                        pod=internal_utils.key(args.pod)) as sp:
+            try:
+                result = self._preempt_routine(args)
+                outcome = "victims" if result.node_name_to_meta_victims else "none"
+                sp.add(outcome=outcome)
+                metrics.inc("tpu_hive_extender_requests_total",
+                            routine="preempt", outcome=outcome)
+                return result
+            except Exception:
+                metrics.inc("tpu_hive_extender_requests_total",
+                            routine="preempt", outcome="error")
+                raise
+            finally:
+                metrics.observe("tpu_hive_preempt_latency_seconds",
+                                time.perf_counter() - t0)
 
     def _preempt_routine(self, args: ei.ExtenderPreemptionArgs) -> ei.ExtenderPreemptionResult:
         with self.scheduler_lock:

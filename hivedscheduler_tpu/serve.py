@@ -95,6 +95,11 @@ def main(argv=None) -> int:
     parser.add_argument("--lora-mlp", action="store_true",
                         help="the checkpoint carries MLP adapters too")
     parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("--metrics-dump", default="",
+                        help="after the run, write the Prometheus exposition "
+                        "text (per-priority TTFT/TPOT/queue-wait histograms) "
+                        "to this path and a Chrome-trace/Perfetto JSON of "
+                        "request lifecycles to <path>.trace.json")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
     if args.prefix_cache > 0:
@@ -109,6 +114,11 @@ def main(argv=None) -> int:
             )
 
     common.init_all(logging.DEBUG if args.verbose else logging.INFO)
+    if args.metrics_dump:
+        # request-lifecycle spans only reach the ring while tracing is on
+        from hivedscheduler_tpu.obs import trace as obs_trace
+
+        obs_trace.enable()
     import jax
     import jax.numpy as jnp
 
@@ -249,6 +259,17 @@ def main(argv=None) -> int:
                  "(%s entries held)",
                  eng.prefix_hits, eng.prefix_tokens_reused,
                  len(eng._prefix_cache))
+    if args.metrics_dump:
+        from hivedscheduler_tpu.obs import trace as obs_trace
+        from hivedscheduler_tpu.runtime.metrics import REGISTRY
+
+        with open(args.metrics_dump, "w") as f:
+            f.write(REGISTRY.render())
+        trace_path = args.metrics_dump + ".trace.json"
+        obs_trace.write_chrome_trace(trace_path)
+        log.info("metrics exposition -> %s; Chrome trace -> %s "
+                 "(open in https://ui.perfetto.dev)",
+                 args.metrics_dump, trace_path)
     return 0
 
 

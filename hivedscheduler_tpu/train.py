@@ -96,6 +96,12 @@ def main(argv=None) -> int:
                         help="capture a jax.profiler trace (TensorBoard/"
                         "Perfetto format) of steps 2..4 into this directory "
                         "— step 1 is compile and would drown the trace")
+    parser.add_argument("--timeline", default="",
+                        help="write a per-step JSONL timeline (step, wall_s, "
+                        "tokens_per_sec, loss, compile flag) to this path — "
+                        "the host-side complement of --profile-dir's device "
+                        "trace. Syncs on the loss every step, so per-step "
+                        "wall times are true (small dispatch-overlap cost)")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=50)
     parser.add_argument("--log-every", type=int, default=10)
@@ -213,6 +219,11 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     tokens_per_step = args.batch * args.seq_len
     profiling = False
+    timeline = open(args.timeline, "w") if args.timeline else None
+    if timeline is not None:
+        import json
+
+        from hivedscheduler_tpu.obs import trace as obs_trace
     if args.profile_dir and args.steps - start_step < 2:
         log.warning(
             "--profile-dir needs at least 2 steps to trace (step 1 is "
@@ -233,6 +244,7 @@ def main(argv=None) -> int:
                 jax.profiler.stop_trace()
                 profiling = False
                 log.info("profiler trace written to %s", args.profile_dir)
+        step_t0 = time.perf_counter()
         tokens = data_lib.device_put_global(
             next(batches), token_sharding, args.batch
         )
@@ -243,6 +255,23 @@ def main(argv=None) -> int:
             params = tm.combine_lora_params(base_params, lora_params)
         else:
             params, opt_state, loss = step_fn(params, opt_state, tokens)
+        if timeline is not None:
+            # sync so wall covers the whole step (data + dispatch + compute);
+            # the first step of an incarnation includes compilation
+            jax.block_until_ready(loss)
+            wall = time.perf_counter() - step_t0
+            record = {
+                "step": step + 1,
+                "wall_s": round(wall, 6),
+                "tokens_per_sec": round(tokens_per_step / max(wall, 1e-9), 1),
+                "loss": float(loss),
+                "compile": step == start_step,
+            }
+            timeline.write(json.dumps(record) + "\n")
+            timeline.flush()
+            obs_trace.complete("train/step", step_t0, time.perf_counter(),
+                               cat="train", step=step + 1,
+                               compile=step == start_step)
         if (step + 1) % args.log_every == 0:
             loss_v = float(loss)
             dt = time.perf_counter() - t0
@@ -258,6 +287,9 @@ def main(argv=None) -> int:
         jax.block_until_ready(loss)
         jax.profiler.stop_trace()
         log.info("profiler trace written to %s", args.profile_dir)
+    if timeline is not None:
+        timeline.close()
+        log.info("step timeline written to %s", args.timeline)
     if args.checkpoint_dir:
         ckpt.save(args.checkpoint_dir, args.steps, params, opt_state)
     log.info("training complete: %s steps", args.steps)

@@ -117,7 +117,8 @@ def _make_handler(scheduler: HivedScheduler):
         # ---------------- GET: inspect ----------------
         def do_GET(self) -> None:
             try:
-                path = self.path.rstrip("/")
+                full, _, query = self.path.partition("?")
+                path = full.rstrip("/")
                 if path == "/healthz":
                     # bounded liveness: a wedged scheduler lock or dead watch
                     # threads must fail the probe, not just a dead HTTP server
@@ -144,12 +145,30 @@ def _make_handler(scheduler: HivedScheduler):
                         C.FILTER_PATH, C.BIND_PATH, C.PREEMPT_PATH,
                         C.AFFINITY_GROUPS_PATH, C.CLUSTER_STATUS_PATH,
                         C.PHYSICAL_CLUSTER_PATH, C.VIRTUAL_CLUSTERS_PATH,
+                        C.TRACES_PATH, C.TRACES_CHROME_PATH,
                     ]})
+                elif path == C.TRACES_CHROME_PATH:
+                    from hivedscheduler_tpu.obs import trace
+
+                    self._reply(200, trace.to_chrome_trace())
+                elif path == C.TRACES_PATH:
+                    from urllib.parse import parse_qs
+
+                    from hivedscheduler_tpu.obs.decisions import RECORDER
+
+                    try:
+                        n = int(parse_qs(query).get("n", ["32"])[0])
+                    except ValueError:
+                        raise WebServerError(400, "n must be an integer")
+                    self._reply(200, {
+                        "enabled": RECORDER.enabled,
+                        "items": RECORDER.last(n),
+                    })
                 elif path == C.AFFINITY_GROUPS_PATH.rstrip("/"):
                     groups = scheduler.get_all_affinity_groups()
                     self._reply(200, {"items": [g.to_dict() for g in groups]})
-                elif self.path.startswith(C.AFFINITY_GROUPS_PATH):
-                    name = self.path[len(C.AFFINITY_GROUPS_PATH):].rstrip("/")
+                elif full.startswith(C.AFFINITY_GROUPS_PATH):
+                    name = full[len(C.AFFINITY_GROUPS_PATH):].rstrip("/")
                     self._reply(200, scheduler.get_affinity_group(name).to_dict())
                 elif path == C.CLUSTER_STATUS_PATH:
                     self._reply(200, scheduler.get_cluster_status().to_dict())
@@ -163,8 +182,8 @@ def _make_handler(scheduler: HivedScheduler):
                         200,
                         {vc: [s.to_dict() for s in lst] for vc, lst in vcs.items()},
                     )
-                elif self.path.startswith(C.VIRTUAL_CLUSTERS_PATH):
-                    vcn = self.path[len(C.VIRTUAL_CLUSTERS_PATH):].rstrip("/")
+                elif full.startswith(C.VIRTUAL_CLUSTERS_PATH):
+                    vcn = full[len(C.VIRTUAL_CLUSTERS_PATH):].rstrip("/")
                     self._reply(
                         200,
                         [s.to_dict() for s in scheduler.get_virtual_cluster_status(vcn)],

@@ -44,3 +44,25 @@ def walk_status(statuses):
     for s in statuses:
         yield s
         yield from walk_status(s.cell_children)
+
+
+def validate_chrome_trace(obj):
+    """Assert ``obj`` is a valid Chrome trace (JSON Object Format) that
+    Perfetto / chrome://tracing load: a traceEvents array of event objects
+    each carrying name/ph/pid/tid and a numeric ts; complete ("X") events
+    additionally need a non-negative numeric dur. Returns the events."""
+    assert isinstance(obj, dict), "trace must be the JSON object format"
+    events = obj.get("traceEvents")
+    assert isinstance(events, list), "traceEvents must be an array"
+    for ev in events:
+        assert isinstance(ev, dict)
+        assert isinstance(ev.get("name"), str) and ev["name"]
+        assert isinstance(ev.get("ph"), str) and ev["ph"]
+        assert isinstance(ev.get("ts"), (int, float))
+        assert isinstance(ev.get("pid"), int)
+        assert isinstance(ev.get("tid"), int)
+        if ev["ph"] == "X":
+            assert isinstance(ev.get("dur"), (int, float)) and ev["dur"] >= 0
+        if "args" in ev:
+            assert isinstance(ev["args"], dict)
+    return events

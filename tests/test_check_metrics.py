@@ -1,0 +1,43 @@
+"""Guard: the metric-name catalogue lint (tools/check_metrics.py) passes on
+the package, and actually catches the two drift directions it exists for."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_metrics.py")
+
+
+def test_package_metric_names_all_described():
+    """Every REGISTRY.inc/observe/set_gauge literal name has a describe()
+    entry and no described name is dead (ISSUE satellite)."""
+    proc = subprocess.run([sys.executable, TOOL], cwd=REPO,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"check_metrics failed:\n{proc.stdout}{proc.stderr}"
+    )
+    assert "OK" in proc.stdout
+
+
+def test_collector_catches_drift(tmp_path):
+    """The AST collector flags undescribed emits, dead describes, and
+    non-literal names."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_metrics
+    finally:
+        sys.path.pop(0)
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "REGISTRY.describe('tpu_hive_dead_total', 'never emitted')\n"
+        "REGISTRY.inc('tpu_hive_orphan_total')\n"
+        "metrics.observe('tpu_hive_lat_seconds', 0.1)\n"
+        "name = 'tpu_hive_dynamic'\n"
+        "REGISTRY.inc(name)\n"
+    )
+    emitted, described, dynamic = check_metrics.collect(str(pkg))
+    assert set(emitted) == {"tpu_hive_orphan_total", "tpu_hive_lat_seconds"}
+    assert described == {"tpu_hive_dead_total"}
+    assert len(dynamic) == 1 and "non-literal" in dynamic[0]

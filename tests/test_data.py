@@ -138,7 +138,6 @@ def test_prefetch_abandoned_iterator_stops_worker():
     --steps) must signal the producer thread to exit instead of leaving it
     blocked forever on the bounded queue (thread + staged-batch leak)."""
     import threading
-    import time
 
     produced = []
 
@@ -149,13 +148,19 @@ def test_prefetch_abandoned_iterator_stops_worker():
             yield np.full((2, 2), i, np.int32)
             i += 1
 
-    before = threading.active_count()
+    # capture the worker thread itself via an enumerate() diff — asserting
+    # on the global active_count() flakes when an unrelated library thread
+    # starts mid-test (ADVICE.md round 5)
+    before = set(threading.enumerate())
     it = data_lib.prefetch(infinite(), depth=2)
     next(it)
+    workers = [t for t in threading.enumerate() if t not in before]
+    assert workers, "prefetch started no worker thread"
     it.close()  # GeneratorExit -> finally -> closed.set()
-    deadline = time.monotonic() + 5.0
-    while threading.active_count() > before and time.monotonic() < deadline:
-        time.sleep(0.05)
-    assert threading.active_count() <= before
+    for t in workers:
+        t.join(timeout=5.0)
+    assert not any(t.is_alive() for t in workers), (
+        "prefetch worker still alive after the consumer was closed"
+    )
     # the producer stopped near where it was abandoned, not unbounded
     assert len(produced) <= 6

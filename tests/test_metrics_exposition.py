@@ -1,0 +1,170 @@
+"""Prometheus exposition lint: Registry.render() must stay parseable by a
+strict reader — HELP/TYPE ordering, label formatting, cumulative monotone
+``le`` buckets with ``+Inf`` == ``_count`` — and /metrics must serve it on
+the fake-cluster webserver (ISSUE satellite; the e2e smoke in test_e2e.py
+only greps for substrings)."""
+
+import os
+import re
+import urllib.request
+
+import pytest
+
+from hivedscheduler_tpu.runtime.metrics import Registry
+
+SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?P<labels>\{[^}]*\})? (?P<value>[^ ]+)$'
+)
+LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def parse(text):
+    """Strict-ish exposition parse: returns (samples, meta) where samples is
+    [(name, {labels}, value)] and meta is {name: [("HELP"|"TYPE", payload)]}.
+    Asserts structural rules along the way."""
+    samples = []
+    meta = {}
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            kind, rest = line[2:6], line[7:]
+            name, _, payload = rest.partition(" ")
+            meta.setdefault(name, []).append((kind, payload))
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line!r}"
+        m = SAMPLE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        labels = dict(LABEL.findall(m.group("labels") or ""))
+        value = float(m.group("value"))
+        samples.append((m.group("name"), labels, value))
+    return samples, meta
+
+
+def series(samples, name):
+    return [(l, v) for n, l, v in samples if n == name]
+
+
+def base_name(sample_name):
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            return sample_name[: -len(suffix)]
+    return sample_name
+
+
+class TestExpositionFormat:
+    def build(self):
+        r = Registry()
+        r.describe("tpu_hive_test_total", "a labeled counter")
+        r.describe("tpu_hive_test_gauge", "a gauge")
+        r.describe("tpu_hive_test_latency_seconds", "a histogram")
+        r.inc("tpu_hive_test_total", routine="filter", outcome="ok")
+        r.inc("tpu_hive_test_total", routine="filter", outcome="error")
+        r.inc("tpu_hive_test_total", 2.5, routine="bind", outcome="ok")
+        r.set_gauge("tpu_hive_test_gauge", 3)
+        for v in (0.0005, 0.002, 0.02, 0.2, 2.0, 60.0):
+            r.observe("tpu_hive_test_latency_seconds", v)
+        for v in (0.01, 0.3):
+            r.observe("tpu_hive_test_latency_seconds", v, priority="10")
+        return r
+
+    def test_help_immediately_precedes_type(self):
+        text = self.build().render()
+        lines = text.splitlines()
+        for i, line in enumerate(lines):
+            if line.startswith("# HELP "):
+                name = line.split()[2]
+                assert lines[i + 1].startswith(f"# TYPE {name} "), (
+                    f"HELP for {name} not immediately followed by its TYPE"
+                )
+
+    def test_type_appears_once_before_samples(self):
+        samples, meta = parse(self.build().render())
+        for name, entries in meta.items():
+            types = [p for k, p in entries if k == "TYPE"]
+            assert len(types) == 1, f"{name}: TYPE emitted {len(types)} times"
+        # every sample's base family carries a TYPE
+        for n, _, _ in samples:
+            assert base_name(n) in meta, f"sample {n} has no TYPE header"
+
+    def test_histogram_buckets_cumulative_and_inf_equals_count(self):
+        samples, _ = parse(self.build().render())
+        name = "tpu_hive_test_latency_seconds"
+        # split series by their non-le labels (the priority classes)
+        by_series = {}
+        for labels, value in series(samples, name + "_bucket"):
+            key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+            by_series.setdefault(key, []).append((labels["le"], value))
+        counts = {tuple(sorted(l.items())): v
+                  for l, v in series(samples, name + "_count")}
+        assert len(by_series) == 2  # unlabeled + priority="10"
+        for key, buckets in by_series.items():
+            # +Inf must be last; cumulative counts monotone non-decreasing
+            les = [le for le, _ in buckets]
+            assert les[-1] == "+Inf"
+            bounds = [float(le) for le in les[:-1]]
+            assert bounds == sorted(bounds)
+            values = [v for _, v in buckets]
+            assert values == sorted(values), f"{key}: buckets not cumulative"
+            assert values[-1] == counts[key], (
+                f"{key}: +Inf bucket != _count"
+            )
+
+    def test_histogram_sum_and_labels_round_trip(self):
+        samples, _ = parse(self.build().render())
+        name = "tpu_hive_test_latency_seconds"
+        sums = {tuple(sorted(l.items())): v
+                for l, v in series(samples, name + "_sum")}
+        assert sums[()] == pytest.approx(62.2225)
+        assert sums[(("priority", "10"),)] == pytest.approx(0.31)
+        # labeled counters render every label pair
+        ctr = series(samples, "tpu_hive_test_total")
+        assert ({"routine": "bind", "outcome": "ok"}, 2.5) in ctr
+        assert len(ctr) == 3
+
+    def test_default_registry_renders_clean(self):
+        """The process-wide REGISTRY (whatever the suite already pushed into
+        it) must always pass the same lint."""
+        from hivedscheduler_tpu.runtime.metrics import REGISTRY
+
+        samples, meta = parse(REGISTRY.render())
+        for name, entries in meta.items():
+            assert [p for k, p in entries if k == "TYPE"], name
+
+
+class TestMetricsEndpointBoot:
+    def test_fake_cluster_webserver_serves_metrics(self):
+        """Boot the fake-cluster stack and lint the real /metrics payload."""
+        from hivedscheduler_tpu.api.config import load_config
+        from hivedscheduler_tpu.k8s.fake import FakeKubeClient
+        from hivedscheduler_tpu.k8s.types import Node
+        from hivedscheduler_tpu.runtime.scheduler import HivedScheduler
+        from hivedscheduler_tpu.webserver import WebServer
+
+        fixture = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "example", "config", "design", "tpu-hive.yaml",
+        )
+        config = load_config(fixture)
+        config.web_server_address = "127.0.0.1:0"
+        kube = FakeKubeClient()
+        scheduler = HivedScheduler(config, kube)
+        algo = scheduler.scheduler_algorithm
+        for n in sorted({n for ccl in algo.full_cell_list.values()
+                         for c in ccl[max(ccl)] for n in c.nodes}):
+            kube.create_node(Node(name=n))
+        scheduler.start()
+        server = WebServer(scheduler)
+        host, port = server.async_run()
+        try:
+            with urllib.request.urlopen(f"http://{host}:{port}/metrics") as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+        finally:
+            server.stop()
+        samples, meta = parse(text)
+        assert ("tpu_hive_bad_nodes", {}, 0.0) in samples
+        for n, _, _ in samples:
+            fam = base_name(n)
+            assert fam in meta and any(k == "TYPE" for k, _ in meta[fam])

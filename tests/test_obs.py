@@ -1,0 +1,352 @@
+"""obs subsystem tests: the span tracer (ring bound, no-op fast path,
+Chrome-trace schema), scheduler decision traces through the real
+HivedAlgorithm ladder, and the webserver's /v1/inspect/traces endpoints.
+
+No jax needed — the algorithm layer is pure Python; the serving/train
+emitters are covered in tests/test_obs_workloads.py.
+"""
+
+import json
+import logging
+import os
+import threading
+
+import pytest
+
+from helpers import make_pod, set_healthy_nodes, validate_chrome_trace
+
+from hivedscheduler_tpu.api import constants as C
+from hivedscheduler_tpu.api.config import load_config
+from hivedscheduler_tpu.algorithm import HivedAlgorithm
+from hivedscheduler_tpu.obs import decisions as obs_decisions
+from hivedscheduler_tpu.obs import trace as obs_trace
+from hivedscheduler_tpu.runtime.types import FILTERING_PHASE
+from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+logging.getLogger().setLevel(logging.ERROR)
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "example", "config", "design", "tpu-hive.yaml",
+)
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation():
+    """Every test starts with observability off and empty rings; global
+    state never leaks into other tests."""
+    obs_trace.disable()
+    obs_trace.TRACER.clear()
+    obs_decisions.RECORDER.disable()
+    obs_decisions.RECORDER.clear()
+    obs_decisions.RECORDER.on_commit = None
+    yield
+    obs_trace.disable()
+    obs_trace.TRACER.clear()
+    obs_decisions.RECORDER.disable()
+    obs_decisions.RECORDER.clear()
+    obs_decisions.RECORDER.on_commit = None
+
+
+# ---------------------------------------------------------------- tracer
+
+
+class TestTracer:
+    def test_disabled_is_noop_and_allocation_free(self):
+        assert not obs_trace.enabled()
+        sp = obs_trace.span("x", cat="t", a=1)
+        sp2 = obs_trace.span("y")
+        assert sp is sp2  # the shared no-op object: no allocation per call
+        with sp:
+            sp.add(outcome="whatever")
+        obs_trace.instant("z", b=2)
+        obs_trace.complete("w", 0.0, 1.0)
+        assert len(obs_trace.TRACER) == 0
+
+    def test_span_records_complete_event(self):
+        obs_trace.enable()
+        with obs_trace.span("work", cat="unit", k="v") as sp:
+            sp.add(outcome="done")
+        events = [e for e in obs_trace.TRACER.snapshot() if e["ph"] == "X"]
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["name"] == "work" and ev["cat"] == "unit"
+        assert ev["args"] == {"k": "v", "outcome": "done"}
+        assert ev["dur"] >= 0
+
+    def test_span_tags_exceptions(self):
+        obs_trace.enable()
+        with pytest.raises(ValueError):
+            with obs_trace.span("boom"):
+                raise ValueError("nope")
+        ev = [e for e in obs_trace.TRACER.snapshot() if e["ph"] == "X"][0]
+        assert ev["args"]["error"] == "ValueError"
+
+    def test_ring_is_bounded(self):
+        t = obs_trace.Tracer(capacity=8)
+        for i in range(20):
+            t.instant(f"e{i}")
+        assert len(t) == 8
+        assert t.dropped == 12
+        names = [e["name"] for e in t.snapshot()]
+        assert names == [f"e{i}" for i in range(12, 20)]  # newest kept
+
+    def test_chrome_export_schema_and_json_round_trip(self, tmp_path):
+        obs_trace.enable()
+        with obs_trace.span("a", cat="c1", x=1):
+            pass
+        obs_trace.instant("marker", cat="c2")
+        obs_trace.TRACER.complete("explicit", 1.0, 2.5, cat="c3",
+                                  tid=7, args={"rid": 7})
+        path = tmp_path / "trace.json"
+        obs_trace.write_chrome_trace(str(path))
+        obj = json.loads(path.read_text())
+        events = validate_chrome_trace(obj)
+        assert {e["name"] for e in events} >= {"a", "marker", "explicit"}
+        explicit = next(e for e in events if e["name"] == "explicit")
+        assert explicit["tid"] == 7
+        assert explicit["dur"] == pytest.approx(1.5e6)  # 1.5 s in us
+
+    def test_concurrent_emit_is_safe(self):
+        obs_trace.enable(capacity=100_000)
+
+        def emit(tid):
+            for i in range(500):
+                obs_trace.instant(f"t{tid}-{i}")
+
+        threads = [threading.Thread(target=emit, args=(t,)) for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # metadata event from enable() + all 2000 instants
+        assert len(obs_trace.TRACER) == 2001
+
+
+# ------------------------------------------------------- decision traces
+
+
+def fresh_algo():
+    algo = HivedAlgorithm(load_config(FIXTURE))
+    nodes = set_healthy_nodes(algo)
+    return algo, nodes
+
+
+class TestDecisionTraces:
+    def test_disabled_records_nothing(self):
+        algo, nodes = fresh_algo()
+        pod = make_pod("p", {"virtualCluster": "vc2", "priority": 0,
+                             "chipType": "v5e-chip", "chipNumber": 8})
+        algo.schedule(pod, nodes, FILTERING_PHASE)
+        assert obs_decisions.RECORDER.last() == []
+
+    def test_bind_decision_explains_attempts(self):
+        obs_decisions.RECORDER.enable()
+        algo, nodes = fresh_algo()
+        pod = make_pod("p", {"virtualCluster": "vc2", "priority": 0,
+                             "chipType": "v5e-chip", "chipNumber": 8})
+        r = algo.schedule(pod, nodes, FILTERING_PHASE)
+        assert r.pod_bind_info is not None
+        items = obs_decisions.RECORDER.last()
+        assert len(items) == 1
+        d = items[0]
+        assert d["pod"] == "p(default/p)"
+        assert d["vc"] == "vc2" and d["priority"] == 0
+        assert d["phase"] == FILTERING_PHASE
+        assert d["outcome"] == "bind" and d["node"]
+        assert d["elapsedMs"] > 0
+        # the ladder probed at least one chain on the guaranteed path and
+        # the winning attempt is marked placed
+        assert d["attempts"], "no placement attempts recorded"
+        placed = [a for a in d["attempts"] if a["outcome"] == "placed"]
+        assert placed and placed[-1]["path"] in ("guaranteed", "opportunistic")
+        assert any(a["where"].startswith(("chain ", "pinned cell "))
+                   for a in d["attempts"])
+
+    def test_wait_decision_carries_reason(self):
+        obs_decisions.RECORDER.enable()
+        algo, nodes = fresh_algo()
+        # vc2 guarantees a single v5e-8: a 16-chip guaranteed gang can't fit
+        pod = make_pod("big", {"virtualCluster": "vc2", "priority": 0,
+                               "chipType": "v5e-chip", "chipNumber": 16})
+        r = algo.schedule(pod, nodes, FILTERING_PHASE)
+        assert r.pod_bind_info is None
+        d = obs_decisions.RECORDER.last()[0]
+        assert d["outcome"] == "wait"
+        failed = [a for a in d["attempts"] if a["outcome"] == "failed"]
+        assert failed and all(a["reason"] for a in failed)
+
+    def test_existing_group_attempt_recorded(self):
+        obs_decisions.RECORDER.enable()
+        algo, nodes = fresh_algo()
+        spec = {"virtualCluster": "vc2", "priority": 1, "chipType": "v5p-chip",
+                "chipNumber": 4,
+                "affinityGroup": {"name": "g",
+                                  "members": [{"podNumber": 2,
+                                               "chipNumber": 4}]}}
+        p0 = make_pod("g-0", spec)
+        r0 = algo.schedule(p0, nodes, FILTERING_PHASE)
+        algo.add_allocated_pod(new_binding_pod(p0, r0.pod_bind_info))
+        p1 = make_pod("g-1", spec)
+        algo.schedule(p1, nodes, FILTERING_PHASE)
+        d = obs_decisions.RECORDER.last()[0]
+        assert d["pod"] == "g-1(default/g-1)"
+        assert any(a["path"] == "existing-allocated" and a["outcome"] == "placed"
+                   for a in d["attempts"])
+
+    def test_error_decision_committed(self):
+        obs_decisions.RECORDER.enable()
+        algo, nodes = fresh_algo()
+        pod = make_pod("bad", {"virtualCluster": "no-such-vc", "priority": 0,
+                               "chipType": "v5e-chip", "chipNumber": 8})
+        with pytest.raises(Exception):
+            algo.schedule(pod, nodes, FILTERING_PHASE)
+        d = obs_decisions.RECORDER.last()[0]
+        assert d["outcome"] == "error" and "no-such-vc" in d["reason"]
+
+    def test_ring_bound_and_most_recent_first(self):
+        rec = obs_decisions.DecisionRecorder(capacity=3)
+        rec.enable()
+        for i in range(5):
+            d = rec.begin(f"default/p{i}", FILTERING_PHASE)
+            d.finish("wait", reason="r")
+            rec.commit(d)
+        items = rec.last()
+        assert [i["pod"] for i in items] == ["default/p4", "default/p3",
+                                             "default/p2"]
+        assert [i["pod"] for i in rec.last(1)] == ["default/p4"]
+
+    def test_explain_line_and_commit_callback(self):
+        obs_decisions.RECORDER.enable()
+        seen = []
+        obs_decisions.RECORDER.on_commit = lambda d: seen.append(d.explain())
+        algo, nodes = fresh_algo()
+        pod = make_pod("p", {"virtualCluster": "vc2", "priority": 0,
+                             "chipType": "v5e-chip", "chipNumber": 8})
+        algo.schedule(pod, nodes, FILTERING_PHASE)
+        assert len(seen) == 1
+        line = seen[0]
+        assert "default/p" in line and "-> bind" in line and "vc=vc2" in line
+
+    def test_decisions_mirror_into_trace_timeline(self):
+        obs_trace.enable()
+        obs_decisions.RECORDER.enable()
+        algo, nodes = fresh_algo()
+        pod = make_pod("p", {"virtualCluster": "vc2", "priority": 0,
+                             "chipType": "v5e-chip", "chipNumber": 8})
+        algo.schedule(pod, nodes, FILTERING_PHASE)
+        names = [e["name"] for e in obs_trace.TRACER.snapshot()]
+        assert "schedule p(default/p)" in names
+
+
+# ------------------------------------------------- webserver integration
+
+
+@pytest.fixture
+def stack():
+    from hivedscheduler_tpu.k8s.fake import FakeKubeClient
+    from hivedscheduler_tpu.k8s.types import Node
+    from hivedscheduler_tpu.runtime.scheduler import HivedScheduler
+    from hivedscheduler_tpu.webserver import WebServer
+
+    config = load_config(FIXTURE)
+    config.web_server_address = "127.0.0.1:0"
+    kube = FakeKubeClient()
+    scheduler = HivedScheduler(config, kube)
+    algo = scheduler.scheduler_algorithm
+    for n in sorted({n for ccl in algo.full_cell_list.values()
+                     for c in ccl[max(ccl)] for n in c.nodes}):
+        kube.create_node(Node(name=n))
+    scheduler.start()
+    server = WebServer(scheduler)
+    host, port = server.async_run()
+    yield kube, scheduler, f"http://{host}:{port}"
+    server.stop()
+
+
+def get(base, path):
+    import urllib.request
+
+    with urllib.request.urlopen(base + path) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestTracesEndpoint:
+    def _schedule_some(self, kube, scheduler, n=3):
+        from hivedscheduler_tpu.k8s import serde
+        from hivedscheduler_tpu.runtime import extender as ei
+
+        nodes = sorted(nd.name for nd in kube.list_nodes())
+        for i in range(n):
+            pod = make_pod(f"t{i}", {"virtualCluster": "vc2", "priority": 0,
+                                     "chipType": "v5e-chip", "chipNumber": 8})
+            kube.create_pod(pod)
+            scheduler.filter_routine(ei.ExtenderArgs(
+                pod=kube.get_pod(pod.namespace, pod.name), node_names=nodes))
+
+    def test_traces_endpoint_serves_last_decisions(self, stack):
+        kube, scheduler, base = stack
+        obs_decisions.RECORDER.enable()
+        self._schedule_some(kube, scheduler)
+        status, body = get(base, C.TRACES_PATH)
+        assert status == 200 and body["enabled"]
+        assert len(body["items"]) == 3
+        # most recent first, each with per-attempt outcome explanations
+        assert body["items"][0]["pod"] == "t2(default/t2)"
+        for item in body["items"]:
+            assert item["outcome"] in ("bind", "wait")
+            assert all({"where", "path", "outcome", "reason"} <= set(a)
+                       for a in item["attempts"])
+        status, body = get(base, C.TRACES_PATH + "?n=1")
+        assert status == 200 and len(body["items"]) == 1
+        assert body["items"][0]["pod"] == "t2(default/t2)"
+
+    def test_chrome_endpoint_is_valid_trace(self, stack):
+        kube, scheduler, base = stack
+        obs_trace.enable()
+        obs_decisions.RECORDER.enable()
+        self._schedule_some(kube, scheduler)
+        status, body = get(base, C.TRACES_CHROME_PATH)
+        assert status == 200
+        events = validate_chrome_trace(body)
+        names = {e["name"] for e in events}
+        assert "filter_routine" in names  # extender span
+        assert any(n.startswith("schedule ") for n in names)  # decisions
+
+    def test_traces_listed_in_index(self, stack):
+        _, _, base = stack
+        status, body = get(base, "/v1")
+        assert status == 200
+        assert C.TRACES_PATH in body["paths"]
+        assert C.TRACES_CHROME_PATH in body["paths"]
+
+
+class TestDemoCliTraceFlags:
+    def test_cli_explain_and_trace_file(self, tmp_path, monkeypatch, capsys):
+        """--fake-cluster --explain --trace-file: the demo run produces a
+        Perfetto-loadable trace JSON on shutdown (acceptance criterion)."""
+        import threading as _threading
+
+        from hivedscheduler_tpu import cli
+        from hivedscheduler_tpu.common import utils as common
+
+        trace_file = tmp_path / "demo.trace.json"
+        # ephemeral port: the fixture defaults to :30096, which a test must
+        # not squat on
+        cfg_path = tmp_path / "cfg.yaml"
+        cfg_path.write_text(open(FIXTURE).read()
+                            + '\nwebServerAddress: "127.0.0.1:0"\n')
+        # release the CLI's stop.wait() immediately after startup
+        stop = _threading.Event()
+
+        def fake_stop_event():
+            _threading.Timer(0.3, stop.set).start()
+            return stop
+
+        monkeypatch.setattr(common, "new_stop_event", fake_stop_event)
+        rc = cli.main(["--config", str(cfg_path), "--fake-cluster",
+                       "--explain", "--trace-file", str(trace_file)])
+        assert rc == 0
+        obj = json.loads(trace_file.read_text())
+        validate_chrome_trace(obj)
+        assert obs_decisions.RECORDER.enabled  # --fake-cluster run enables

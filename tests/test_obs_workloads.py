@@ -1,0 +1,94 @@
+"""Workload-side observability: serving request spans + per-priority
+histograms (serve --metrics-dump) and the train step timeline JSONL
+(train --timeline). The serve run's trace JSON must be Perfetto-loadable
+(acceptance criterion; schema checked by helpers.validate_chrome_trace)."""
+
+import json
+
+import pytest
+
+pytest.importorskip("jax")
+
+from helpers import validate_chrome_trace
+
+MODEL = ["--d-model", "32", "--n-heads", "4", "--n-layers", "2",
+         "--d-ff", "64", "--vocab-size", "64"]
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation():
+    from hivedscheduler_tpu.obs import trace as obs_trace
+
+    obs_trace.disable()
+    obs_trace.TRACER.clear()
+    yield
+    obs_trace.disable()
+    obs_trace.TRACER.clear()
+
+
+def test_serve_metrics_dump_writes_exposition_and_trace(tmp_path, capsys):
+    from hivedscheduler_tpu import serve
+
+    dump = tmp_path / "metrics.txt"
+    rc = serve.main(MODEL + [
+        "--requests", "4", "--max-batch", "2", "--max-len", "64",
+        "--max-new-tokens", "4", "--high-priority-every", "2",
+        "--metrics-dump", str(dump),
+    ])
+    assert rc == 0
+    text = dump.read_text()
+    # per-priority-class serving histograms made it into the registry
+    assert '# TYPE tpu_hive_serve_ttft_seconds histogram' in text
+    assert 'tpu_hive_serve_ttft_seconds_bucket{priority="0",le=' in text
+    assert 'tpu_hive_serve_ttft_seconds_bucket{priority="10",le=' in text
+    assert 'tpu_hive_serve_queue_wait_seconds_count{priority="0"}' in text
+    assert 'tpu_hive_serve_requests_total{priority="0"}' in text
+    # the trace JSON is a valid Chrome trace with request lifecycle spans
+    obj = json.loads((tmp_path / "metrics.txt.trace.json").read_text())
+    events = validate_chrome_trace(obj)
+    names = [e["name"] for e in events]
+    assert names.count("request/decode") == 4  # one lane per request
+    assert "request/queued" in names and "request/prefill" in names
+    decode = next(e for e in events if e["name"] == "request/decode")
+    assert {"rid", "priority", "prompt_tokens", "new_tokens"} <= set(
+        decode["args"])
+
+
+def test_request_lifecycle_timestamps_populated():
+    """Engine-level: a drained request carries the full queued -> admitted
+    -> first-token -> done timestamp chain, in order."""
+    import jax
+
+    from hivedscheduler_tpu.models import serving, transformer as tm
+
+    cfg = tm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                               n_layers=2, d_ff=64, max_seq_len=64)
+    params = tm.cast_params(tm.init_params(cfg, jax.random.PRNGKey(0)),
+                            cfg.dtype)
+    eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64)
+    reqs = [eng.submit([1, 2, 3], 3, priority=p) for p in (0, 5)]
+    eng.run_until_drained()
+    for r in reqs:
+        assert r.done and r.done_at is not None
+        assert r.submitted_at <= r.admitted_at <= r.first_token_at <= r.done_at
+        assert r.queue_wait_s is not None and r.queue_wait_s >= 0
+        assert r.tpot_s is not None and r.tpot_s >= 0
+
+
+def test_train_timeline_jsonl(tmp_path):
+    from hivedscheduler_tpu import train
+
+    timeline = tmp_path / "steps.jsonl"
+    rc = train.main(MODEL + [
+        "--steps", "3", "--batch", "4", "--seq-len", "32", "--tp", "2",
+        "--log-every", "100", "--timeline", str(timeline),
+    ])
+    assert rc in (0, None)
+    lines = [json.loads(l) for l in timeline.read_text().splitlines()]
+    assert [l["step"] for l in lines] == [1, 2, 3]
+    for l in lines:
+        assert l["wall_s"] > 0
+        assert l["tokens_per_sec"] > 0
+        assert isinstance(l["loss"], float)
+    # only the first step of the incarnation compiles
+    assert [l["compile"] for l in lines] == [True, False, False]

@@ -1,0 +1,104 @@
+#!/usr/bin/env python3
+"""Static metric-name lint: every metric emitted anywhere in the package
+must carry a ``describe()`` help entry, and every described name must be
+emitted somewhere.
+
+Run directly (``python tools/check_metrics.py``; exit 1 on violations) or
+through its guard test (``tests/test_check_metrics.py``). The check is
+AST-based: it finds ``<anything>.inc("name", ...)`` / ``.observe`` /
+``.set_gauge`` calls whose first argument is a string literal, so renaming
+a metric at an emit site without updating the catalogue (or vice versa)
+fails CI instead of silently shipping an undocumented or dead series.
+
+Emit sites with a NON-literal first argument are reported too: a computed
+metric name can't be checked against the catalogue (and can't be grepped
+by operators), so the package style forbids it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Set, Tuple
+
+_EMIT_METHODS = {"inc", "observe", "set_gauge"}
+
+
+def _package_root() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(here), "hivedscheduler_tpu")
+
+
+def collect(package_root: str) -> Tuple[Dict[str, List[str]], Set[str], List[str]]:
+    """Returns (emitted name -> [file:line sites], described names,
+    non-literal emit sites)."""
+    emitted: Dict[str, List[str]] = {}
+    described: Set[str] = set()
+    dynamic: List[str] = []
+    for dirpath, _, files in os.walk(package_root):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, os.path.dirname(package_root))
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                if func.attr == "describe" and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                        described.add(arg.value)
+                    continue
+                if func.attr not in _EMIT_METHODS or not node.args:
+                    continue
+                arg = node.args[0]
+                site = f"{rel}:{node.lineno}"
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    # only our namespace: .observe()/.inc() on unrelated
+                    # objects (e.g. test doubles) shouldn't trip the lint
+                    if arg.value.startswith("tpu_hive_"):
+                        emitted.setdefault(arg.value, []).append(site)
+                elif func.attr in ("inc", "set_gauge") or _looks_like_registry(func):
+                    dynamic.append(f"{site}: {func.attr}() with non-literal name")
+    return emitted, described, dynamic
+
+
+def _looks_like_registry(func: ast.Attribute) -> bool:
+    """``REGISTRY.observe`` / ``metrics.observe`` — ignore observe() on
+    other receivers (it is a common method name)."""
+    base = func.value
+    return isinstance(base, ast.Name) and base.id.lower() in (
+        "registry", "metrics", "_metrics",
+    )
+
+
+def main() -> int:
+    emitted, described, dynamic = collect(_package_root())
+    ok = True
+    undescribed = sorted(set(emitted) - described)
+    unused = sorted(described - set(emitted))
+    for name in undescribed:
+        ok = False
+        sites = ", ".join(emitted[name])
+        print(f"UNDESCRIBED metric {name!r} emitted at {sites} has no "
+              f"REGISTRY.describe() help entry")
+    for name in unused:
+        ok = False
+        print(f"UNUSED metric {name!r} is described but never emitted")
+    for site in dynamic:
+        ok = False
+        print(f"DYNAMIC metric name at {site} — use a string literal")
+    if ok:
+        print(f"check_metrics: OK ({len(emitted)} emitted names, "
+              f"{len(described)} described)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
