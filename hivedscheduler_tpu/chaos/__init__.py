@@ -1,0 +1,46 @@
+"""Chaos engineering for the scheduler stack.
+
+The reference claims fault tolerance (bad-hardware awareness, work-preserving
+reconfiguration, crash recovery from pod annotations — README.md:42) but only
+exercises it through hand-written unit cases. This package *attacks* those
+paths systematically:
+
+- ``chaos.injector``: a deterministic, seeded fault injector wrapping any
+  ``KubeClient`` — dropped/delayed/reordered watch events, transient HTTP
+  429/500/timeout errors on reads and binds (including the ambiguous
+  failed-after-commit case), while keeping the list path (the recovery
+  barrier) reliable, as in real list+watch.
+- ``chaos.invariants``: a reusable checker re-deriving the algorithm's
+  structural guarantees from scratch — VC safety, gang atomicity, used-count
+  books, no leaked or doubly-allocated cells — plus chip-granular placement
+  preservation across restart (the ``test_recovery_scale.py`` contract).
+- ``chaos.harness``: a seeded soak driver running full schedule/bind cycles
+  through the runtime over a fake ApiServer while injecting node
+  NotReady flaps, mid-gang pod deletions, and scheduler crash-restarts
+  (fresh ``HivedScheduler`` replaying recovery from pod annotations),
+  checking invariants after every schedule.
+
+The fault model — which faults are tolerated at which layer — is catalogued
+in ``doc/design/fault-model.md``. Seeds that ever found a violation are
+pinned forever in ``tools/check_chaos_seeds.py``.
+"""
+
+from hivedscheduler_tpu.chaos.injector import ChaosKubeClient, FaultPlan, InjectedApiError
+from hivedscheduler_tpu.chaos.invariants import (
+    InvariantViolation,
+    check_all,
+    check_placement_preserved,
+    placement_snapshot,
+)
+from hivedscheduler_tpu.chaos.harness import ChaosHarness
+
+__all__ = [
+    "ChaosHarness",
+    "ChaosKubeClient",
+    "FaultPlan",
+    "InjectedApiError",
+    "InvariantViolation",
+    "check_all",
+    "check_placement_preserved",
+    "placement_snapshot",
+]

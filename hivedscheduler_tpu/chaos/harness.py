@@ -1,0 +1,434 @@
+"""Seeded chaos soak: full schedule/bind cycles through the runtime under
+injected faults, with invariants checked after every schedule.
+
+The harness plays the kube-scheduler's role against a real
+``HivedScheduler`` wired to a :class:`~hivedscheduler_tpu.chaos.injector.
+ChaosKubeClient` over the in-memory fake ApiServer:
+
+- **schedule gang**: create the member pods, drive ``filter_routine`` (and
+  ``preempt_routine`` when the filter nominates victims — the harness then
+  kills the victim gangs, as the kube-scheduler's preemption would) and
+  commit with ``bind_routine``. Transient injected errors are retried the
+  way the real control loop retries (each kube-scheduler cycle re-enters
+  filter); a gang that cannot place is rolled back whole — gang semantics.
+- **node flap**: NotReady <-> healthy through the informer (exercises
+  ``_set_bad_cell`` / doomed-bad binding / ``_set_healthy_cell``).
+- **kill pod mid-gang**: delete one member, then — as a gang framework
+  would — the rest of the gang.
+- **crash-restart**: detach the dead scheduler's informers, build a fresh
+  ``HivedScheduler`` over the same cluster state and replay recovery from
+  pod annotations; every previously-bound gang must come back with its
+  exact chip-granular placement (the ``test_recovery_scale.py`` contract).
+
+After every completed schedule the harness runs the internal-consistency
+invariants (VC safety, books, ownership); at quiescent points (held events
+flushed) it additionally checks gang atomicity against its own registry of
+complete gangs. Violations are *collected*, not raised, so one soak reports
+everything a seed finds; ``tools/check_chaos_seeds.py`` replays pinned seeds
+as a permanent regression suite.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from typing import Dict, List, Optional
+
+from hivedscheduler_tpu.api import constants as api_constants
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.api.config import Config, new_config
+from hivedscheduler_tpu.api.types import (
+    CellTypeSpec,
+    MeshLevelSpec,
+    MeshSpec,
+    PhysicalCellSpec,
+    PhysicalClusterSpec,
+    VirtualCellSpec,
+    VirtualClusterSpec,
+)
+from hivedscheduler_tpu.chaos import invariants
+from hivedscheduler_tpu.chaos.injector import (
+    ChaosKubeClient,
+    FaultPlan,
+    InjectedApiError,
+)
+from hivedscheduler_tpu.common.utils import to_json
+from hivedscheduler_tpu.k8s.fake import FakeKubeClient
+from hivedscheduler_tpu.k8s.types import Container, Node, NodeCondition, Pod
+from hivedscheduler_tpu.runtime import extender as ei
+from hivedscheduler_tpu.runtime.scheduler import HivedScheduler
+
+log = logging.getLogger(__name__)
+
+
+def default_config() -> Config:
+    """A compact two-v5p-chain (multi-chain relaxation reachable) + generic
+    v4 pool cluster with three VCs — the chaos analogue of the fuzz
+    harness's cluster, sized for tier-1 soak speed."""
+    mesh_a = MeshSpec(
+        topology=(4, 4, 2), chip_type="v5p-chip", host_shape=(2, 2, 1),
+        levels=[
+            MeshLevelSpec(name="cA-2x2x1", shape=(2, 2, 1)),
+            MeshLevelSpec(name="cA-2x2x2", shape=(2, 2, 2)),
+            MeshLevelSpec(name="cA-4x2x2", shape=(4, 2, 2)),
+            MeshLevelSpec(name="cA-4x4x2", shape=(4, 4, 2)),
+        ],
+    )
+    mesh_b = MeshSpec(
+        topology=(2, 2, 2), chip_type="v5p-chip", host_shape=(2, 2, 1),
+        levels=[
+            MeshLevelSpec(name="cB-2x2x1", shape=(2, 2, 1)),
+        ],
+    )
+    generic = CellTypeSpec(
+        child_cell_type="v4-node", child_cell_number=4, is_node_level=False,
+    )
+    v4_node = CellTypeSpec(
+        child_cell_type="v4-chip", child_cell_number=4, is_node_level=True,
+    )
+    return new_config(Config(
+        physical_cluster=PhysicalClusterSpec(
+            cell_types={
+                "chainA": CellTypeSpec(mesh=mesh_a),
+                "chainB": CellTypeSpec(mesh=mesh_b),
+                "v4-pool": generic,
+                "v4-node": v4_node,
+            },
+            physical_cells=[
+                PhysicalCellSpec(cell_type="chainA", cell_address="podA"),
+                PhysicalCellSpec(cell_type="chainB", cell_address="podB"),
+                PhysicalCellSpec(cell_type="v4-pool", cell_address="pool0"),
+            ],
+        ),
+        virtual_clusters={
+            "vc-a": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=1, cell_type="chainA.cA-4x2x2"),
+                VirtualCellSpec(cell_number=2, cell_type="chainB.cB-2x2x1"),
+                VirtualCellSpec(cell_number=2, cell_type="v4-pool.v4-node"),
+            ]),
+            "vc-b": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=1, cell_type="chainA.cA-2x2x2"),
+            ]),
+            "vc-c": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=2, cell_type="chainA.cA-2x2x1"),
+                VirtualCellSpec(cell_number=1, cell_type="v4-pool.v4-node"),
+            ]),
+        },
+    ))
+
+
+def _make_pod(name: str, spec: dict) -> Pod:
+    return Pod(
+        name=name,
+        uid=name,
+        annotations={
+            api_constants.ANNOTATION_POD_SCHEDULING_SPEC: to_json(spec)
+        },
+        containers=[Container(resource_limits={
+            api_constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1
+        })],
+    )
+
+
+_NOT_READY = [NodeCondition(type="Ready", status="False")]
+
+# gang shapes: (pods, chips per pod); (6, 4) = 24 chips exceeds vc-a's
+# per-chain v5p quota (16 on chainA + 8 on chainB) so a guaranteed vc-a
+# draw exercises multi-chain relaxation
+_GANG_SHAPES = [(1, 1), (1, 2), (1, 4), (2, 4), (4, 4), (2, 8), (6, 4)]
+
+
+class ChaosHarness:
+    """One seeded soak run; see the module docstring. ``run(n)`` executes
+    ``n`` schedule attempts interleaved with flaps/kills/restarts and
+    returns a report dict (``violations`` empty on a clean run)."""
+
+    def __init__(
+        self,
+        seed: int,
+        plan: Optional[FaultPlan] = None,
+        config_factory=default_config,
+        restart_every: int = 8,
+    ):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.config_factory = config_factory
+        self.fake = FakeKubeClient()
+        self.chaos = ChaosKubeClient(self.fake, seed=seed, plan=plan)
+        self.scheduler = HivedScheduler(config_factory(), self.chaos)
+        self.nodes = sorted({
+            n for ccl in self.algo.full_cell_list.values()
+            for c in ccl[max(ccl)] for n in c.nodes
+        })
+        for n in self.nodes:
+            self.fake.create_node(Node(name=n))
+        self.scheduler.start()
+        self.bad_nodes: set = set()
+        self.groups: Dict[str, List[Pod]] = {}  # complete gangs: bound pods
+        self.violations: List[str] = []
+        self.restart_every = max(1, restart_every)
+        self.restarts = 0
+        self.schedules_done = 0
+        self.gangs_completed = 0
+        self.gid = 0
+
+    @property
+    def algo(self):
+        return self.scheduler.scheduler_algorithm
+
+    # ------------------------------------------------------------------
+    # invariant checking
+    # ------------------------------------------------------------------
+
+    def _check(self, ctx: str, quiesce: bool = False) -> None:
+        """Internal-consistency invariants always; gang atomicity against
+        the harness's registry only at quiescent points (held watch events
+        flushed, nothing mid-flight)."""
+        if quiesce:
+            self.chaos.flush_held()
+        full = set(self.groups) if quiesce else None
+        try:
+            with self.scheduler.scheduler_lock:
+                invariants.check_all(
+                    self.algo, f"seed {self.seed} {ctx}", full_groups=full
+                )
+        except invariants.InvariantViolation as e:
+            self.violations.append(str(e))
+
+    # ------------------------------------------------------------------
+    # schedule / bind driving (the kube-scheduler's role)
+    # ------------------------------------------------------------------
+
+    def _heal_missing_pod(self) -> None:
+        """A dropped ADDED means the scheduler never heard of the pod; the
+        real ladder heals through relist — replay the store as a sync."""
+        self.chaos.flush_held()
+        self.chaos.sync()
+
+    def _bind(self, pod_name: str, node: str) -> bool:
+        """Commit one member bind, absorbing injected transients and the
+        already-bound rejection (a concurrent force-bind won the race)."""
+        for _ in range(8):
+            try:
+                self.scheduler.bind_routine(ei.ExtenderBindingArgs(
+                    pod_name=pod_name, pod_namespace="default",
+                    pod_uid=pod_name, node=node,
+                ))
+                return True
+            except api.WebServerError as e:
+                stored = self.fake.get_pod("default", pod_name)
+                if stored is not None and stored.node_name == node:
+                    return True  # bound through another path
+                if 400 <= e.code < 500:
+                    return False
+            except InjectedApiError:
+                pass
+        stored = self.fake.get_pod("default", pod_name)
+        return stored is not None and stored.node_name == node
+
+    def op_schedule_gang(self) -> None:
+        rng = self.rng
+        vc = rng.choice(["vc-a", "vc-b", "vc-c"])
+        prio = rng.choice([-1, -1, 0, 1, 5, 10])
+        pods, chips = rng.choice(_GANG_SHAPES)
+        name = f"g{self.gid}"
+        self.gid += 1
+        spec = {
+            "virtualCluster": vc, "priority": prio,
+            "leafCellType": rng.choice(["v5p-chip", "v5p-chip", "v4-chip"]),
+            "leafCellNumber": chips,
+            "multiChainRelaxPolicy": rng.choice(["fewest", "balanced"]),
+            "affinityGroup": {
+                "name": name,
+                "members": [{"podNumber": pods, "leafCellNumber": chips}],
+            },
+        }
+        created: List[str] = []
+        bound: List[Pod] = []
+        ok = True
+        for i in range(pods):
+            pod_name = f"{name}-{i}"
+            self.fake.create_pod(_make_pod(pod_name, spec))
+            created.append(pod_name)
+            node = self._filter_member(pod_name, spec)
+            if node is None or not self._bind(pod_name, node):
+                ok = False
+                break
+            stored = self.fake.get_pod("default", pod_name)
+            if stored is None or not stored.node_name:
+                ok = False
+                break
+            bound.append(stored)
+        if ok:
+            self.groups[name] = bound
+            self.gangs_completed += 1
+        else:
+            self._rollback(created)
+        self.schedules_done += 1
+        self._check(f"after schedule #{self.schedules_done} ({name})")
+
+    def _filter_member(self, pod_name: str, spec: dict) -> Optional[str]:
+        """Drive filter (+ preempt) for one member until it lands on a node
+        or is judged unplaceable. Returns the placement node or None."""
+        for attempt in range(24):
+            pod = self.fake.get_pod("default", pod_name)
+            if pod is None:
+                return None
+            try:
+                result = self.scheduler.filter_routine(ei.ExtenderArgs(
+                    pod=pod, node_names=list(self.nodes)))
+            except api.WebServerError as e:
+                if 400 <= e.code < 500:
+                    stored = self.fake.get_pod("default", pod_name)
+                    if stored is not None and stored.node_name:
+                        # a racing force-bind already committed the member
+                        return stored.node_name
+                    # else most commonly "Pod does not exist...": the ADDED
+                    # was dropped or still held — heal and retry once more
+                    self._heal_missing_pod()
+                    continue
+                raise
+            except InjectedApiError:
+                continue  # transient; the control loop just re-enters
+            if result.node_names:
+                return result.node_names[0]
+            if result.failed_nodes and any(
+                k != api_constants.COMPONENT_NAME
+                for k in result.failed_nodes
+            ):
+                # preemption may help: run the preempt phase, kill victims
+                if not self._preempt_member(pod_name):
+                    return None
+                continue
+            return None  # waiting: gang can't place now
+        return None
+
+    def _preempt_member(self, pod_name: str) -> bool:
+        pod = self.fake.get_pod("default", pod_name)
+        if pod is None:
+            return False
+        try:
+            result = self.scheduler.preempt_routine(ei.ExtenderPreemptionArgs(
+                pod=pod,
+                node_name_to_meta_victims={n: [] for n in self.nodes},
+            ))
+        except (api.WebServerError, InjectedApiError):
+            return False
+        victims = {
+            uid for uids in result.node_name_to_meta_victims.values()
+            for uid in uids
+        }
+        if not victims:
+            return True  # free resource appeared; filter will place
+        for gname, gpods in list(self.groups.items()):
+            if any(bp.uid in victims for bp in gpods):
+                self._delete_gang(gname)
+        return True
+
+    def _rollback(self, pod_names: List[str]) -> None:
+        """Gang semantics: a member that cannot place takes the whole gang
+        down (and a possible half-scheduled group with it)."""
+        for pn in pod_names:
+            self.fake.delete_pod("default", pn)
+        self.chaos.flush_held()
+
+    def _delete_gang(self, name: str) -> None:
+        for bp in self.groups.pop(name, []):
+            self.fake.delete_pod(bp.namespace, bp.name)
+
+    # ------------------------------------------------------------------
+    # fault operations
+    # ------------------------------------------------------------------
+
+    def op_delete_gang(self) -> None:
+        if not self.groups:
+            return
+        self._delete_gang(self.rng.choice(sorted(self.groups)))
+
+    def op_flip_node(self) -> None:
+        """NotReady <-> healthy through the informer — bad-cell flap."""
+        n = self.rng.choice(self.nodes)
+        if n in self.bad_nodes:
+            self.bad_nodes.discard(n)
+            self.fake.update_node(Node(name=n))
+        else:
+            self.bad_nodes.add(n)
+            self.fake.update_node(Node(name=n, conditions=list(_NOT_READY)))
+
+    def op_kill_pod_mid_gang(self) -> None:
+        """Delete one member of a bound gang, then (as the gang framework
+        would) tear down the rest — never leaves a partial gang behind."""
+        if not self.groups:
+            return
+        name = self.rng.choice(sorted(self.groups))
+        pods = self.groups[name]
+        victim = self.rng.choice(pods)
+        self.fake.delete_pod(victim.namespace, victim.name)
+        self._delete_gang(name)
+
+    def heal_all(self) -> None:
+        for n in sorted(self.bad_nodes):
+            self.fake.update_node(Node(name=n))
+        self.bad_nodes.clear()
+        self.chaos.flush_held()
+
+    # ------------------------------------------------------------------
+    # crash-restart (recovery from pod annotations)
+    # ------------------------------------------------------------------
+
+    def crash_restart(self, quiesced: bool = True) -> None:
+        """Tear the scheduler down and replay recovery: a fresh
+        ``HivedScheduler`` over the same cluster state must rebuild every
+        bound gang at identical chip-granular placement. Pass
+        ``quiesced=False`` when crashing deliberately mid-gang (members
+        still unbound): internal invariants are still enforced, but the
+        complete-gang registry comparison is skipped — the half-bound gang
+        is legitimately present with open slots."""
+        self.chaos.flush_held()
+        with self.scheduler.scheduler_lock:
+            known = [n for n in self.groups if n in self.algo.affinity_groups]
+            before = invariants.placement_snapshot(self.algo, known)
+        self.chaos.detach_handlers()
+        self.scheduler = HivedScheduler(self.config_factory(), self.chaos)
+        self.scheduler.start()
+        self.restarts += 1
+        with self.scheduler.scheduler_lock:
+            after = invariants.placement_snapshot(
+                self.algo,
+                [n for n in known if n in self.algo.affinity_groups],
+            )
+        try:
+            invariants.check_placement_preserved(
+                before, after, f"seed {self.seed} restart #{self.restarts}"
+            )
+        except invariants.InvariantViolation as e:
+            self.violations.append(str(e))
+        self._check(f"after restart #{self.restarts}", quiesce=quiesced)
+
+    # ------------------------------------------------------------------
+    # the soak loop
+    # ------------------------------------------------------------------
+
+    def run(self, n_schedules: int) -> dict:
+        ops = (
+            [self.op_schedule_gang] * 5
+            + [self.op_delete_gang] * 2
+            + [self.op_flip_node] * 2
+            + [self.op_kill_pod_mid_gang] * 1
+        )
+        last_restart_at = 0
+        while self.schedules_done < n_schedules:
+            self.rng.choice(ops)()
+            if self.schedules_done - last_restart_at >= self.restart_every:
+                last_restart_at = self.schedules_done
+                self.crash_restart()
+        self._check("final quiesce", quiesce=True)
+        return {
+            "seed": self.seed,
+            "schedules": self.schedules_done,
+            "gangs_completed": self.gangs_completed,
+            "gangs_live": len(self.groups),
+            "restarts": self.restarts,
+            "injector": dict(self.chaos.stats),
+            "violations": list(self.violations),
+        }

@@ -1,0 +1,236 @@
+"""Deterministic, seeded fault injection around any ``KubeClient``.
+
+``ChaosKubeClient`` wraps an inner client (the in-memory fake, the REST
+client, anything implementing the interface) and perturbs the two surfaces a
+real ApiServer perturbs:
+
+- **The informer stream**: watch events may be *delayed* (held back and
+  delivered later), *reordered across objects*, or *dropped*. The
+  perturbations respect the informer contract consumers are entitled to:
+  events for ONE object are never delivered out of order (k8s reflectors
+  order per object; only cross-object interleaving is unspecified), a
+  DELETED is never dropped outright — a missed delete is synthesized by the
+  next relist in a real informer, so "eventually delivered" is the honest
+  model — and the ``sync()`` list path is always faithful (the list is
+  reliable; only the watch stream is lossy). Dropping an ADDED/MODIFIED is
+  legal anywhere: informers legitimately skip intermediate states. Callers
+  quiesce with :meth:`flush_held`.
+- **Request/response**: reads (``get_node``/``list_nodes``/``get_pod``/
+  ``list_pods``) and the bind write raise :class:`InjectedApiError`
+  (transient 429/500/timeout class) with a seeded probability, bounded by
+  ``max_consecutive_errors`` so no operation is starved forever. Binds
+  additionally inject the *ambiguous* failure: the inner bind commits and
+  the error surfaces afterwards — exactly the case the runtime's idempotent
+  bind retry must absorb.
+
+Everything is driven by one ``random.Random(seed)``: the same seed over the
+same call sequence injects the same faults, which is what makes
+``tools/check_chaos_seeds.py`` a replayable regression suite.
+
+Scheduler crash-restart (tearing down a ``HivedScheduler`` and replaying
+recovery from pod annotations) is orchestrated by ``chaos.harness`` — the
+client supports it via :meth:`detach_handlers`, which disconnects the dead
+scheduler's informer callbacks so a fresh instance can register cleanly over
+the same cluster state.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Tuple
+
+from hivedscheduler_tpu.k8s.client import KubeClient
+from hivedscheduler_tpu.k8s.types import Binding, Node, Pod
+
+
+class InjectedApiError(Exception):
+    """A chaos-injected transient ApiServer failure (429/500/timeout)."""
+
+    def __init__(self, code, op: str):
+        super().__init__(f"injected {code} on {op}")
+        self.code = code
+        self.op = op
+
+
+@dataclass
+class FaultPlan:
+    """Knobs for one chaos run (all probabilities in [0, 1])."""
+
+    # informer-stream faults
+    drop_event_p: float = 0.05      # ADDED/MODIFIED only; DELETED is delayed
+    delay_event_p: float = 0.10     # hold the event for later delivery
+    reorder_p: float = 0.25         # chance held events interleave early/late
+    # request/response faults
+    error_p: float = 0.10
+    max_consecutive_errors: int = 2
+    error_codes: Tuple = (429, 500, "timeout")
+    # bind-specific: of the injected bind errors, fraction that fail AFTER
+    # the inner bind committed (the ambiguous case)
+    bind_fail_after_p: float = 0.5
+
+
+class ChaosKubeClient(KubeClient):
+    """Seeded fault-injecting wrapper; see module docstring."""
+
+    def __init__(self, inner: KubeClient, seed: int = 0,
+                 plan: Optional[FaultPlan] = None):
+        self.inner = inner
+        self.plan = plan or FaultPlan()
+        self.rng = random.Random(seed)
+        self._node_handlers: List[tuple] = []
+        self._pod_handlers: List[tuple] = []
+        # held-back events, per object key (insertion-ordered so a full
+        # flush replays oldest-held objects first): key -> deque of
+        # (kind, slot, objs)
+        self._held: "OrderedDict[tuple, Deque[tuple]]" = OrderedDict()
+        self._in_sync = False
+        self._consecutive_errors: Dict[str, int] = {}
+        self.stats = {
+            "dropped": 0, "delayed": 0, "reordered": 0,
+            "errors_injected": 0, "binds_failed_after": 0,
+        }
+        inner.on_node_event(
+            lambda n: self._event("node", 0, (n,)),
+            lambda o, n: self._event("node", 1, (o, n)),
+            lambda n: self._event("node", 2, (n,)),
+        )
+        inner.on_pod_event(
+            lambda p: self._event("pod", 0, (p,)),
+            lambda o, p: self._event("pod", 1, (o, p)),
+            lambda p: self._event("pod", 2, (p,)),
+        )
+
+    # --- informer stream --------------------------------------------------
+    def on_node_event(self, add, update, delete) -> None:
+        self._node_handlers.append((add, update, delete))
+
+    def on_pod_event(self, add, update, delete) -> None:
+        self._pod_handlers.append((add, update, delete))
+
+    def detach_handlers(self) -> None:
+        """Disconnect every registered outer handler (a crashed scheduler's
+        informer callbacks must stop receiving events before the restarted
+        instance registers its own)."""
+        self._node_handlers.clear()
+        self._pod_handlers.clear()
+
+    @staticmethod
+    def _key(kind: str, objs: tuple) -> tuple:
+        obj = objs[-1]  # update events carry (old, new): key by the object
+        return (kind, obj.name if kind == "node" else obj.key)
+
+    def _deliver(self, kind: str, slot: int, objs: tuple) -> None:
+        handlers = self._node_handlers if kind == "node" else self._pod_handlers
+        for triple in list(handlers):
+            triple[slot](*objs)
+
+    def _flush_key(self, key: tuple) -> None:
+        q = self._held.pop(key, None)
+        while q:
+            kind, slot, objs = q.popleft()
+            self._deliver(kind, slot, objs)
+
+    def _event(self, kind: str, slot: int, objs: tuple) -> None:
+        if self._in_sync:
+            # the list path is reliable (real list+watch): recovery-barrier
+            # replays are delivered faithfully
+            self._deliver(kind, slot, objs)
+            return
+        p = self.plan
+        key = self._key(kind, objs)
+        r = self.rng.random()
+        if key in self._held:
+            # per-object ordering: this event cannot jump ahead of the
+            # object's held events — either release them all now (the
+            # stream catches up) or queue behind them
+            if r < p.reorder_p:
+                self.stats["reordered"] += 1
+                self._flush_key(key)
+                self._deliver(kind, slot, objs)
+            else:
+                self._held[key].append((kind, slot, objs))
+            return
+        if r < p.drop_event_p and slot != 2:
+            # a dropped ADDED/MODIFIED is an informer skipping an
+            # intermediate state (healed at the latest by the next resync);
+            # a DELETED would only be synthesized by a relist, so it is
+            # delayed below instead of lost
+            self.stats["dropped"] += 1
+            return
+        if r < p.drop_event_p + p.delay_event_p:
+            self.stats["delayed"] += 1
+            self._held[key] = deque([(kind, slot, objs)])
+            return
+        self._deliver(kind, slot, objs)
+        # cross-object reordering: another object's held (older) events
+        # replay AFTER this (newer) one
+        if self._held and self.rng.random() < p.reorder_p:
+            self.stats["reordered"] += 1
+            self._flush_key(next(iter(self._held)))
+
+    def flush_held(self) -> None:
+        """Deliver every held event (per-object order preserved) — the
+        quiesce point before invariant checks that compare against an
+        external view of the cluster."""
+        while self._held:
+            self._flush_key(next(iter(self._held)))
+
+    # --- request/response faults ------------------------------------------
+    def _maybe_fail(self, op: str) -> None:
+        p = self.plan
+        if p.error_p <= 0.0:
+            return
+        streak = self._consecutive_errors.get(op, 0)
+        if streak < p.max_consecutive_errors and self.rng.random() < p.error_p:
+            self._consecutive_errors[op] = streak + 1
+            self.stats["errors_injected"] += 1
+            raise InjectedApiError(self.rng.choice(p.error_codes), op)
+        self._consecutive_errors[op] = 0
+
+    # --- interface passthrough with faults ---------------------------------
+    def sync(self) -> None:
+        # held (older) events must not be delivered after the (newer) list
+        # replay: release them first, then list faithfully
+        self.flush_held()
+        self._in_sync = True
+        try:
+            self.inner.sync()
+        finally:
+            self._in_sync = False
+
+    def watches_alive(self) -> bool:
+        return self.inner.watches_alive()
+
+    def get_node(self, name: str) -> Optional[Node]:
+        self._maybe_fail("get_node")
+        return self.inner.get_node(name)
+
+    def list_nodes(self) -> List[Node]:
+        self._maybe_fail("list_nodes")
+        return self.inner.list_nodes()
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        self._maybe_fail("get_pod")
+        return self.inner.get_pod(namespace, name)
+
+    def list_pods(self) -> List[Pod]:
+        self._maybe_fail("list_pods")
+        return self.inner.list_pods()
+
+    def bind_pod(self, binding: Binding) -> None:
+        p = self.plan
+        streak = self._consecutive_errors.get("bind_pod", 0)
+        if (p.error_p > 0.0 and streak < p.max_consecutive_errors
+                and self.rng.random() < p.error_p):
+            self._consecutive_errors["bind_pod"] = streak + 1
+            self.stats["errors_injected"] += 1
+            if self.rng.random() < p.bind_fail_after_p:
+                # the ambiguous failure: the bind COMMITTED, the response
+                # was lost — a blind retry must be idempotent
+                self.inner.bind_pod(binding)
+                self.stats["binds_failed_after"] += 1
+            raise InjectedApiError(self.rng.choice(p.error_codes), "bind_pod")
+        self._consecutive_errors["bind_pod"] = 0
+        self.inner.bind_pod(binding)

@@ -1,0 +1,244 @@
+"""Structural invariants of the scheduler algorithm, re-derived from scratch.
+
+One reusable checker shared by the chaos harness (``chaos.harness``), the
+randomized fuzz (``tests/test_invariant_fuzz.py``) and the pinned-seed replay
+tool (``tools/check_chaos_seeds.py``). Every check recomputes its ground truth
+from the cell trees instead of trusting the algorithm's own books, so drift in
+the incremental bookkeeping cannot hide itself:
+
+- **VC safety** (the paper's core guarantee, reference
+  ``hived_algorithm.go:1242-1292``): at every chain/level,
+  ``totalLeftCellNum >= allVCFreeCellNum`` — no tenant can be pushed under
+  quota by other tenants' allocations.
+- **Used-count books**: each cell's ``used_leaf_cell_num_at_priorities``
+  equals a recount of its allocated leaf descendants, on the physical AND
+  every virtual tree.
+- **Priority max-invariant**: ``parent.priority == max(children priorities)``
+  (reference ``cell_allocation.go:425-441``).
+- **Free-list hygiene**: no free cell carries a guaranteed priority (a
+  leaked VC binding).
+- **No leaked or doubly-allocated cells**: the set of physical leaf cells
+  carrying a used priority must exactly tile the union of all affinity-group
+  placements, with no leaf owned by two non-preempting groups (a preemptor in
+  ``Preempting`` state legitimately *reserves* cells a victim still uses).
+- **Gang atomicity**: an ``Allocated`` group's placement is fully decided
+  (no ``None`` slot), and — at quiescent points, where the caller passes the
+  gangs it believes complete — every member pod slot is filled: never a
+  partially-bound affinity group.
+- **Placement preservation**: chip-granular (node -> exact leaf-cell
+  indices) equality across a crash-restart — the same contract as
+  ``tests/test_recovery_scale.py`` (same nodes but different chips counts as
+  lost: ICI contiguity is broken).
+
+All checks raise :class:`InvariantViolation` (an ``AssertionError`` subclass,
+so plain ``assert``-style consumers and pytest treat it naturally).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Set
+
+from hivedscheduler_tpu.algorithm.constants import (
+    FREE_PRIORITY,
+    GROUP_ALLOCATED,
+    GROUP_PREEMPTING,
+    MIN_GUARANTEED_PRIORITY,
+)
+
+
+class InvariantViolation(AssertionError):
+    """A structural guarantee of the scheduler was broken."""
+
+
+def _fail(ctx: str, msg: str) -> None:
+    raise InvariantViolation(f"{ctx}: {msg}" if ctx else msg)
+
+
+def _all_cells(ccl):
+    for level in sorted(ccl):
+        for c in ccl[level]:
+            yield c
+
+
+def _leaf_descendants(c):
+    if not c.children:
+        yield c
+        return
+    for ch in c.children:
+        yield from _leaf_descendants(ch)
+
+
+# ---------------------------------------------------------------------------
+# individual checks
+# ---------------------------------------------------------------------------
+
+def check_vc_safety(algo, ctx: str = "") -> None:
+    """totalLeftCellNum >= allVCFreeCellNum at every chain/level."""
+    for chain, levels in algo.total_left_cell_num.items():
+        for level, left in levels.items():
+            free = algo.all_vc_free_cell_num.get(chain, {}).get(level, 0)
+            if left < free:
+                _fail(ctx, f"VC safety broken: chain {chain} level {level}: "
+                           f"{left} left < {free} free in all VCs")
+
+
+def check_books(algo, ctx: str = "") -> None:
+    """Used-count recount + priority max-invariant on the physical and every
+    virtual tree, plus free-list hygiene."""
+    trees = list(algo.full_cell_list.items()) + [
+        (f"{vcn}/{chain}", ccl)
+        for vcn, sched in algo.vc_schedulers.items()
+        for chain, ccl in sched.non_pinned_full_cell_list.items()
+    ]
+    for label, ccl in trees:
+        for c in _all_cells(ccl):
+            recount: Dict[int, int] = {}
+            for leaf in _leaf_descendants(c):
+                if leaf.priority != FREE_PRIORITY:
+                    recount[leaf.priority] = recount.get(leaf.priority, 0) + 1
+            if dict(c.used_leaf_cell_num_at_priorities) != recount:
+                _fail(ctx, f"used-count books drifted at {label}:{c.address}: "
+                           f"{c.used_leaf_cell_num_at_priorities} != recount "
+                           f"{recount}")
+            if c.children:
+                max_child = max(ch.priority for ch in c.children)
+                if c.priority != max_child:
+                    _fail(ctx, f"priority invariant broken at {label}:"
+                               f"{c.address}: {c.priority} != max(children) "
+                               f"{max_child}")
+    for chain, fl in algo.free_cell_list.items():
+        for level in sorted(fl):
+            for c in fl[level]:
+                if c.priority >= MIN_GUARANTEED_PRIORITY:
+                    _fail(ctx, f"free cell {c.address} carries guaranteed "
+                               f"priority {c.priority}")
+
+
+def check_cell_ownership(algo, ctx: str = "") -> None:
+    """No leaked and no doubly-allocated leaf cells.
+
+    - *Double allocation*: a physical leaf cell placed in two groups that
+      both really hold it (``Allocated``/``BeingPreempted``). A
+      ``Preempting`` group's placement legitimately overlaps its victims'
+      (its cells are Reserving while the victim still runs), so preemptors
+      are excluded from the uniqueness check.
+    - *Leak*: a leaf cell carrying a used (non-FREE) priority that belongs
+      to no group's placement — an allocation whose owner vanished.
+    """
+    owners: Dict[str, List[str]] = {}     # leaf address -> owning group names
+    placed: Set[str] = set()              # union over ALL groups (any state)
+    for g in algo.affinity_groups.values():
+        for podps in g.physical_leaf_cell_placement.values():
+            for podp in podps:
+                for c in podp:
+                    if c is None:
+                        continue
+                    placed.add(c.address)
+                    if g.state != GROUP_PREEMPTING:
+                        owners.setdefault(c.address, [])
+                        if g.name not in owners[c.address]:
+                            owners[c.address].append(g.name)
+    for addr, names in owners.items():
+        if len(names) > 1:
+            _fail(ctx, f"leaf cell {addr} doubly allocated to groups {names}")
+    for chain, ccl in algo.full_cell_list.items():
+        for top in ccl[max(ccl)]:
+            for leaf in _leaf_descendants(top):
+                if leaf.priority != FREE_PRIORITY and leaf.address not in placed:
+                    _fail(ctx, f"leaf cell {leaf.address} (chain {chain}) "
+                               f"carries priority {leaf.priority} but belongs "
+                               f"to no affinity group — leaked allocation")
+
+
+def check_gang_atomicity(
+    algo,
+    ctx: str = "",
+    full_groups: Optional[Iterable[str]] = None,
+    allow_partial_placement: bool = False,
+) -> None:
+    """Never a partially-bound affinity group.
+
+    Structural part: every ``Allocated`` group's physical placement is
+    fully decided — no ``None`` cell slot (the gang's slice was committed
+    atomically at schedule time). ``allow_partial_placement=True`` waives
+    it for *reconfiguration* replays: the tolerance ladder deliberately
+    ignores placements on chains that vanished from the new config (the
+    pods are still absorbed, never lost — see PARITY.md), which leaves
+    legitimate undecided slots.
+
+    Quiescent part (when ``full_groups`` is given — the gang names the
+    caller believes completely bound, with nothing mid-flight): the set of
+    ``Allocated`` groups must equal ``full_groups`` exactly, and each must
+    have every member pod slot filled.
+    """
+    allocated = {
+        g.name: g for g in algo.affinity_groups.values()
+        if g.state == GROUP_ALLOCATED
+    }
+    if not allow_partial_placement:
+        for name, g in allocated.items():
+            for ln, podps in g.physical_leaf_cell_placement.items():
+                for i, podp in enumerate(podps):
+                    if any(c is None for c in podp):
+                        _fail(ctx, f"group {name} member {ln}x#{i} has an "
+                                   f"undecided cell slot in an Allocated "
+                                   f"group")
+    if full_groups is None:
+        return
+    expected = set(full_groups)
+    if set(allocated) != expected:
+        _fail(ctx, f"gang atomicity: allocated groups {sorted(allocated)} != "
+                   f"expected complete gangs {sorted(expected)}")
+    for name in expected:
+        g = allocated[name]
+        for ln, pods in g.allocated_pods.items():
+            missing = sum(1 for p in pods if p is None)
+            if missing:
+                _fail(ctx, f"group {name} is partially bound: {missing} of "
+                           f"{len(pods)} member pods ({ln} cells each) "
+                           f"never bound")
+
+
+def check_all(
+    algo,
+    ctx: str = "",
+    full_groups: Optional[Iterable[str]] = None,
+    allow_partial_placement: bool = False,
+) -> None:
+    """Run every algorithm-state invariant (one locked snapshot per check)."""
+    check_vc_safety(algo, ctx)
+    check_books(algo, ctx)
+    check_cell_ownership(algo, ctx)
+    check_gang_atomicity(algo, ctx, full_groups=full_groups,
+                         allow_partial_placement=allow_partial_placement)
+
+
+# ---------------------------------------------------------------------------
+# placement preservation across restart
+# ---------------------------------------------------------------------------
+
+def placement_snapshot(algo, names: Optional[Iterable[str]] = None):
+    """{group name -> {node -> sorted leaf-cell indices}} at chip
+    granularity — the identity of each gang's physical slice. ``names``
+    restricts the snapshot; default is every current group."""
+    if names is None:
+        names = list(algo.affinity_groups)
+    snap = {}
+    for name in names:
+        g = algo.get_affinity_group(name)
+        snap[name] = {
+            n: sorted(ix) for n, ix in g.status.physical_placement.items()
+        }
+    return snap
+
+
+def check_placement_preserved(before, after, ctx: str = "") -> None:
+    """Every group present before must exist after with the exact same
+    chip-granular placement (same nodes but different chips = lost slice:
+    ICI contiguity broken — the ``test_recovery_scale.py`` contract)."""
+    for name, chips_before in before.items():
+        if name not in after:
+            _fail(ctx, f"group {name} lost across restart")
+        if after[name] != chips_before:
+            _fail(ctx, f"group {name} placement changed across restart: "
+                       f"{chips_before} -> {after[name]}")

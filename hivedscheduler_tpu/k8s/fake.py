@@ -35,6 +35,22 @@ class FakeKubeClient(KubeClient):
         self._draining: set = set()
 
     # --- ordered delivery --------------------------------------------------
+    def _fire(self, fire, copies: tuple) -> None:
+        """The single chokepoint through which every handler is invoked.
+
+        Debug-mode leaf-lock assertion: the store lock must NOT be held by
+        the calling thread while a handler runs — handlers may take the
+        scheduler lock and read back into the store, so firing under the
+        store lock inverts the lock order (the architecture rule pinned in
+        CLAUDE.md: the store lock is a leaf lock, never call handlers under
+        it). Plain ``assert`` so ``python -O`` removes the check."""
+        assert not self._lock._is_owned(), (
+            "FakeKubeClient handler invoked while the store (leaf) lock is "
+            "held by this thread — lock-order inversion; deliver through "
+            "_emit, which releases the lock before firing"
+        )
+        fire(*copies)
+
     def _emit(self, key: str, handlers: List, slot: int, *objs) -> None:
         """Must be called with self._lock held: enqueue one event per handler
         (events of one key keep store-mutation order), then drain outside the
@@ -56,7 +72,7 @@ class FakeKubeClient(KubeClient):
                         return
                     fire, copies = q.popleft()
                 try:
-                    fire(*copies)
+                    self._fire(fire, copies)
                 except Exception:
                     # release drainership (remaining events stay queued, in
                     # order, for the next mutator of this key) and surface

@@ -12,28 +12,44 @@ address contract, ``internal/utils.go:291-314`` for Bind):
 - ``bind_pod`` POSTs the Bind subresource with the scheduler's annotations in
   ``binding.metadata.annotations`` — the ApiServer merges them onto the pod,
   which is exactly how the placement record becomes durable.
+
+Failure ladder (doc/design/fault-model.md): transient request failures
+(429/5xx/timeout/connection) retry with bounded exponential backoff +
+jitter, counted in ``tpu_hive_k8s_retries_total``; watch disconnects
+reconnect with their own backoff ladder, a 410 Gone falls back to
+list+reconcile, and a watch that cannot reconnect past
+``watch_failure_threshold`` consecutive attempts reports itself dead
+through ``watches_alive()`` (flipping the scheduler's /healthz) until a
+reconnect succeeds.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import os
+import random
 import ssl
 import threading
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from hivedscheduler_tpu.k8s import serde
 from hivedscheduler_tpu.k8s.client import KubeClient
 from hivedscheduler_tpu.k8s.types import Binding, Node, Pod
+from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
 
 log = logging.getLogger(__name__)
 
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# HTTP statuses worth a retry: throttled or server-side transient. Anything
+# else 4xx is a real rejection and must surface immediately.
+_RETRYABLE_CODES = frozenset({429, 500, 502, 503, 504})
 
 
 class RestKubeClient(KubeClient):
@@ -47,9 +63,33 @@ class RestKubeClient(KubeClient):
         timeout: float = 30.0,
         bearer_token: Optional[str] = None,
         ca_cert: Optional[str] = None,
+        max_retries: int = 4,
+        retry_backoff_s: float = 0.1,
+        retry_backoff_cap_s: float = 2.0,
+        watch_backoff_s: float = 1.0,
+        watch_backoff_cap_s: float = 30.0,
+        watch_failure_threshold: int = 3,
     ):
+        """Retry knobs: each request makes up to ``1 + max_retries``
+        attempts on retryable failures (429/5xx/timeout/connection), backing
+        off exponentially from ``retry_backoff_s`` with jitter, capped at
+        ``retry_backoff_cap_s``. Watches reconnect forever on their own
+        ladder (``watch_backoff_s`` .. ``watch_backoff_cap_s``); after
+        ``watch_failure_threshold`` consecutive failed reconnects the watch
+        reports unhealthy via ``watches_alive()`` until it reconnects."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.max_retries = max(0, max_retries)
+        self.retry_backoff_s = retry_backoff_s
+        self.retry_backoff_cap_s = retry_backoff_cap_s
+        self.watch_backoff_s = watch_backoff_s
+        self.watch_backoff_cap_s = watch_backoff_cap_s
+        self.watch_failure_threshold = max(1, watch_failure_threshold)
+        self._jitter = random.Random()
+        # path -> is the watch stream believed healthy (missing = not
+        # started yet, which counts as healthy: a pre-sync client is not
+        # wedged)
+        self._watch_ok: Dict[str, bool] = {}
         if bearer_token is not None and not self.base_url.startswith("https"):
             # the TLS-only rule for the auto-detected SA token applies to
             # explicit tokens too: a bearer token must never ride plaintext
@@ -111,19 +151,66 @@ class RestKubeClient(KubeClient):
             headers["Authorization"] = f"Bearer {token}"
         return headers
 
+    @staticmethod
+    def _retry_reason(e: Exception) -> Optional[str]:
+        """Bounded-cardinality label for a retryable failure; None means the
+        failure is terminal (a real 4xx rejection, malformed response...)."""
+        if isinstance(e, urllib.error.HTTPError):
+            return str(e.code) if e.code in _RETRYABLE_CODES else None
+        if isinstance(e, urllib.error.URLError):
+            if isinstance(e.reason, (TimeoutError, ssl.SSLError)):
+                return "timeout"
+            return "connection"
+        if isinstance(e, TimeoutError):
+            return "timeout"
+        if isinstance(e, (ConnectionError, http.client.HTTPException)):
+            # reset/refused mid-exchange, truncated chunked body, bad status
+            # line from a bouncing proxy — all transport-transient
+            return "connection"
+        return None
+
+    def _backoff(self, attempt: int, base: float, cap: float) -> float:
+        """Exponential backoff with equal jitter: half deterministic, half
+        uniform — spreads a thundering herd of schedulers without ever
+        collapsing the delay to ~0."""
+        d = min(cap, base * (2 ** attempt))
+        return d / 2 + self._jitter.uniform(0, d / 2)
+
     def _request(self, method: str, path: str, body: Optional[dict] = None):
+        """One API request with bounded retry on transient failures. Safe
+        for the Bind POST too: a bind is idempotent (same pod, same node,
+        same annotations merge), so at-least-once delivery after an
+        ambiguous timeout converges."""
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            self.base_url + path,
-            data=data,
-            method=method,
-            headers=self._headers(data is not None),
-        )
-        with urllib.request.urlopen(
-            req, timeout=self.timeout, context=self._ssl_context
-        ) as resp:
-            raw = resp.read()
-            return json.loads(raw) if raw else None
+        attempt = 0
+        while True:
+            req = urllib.request.Request(
+                self.base_url + path,
+                data=data,
+                method=method,
+                headers=self._headers(data is not None),
+            )
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout, context=self._ssl_context
+                ) as resp:
+                    raw = resp.read()
+                    return json.loads(raw) if raw else None
+            except Exception as e:
+                reason = self._retry_reason(e)
+                if reason is None or attempt >= self.max_retries or self._stop.is_set():
+                    raise
+                metrics.inc("tpu_hive_k8s_retries_total",
+                            op=method, reason=reason)
+                delay = self._backoff(
+                    attempt, self.retry_backoff_s, self.retry_backoff_cap_s
+                )
+                log.warning(
+                    "%s %s failed transiently (%s); retry %d/%d in %.2fs",
+                    method, path, e, attempt + 1, self.max_retries, delay,
+                )
+                self._stop.wait(delay)
+                attempt += 1
 
     # --- informer registration --------------------------------------------
     def on_node_event(self, add, update, delete) -> None:
@@ -168,12 +255,16 @@ class RestKubeClient(KubeClient):
         self._stop.set()
 
     def watches_alive(self) -> bool:
-        """Liveness for the scheduler's /healthz: dead watch threads mean the
-        informer stream silently stopped. A deliberately stopped client (or
-        one that has not synced yet) is not 'wedged'."""
+        """Liveness for the scheduler's /healthz: dead watch threads — or
+        live threads stuck past ``watch_failure_threshold`` consecutive
+        failed reconnects — mean the informer stream stopped delivering.
+        Recovers to True as soon as every watch reconnects. A deliberately
+        stopped client (or one that has not synced yet) is not 'wedged'."""
         if self._stop.is_set():
             return True
-        return all(t.is_alive() for t in self._watch_threads)
+        return all(t.is_alive() for t in self._watch_threads) and all(
+            self._watch_ok.values()
+        )
 
     def _list_and_diff(self, path: str, parse, handlers, key_fn, cache: dict) -> str:
         """List and reconcile against the cache: adds for new objects,
@@ -203,6 +294,8 @@ class RestKubeClient(KubeClient):
         self, path: str, parse, handlers, key_fn, cache: dict, resource_version: str
     ) -> None:
         rv = resource_version
+        failures = 0  # consecutive failed connect/stream attempts
+        self._watch_ok[path] = True
         while not self._stop.is_set():
             url = f"{self.base_url}{path}?watch=true"
             if rv:
@@ -212,6 +305,9 @@ class RestKubeClient(KubeClient):
                 with urllib.request.urlopen(
                     req, timeout=None, context=self._ssl_context
                 ) as resp:
+                    # connected: the stream is delivering again
+                    failures = 0
+                    self._watch_ok[path] = True
                     for line in resp:
                         if self._stop.is_set():
                             return
@@ -251,8 +347,18 @@ class RestKubeClient(KubeClient):
             except Exception as e:
                 if self._stop.is_set():
                     return
-                log.warning("watch %s disconnected (%s); reconnecting", path, e)
-                self._stop.wait(1.0)
+                failures += 1
+                if failures >= self.watch_failure_threshold:
+                    # stuck, not blipping: flip /healthz until a reconnect
+                    self._watch_ok[path] = False
+                delay = self._backoff(
+                    failures - 1, self.watch_backoff_s, self.watch_backoff_cap_s
+                )
+                log.warning(
+                    "watch %s disconnected (%s); reconnect attempt %d in %.2fs",
+                    path, e, failures, delay,
+                )
+                self._stop.wait(delay)
 
     # --- reads ------------------------------------------------------------
     def get_node(self, name: str) -> Optional[Node]:

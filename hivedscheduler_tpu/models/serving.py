@@ -314,6 +314,10 @@ class Request:
     max_new_tokens: int
     tokens_out: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # why the request finished: "eos" (stop token), "length" (budget
+    # exhausted), or "shed" (queue-wait deadline exceeded before admission —
+    # the request never ran; tokens_out is empty)
+    finish_reason: Optional[str] = None
     # admission priority: higher jumps the queue (FIFO within a level) —
     # the engine-level analogue of the scheduler's guaranteed-vs-
     # opportunistic ordering. Scheduling-only: a request's STREAM is
@@ -327,7 +331,12 @@ class Request:
     # one must bound the high-priority offered load themselves (or
     # periodically resubmit aged work at a boosted priority); the per-class
     # TTFT/queue-wait histograms (tpu_hive_serve_*_seconds{priority=...})
-    # make starvation visible.
+    # make starvation visible. ``queue_timeout_s`` converts unbounded
+    # starvation into bounded, *observable* load shedding: an expired waiter
+    # finishes with finish_reason="shed" (counted per class in
+    # tpu_hive_serve_shed_total) instead of waiting forever — under
+    # sustained overload the starved low-priority work is shed first, which
+    # is the documented graceful degradation of strict priority.
     priority: int = 0
     # wall-clock bookkeeping (perf_counter): queue wait = admitted - submitted;
     # time-to-first-token = queue wait + prefill (the latency prefix caching
@@ -388,6 +397,8 @@ class ServingEngine:
         prefix_cache_size: int = 0,
         prefill_chunk: int = 0,
         kv_dtype: Optional[str] = None,
+        queue_timeout_s: Optional[float] = None,
+        clock=time.perf_counter,
     ):
         """``mesh``: lay the engine out over a dp x tp serving mesh —
         params by ``decode.serving_shardings`` (tp shards heads/ff/vocab),
@@ -413,9 +424,22 @@ class ServingEngine:
         per-token-per-head absmax scales) — decode streams half the KV
         bytes from HBM; see RaggedCache. int8 engines are bit-exact among
         themselves under every composition; int8-vs-float differs by the
-        bounded quantization error."""
+        bounded quantization error.
+
+        ``queue_timeout_s``: per-request admission deadline. A queued
+        request whose wait exceeds it is SHED before the next admission
+        sweep — finished with ``finish_reason="shed"``, no tokens, counted
+        per priority class in ``tpu_hive_serve_shed_total`` — bounding the
+        strict-priority starvation caveat with observable load shedding
+        instead of unbounded waits. ``None`` (default) never sheds.
+
+        ``clock``: the engine's wall-clock source (``time.perf_counter``);
+        injectable so overload/deadline behavior is testable
+        deterministically."""
         self.params = params
         self.cfg = cfg
+        self.queue_timeout_s = queue_timeout_s
+        self._clock = clock
         self.max_batch = max_batch
         self.max_len = max_len
         # read-only after construction: the jitted sampler closes over
@@ -587,7 +611,7 @@ class ServingEngine:
                 f"max_len {self.max_len}"
             )
         req = Request(self._next_rid, list(prompt), max_new_tokens,
-                      priority=priority, submitted_at=time.perf_counter())
+                      priority=priority, submitted_at=self._clock())
         self._next_rid += 1
         # stable insertion keeps FIFO within a priority level: insert
         # before the first strictly-lower-priority waiter
@@ -656,14 +680,36 @@ class ServingEngine:
         while len(self._prefix_cache) > self.prefix_cache_size:
             self._prefix_cache.popitem(last=False)  # evict LRU; frees HBM
 
+    def _shed_expired(self) -> None:
+        """Queue-wait deadline: finish expired waiters with
+        ``finish_reason="shed"`` before admission. Under strict priority the
+        longest waiters are the lowest classes, so sustained overload sheds
+        low-priority work first — bounded, observable degradation (see the
+        starvation caveat on ``Request.priority``)."""
+        if self.queue_timeout_s is None or not self.queue:
+            return
+        now = self._clock()
+        kept: List[Request] = []
+        for req in self.queue:
+            if now - req.submitted_at > self.queue_timeout_s:
+                req.done = True
+                req.done_at = now
+                req.finish_reason = "shed"
+                metrics.inc("tpu_hive_serve_shed_total",
+                            priority=str(req.priority))
+            else:
+                kept.append(req)
+        self.queue = kept
+
     def _admit(self) -> None:
+        self._shed_expired()
         for slot in range(self.max_batch):
             if not self.queue:
                 return
             if self.slots[slot] is not None:
                 continue
             req = self.queue.pop(0)
-            req.admitted_at = time.perf_counter()
+            req.admitted_at = self._clock()
             hit = self._match_prefix(req.prompt) if self._prefix_cache else None
             if hit is not None:
                 payload, plen = hit[1]
@@ -807,12 +853,13 @@ class ServingEngine:
 
     def _emit(self, req: Request, slot: int, tok: int) -> None:
         if req.first_token_at is None:
-            req.first_token_at = time.perf_counter()
+            req.first_token_at = self._clock()
         req.tokens_out.append(tok)
         self._last_host[slot] = tok
         if len(req.tokens_out) >= req.max_new_tokens or tok == self.eos_id:
             req.done = True
-            req.done_at = time.perf_counter()
+            req.finish_reason = "eos" if tok == self.eos_id else "length"
+            req.done_at = self._clock()
             self._observe_request(req)
 
     def _observe_request(self, req: Request) -> None:

@@ -1,4 +1,4 @@
-"""Sharded train-state checkpointing (orbax).
+"""Sharded train-state checkpointing (orbax) with crash-atomic commits.
 
 The scheduler side persists placements in pod annotations (crash recovery);
 this is the *workload* side: periodic save/restore of the sharded training
@@ -6,14 +6,32 @@ state so a gang that is preempted (or hits bad hardware and is rescheduled
 onto a different sub-mesh) resumes from its last step. Restore distributes
 each array directly to its target shards — no host-memory gather of the full
 state.
+
+Crash atomicity: a save is only *committed* once a ``hived_complete.json``
+marker lands inside the step directory — written via the classic atomic
+sequence (temp file in the same directory, flush, fsync, ``os.rename``,
+directory fsync) strictly AFTER orbax reports the step fully written. A
+process killed mid-save leaves a step directory without a marker; restore
+and ``latest_step`` skip such partial steps and fall back to the newest
+committed one, and restore additionally survives a marker-bearing step whose
+payload is unreadable (torn storage) by walking down the committed-step
+ladder. Checkpoints written before this scheme (no markers anywhere) keep
+their legacy behavior.
 """
 
 from __future__ import annotations
 
+import json
+import logging
 import os
-from typing import Any, Optional, Tuple
+import tempfile
+from typing import Any, List, Optional, Tuple
 
 import jax
+
+log = logging.getLogger(__name__)
+
+_COMPLETE_MARKER = "hived_complete.json"
 
 
 def _manager(directory: str, max_to_keep: int = 3, create: bool = False):
@@ -25,8 +43,66 @@ def _manager(directory: str, max_to_keep: int = 3, create: bool = False):
     )
 
 
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Crash-atomic file write: temp file in the SAME directory (rename
+    must not cross filesystems), flush + fsync, ``os.rename`` over the
+    destination, then best-effort fsync of the directory so the rename
+    itself is durable. Readers see either the old content or the new,
+    never a torn write."""
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=".tmp-", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:
+        dirfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+    except OSError:
+        pass  # some filesystems refuse directory fsync; rename still atomic
+
+
+def _marker_path(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), str(step), _COMPLETE_MARKER)
+
+
+def _committed_steps(directory: str) -> Optional[List[int]]:
+    """Descending committed steps, or None when NO step carries a marker —
+    a legacy (pre-marker) checkpoint directory, handled by orbax's own
+    bookkeeping for backward compatibility."""
+    directory = os.path.abspath(directory)
+    steps: List[int] = []
+    any_step_dir = False
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return None
+    for name in entries:
+        if not name.isdigit():
+            continue
+        any_step_dir = True
+        if os.path.exists(os.path.join(directory, name, _COMPLETE_MARKER)):
+            steps.append(int(name))
+    if not steps:
+        return None if any_step_dir else []
+    return sorted(steps, reverse=True)
+
+
 def save(directory: str, step: int, params: Any, opt_state: Any) -> None:
-    """Save one checkpoint (blocking). Arrays keep their shardings."""
+    """Save one checkpoint (blocking). Arrays keep their shardings. The
+    step is committed — visible to ``latest_step``/``restore`` — only once
+    its completion marker is atomically in place."""
     import orbax.checkpoint as ocp
 
     mgr = _manager(directory, create=True)
@@ -36,15 +112,64 @@ def save(directory: str, step: int, params: Any, opt_state: Any) -> None:
     ))
     mgr.wait_until_finished()
     mgr.close()
+    atomic_write_bytes(
+        _marker_path(directory, step),
+        json.dumps({"step": step, "format": "orbax-composite-v1"}).encode(),
+    )
 
 
 def latest_step(directory: str) -> Optional[int]:
     if not os.path.isdir(directory):
         return None  # a read must not create the directory
+    committed = _committed_steps(directory)
+    if committed is not None:
+        return committed[0] if committed else None
     mgr = _manager(directory)
     step = mgr.latest_step()
     mgr.close()
     return step
+
+
+def _restore_ladder(directory: str, step: Optional[int], do_restore):
+    """Shared restore core: resolve the step ladder and walk it.
+
+    An explicit ``step`` is restored exactly (failure raises — the caller
+    asked for that step). With ``step=None`` the newest *committed* step is
+    tried first; if its payload is unreadable (torn/truncated storage past
+    the commit marker), the ladder falls back to the next committed step —
+    a resume always lands on the newest complete checkpoint. Legacy
+    directories (no markers) use orbax's own latest-step bookkeeping, also
+    walking down on unreadable payloads. Returns ``(step, restored)``."""
+    if not os.path.isdir(directory):
+        raise FileNotFoundError(f"no checkpoint found under {directory}")
+    mgr = _manager(directory)
+    try:
+        if step is not None:
+            return step, do_restore(mgr, step)
+        committed = _committed_steps(directory)
+        if committed is not None:
+            candidates = committed
+        else:
+            candidates = sorted(mgr.all_steps(), reverse=True)
+        if not candidates:
+            raise FileNotFoundError(f"no checkpoint found under {directory}")
+        last_exc: Optional[Exception] = None
+        for s in candidates:
+            try:
+                return s, do_restore(mgr, s)
+            except Exception as e:  # torn payload despite the marker
+                last_exc = e
+                log.warning(
+                    "checkpoint step %d under %s is unreadable (%s); "
+                    "falling back to the previous complete checkpoint",
+                    s, directory, e,
+                )
+        raise RuntimeError(
+            f"every checkpoint under {directory} is unreadable "
+            f"(tried steps {candidates})"
+        ) from last_exc
+    finally:
+        mgr.close()
 
 
 def restore_params(
@@ -66,17 +191,10 @@ def restore_params(
             tree,
         )
 
-    if not os.path.isdir(directory):
-        raise FileNotFoundError(f"no checkpoint found under {directory}")
-    mgr = _manager(directory)
-    if step is None:
-        step = mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint found under {directory}")
-    restored = mgr.restore(step, args=ocp.args.Composite(
-        params=ocp.args.StandardRestore(as_abstract(params_template)),
-    ))
-    mgr.close()
+    step, restored = _restore_ladder(directory, step, lambda mgr, s: mgr.restore(
+        s, args=ocp.args.Composite(
+            params=ocp.args.StandardRestore(as_abstract(params_template)),
+        )))
     params = jax.tree.map(
         lambda x, t: (
             jax.device_put(x, t.sharding) if getattr(t, "sharding", None) is not None else x
@@ -140,18 +258,11 @@ def restore(
             tree,
         )
 
-    if not os.path.isdir(directory):
-        raise FileNotFoundError(f"no checkpoint found under {directory}")
-    mgr = _manager(directory)
-    if step is None:
-        step = mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint found under {directory}")
-    restored = mgr.restore(step, args=ocp.args.Composite(
-        params=ocp.args.StandardRestore(as_abstract(params_template)),
-        opt_state=ocp.args.StandardRestore(as_abstract(opt_state_template)),
-    ))
-    mgr.close()
+    step, restored = _restore_ladder(directory, step, lambda mgr, s: mgr.restore(
+        s, args=ocp.args.Composite(
+            params=ocp.args.StandardRestore(as_abstract(params_template)),
+            opt_state=ocp.args.StandardRestore(as_abstract(opt_state_template)),
+        )))
 
     # guarantee every leaf lands exactly on its template's sharding (orbax can
     # fall back to single-device placement for leaves without sharding info)

@@ -122,6 +122,10 @@ REGISTRY.describe("tpu_hive_http_requests_total",
 REGISTRY.describe("tpu_hive_extender_requests_total",
                   "Extender requests by routine and outcome")
 REGISTRY.describe("tpu_hive_binds_total", "Bind subresource commits")
+REGISTRY.describe("tpu_hive_bind_retries_total",
+                  "Idempotent bind re-deliveries after transient failures")
+REGISTRY.describe("tpu_hive_k8s_retries_total",
+                  "K8s REST request retries by operation and reason")
 REGISTRY.describe("tpu_hive_force_binds_total", "Force-bind escalations")
 REGISTRY.describe("tpu_hive_bad_nodes", "Nodes currently considered bad")
 REGISTRY.describe("tpu_hive_filter_latency_seconds", "filterRoutine latency")
@@ -136,3 +140,6 @@ REGISTRY.describe("tpu_hive_serve_tpot_seconds",
                   "Serving time-per-output-token after the first token")
 REGISTRY.describe("tpu_hive_serve_requests_total",
                   "Serving requests completed by priority class")
+REGISTRY.describe("tpu_hive_serve_shed_total",
+                  "Serving requests shed on queue-wait deadline by priority "
+                  "class")

@@ -33,6 +33,15 @@ from hivedscheduler_tpu.runtime.types import (
 
 log = logging.getLogger(__name__)
 
+# Bind-commit retry policy: binds are idempotent by construction (same pod,
+# same node, same annotations — the ApiServer merge converges), so bounded
+# at-least-once delivery is safe. The backoff is deliberately short: the
+# retry loop runs under the scheduler lock (as the reference's bindRoutine
+# does), so a wedged ApiServer must fail fast and leave the retry to the
+# next kube-scheduler cycle (the POD_BINDING insist path re-delivers).
+BIND_RETRY_ATTEMPTS = 3
+BIND_RETRY_BACKOFF_S = 0.05
+
 
 class HivedScheduler:
     """Reference: HivedScheduler, scheduler.go:53-120."""
@@ -196,7 +205,18 @@ class HivedScheduler:
     ) -> Optional[str]:
         """Reference: validatePodBindInfo, scheduler.go:385-421."""
         node = pod_bind_info.node
-        if self.kube_client.get_node(node) is None:
+        try:
+            known_node = self.kube_client.get_node(node)
+        except Exception as e:
+            # a transient ApiServer read failure must not fail the filter
+            # after the algorithm already allocated: treat the placement as
+            # unverifiable, which escalates to force bind (the bind itself
+            # retries) instead of surfacing a 500 mid-gang
+            return (
+                f"The SchedulerAlgorithm decided to bind on node {node}, but the "
+                f"ApiServer read to verify it failed transiently: {e}"
+            )
+        if known_node is None:
             return (
                 f"The SchedulerAlgorithm decided to bind on node {node}, but the node "
                 f"does not exist or has not been informed to the scheduler"
@@ -383,7 +403,7 @@ class HivedScheduler:
                         f"Pod binding node mismatch: expected {binding_pod.node_name}, "
                         f"received {args.node}"
                     )
-                self.kube_client.bind_pod(
+                self._commit_bind(
                     Binding(
                         pod_name=binding_pod.name,
                         pod_namespace=binding_pod.namespace,
@@ -398,6 +418,52 @@ class HivedScheduler:
                 f"Pod cannot be bound without a scheduling placement: Pod current "
                 f"scheduling state {pod_status.pod_state}, received node {args.node}"
             )
+
+    def _commit_bind(self, binding: Binding) -> None:
+        """Deliver one bind to the ApiServer with bounded, idempotent retry.
+
+        A transient failure (429/5xx/timeout) may be *ambiguous* — the POST
+        committed but the response was lost — so before giving an attempt
+        up the pod is re-read: a pod already on the target node with the
+        same UID means the bind landed and the failure was response-side.
+        The terminal failure re-raises; the pod stays POD_BINDING and the
+        next filter cycle insists the bind again (force-bind ladder)."""
+        last_exc: Optional[Exception] = None
+        delay = BIND_RETRY_BACKOFF_S
+        for attempt in range(BIND_RETRY_ATTEMPTS):
+            if attempt:
+                metrics.inc("tpu_hive_bind_retries_total")
+                time.sleep(delay)
+                delay *= 2
+            try:
+                self.kube_client.bind_pod(binding)
+                return
+            except Exception as e:
+                last_exc = e
+                try:
+                    stored = self.kube_client.get_pod(
+                        binding.pod_namespace, binding.pod_name
+                    )
+                except Exception:
+                    stored = None
+                if (
+                    stored is not None
+                    and stored.uid == binding.pod_uid
+                    and stored.node_name == binding.node
+                ):
+                    log.warning(
+                        "[%s/%s]: bind reported failure (%s) but the pod is "
+                        "already bound to %s — treating as committed",
+                        binding.pod_namespace, binding.pod_name, e, binding.node,
+                    )
+                    return
+                log.warning(
+                    "[%s/%s]: bind attempt %d/%d failed: %s",
+                    binding.pod_namespace, binding.pod_name, attempt + 1,
+                    BIND_RETRY_ATTEMPTS, e,
+                )
+        assert last_exc is not None
+        raise last_exc
 
     def preempt_routine(self, args: ei.ExtenderPreemptionArgs) -> ei.ExtenderPreemptionResult:
         """Reference: preemptRoutine, scheduler.go:629-721."""

@@ -32,6 +32,10 @@ def main(argv=None) -> int:
     parser.add_argument("--arrival-every", type=int, default=3,
                         help="admit a new request every N engine steps "
                         "(0 = all up front)")
+    parser.add_argument("--queue-timeout", type=float, default=0.0,
+                        help="shed requests whose queue wait exceeds this "
+                             "many seconds (finish_reason=shed, counted in "
+                             "tpu_hive_serve_shed_total); 0 = never shed")
     parser.add_argument("--high-priority-every", type=int, default=0,
                         help="submit every Nth request at priority 10 "
                         "(0 = all priority 0); high-priority waiters jump "
@@ -174,6 +178,7 @@ def main(argv=None) -> int:
             mesh=mesh, prefix_cache_size=args.prefix_cache,
             prefill_chunk=args.prefill_chunk,
             kv_dtype=None if args.kv_quantize == "none" else args.kv_quantize,
+            queue_timeout_s=args.queue_timeout if args.queue_timeout > 0 else None,
         )
         if args.draft_layers > 0:
             from hivedscheduler_tpu.models.speculative import derive_draft_config
@@ -251,6 +256,11 @@ def main(argv=None) -> int:
         len(reqs), total_tokens, dt, total_tokens / dt,
         100.0 * eng.occupancy, eng.steps,
     )
+    shed = [r for r in reqs if r.finish_reason == "shed"]
+    if shed:
+        log.info("shed %s request(s) on the %.1fs queue-wait deadline: %s",
+                 len(shed), args.queue_timeout,
+                 " ".join(str(r.rid) for r in shed))
     if args.draft_layers > 0:
         log.info("speculation: %s/%s draft tokens accepted (%.0f%%)",
                  eng.accepted, eng.drafted, 100.0 * eng.acceptance)

@@ -40,6 +40,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; long soaks opt out via this marker
+    config.addinivalue_line(
+        "markers", "slow: long-running soak/stress variants excluded from tier-1"
+    )
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _no_tpu_tunnel():
     """Guard: without the HIVED_TEST_TPU opt-in, no test process may reach

@@ -1,0 +1,34 @@
+"""Guard: the pinned chaos-seed replay (tools/check_chaos_seeds.py) runs
+clean, and the replay machinery is genuinely deterministic — the property
+that makes a pinned seed a faithful permanent regression test."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_chaos_seeds.py")
+
+
+def test_pinned_seeds_replay_clean():
+    proc = subprocess.run([sys.executable, TOOL], cwd=REPO,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"check_chaos_seeds failed:\n{proc.stdout}{proc.stderr}"
+    )
+    assert "OK" in proc.stdout
+
+
+def test_replay_is_deterministic():
+    """Same seed, same plan => identical injector fault sequence and
+    outcome — byte-equal reports (minus nothing: the report has no
+    timestamps by design)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_chaos_seeds
+    finally:
+        sys.path.pop(0)
+    a = check_chaos_seeds.replay(seed=3, schedules=5)
+    b = check_chaos_seeds.replay(seed=3, schedules=5)
+    assert a == b
+    assert a["violations"] == []

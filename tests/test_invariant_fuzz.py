@@ -32,6 +32,7 @@ from hivedscheduler_tpu.algorithm.constants import (
 )
 from hivedscheduler_tpu.algorithm.hived import HivedAlgorithm
 from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.chaos import invariants as chaos_invariants
 from hivedscheduler_tpu.api.config import Config, new_config
 from hivedscheduler_tpu.api.types import (
     CellTypeSpec,
@@ -237,51 +238,17 @@ class Harness:
 
     # ---------------- invariants ----------------
 
-    def check_invariants(self, ctx=""):
-        a = self.algo
-        # 1. VC safety inequality at every chain/level
-        for chain, levels in a.total_left_cell_num.items():
-            for level, left in levels.items():
-                free = a.all_vc_free_cell_num.get(chain, {}).get(level, 0)
-                assert left >= free, (
-                    f"{ctx}: VC safety broken: chain {chain} level {level}: "
-                    f"{left} left < {free} free in all VCs"
-                )
-        # 2 + 3. books and priority max-invariant on both trees
-        trees = list(a.full_cell_list.items()) + [
-            (f"{vcn}/{chain}", ccl)
-            for vcn, sched in a.vc_schedulers.items()
-            for chain, ccl in sched.non_pinned_full_cell_list.items()
-        ]
-        for label, ccl in trees:
-            for c in all_cells(ccl):
-                recount = {}
-                for leaf in leaf_descendants(c):
-                    if leaf.priority != FREE_PRIORITY:
-                        recount[leaf.priority] = recount.get(leaf.priority, 0) + 1
-                assert dict(c.used_leaf_cell_num_at_priorities) == recount, (
-                    f"{ctx}: used-count books drifted at {label}:{c.address}: "
-                    f"{c.used_leaf_cell_num_at_priorities} != recount {recount}"
-                )
-                if c.children:
-                    max_child = max(ch.priority for ch in c.children)
-                    assert c.priority == max_child, (
-                        f"{ctx}: priority invariant broken at {label}:"
-                        f"{c.address}: {c.priority} != max(children) {max_child}"
-                    )
-        # 4. free-list hygiene: "free" means free of a VC binding, not idle
-        # — opportunistic pods legitimately run on free-list cells (the
-        # reference's opportunistic path never touches the free list), but a
-        # GUARANTEED priority in the free list would mean a VC binding leaked
-        from hivedscheduler_tpu.algorithm.constants import MIN_GUARANTEED_PRIORITY
-
-        for chain, fl in a.free_cell_list.items():
-            for level in sorted(fl):
-                for c in fl[level]:
-                    assert c.priority < MIN_GUARANTEED_PRIORITY, (
-                        f"{ctx}: free cell {c.address} carries guaranteed "
-                        f"priority {c.priority}"
-                    )
+    def check_invariants(self, ctx="", allow_partial_placement=False):
+        """One shared checker with the chaos harness and the pinned-seed
+        replay tool: chaos.invariants re-derives VC safety, the used-count
+        books, priority max-invariant, free-list hygiene, cell ownership
+        (no leak / no double allocation) and structural gang atomicity from
+        scratch (see that module for the per-invariant contracts).
+        ``allow_partial_placement`` is for reconfiguration replays, whose
+        tolerance ladder legitimately ignores vanished-chain placements."""
+        chaos_invariants.check_all(
+            self.algo, ctx, allow_partial_placement=allow_partial_placement
+        )
 
     def snapshot(self):
         """Full reachable state of the physical + virtual trees."""
@@ -479,7 +446,8 @@ def test_reconfig_replay_fuzz(seed, kind):
              h.op_delete_gang, h.op_flip_node]
         )()
     fresh, h2 = _replay(h, config=_mutated_config(kind))
-    h2.check_invariants(f"seed {seed} kind {kind} after reconfig replay")
+    h2.check_invariants(f"seed {seed} kind {kind} after reconfig replay",
+                        allow_partial_placement=True)
     # every replayed pod must be ABSORBED (registered in its group's slots)
     # — the ladder may demote or ignore placements, never lose pods
     absorbed = sum(
@@ -494,7 +462,8 @@ def test_reconfig_replay_fuzz(seed, kind):
         for bp in h.groups[name]:
             if name in fresh.affinity_groups:
                 fresh.delete_allocated_pod(bp)
-    h2.check_invariants(f"seed {seed} kind {kind} after full delete")
+    h2.check_invariants(f"seed {seed} kind {kind} after full delete",
+                        allow_partial_placement=True)
     # heal everything before the pristine comparison: doomed-bad binding
     # choices are path-dependent, so only the all-healthy end state is
     # deterministic (same reason test_full_delete_restores_pristine_state

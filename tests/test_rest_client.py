@@ -18,7 +18,7 @@ class MiniApiServer:
     """Just enough of the K8s API: /api/v1/{nodes,pods} list+watch, pod GET,
     and the pods/{name}/binding subresource."""
 
-    def __init__(self):
+    def __init__(self, port=0):
         self.nodes = {}
         self.pods = {}  # key ns/name -> k8s dict
         self.rv = 1
@@ -27,6 +27,10 @@ class MiniApiServer:
         # wire-request log (method, path-with-query) — the kind-e2e dry-run
         # derives the client's required RBAC verbs from this
         self.requests = []
+        # failure injection: exact path (no query) -> list of HTTP status
+        # codes; each matching request consumes one and fails with it
+        # (tests/test_rest_failures.py drives the client's retry ladder)
+        self.fail_next = {}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -50,9 +54,20 @@ class MiniApiServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _maybe_fail(self, path):
+                with outer.lock:
+                    codes = outer.fail_next.get(path)
+                    code = codes.pop(0) if codes else None
+                if code is not None:
+                    self._json(code, {"kind": "Status", "code": code})
+                    return True
+                return False
+
             def do_GET(self):
                 path, _, query = self.path.partition("?")
                 watching = "watch=true" in query
+                if not watching and self._maybe_fail(path):
+                    return
                 if path == "/api/v1/nodes" and not watching:
                     with outer.lock:
                         items = list(outer.nodes.values())
@@ -103,6 +118,8 @@ class MiniApiServer:
             def do_POST(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = json.loads(self.rfile.read(length)) if length else {}
+                if self._maybe_fail(self.path.partition("?")[0]):
+                    return
                 parts = self.path.split("/")
                 if self.path.endswith("/binding"):
                     ns, name = parts[4], parts[6]
@@ -121,7 +138,7 @@ class MiniApiServer:
                 else:
                     self._json(404, {"code": 404})
 
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self.thread.start()
         self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"

@@ -1,0 +1,175 @@
+"""REST client failure ladder, driven through the local MiniApiServer stub
+(no network): transient 5xx/429 retry with backoff, terminal 4xx surfacing
+immediately, the 410 Gone relist+reconcile resync (synthesized deletes for
+objects vanished during the watch gap), and ``watches_alive`` flipping false
+on a wedged watch (connection refused past the failure threshold) then
+recovering once the ApiServer returns on the same address."""
+
+import threading
+import time
+
+import pytest
+import urllib.error
+
+from hivedscheduler_tpu.k8s.rest import RestKubeClient
+from hivedscheduler_tpu.runtime.metrics import REGISTRY
+
+from test_rest_client import MiniApiServer, wait_for
+
+
+@pytest.fixture
+def apiserver():
+    s = MiniApiServer()
+    yield s
+    s.close()
+
+
+def fast_client(url, **kw):
+    """A client whose retry/backoff ladder runs at test speed."""
+    kw.setdefault("max_retries", 3)
+    kw.setdefault("retry_backoff_s", 0.01)
+    kw.setdefault("retry_backoff_cap_s", 0.02)
+    kw.setdefault("watch_backoff_s", 0.02)
+    kw.setdefault("watch_backoff_cap_s", 0.05)
+    kw.setdefault("watch_failure_threshold", 2)
+    return RestKubeClient(url, **kw)
+
+
+def _retries(op, reason) -> float:
+    return REGISTRY._counters.get(
+        ("tpu_hive_k8s_retries_total",
+         (("op", op), ("reason", reason))), 0.0
+    )
+
+
+def test_transient_errors_retried_and_counted(apiserver):
+    """500 then 429 on the list: the request ladder absorbs both, the call
+    succeeds, and each retry lands in tpu_hive_k8s_retries_total."""
+    apiserver.add_node("n0")
+    before_500 = _retries("GET", "500")
+    before_429 = _retries("GET", "429")
+    apiserver.fail_next["/api/v1/nodes"] = [500, 429]
+    client = fast_client(apiserver.url)
+    assert [n.name for n in client.list_nodes()] == ["n0"]
+    assert _retries("GET", "500") == before_500 + 1
+    assert _retries("GET", "429") == before_429 + 1
+    client.stop()
+
+
+def test_transient_bind_retried(apiserver):
+    """The Bind POST rides the same ladder (binds are idempotent: same pod,
+    same node, same annotation merge)."""
+    from hivedscheduler_tpu.k8s.types import Binding
+
+    apiserver.add_node("n0")
+    apiserver.add_pod("default", "p1")
+    path = "/api/v1/namespaces/default/pods/p1/binding"
+    apiserver.fail_next[path] = [503]
+    client = fast_client(apiserver.url)
+    client.bind_pod(Binding(pod_name="p1", pod_namespace="default",
+                            pod_uid="p1", node="n0"))
+    bound = client.get_pod("default", "p1")
+    assert bound.node_name == "n0"
+    client.stop()
+
+
+def test_terminal_4xx_not_retried(apiserver):
+    """A real rejection (403) surfaces immediately — only one wire request,
+    no backoff burned."""
+    apiserver.fail_next["/api/v1/nodes"] = [403, 403, 403, 403]
+    client = fast_client(apiserver.url)
+    with pytest.raises(urllib.error.HTTPError):
+        client.list_nodes()
+    with apiserver.lock:
+        n_reqs = sum(1 for m, p in apiserver.requests
+                     if m == "GET" and p == "/api/v1/nodes")
+    assert n_reqs == 1
+    client.stop()
+
+
+def test_retry_exhaustion_raises(apiserver):
+    """max_retries bounds the ladder: a persistently-500 endpoint fails
+    after 1 + max_retries attempts."""
+    apiserver.fail_next["/api/v1/pods"] = [500] * 10
+    client = fast_client(apiserver.url, max_retries=2)
+    with pytest.raises(urllib.error.HTTPError):
+        client.list_pods()
+    with apiserver.lock:
+        n_reqs = sum(1 for m, p in apiserver.requests
+                     if m == "GET" and p == "/api/v1/pods")
+    assert n_reqs == 3  # initial + 2 retries
+    client.stop()
+
+
+def test_410_gone_resync_reconciles(apiserver):
+    """The watch-gap ladder: objects created AND deleted while the watch
+    was broken must surface as synthesized add/delete events after the 410
+    Gone relist (the client's cache diff — reference informer semantics)."""
+    apiserver.add_pod("default", "old")
+    client = fast_client(apiserver.url)
+    seen = {"adds": [], "deletes": []}
+    client.on_pod_event(
+        lambda p: seen["adds"].append(p.key),
+        lambda o, p: None,
+        lambda p: seen["deletes"].append(p.key),
+    )
+    client.on_node_event(lambda n: None, lambda o, n: None, lambda n: None)
+    client.sync()
+    assert seen["adds"] == ["default/old"]
+    assert wait_for(lambda: len(apiserver.watchers) == 2)
+
+    # the watch gap: one pod vanishes, another appears, NO events emitted
+    with apiserver.lock:
+        del apiserver.pods["default/old"]
+        apiserver.rv += 1
+        apiserver.pods["default/new"] = {
+            "metadata": {"name": "new", "namespace": "default", "uid": "new",
+                         "resourceVersion": str(apiserver.rv)},
+            "spec": {"containers": []},
+            "status": {"phase": "Pending"},
+        }
+    # ...then the ApiServer declares the client's resourceVersion Gone
+    apiserver.emit("pods", {"type": "ERROR", "object": {"code": 410}})
+    assert wait_for(lambda: "default/new" in seen["adds"])
+    assert wait_for(lambda: "default/old" in seen["deletes"])
+    client.stop()
+
+
+def test_watches_alive_flips_and_recovers():
+    """Kill the ApiServer: after watch_failure_threshold consecutive
+    connection-refused reconnects the client reports watches_alive()=False
+    (the scheduler's /healthz would go unhealthy). Restart the server on
+    the same port: the watch reconnects and liveness recovers — no client
+    restart needed."""
+    server = MiniApiServer()
+    port = server.httpd.server_address[1]
+    server.add_node("n0")
+    client = fast_client(server.url)
+    seen = []
+    client.on_node_event(lambda n: seen.append(n.name),
+                         lambda o, n: seen.append(n.name), lambda n: None)
+    client.on_pod_event(lambda p: None, lambda o, p: None, lambda p: None)
+    client.sync()
+    assert client.watches_alive()
+    assert wait_for(lambda: len(server.watchers) == 2)
+
+    server.close()  # connection refused from here on
+    assert wait_for(lambda: not client.watches_alive(), timeout=10.0), (
+        "watches_alive never flipped false after the ApiServer died"
+    )
+
+    # ApiServer comes back on the same address with one more node
+    server2 = MiniApiServer(port=port)
+    try:
+        server2.add_node("n0")
+        server2.add_node("n1")
+        assert wait_for(lambda: client.watches_alive(), timeout=10.0), (
+            "watches_alive never recovered after the ApiServer returned"
+        )
+        # ...and the reconnected watch delivers again
+        assert wait_for(lambda: len(server2.watchers) >= 2, timeout=10.0)
+        server2.add_node("n2")
+        assert wait_for(lambda: "n2" in seen, timeout=10.0)
+    finally:
+        client.stop()
+        server2.close()

@@ -1,0 +1,123 @@
+"""Per-request queue-wait deadlines + load shedding (models/serving.py).
+
+The engine's admission is strict priority with no aging (documented
+starvation caveat, PR 1); ``queue_timeout_s`` bounds it: an expired waiter
+finishes with the distinct ``finish_reason="shed"`` and a per-priority-class
+counter instead of waiting forever. Under sustained overload the starved
+LOW-priority work is what exceeds its deadline — graceful degradation, shed
+from the bottom of the priority ladder. Time is injected (``clock=``) so the
+overload scenarios are deterministic on a 1-core CI box."""
+
+import pytest
+
+pytest.importorskip("jax")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from hivedscheduler_tpu.models import serving, transformer as tm  # noqa: E402
+from hivedscheduler_tpu.runtime.metrics import REGISTRY  # noqa: E402
+
+
+def cfg_of(**kw):
+    base = dict(vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2,
+                n_layers=2, d_ff=128, max_seq_len=128, dtype=jnp.float32)
+    base.update(kw)
+    return tm.TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = cfg_of()
+    params = tm.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _shed_count(priority: str) -> float:
+    return REGISTRY._counters.get(
+        ("tpu_hive_serve_shed_total", (("priority", priority),)), 0.0
+    )
+
+
+def test_overload_sheds_low_priority_first(setup):
+    """max_batch=1 under overload: the high-priority request jumps the
+    queue (strict priority), so the low-priority waiter is the one whose
+    deadline expires — it is shed, the high-priority one is served."""
+    cfg, params = setup
+    clock = FakeClock()
+    eng = serving.ServingEngine(params, cfg, max_batch=1, max_len=64,
+                                queue_timeout_s=10.0, clock=clock)
+    shed0_before = _shed_count("0")
+    shed1_before = _shed_count("1")
+
+    running = eng.submit([5, 9, 2], 3)          # occupies the only slot
+    eng.step()
+    assert eng.slots[0] is running
+
+    low = eng.submit([1, 2], 4, priority=0)     # waits from t=0
+    clock.t = 8.0
+    high = eng.submit([3, 4], 4, priority=1)    # waits from t=8
+    assert eng.queue[0] is high                 # strict priority: jumped ahead
+
+    clock.t = 12.0                              # low has waited 12s > 10s,
+    eng.run_until_drained()                     # high only 4s
+
+    assert low.done and low.finish_reason == "shed"
+    assert low.tokens_out == [] and low.admitted_at is None
+    assert high.done and high.finish_reason == "length"
+    assert len(high.tokens_out) == 4
+    assert running.done and running.finish_reason == "length"
+    assert _shed_count("0") == shed0_before + 1
+    assert _shed_count("1") == shed1_before     # high priority never shed
+
+
+def test_no_timeout_never_sheds(setup):
+    cfg, params = setup
+    clock = FakeClock()
+    eng = serving.ServingEngine(params, cfg, max_batch=1, max_len=64,
+                                clock=clock)
+    a = eng.submit([5, 9, 2], 2)
+    b = eng.submit([7, 8], 2)
+    clock.t = 1e9                               # ancient waiters, no deadline
+    eng.run_until_drained()
+    assert a.finish_reason == "length" and b.finish_reason == "length"
+
+
+def test_finish_reason_eos_vs_length(setup):
+    """eos wins over budget exhaustion when the stop token lands."""
+    cfg, params = setup
+    eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64)
+    probe = eng.submit([5, 9, 2], 6)
+    eng.run_until_drained()
+    assert probe.finish_reason == "length"
+    # replay with eos set to the first emitted token: stops immediately
+    eng2 = serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                 eos_id=probe.tokens_out[0])
+    stopped = eng2.submit([5, 9, 2], 6)
+    eng2.run_until_drained()
+    assert stopped.finish_reason == "eos"
+    assert stopped.tokens_out == probe.tokens_out[:1]
+
+
+def test_shed_while_slots_busy_then_recycled(setup):
+    """A shed request must never occupy a slot afterwards: the freed
+    capacity goes to in-deadline waiters; draining terminates."""
+    cfg, params = setup
+    clock = FakeClock()
+    eng = serving.ServingEngine(params, cfg, max_batch=1, max_len=64,
+                                queue_timeout_s=5.0, clock=clock)
+    eng.submit([5, 9, 2], 2)
+    stale = [eng.submit([i + 1, i + 2], 2) for i in range(3)]
+    clock.t = 6.0
+    fresh = eng.submit([9, 9], 2)
+    eng.run_until_drained()
+    assert all(r.finish_reason == "shed" for r in stale)
+    assert fresh.finish_reason == "length" and len(fresh.tokens_out) == 2

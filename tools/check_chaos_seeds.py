@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""Pinned chaos-seed replay: every seed that ever found an invariant
+violation becomes a permanent regression test.
+
+Mirrors ``tools/check_metrics.py``: run directly (``python
+tools/check_chaos_seeds.py``; exit 1 on any violation) or through its guard
+test (``tests/test_chaos_seeds.py``). The chaos injector is fully
+deterministic per seed (one ``random.Random(seed)`` drives every fault
+decision), so a seed that exposed a bug replays the exact fault sequence —
+append it to ``PINNED_SEEDS`` with a comment naming the bug and it guards
+the fix forever.
+
+Workflow when a soak (tests/test_chaos.py) or this tool reports a
+violation:
+
+1. reproduce: ``python tools/check_chaos_seeds.py --seed <N>``
+2. fix the scheduler/runtime bug it exposed
+3. append ``(N, SOAK, "<what it caught>")`` to PINNED_SEEDS — the seed now
+   replays on every CI run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+# runnable as a plain script: the repo root (not tools/) holds the package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (seed, plan_name, schedules, why-it-is-pinned)
+# plan names index into PLANS below, so a pinned seed replays under the
+# exact fault mix that found its bug even if the default soak mix evolves.
+PINNED_SEEDS = [
+    # Initial coverage set (no violation ever found on these — they pin the
+    # baseline fault mix: drops+delays+reorders, ambiguous binds, node
+    # flaps, crash-restarts — so the harness itself is regression-guarded):
+    (0, "soak-v1", 8, "baseline: delays + transient errors + restarts"),
+    (5, "soak-v1", 8, "baseline: ambiguous bind failure mid-gang"),
+    (7, "soak-v1", 8, "baseline: heavy reorder + drops"),
+    (11, "soak-v1", 8, "baseline: multi-chain relax under flaps"),
+    (13, "soak-v1", 8, "baseline: bench seed, preemption-heavy mix"),
+]
+
+
+def _plans():
+    from hivedscheduler_tpu.chaos import FaultPlan
+
+    return {
+        "soak-v1": FaultPlan(
+            drop_event_p=0.08, delay_event_p=0.15, reorder_p=0.35,
+            error_p=0.2, max_consecutive_errors=2, bind_fail_after_p=0.5,
+        ),
+    }
+
+
+def replay(seed: int, plan_name: str = "soak-v1", schedules: int = 8) -> dict:
+    from hivedscheduler_tpu.chaos import ChaosHarness
+
+    harness = ChaosHarness(seed=seed, plan=_plans()[plan_name],
+                           restart_every=3)
+    return harness.run(schedules)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int, default=None,
+                        help="replay ONE seed (debugging) instead of the "
+                             "pinned set")
+    parser.add_argument("--schedules", type=int, default=8)
+    parser.add_argument("--plan", default="soak-v1", choices=["soak-v1"])
+    args = parser.parse_args(argv)
+    logging.disable(logging.CRITICAL)
+
+    if args.seed is not None:
+        targets = [(args.seed, args.plan, args.schedules, "ad hoc")]
+    else:
+        targets = PINNED_SEEDS
+    ok = True
+    for seed, plan_name, schedules, why in targets:
+        report = replay(seed, plan_name, schedules)
+        if report["violations"]:
+            ok = False
+            print(f"SEED {seed} ({why}): {len(report['violations'])} "
+                  f"invariant violation(s):")
+            for v in report["violations"]:
+                print(f"  {v}")
+        else:
+            print(f"seed {seed} [{plan_name} x{schedules}] OK — "
+                  f"{report['gangs_completed']} gangs, "
+                  f"{report['restarts']} restarts, "
+                  f"injector {json.dumps(report['injector'])}")
+    if ok:
+        print(f"check_chaos_seeds: OK ({len(targets)} seed(s) clean)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
