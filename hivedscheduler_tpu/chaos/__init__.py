@@ -19,10 +19,16 @@ paths systematically:
   NotReady flaps, mid-gang pod deletions, and scheduler crash-restarts
   (fresh ``HivedScheduler`` replaying recovery from pod annotations),
   checking invariants after every schedule.
+- ``chaos.workload``: the *workload*-side soak — SIGKILL/SIGTERM/injected
+  hangs against a real CPU-only training subprocess, asserting the
+  supervisor's exit contracts and bit-exact checkpoint resume
+  (``parallel/supervisor.py``; seeds pinned in
+  ``tools/check_workload_seeds.py``).
 
 The fault model — which faults are tolerated at which layer — is catalogued
 in ``doc/design/fault-model.md``. Seeds that ever found a violation are
-pinned forever in ``tools/check_chaos_seeds.py``.
+pinned forever in ``tools/check_chaos_seeds.py`` /
+``tools/check_workload_seeds.py``.
 """
 
 from hivedscheduler_tpu.chaos.injector import ChaosKubeClient, FaultPlan, InjectedApiError
@@ -33,6 +39,10 @@ from hivedscheduler_tpu.chaos.invariants import (
     placement_snapshot,
 )
 from hivedscheduler_tpu.chaos.harness import ChaosHarness
+from hivedscheduler_tpu.chaos.workload import (
+    WorkloadChaosHarness,
+    WorkloadFaultPlan,
+)
 
 __all__ = [
     "ChaosHarness",
@@ -40,6 +50,8 @@ __all__ = [
     "FaultPlan",
     "InjectedApiError",
     "InvariantViolation",
+    "WorkloadChaosHarness",
+    "WorkloadFaultPlan",
     "check_all",
     "check_placement_preserved",
     "placement_snapshot",

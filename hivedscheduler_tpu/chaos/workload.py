@@ -1,0 +1,283 @@
+"""Process-level workload chaos: kill, hang and restart a real training
+subprocess, then prove the resumed run is bit-exact.
+
+The scheduler-side soak (``chaos.harness``) attacks the control plane; this
+harness attacks the *workload* contract that makes HiveD's preemption
+work-preserving end to end (ISSUE 3): a training job must survive
+
+- **SIGKILL** mid-step (hard preemption / OOM-killer / node loss): the next
+  incarnation restores the newest committed checkpoint — params, optimizer
+  AND data-loader RNG state — and reproduces the uninterrupted run's loss
+  trajectory **bit-exactly** (CPU; guard against silent data replay/skip).
+- **SIGTERM** (cooperative preemption): the supervisor checkpoints at the
+  next step boundary and exits 0 within the grace period.
+- **hang** (wedged step, injected via ``HIVED_FAULT_HANG_AT``): the
+  watchdog records ``hived_stall.json`` and exits ``EXIT_STALLED`` so the
+  gang restarts instead of wedging forever.
+
+Every fault decision is drawn from one ``random.Random(seed)``, so a seed
+replays the same episode plan forever — the same pin-the-seed policy as the
+scheduler soak (``tools/check_workload_seeds.py`` mirrors
+``tools/check_chaos_seeds.py``).
+
+All subprocesses run CPU-only with the CLAUDE.md env recipe
+(``PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu``): a process holding the
+single-grant TPU tunnel must NEVER be killed — which is exactly what this
+harness does for a living. ``HIVED_FAULT_STEP_DELAY`` paces the tiny model's
+steps so signals land inside the training window deterministically enough
+to matter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from hivedscheduler_tpu.parallel import supervisor as sup_lib
+
+log = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the fault ladder the seeded plan draws from (NaN/divergence rollback is
+# exercised by its own deterministic test: it legitimately changes the
+# data stream, so it cannot share the bit-exactness assertion)
+EPISODE_KINDS = ("sigkill", "sigterm", "hang")
+
+
+@dataclasses.dataclass
+class WorkloadFaultPlan:
+    """Seeded episode plan: how many times to interrupt the run, and the
+    step window faults may land in. Steps are drawn in
+    ``[min_step, steps - 2]`` so a checkpoint can exist before the first
+    fault and at least one step remains after the last."""
+
+    episodes: int = 2
+    min_step: int = 3
+    kinds: Tuple[str, ...] = EPISODE_KINDS
+
+    def draw(self, rng: random.Random, steps: int) -> List[Tuple[str, int]]:
+        hi = max(self.min_step, steps - 2)
+        return [(rng.choice(list(self.kinds)), rng.randint(self.min_step, hi))
+                for _ in range(self.episodes)]
+
+
+def cpu_only_env(**extra: str) -> Dict[str, str]:
+    """The CLAUDE.md subprocess recipe: never let a killable child touch
+    the axon TPU backend (single-grant tunnel)."""
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    # never inherit a caller's armed fault hooks
+    for k in list(env):
+        if k.startswith("HIVED_FAULT_"):
+            del env[k]
+    env.update(extra)
+    return env
+
+
+def read_timeline(path: str) -> Dict[int, float]:
+    """step -> loss from a ``train --timeline`` JSONL (empty if absent)."""
+    out: Dict[int, float] = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn final line of a killed incarnation
+                out[rec["step"]] = rec["loss"]
+    except OSError:
+        pass
+    return out
+
+
+class WorkloadChaosHarness:
+    """Drive one seeded episode plan over a tiny CPU-only training run.
+
+    ``run()`` executes the plan — each episode launches an incarnation of
+    ``python -m hivedscheduler_tpu.train`` against a shared checkpoint
+    directory, injects its fault, and asserts the per-fault exit contract —
+    then a final incarnation runs to completion and the merged trajectory
+    is compared bit-for-bit against an uninterrupted reference run.
+    Violations are collected (not raised) and returned in a deterministic
+    report dict, mirroring ``chaos.harness.ChaosHarness.run``.
+    """
+
+    def __init__(self, seed: int, workdir: str, *, steps: int = 8,
+                 checkpoint_every: int = 2,
+                 plan: Optional[WorkloadFaultPlan] = None,
+                 step_delay_s: float = 0.25, watchdog_secs: float = 2.0,
+                 grace_secs: float = 30.0, run_timeout_s: float = 240.0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.workdir = workdir
+        self.steps = steps
+        self.checkpoint_every = checkpoint_every
+        self.plan = plan or WorkloadFaultPlan()
+        self.episodes = self.plan.draw(self.rng, steps)
+        self.step_delay_s = step_delay_s
+        self.watchdog_secs = watchdog_secs
+        self.grace_secs = grace_secs
+        self.run_timeout_s = run_timeout_s
+        self.violations: List[str] = []
+
+    # -- building blocks ---------------------------------------------------
+    def train_cmd(self, ckpt_dir: str, timeline: str,
+                  steps: Optional[int] = None) -> List[str]:
+        return [
+            sys.executable, "-m", "hivedscheduler_tpu.train",
+            "--steps", str(steps if steps is not None else self.steps),
+            "--batch", "2", "--seq-len", "16", "--vocab-size", "64",
+            "--d-model", "16", "--n-layers", "1", "--n-heads", "2",
+            "--d-ff", "32", "--log-every", "100",
+            "--checkpoint-dir", ckpt_dir,
+            "--checkpoint-every", str(self.checkpoint_every),
+            "--timeline", timeline,
+            "--grace-secs", str(self.grace_secs),
+            "--watchdog-secs", str(self.watchdog_secs),
+        ]
+
+    def _wait_for_step(self, proc: subprocess.Popen, timeline: str,
+                       step: int) -> bool:
+        """Poll the incarnation's timeline until ``step`` is recorded (True)
+        or the process exits first (False)."""
+        deadline = time.monotonic() + self.run_timeout_s
+        while time.monotonic() < deadline:
+            if read_timeline(timeline).get(step) is not None:
+                return True
+            if proc.poll() is not None:
+                return False
+            time.sleep(0.02)
+        return False
+
+    def _wait(self, proc: subprocess.Popen, what: str) -> Optional[int]:
+        try:
+            proc.wait(timeout=self.run_timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            self.violations.append(f"{what}: incarnation did not exit within "
+                                   f"{self.run_timeout_s}s")
+            return None
+        return proc.returncode
+
+    def reference_run(self) -> Dict[int, float]:
+        """The uninterrupted ground-truth trajectory (own checkpoint dir)."""
+        ck = os.path.join(self.workdir, "ref-ck")
+        tl = os.path.join(self.workdir, "ref-timeline.jsonl")
+        proc = subprocess.Popen(
+            self.train_cmd(ck, tl), cwd=_REPO_ROOT, env=cpu_only_env(),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        rc = self._wait(proc, "reference")
+        if rc != 0:
+            self.violations.append(f"reference run exited {rc}")
+        return read_timeline(tl)
+
+    # -- the soak ----------------------------------------------------------
+    def run(self) -> dict:
+        ck = os.path.join(self.workdir, "soak-ck")
+        timelines: List[str] = []
+        reference = self.reference_run()
+        if len(reference) != self.steps:
+            self.violations.append(
+                f"reference covered {len(reference)}/{self.steps} steps")
+
+        for i, (kind, at_step) in enumerate(self.episodes):
+            tl = os.path.join(self.workdir, f"incarnation-{i}.jsonl")
+            timelines.append(tl)
+            extra = {sup_lib.ENV_FAULT_STEP_DELAY: str(self.step_delay_s)}
+            if kind == "hang":
+                extra[sup_lib.ENV_FAULT_HANG_AT] = str(at_step)
+            proc = subprocess.Popen(
+                self.train_cmd(ck, tl), cwd=_REPO_ROOT,
+                env=cpu_only_env(**extra),
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            if kind == "sigkill":
+                if self._wait_for_step(proc, tl, at_step):
+                    proc.send_signal(signal.SIGKILL)
+                rc = self._wait(proc, f"episode {i} ({kind}@{at_step})")
+                if rc == 0 and read_timeline(tl).get(self.steps) is None:
+                    self.violations.append(
+                        f"episode {i}: sigkill incarnation exited 0 without "
+                        f"finishing")
+            elif kind == "sigterm":
+                if self._wait_for_step(proc, tl, at_step):
+                    proc.send_signal(signal.SIGTERM)
+                rc = self._wait(proc, f"episode {i} ({kind}@{at_step})")
+                if rc != 0:
+                    self.violations.append(
+                        f"episode {i}: SIGTERM incarnation exited {rc}, "
+                        f"expected a clean checkpoint-and-exit (0)")
+                from hivedscheduler_tpu.parallel import checkpoint as ckpt_lib
+
+                if ckpt_lib.latest_step(ck) is None:
+                    self.violations.append(
+                        f"episode {i}: SIGTERM left no committed checkpoint")
+            else:  # hang
+                rc = self._wait(proc, f"episode {i} ({kind}@{at_step})")
+                if rc != sup_lib.EXIT_STALLED:
+                    self.violations.append(
+                        f"episode {i}: hung incarnation exited {rc}, "
+                        f"expected EXIT_STALLED ({sup_lib.EXIT_STALLED})")
+                if not os.path.exists(
+                        os.path.join(ck, sup_lib.STALL_RECORD)):
+                    self.violations.append(
+                        f"episode {i}: watchdog left no stall record")
+
+        # final incarnation: run to completion
+        tl = os.path.join(self.workdir, "incarnation-final.jsonl")
+        timelines.append(tl)
+        proc = subprocess.Popen(
+            self.train_cmd(ck, tl), cwd=_REPO_ROOT, env=cpu_only_env(),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        rc = self._wait(proc, "final incarnation")
+        if rc != 0:
+            self.violations.append(f"final incarnation exited {rc}")
+
+        # bit-exactness: EVERY step any incarnation ever recorded must match
+        # the uninterrupted reference — replayed steps (between the restored
+        # checkpoint and the kill point) included; a mismatch means the
+        # resume silently changed the data stream or the restored state
+        covered: set = set()
+        for t in timelines:
+            for step, loss in read_timeline(t).items():
+                covered.add(step)
+                ref = reference.get(step)
+                if ref is None:
+                    self.violations.append(
+                        f"{os.path.basename(t)}: step {step} beyond the "
+                        f"reference run")
+                elif loss != ref:
+                    self.violations.append(
+                        f"{os.path.basename(t)}: step {step} loss {loss!r} "
+                        f"!= reference {ref!r} (resume not bit-exact)")
+        missing = set(range(1, self.steps + 1)) - covered
+        if missing:
+            self.violations.append(
+                f"steps never executed by any incarnation: {sorted(missing)}")
+
+        return {
+            "seed": self.seed,
+            "episodes": [list(e) for e in self.episodes],
+            "steps": self.steps,
+            "incarnations": len(self.episodes) + 1,
+            "violations": list(self.violations),
+        }

@@ -53,6 +53,16 @@ def main(argv=None) -> int:
         help="write the Chrome-trace/Perfetto JSON of the run to this path "
         "on shutdown (also served live at GET /v1/inspect/traces/chrome)",
     )
+    parser.add_argument(
+        "--drain-secs",
+        type=float,
+        default=2.0,
+        help="graceful-termination window after SIGTERM/SIGINT: /readyz "
+        "flips to 503 + Retry-After immediately (stop sending work) while "
+        "in-flight extender requests finish for this many seconds, then "
+        "the server stops; /healthz stays green throughout (0 = stop "
+        "immediately)",
+    )
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -107,6 +117,14 @@ def main(argv=None) -> int:
     log.info("tpu-hive ready on %s:%s", host, port)
     stop = common.new_stop_event()
     stop.wait()
+    # graceful termination: readiness flips first (load balancer / probes
+    # stop routing new work), in-flight requests get the drain window,
+    # liveness stays green — then the listener closes
+    if args.drain_secs > 0:
+        import time
+
+        server.begin_drain(retry_after_s=max(1, int(args.drain_secs)))
+        time.sleep(args.drain_secs)
     server.stop()
     if args.trace_file:
         obs_trace.write_chrome_trace(args.trace_file)

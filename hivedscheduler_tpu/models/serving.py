@@ -305,6 +305,13 @@ def advance_ragged(
                                k_scale=new_ks, v_scale=new_vs)
 
 
+class EngineDraining(RuntimeError):
+    """Raised by ``submit()`` once ``begin_drain()`` was called: the engine
+    finishes in-flight work but admits nothing new. The serving front-end
+    maps this to HTTP 503 + ``Retry-After`` (the preempted-replica
+    admission contract; see doc/design/fault-model.md)."""
+
+
 @dataclasses.dataclass
 class Request:
     """One serving request; ``tokens_out`` fills as the engine runs."""
@@ -315,8 +322,10 @@ class Request:
     tokens_out: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
     # why the request finished: "eos" (stop token), "length" (budget
-    # exhausted), or "shed" (queue-wait deadline exceeded before admission —
-    # the request never ran; tokens_out is empty)
+    # exhausted), "shed" (queue-wait deadline exceeded before admission —
+    # the request never ran; tokens_out is empty), or "preempted" (the
+    # engine's drain deadline expired before this request finished; its
+    # stream is truncated at whatever was emitted)
     finish_reason: Optional[str] = None
     # admission priority: higher jumps the queue (FIFO within a level) —
     # the engine-level analogue of the scheduler's guaranteed-vs-
@@ -503,6 +512,7 @@ class ServingEngine:
             self._token_sharding = NamedSharding(mesh, P(row))
         self.mesh = mesh
         self.queue: List[Request] = []
+        self.draining = False  # see begin_drain()
         self._next_rid = 0
         self.steps = 0  # decode steps executed (for occupancy stats)
         self.slot_steps = 0  # sum of active slots over decode steps
@@ -599,6 +609,12 @@ class ServingEngine:
         high-priority request inserts ahead of them). If bounded wait
         matters, cap the high-priority offered load or re-submit aged
         requests at a boosted priority — see ``Request.priority``."""
+        if self.draining:
+            metrics.inc("tpu_hive_serve_drain_rejected_total")
+            raise EngineDraining(
+                "engine is draining (preemption requested): new requests "
+                "are rejected — retry on another replica"
+            )
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -947,6 +963,50 @@ class ServingEngine:
             if not self.step():
                 return
         raise RuntimeError(f"serving did not drain in {max_steps} steps")
+
+    # -- graceful preemption (work-preserving drain) -----------------------
+    def begin_drain(self) -> None:
+        """Flip admission off: every later ``submit()`` raises
+        :class:`EngineDraining` (counted in
+        ``tpu_hive_serve_drain_rejected_total``; the HTTP front-end's 503 +
+        ``Retry-After``). Requests already in the system — queued waiters
+        and decoding slots — are in-flight and keep running; use
+        :meth:`drain` to finish them under a deadline."""
+        if not self.draining:
+            self.draining = True
+
+    def drain(self, deadline_s: Optional[float] = None,
+              max_steps: int = 100_000) -> bool:
+        """Finish all in-flight work, bounded by ``deadline_s``.
+
+        Calls :meth:`begin_drain` then steps the engine until nothing is
+        queued or active. Returns True when fully drained; when the
+        deadline expires first, every still-unfinished request is finalized
+        with ``finish_reason="preempted"`` (its stream truncated at what
+        was emitted) and the engine state is cleared — the bounded-exit
+        guarantee a preempting scheduler needs (SIGTERM must not wait on an
+        unbounded decode tail)."""
+        self.begin_drain()
+        t0 = self._clock()
+        steps = 0
+        while self.step():
+            steps += 1
+            expired = (deadline_s is not None
+                       and self._clock() - t0 > deadline_s)
+            if expired or steps >= max_steps:
+                now = self._clock()
+                leftovers = list(self.queue) + [
+                    r for r in self.slots if r is not None
+                ]
+                for req in leftovers:
+                    req.done = True
+                    req.done_at = now
+                    req.finish_reason = "preempted"
+                self.queue.clear()
+                self.slots = [None] * self.max_batch
+                self._prefilling.clear()
+                return False
+        return True
 
     @property
     def occupancy(self) -> float:

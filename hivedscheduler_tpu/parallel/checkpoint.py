@@ -99,10 +99,17 @@ def _committed_steps(directory: str) -> Optional[List[int]]:
     return sorted(steps, reverse=True)
 
 
-def save(directory: str, step: int, params: Any, opt_state: Any) -> None:
+def save(directory: str, step: int, params: Any, opt_state: Any,
+         extra: Optional[dict] = None) -> None:
     """Save one checkpoint (blocking). Arrays keep their shardings. The
     step is committed — visible to ``latest_step``/``restore`` — only once
-    its completion marker is atomically in place."""
+    its completion marker is atomically in place.
+
+    ``extra``: JSON-serializable sidecar state of record (data-loader RNG
+    position, supervisor bookkeeping) stored INSIDE the commit marker, so
+    it commits atomically with the step — a resume can never see arrays
+    from one save paired with loader state from another. Read it back with
+    :func:`read_metadata`."""
     import orbax.checkpoint as ocp
 
     mgr = _manager(directory, create=True)
@@ -112,10 +119,29 @@ def save(directory: str, step: int, params: Any, opt_state: Any) -> None:
     ))
     mgr.wait_until_finished()
     mgr.close()
+    marker = {"step": step, "format": "orbax-composite-v1"}
+    if extra:
+        marker["extra"] = extra
     atomic_write_bytes(
-        _marker_path(directory, step),
-        json.dumps({"step": step, "format": "orbax-composite-v1"}).encode(),
+        _marker_path(directory, step), json.dumps(marker).encode(),
     )
+
+
+def read_metadata(directory: str, step: Optional[int] = None) -> dict:
+    """The commit marker's sidecar dict for ``step`` (default: the newest
+    committed step). ``{}`` for legacy markers without ``extra``, steps
+    without a marker, or unreadable markers — metadata is best-effort by
+    contract; the arrays are the source of truth."""
+    if step is None:
+        committed = _committed_steps(directory)
+        if not committed:
+            return {}
+        step = committed[0]
+    try:
+        with open(_marker_path(directory, step)) as f:
+            return json.load(f).get("extra", {}) or {}
+    except (OSError, ValueError):
+        return {}
 
 
 def latest_step(directory: str) -> Optional[int]:

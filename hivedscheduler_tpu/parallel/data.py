@@ -10,7 +10,8 @@ the standard packed-LM format) or the deterministic synthetic corpus used by
 
 from __future__ import annotations
 
-from typing import Iterator
+import dataclasses
+from typing import Iterator, Optional
 
 import numpy as np
 
@@ -86,16 +87,142 @@ def host_batches(
         step += 1
 
 
-def prefetch(batches: Iterator[np.ndarray], depth: int = 2) -> Iterator[np.ndarray]:
+@dataclasses.dataclass
+class LoaderState:
+    """Checkpointable data-loader state of record.
+
+    ``seed`` seeds the stream; ``step`` is the number of batches already
+    emitted (the stream position); ``epoch`` counts full passes of the
+    corpus token count (derived — kept explicit so operators can read it
+    out of the checkpoint marker); ``bitgen`` is the numpy bit-generator
+    state dict of the persistent stream RNG (JSON-serializable: PCG64 state
+    is plain ints/strings). Checkpointing this alongside params/opt_state
+    is what makes a preempted run resume the EXACT uninterrupted data
+    stream instead of silently replaying or skipping data.
+
+    Serialization is the canonical dataclass mapping
+    (``dataclasses.asdict``) — never hand-roll a field list here; the guard
+    test pins ``to_dict()`` keys to the dataclass fields (CLAUDE.md
+    recurring blind spot)."""
+
+    seed: int = 0
+    step: int = 0
+    epoch: int = 0
+    bitgen: Optional[dict] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LoaderState":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - fields
+        if unknown:
+            raise ValueError(f"unknown LoaderState fields: {sorted(unknown)}")
+        return cls(**d)
+
+
+class CheckpointableBatches:
+    """Stateful host-batch stream with exact-resume checkpointing.
+
+    Same multi-host contract as :func:`host_batches` (every host draws the
+    full batch's start positions from an identical RNG stream and gathers
+    only its own rows), but the RNG is ONE persistent generator advanced
+    per step instead of being re-derived from (seed, step) — its
+    bit-generator state is therefore load-bearing, and :meth:`to_dict` /
+    :meth:`from_dict` carry (seed, step, epoch, bitgen) through the
+    checkpoint so a killed-and-restarted incarnation reproduces the
+    uninterrupted stream bit-exactly (guard:
+    tests/test_data.py::test_checkpointable_batches_resume_bit_exact).
+
+    ``skip(n)`` advances the stream WITHOUT materializing batches — the
+    divergence-rollback path uses it to jump over a poisoned batch, and
+    legacy (pre-loader-state) checkpoints use it to fast-forward to their
+    step counter."""
+
+    def __init__(self, dataset: TokenFileDataset, global_batch: int,
+                 seq_len: int, *, process_index: int = 0,
+                 process_count: int = 1, seed: int = 0,
+                 state: Optional[LoaderState] = None):
+        if global_batch % process_count != 0:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by "
+                f"{process_count} hosts"
+            )
+        self.dataset = dataset
+        self.global_batch = global_batch
+        self.seq_len = seq_len
+        local = global_batch // process_count
+        self._rows = slice(process_index * local, (process_index + 1) * local)
+        if state is None:
+            state = LoaderState(seed=seed)
+        self._state = state
+        self._rng = np.random.default_rng(state.seed)
+        if state.bitgen is not None:
+            self._rng.bit_generator.state = state.bitgen
+
+    @property
+    def step(self) -> int:
+        return self._state.step
+
+    @property
+    def epoch(self) -> int:
+        # full passes of the corpus, by token count consumed
+        return (self._state.step * self.global_batch * self.seq_len
+                // len(self.dataset))
+
+    def state(self) -> LoaderState:
+        """Snapshot the CURRENT state of record (bitgen refreshed)."""
+        return LoaderState(seed=self._state.seed, step=self._state.step,
+                           epoch=self.epoch,
+                           bitgen=self._rng.bit_generator.state)
+
+    def to_dict(self) -> dict:
+        return self.state().to_dict()
+
+    @classmethod
+    def from_dict(cls, d: dict, dataset: TokenFileDataset, global_batch: int,
+                  seq_len: int, *, process_index: int = 0,
+                  process_count: int = 1) -> "CheckpointableBatches":
+        return cls(dataset, global_batch, seq_len,
+                   process_index=process_index, process_count=process_count,
+                   state=LoaderState.from_dict(d))
+
+    def __iter__(self) -> "CheckpointableBatches":
+        return self
+
+    def __next__(self) -> np.ndarray:
+        batch = self.dataset.sample(self._rng, self.global_batch,
+                                    self.seq_len, row_slice=self._rows)
+        self._state.step += 1
+        return batch
+
+    def skip(self, n: int = 1) -> None:
+        """Advance the stream ``n`` batches without gathering tokens. MUST
+        consume exactly the draws :meth:`__next__` would (one full-batch
+        ``integers`` draw per step — mirrors ``TokenFileDataset.sample``;
+        guard: test_checkpointable_batches_skip_matches_next)."""
+        for _ in range(n):
+            self._rng.integers(0, len(self.dataset), size=self.global_batch)
+            self._state.step += 1
+
+
+def prefetch(batches: Iterator[np.ndarray], depth: int = 2,
+             stop=None) -> Iterator[np.ndarray]:
     """Background-thread prefetch: batch N+1 assembles (page faults + the
     native gather, which releases the GIL) while step N computes. ``depth``
     bounds the queue so a fast producer cannot run ahead unbounded;
     ``depth <= 0`` is a no-op passthrough. A producer exception is
     re-raised at the consumer's next pull. Abandoning the iterator early
     (generator close / GC — e.g. the train CLI exiting after --steps)
-    signals the worker, which exits within one poll slice instead of
-    blocking forever on the bounded queue and leaking the thread plus its
-    staged batches for the process lifetime."""
+    signals the worker, DRAINS the staged batches so a producer blocked on
+    the bounded queue unblocks immediately, and joins the thread briefly —
+    no deadlock, no leaked thread holding staged batches for the process
+    lifetime. ``stop`` (a ``threading.Event``, e.g. the supervisor's
+    preemption event) additionally wakes a consumer that is BLOCKED waiting
+    on a hung producer: without it, a SIGTERM arriving while ``next()``
+    waits on a wedged data source could never reach the step boundary and
+    the grace period would force-exit instead of checkpointing."""
     if depth <= 0:
         yield from batches
         return
@@ -103,7 +230,7 @@ def prefetch(batches: Iterator[np.ndarray], depth: int = 2) -> Iterator[np.ndarr
     import threading
 
     q: "queue.Queue" = queue.Queue(maxsize=depth)
-    stop = object()
+    done = object()
     closed = threading.Event()
 
     def put(item) -> bool:
@@ -120,7 +247,7 @@ def prefetch(batches: Iterator[np.ndarray], depth: int = 2) -> Iterator[np.ndarr
             for b in batches:
                 if not put(b):
                     return
-            put(stop)
+            put(done)
         except BaseException as e:  # surface in the consumer, not the log
             put(e)
 
@@ -128,14 +255,30 @@ def prefetch(batches: Iterator[np.ndarray], depth: int = 2) -> Iterator[np.ndarr
     thread.start()
     try:
         while True:
-            item = q.get()
-            if item is stop:
+            try:
+                item = q.get(timeout=0.1)
+            except queue.Empty:
+                if stop is not None and stop.is_set():
+                    return  # supervisor abort: wake from a hung producer
+                continue
+            if item is done:
                 return
             if isinstance(item, BaseException):
                 raise item
             yield item
+            if stop is not None and stop.is_set():
+                return
     finally:
         closed.set()
+        # drain staged batches so a worker mid-put() unblocks NOW (not
+        # after its 0.1 s poll), then reap the thread — a supervisor abort
+        # must leave no worker alive racing the checkpoint-and-exit path
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        thread.join(timeout=2.0)
 
 
 def device_put_global(local_batch: np.ndarray, sharding, global_batch: int):

@@ -8,9 +8,13 @@ from jax import lax
 def varying(x, mesh_axes):
     """Seed device-varying state on fresh arrays so they can sit in loop
     carries with ppermuted data (shard_map vma rules). Handles the
-    pcast/pvary API rename across JAX versions."""
+    pcast/pvary API rename across JAX versions; on pre-vma JAX (no pcast
+    AND no pvary — e.g. 0.4.x) shard_map does not track varying manual
+    axes at all, so there is nothing to seed and the array passes through."""
     if not mesh_axes:
         return x
     if hasattr(lax, "pcast"):
         return lax.pcast(x, tuple(mesh_axes), to="varying")
-    return lax.pvary(x, tuple(mesh_axes))
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, tuple(mesh_axes))
+    return x

@@ -157,6 +157,7 @@ def make_sharded_train_step(
     optimizer: Optional[optax.GradientTransformation] = None,
     grad_accum: int = 1,
     ce_chunk: int = 0,
+    skip_nonfinite: bool = False,
 ):
     """Returns (jitted_step, init_fn, token_sharding).
 
@@ -165,7 +166,14 @@ def make_sharded_train_step(
     with donated carries; ``token_sharding`` is the [dp(+fsdp), sp]
     NamedSharding to device_put batches with. ``grad_accum`` splits each
     batch into that many gradient-accumulation slices (see train_step).
-    """
+
+    ``skip_nonfinite``: gate the update inside the jitted step — when the
+    loss comes out non-finite, params and opt_state pass through UNCHANGED
+    (the update, including the optimizer step count, is dropped), so one
+    poisoned batch cannot NaN the whole state. The returned loss still
+    reports the non-finite value for the caller's divergence accounting
+    (the ``train --on-nan skip`` policy; no extra sync — the gate is a
+    ``jnp.where`` on the donated carries)."""
     optimizer = optimizer or make_optimizer()
     param_shardings, token_sharding = _shardings(cfg, mesh)
 
@@ -198,8 +206,16 @@ def make_sharded_train_step(
         return params, opt_state
 
     def step(params, opt_state, tokens):
-        return train_step(params, opt_state, tokens, cfg, optimizer, mesh,
-                          grad_accum=grad_accum, ce_chunk=ce_chunk)
+        new_params, new_opt, loss = train_step(
+            params, opt_state, tokens, cfg, optimizer, mesh,
+            grad_accum=grad_accum, ce_chunk=ce_chunk)
+        if skip_nonfinite:
+            ok = jnp.isfinite(loss)
+            new_params = jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o), new_params, params)
+            new_opt = jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o), new_opt, opt_state)
+        return new_params, new_opt, loss
 
     jitted = jax.jit(step, donate_argnums=(0, 1))
     return jitted, init_fn, token_sharding

@@ -143,3 +143,17 @@ REGISTRY.describe("tpu_hive_serve_requests_total",
 REGISTRY.describe("tpu_hive_serve_shed_total",
                   "Serving requests shed on queue-wait deadline by priority "
                   "class")
+REGISTRY.describe("tpu_hive_serve_drain_rejected_total",
+                  "Serving requests rejected at submit because the engine "
+                  "is draining (preemption; the 503 + Retry-After path)")
+# workload supervisor (parallel/supervisor.py + the train CLI): the
+# preemption-tolerance surface of the training loop
+REGISTRY.describe("tpu_hive_train_resumes_total",
+                  "Training incarnations that resumed from a committed "
+                  "checkpoint (preemption/crash restarts)")
+REGISTRY.describe("tpu_hive_train_rollbacks_total",
+                  "Divergence-guard rollbacks to the last good checkpoint "
+                  "(non-finite or spiking loss)")
+REGISTRY.describe("tpu_hive_watchdog_stalls_total",
+                  "Watchdog step-deadline expiries (hung step; the process "
+                  "exits nonzero so the gang restarts)")

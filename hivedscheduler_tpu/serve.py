@@ -98,6 +98,13 @@ def main(argv=None) -> int:
     parser.add_argument("--lora-alpha", type=float, default=16.0)
     parser.add_argument("--lora-mlp", action="store_true",
                         help="the checkpoint carries MLP adapters too")
+    parser.add_argument("--drain-deadline", type=float, default=10.0,
+                        help="graceful preemption: on SIGTERM/SIGINT stop "
+                        "admitting (pending synthetic arrivals are rejected "
+                        "through the engine's draining guard — the 503 + "
+                        "Retry-After path), finish in-flight decodes for up "
+                        "to this many seconds, then exit; expired in-flight "
+                        "requests finish with finish_reason=preempted")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--metrics-dump", default="",
                         help="after the run, write the Prometheus exposition "
@@ -216,21 +223,52 @@ def main(argv=None) -> int:
         hp = args.high_priority_every
         return 10 if hp > 0 and (i + 1) % hp == 0 else 0
 
+    from hivedscheduler_tpu.parallel import supervisor as sup_lib
+
+    # graceful preemption: SIGTERM/SIGINT request a drain instead of dying
+    # mid-decode (the workload side of HiveD's work-preserving preemption);
+    # HIVED_FAULT_SERVE_PREEMPT_AT triggers the same path deterministically
+    # for the chaos/fault-ladder tests
+    listener = sup_lib.PreemptionListener().install()
+    faults = sup_lib.FaultInjection.from_env()
     reqs = []
+    rejected = 0
+    drained = True
     t0 = time.perf_counter()
     steps = 0
-    if args.arrival_every == 0:  # all up front
-        while pending:
-            prompt, budget = pending.pop(0)
-            reqs.append(eng.submit(prompt, budget, priority=prio_of(len(reqs))))
-    while pending or (reqs and not all(r.done for r in reqs)):
-        if pending and steps % args.arrival_every == 0:
-            prompt, budget = pending.pop(0)
-            reqs.append(eng.submit(prompt, budget, priority=prio_of(len(reqs))))
-            log.info("admitted request %s (prompt %s, budget %s, prio %s)",
-                     reqs[-1].rid, len(prompt), budget, reqs[-1].priority)
-        eng.step()
-        steps += 1
+    try:
+        if args.arrival_every == 0:  # all up front
+            while pending:
+                prompt, budget = pending.pop(0)
+                reqs.append(eng.submit(prompt, budget,
+                                       priority=prio_of(len(reqs))))
+        while pending or (reqs and not all(r.done for r in reqs)):
+            if faults.take_serve_preempt(steps):
+                listener.trigger()
+            if listener.requested:
+                break
+            if pending and steps % args.arrival_every == 0:
+                prompt, budget = pending.pop(0)
+                reqs.append(eng.submit(prompt, budget,
+                                       priority=prio_of(len(reqs))))
+                log.info("admitted request %s (prompt %s, budget %s, prio %s)",
+                         reqs[-1].rid, len(prompt), budget, reqs[-1].priority)
+            eng.step()
+            steps += 1
+        if listener.requested:
+            # drain: admission off first (503 + Retry-After analogue for the
+            # not-yet-submitted synthetic arrivals), then finish in-flight
+            # decodes bounded by the deadline
+            eng.begin_drain()
+            for prompt, budget in pending:
+                try:
+                    eng.submit(prompt, budget)
+                except serving.EngineDraining:
+                    rejected += 1
+            pending.clear()
+            drained = eng.drain(args.drain_deadline)
+    finally:
+        listener.uninstall()
     dt = time.perf_counter() - t0
 
     total_tokens = sum(len(r.tokens_out) for r in reqs)
@@ -261,6 +299,17 @@ def main(argv=None) -> int:
         log.info("shed %s request(s) on the %.1fs queue-wait deadline: %s",
                  len(shed), args.queue_timeout,
                  " ".join(str(r.rid) for r in shed))
+    if listener.requested:
+        preempted = [r for r in reqs if r.finish_reason == "preempted"]
+        log.info(
+            "preemption drain: rejected %s not-yet-admitted arrival(s) "
+            "(503 + Retry-After path), %s in-flight finished, %s preempted "
+            "at the %.1fs deadline (%s)",
+            rejected, sum(1 for r in reqs if r.done and r.finish_reason
+                          in ("eos", "length")),
+            len(preempted), args.drain_deadline,
+            "fully drained" if drained else "deadline expired",
+        )
     if args.draft_layers > 0:
         log.info("speculation: %s/%s draft tokens accepted (%.0f%%)",
                  eng.accepted, eng.drafted, 100.0 * eng.acceptance)

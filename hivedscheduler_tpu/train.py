@@ -105,12 +105,47 @@ def main(argv=None) -> int:
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=50)
     parser.add_argument("--log-every", type=int, default=10)
+    parser.add_argument("--data-seed", type=int, default=0,
+                        help="data-loader stream seed (the loader's RNG "
+                        "state joins every checkpoint, so a preempted run "
+                        "resumes the exact uninterrupted stream)")
+    parser.add_argument("--grace-secs", type=float, default=30.0,
+                        help="preemption grace period: SIGTERM/SIGINT "
+                        "request checkpoint-and-exit at the next step "
+                        "boundary; a shutdown still wedged after this many "
+                        "seconds is force-exited (uncommitted checkpoint "
+                        "steps stay invisible to restore)")
+    parser.add_argument("--watchdog-secs", type=float, default=0.0,
+                        help="per-step hang deadline (0 = off): a step "
+                        "exceeding it records hived_stall.json in the "
+                        "checkpoint dir and exits nonzero so the gang "
+                        "restarts instead of wedging (first step gets 10x "
+                        "for compile)")
+    parser.add_argument("--on-nan", choices=("halt", "rollback", "skip"),
+                        default="halt",
+                        help="divergence policy for a non-finite loss (or "
+                        "spike, see --loss-spike-factor): halt = exit "
+                        "nonzero with the last good checkpoint intact; "
+                        "rollback = restore the newest committed checkpoint "
+                        "and skip past the poisoned batch; skip = drop the "
+                        "update inside the jitted step (params/opt_state "
+                        "pass through) and continue")
+    parser.add_argument("--loss-spike-factor", type=float, default=0.0,
+                        help="also treat loss > FACTOR x its EMA as "
+                        "divergence (0 = non-finite only; applies to the "
+                        "halt/rollback policies)")
+    parser.add_argument("--max-rollbacks", type=int, default=3,
+                        help="divergence rollback budget before halting "
+                        "(--on-nan rollback)")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
     if args.pp > 1 and args.microbatches <= 0:
         parser.error("--pp > 1 requires --microbatches")
     if args.microbatches > 0 and args.pp <= 1:
         parser.error("--microbatches requires --pp > 1")
+    if args.on_nan == "skip" and args.lora_rank > 0:
+        parser.error("--on-nan skip gates the full train step; with "
+                     "--lora-rank use rollback or halt")
 
     from hivedscheduler_tpu.common import utils as common
 
@@ -169,7 +204,8 @@ def main(argv=None) -> int:
         params = tm.combine_lora_params(base_params, lora_params)
     else:
         step_fn, init_fn, token_sharding = make_sharded_train_step(
-            cfg, mesh, grad_accum=args.grad_accum, ce_chunk=args.ce_chunk
+            cfg, mesh, grad_accum=args.grad_accum, ce_chunk=args.ce_chunk,
+            skip_nonfinite=args.on_nan == "skip",
         )
         params, opt_state = init_fn(jax.random.PRNGKey(0))
 
@@ -183,16 +219,30 @@ def main(argv=None) -> int:
             _, params = ckpt.restore_params(args.init_from, params)
         log.info("warm-started params from %s", args.init_from)
 
+    import math
+
+    from hivedscheduler_tpu.parallel import supervisor as sup_lib
+    from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
+
+    def restore_state(params_t, opt_t):
+        """Restore the newest committed checkpoint into the given templates;
+        returns (step, params, opt_state, loader_metadata)."""
+        step_no, p, o = ckpt.restore(args.checkpoint_dir, params_t, opt_t)
+        meta = ckpt.read_metadata(args.checkpoint_dir, step_no)
+        return step_no, p, o, meta
+
     # resume if this gang incarnation has a previous checkpoint
     start_step = 0
+    resume_meta: dict = {}
     if args.checkpoint_dir:
         last = ckpt.latest_step(args.checkpoint_dir)
         if last is not None:
-            start_step, params, opt_state = ckpt.restore(
-                args.checkpoint_dir, params, opt_state
+            start_step, params, opt_state, resume_meta = restore_state(
+                params, opt_state
             )
             if lora_mode:
                 base_params, lora_params = tm.split_lora_params(params)
+            metrics.inc("tpu_hive_train_resumes_total")
             log.info("resumed from checkpoint step %s", start_step)
 
     from hivedscheduler_tpu.parallel import data as data_lib
@@ -207,22 +257,56 @@ def main(argv=None) -> int:
             )
     else:
         dataset = data_lib.synthetic_dataset(cfg.vocab_size)
-    batches = data_lib.prefetch(
-        data_lib.host_batches(
+
+    def make_loader(loader_dict, fast_forward_to):
+        """The checkpointable batch stream: restored from checkpoint
+        metadata when present, else fresh (fast-forwarded for legacy
+        checkpoints that predate loader-state-of-record)."""
+        if loader_dict:
+            return data_lib.CheckpointableBatches.from_dict(
+                loader_dict, dataset, args.batch, args.seq_len,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+            )
+        loader = data_lib.CheckpointableBatches(
             dataset, args.batch, args.seq_len,
             process_index=jax.process_index(),
             process_count=jax.process_count(),
-            start_step=start_step,
-        ),
-        depth=args.prefetch,
+            seed=args.data_seed,
+        )
+        if fast_forward_to:
+            loader.skip(fast_forward_to)
+        return loader
+
+    # data positions the divergence guard decided to skip (rollback policy)
+    skip_positions: set = set()
+
+    def stream(loader):
+        """Yield (host_batch, loader_state_snapshot): the snapshot rides
+        along so checkpoints commit the loader position of the NEXT
+        unconsumed batch even while prefetch reads ahead."""
+        while True:
+            while loader.step in skip_positions:
+                log.warning("skipping poisoned data position %s", loader.step)
+                loader.skip(1)
+            batch = next(loader)
+            yield batch, loader.to_dict()
+
+    sup = sup_lib.Supervisor(
+        grace_secs=args.grace_secs, watchdog_secs=args.watchdog_secs,
+        spike_factor=args.loss_spike_factor,
+        max_rollbacks=args.max_rollbacks, record_dir=args.checkpoint_dir,
     )
+    loader = make_loader(resume_meta.get("loader"), start_step)
+    loader_snap = loader.to_dict()
+
     t0 = time.perf_counter()
     tokens_per_step = args.batch * args.seq_len
     profiling = False
     timeline = open(args.timeline, "w") if args.timeline else None
-    if timeline is not None:
-        import json
+    import json
 
+    if timeline is not None:
         from hivedscheduler_tpu.obs import trace as obs_trace
     if args.profile_dir and args.steps - start_step < 2:
         log.warning(
@@ -230,68 +314,156 @@ def main(argv=None) -> int:
             "compile); %s step(s) will run — no trace will be written",
             args.steps - start_step,
         )
-    for step in range(start_step, args.steps):
-        if args.profile_dir:
-            # trace steps 2..4 of this incarnation: past compile, short
-            # enough that the Perfetto UI stays responsive
-            rel = step - start_step
-            if rel == 1 and not profiling:
-                jax.profiler.start_trace(args.profile_dir)
-                profiling = True
-                log.info("profiler trace started -> %s", args.profile_dir)
-            elif rel == 4 and profiling:
-                jax.block_until_ready(loss)
-                jax.profiler.stop_trace()
-                profiling = False
-                log.info("profiler trace written to %s", args.profile_dir)
-        step_t0 = time.perf_counter()
-        tokens = data_lib.device_put_global(
-            next(batches), token_sharding, args.batch
-        )
-        if lora_mode:
-            lora_params, opt_state, loss = step_fn(
-                base_params, lora_params, opt_state, tokens
+
+    def save_checkpoint(step_no):
+        if not args.checkpoint_dir:
+            return
+        if ckpt.latest_step(args.checkpoint_dir) == step_no:
+            return  # already committed (e.g. preempted right after a save)
+        ckpt.save(args.checkpoint_dir, step_no, params, opt_state,
+                  extra={"loader": loader_snap})
+
+    preempted = False
+    diverged = None
+    step = start_step
+    loss = None
+    with sup:
+        batches = data_lib.prefetch(stream(loader), depth=args.prefetch,
+                                    stop=sup.preemption.event)
+        while step < args.steps:
+            if sup.preempt_requested:
+                preempted = True
+                break
+            sup.heartbeat(step)
+            # chaos hooks (inert unless HIVED_FAULT_* env vars arm them)
+            sup.faults.pace()
+            sup.faults.maybe_hang(step + 1)
+            if sup.faults.take_nan(step + 1):
+                nan = float("nan")
+                if lora_mode:
+                    lora_params = jax.tree.map(lambda x: x * nan, lora_params)
+                else:
+                    params = jax.tree.map(lambda x: x * nan, params)
+            if args.profile_dir:
+                # trace steps 2..4 of this incarnation: past compile, short
+                # enough that the Perfetto UI stays responsive
+                rel = step - start_step
+                if rel == 1 and not profiling:
+                    jax.profiler.start_trace(args.profile_dir)
+                    profiling = True
+                    log.info("profiler trace started -> %s", args.profile_dir)
+                elif rel == 4 and profiling:
+                    jax.block_until_ready(loss)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    log.info("profiler trace written to %s", args.profile_dir)
+            step_t0 = time.perf_counter()
+            try:
+                local_batch, snap = next(batches)
+            except StopIteration:
+                # the preemption event woke a consumer blocked on data
+                preempted = True
+                break
+            tokens = data_lib.device_put_global(
+                local_batch, token_sharding, args.batch
             )
-            params = tm.combine_lora_params(base_params, lora_params)
-        else:
-            params, opt_state, loss = step_fn(params, opt_state, tokens)
-        if timeline is not None:
-            # sync so wall covers the whole step (data + dispatch + compute);
-            # the first step of an incarnation includes compilation
+            if lora_mode:
+                lora_params, opt_state, loss = step_fn(
+                    base_params, lora_params, opt_state, tokens
+                )
+                params = tm.combine_lora_params(base_params, lora_params)
+            else:
+                params, opt_state, loss = step_fn(params, opt_state, tokens)
+            # the supervisor syncs on the loss every step: the watchdog
+            # heartbeat must reflect completed device work and the
+            # divergence guard must see the value BEFORE the next
+            # checkpoint can commit it (small dispatch-overlap cost, same
+            # trade --timeline already makes)
+            loss_f = float(loss)
+            loader_snap = snap
+            if timeline is not None:
+                wall = time.perf_counter() - step_t0
+                record = {
+                    "step": step + 1,
+                    "wall_s": round(wall, 6),
+                    "tokens_per_sec": round(tokens_per_step / max(wall, 1e-9), 1),
+                    "loss": loss_f,
+                    "compile": step == start_step,
+                }
+                timeline.write(json.dumps(record) + "\n")
+                timeline.flush()
+                obs_trace.complete("train/step", step_t0, time.perf_counter(),
+                                   cat="train", step=step + 1,
+                                   compile=step == start_step)
+            if args.on_nan == "skip":
+                if not math.isfinite(loss_f):
+                    # the jitted gate already dropped this update
+                    log.warning("non-finite loss at step %s: update skipped",
+                                step + 1)
+            else:
+                reason = sup.check_loss(step + 1, loss_f)
+                if reason is not None:
+                    can_roll = (
+                        args.on_nan == "rollback" and args.checkpoint_dir
+                        and ckpt.latest_step(args.checkpoint_dir) is not None
+                    )
+                    if can_roll and sup.note_rollback():
+                        bad_pos = snap["step"] - 1
+                        skip_positions.add(bad_pos)
+                        batches.close()
+                        step, params, opt_state, meta = restore_state(
+                            params, opt_state
+                        )
+                        if lora_mode:
+                            base_params, lora_params = tm.split_lora_params(
+                                params
+                            )
+                        loader = make_loader(meta.get("loader"), step)
+                        loader_snap = loader.to_dict()
+                        batches = data_lib.prefetch(
+                            stream(loader), depth=args.prefetch,
+                            stop=sup.preemption.event,
+                        )
+                        log.warning(
+                            "divergence (%s): rolled back to checkpoint "
+                            "step %s; data position %s will be skipped",
+                            reason, step, bad_pos,
+                        )
+                        continue
+                    diverged = reason
+                    break
+            step += 1
+            if step % args.log_every == 0:
+                dt = time.perf_counter() - t0
+                done = step - start_step
+                log.info(
+                    "step %s loss %.4f | %.0f tok/s",
+                    step, loss_f, done * tokens_per_step / max(dt, 1e-9),
+                )
+            if args.checkpoint_dir and step % args.checkpoint_every == 0:
+                save_checkpoint(step)
+        if profiling:
+            # fewer than 4 steps ran after the trace started
             jax.block_until_ready(loss)
-            wall = time.perf_counter() - step_t0
-            record = {
-                "step": step + 1,
-                "wall_s": round(wall, 6),
-                "tokens_per_sec": round(tokens_per_step / max(wall, 1e-9), 1),
-                "loss": float(loss),
-                "compile": step == start_step,
-            }
-            timeline.write(json.dumps(record) + "\n")
-            timeline.flush()
-            obs_trace.complete("train/step", step_t0, time.perf_counter(),
-                               cat="train", step=step + 1,
-                               compile=step == start_step)
-        if (step + 1) % args.log_every == 0:
-            loss_v = float(loss)
-            dt = time.perf_counter() - t0
-            done = step + 1 - start_step
-            log.info(
-                "step %s loss %.4f | %.0f tok/s",
-                step + 1, loss_v, done * tokens_per_step / max(dt, 1e-9),
+            jax.profiler.stop_trace()
+            log.info("profiler trace written to %s", args.profile_dir)
+        if timeline is not None:
+            timeline.close()
+            log.info("step timeline written to %s", args.timeline)
+        if diverged is not None:
+            log.error(
+                "divergence: %s — halting with the last committed "
+                "checkpoint intact (exit %s)", diverged,
+                sup_lib.EXIT_DIVERGED,
             )
-        if args.checkpoint_dir and (step + 1) % args.checkpoint_every == 0:
-            ckpt.save(args.checkpoint_dir, step + 1, params, opt_state)
-    if profiling:
-        # fewer than 4 steps ran after the trace started
-        jax.block_until_ready(loss)
-        jax.profiler.stop_trace()
-        log.info("profiler trace written to %s", args.profile_dir)
-    if timeline is not None:
-        timeline.close()
-        log.info("step timeline written to %s", args.timeline)
-    if args.checkpoint_dir:
-        ckpt.save(args.checkpoint_dir, args.steps, params, opt_state)
+            return sup_lib.EXIT_DIVERGED
+        save_checkpoint(step)
+        if preempted:
+            log.info(
+                "preemption: committed checkpoint at step %s and exiting "
+                "cleanly within the %.1fs grace period", step, args.grace_secs
+            )
+            return 0
     log.info("training complete: %s steps", args.steps)
     return 0
 

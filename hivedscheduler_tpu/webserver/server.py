@@ -34,11 +34,26 @@ class WebServer:
         self.port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # readiness vs liveness split: draining flips /readyz (stop SENDING
+        # me work) while /healthz (restart me if dead) stays green — the
+        # k8s-conventional graceful-termination sequence
+        self.draining = False
+        self.retry_after_s = 30
+
+    def begin_drain(self, retry_after_s: int = 30) -> None:
+        """Flip /readyz to 503 + ``Retry-After`` while the process keeps
+        serving in-flight requests; callers then stop() after their drain
+        grace. Liveness (/healthz) is NOT affected — a draining scheduler
+        is healthy, it just must not receive new work."""
+        self.retry_after_s = retry_after_s
+        self.draining = True
+        log.info("WebServer draining: /readyz now 503 (Retry-After %ss)",
+                 retry_after_s)
 
     def async_run(self) -> Tuple[str, int]:
         """Start serving in a background thread; returns (host, port) with the
         actually-bound port (reference: AsyncRun, webserver.go:93-137)."""
-        handler = _make_handler(self.scheduler)
+        handler = _make_handler(self.scheduler, self)
         self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="webserver", daemon=True
@@ -54,7 +69,7 @@ class WebServer:
             self._httpd.server_close()
 
 
-def _make_handler(scheduler: HivedScheduler):
+def _make_handler(scheduler: HivedScheduler, webserver: Optional[WebServer] = None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -121,11 +136,36 @@ def _make_handler(scheduler: HivedScheduler):
                 path = full.rstrip("/")
                 if path == "/healthz":
                     # bounded liveness: a wedged scheduler lock or dead watch
-                    # threads must fail the probe, not just a dead HTTP server
+                    # threads must fail the probe, not just a dead HTTP server.
+                    # Liveness is drain-BLIND: a draining process is alive
+                    # (restarting it would lose the in-flight work the drain
+                    # exists to finish) — only /readyz flips.
                     ok = scheduler.healthy()
                     body = b"ok" if ok else b"unhealthy: scheduler lock wedged or watch threads dead"
                     self.send_response(200 if ok else 503)
                     self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/readyz":
+                    # readiness: "send me work?" — 503 while draining (with
+                    # Retry-After so well-behaved clients back off onto
+                    # another replica) or while unhealthy. Flips BEFORE
+                    # /healthz ever would: drain starts at SIGTERM, liveness
+                    # only fails on a genuine wedge.
+                    draining = webserver is not None and webserver.draining
+                    ok = not draining and scheduler.healthy()
+                    if draining:
+                        body = b"draining"
+                    elif ok:
+                        body = b"ready"
+                    else:
+                        body = b"unhealthy: scheduler lock wedged or watch threads dead"
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type", "text/plain")
+                    if draining:
+                        self.send_header(
+                            "Retry-After", str(webserver.retry_after_s))
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)

@@ -138,6 +138,34 @@ def test_restore_falls_back_past_torn_committed_step(tmp_path):
         checkpoint.restore_params(d, template, step=3)
 
 
+def test_extra_metadata_commits_with_the_marker(tmp_path):
+    """Sidecar state of record (loader RNG position) rides INSIDE the
+    commit marker: read_metadata returns it for a committed step, {} for
+    legacy markers, missing steps and missing directories — and deleting
+    the marker (the mid-save crash window) atomically loses arrays AND
+    metadata together."""
+    import os
+
+    d = str(tmp_path)
+    params, opt = _tiny_state(1.0)
+    extra = {"loader": {"seed": 3, "step": 5, "epoch": 0,
+                        "bitgen": {"bit_generator": "PCG64"}}}
+    checkpoint.save(d, 5, params, opt, extra=extra)
+    assert checkpoint.read_metadata(d) == extra
+    assert checkpoint.read_metadata(d, 5) == extra
+    # a later save without extra: newest metadata is {} (legacy shape)
+    checkpoint.save(d, 6, params, opt)
+    assert checkpoint.read_metadata(d) == {}
+    assert checkpoint.read_metadata(d, 5) == extra
+    # absent step / absent dir are best-effort empty, never a raise
+    assert checkpoint.read_metadata(d, 99) == {}
+    assert checkpoint.read_metadata(str(tmp_path / "nope")) == {}
+    # the crash window: no marker => no metadata, same as no arrays
+    os.unlink(os.path.join(d, "6", "hived_complete.json"))
+    assert checkpoint.latest_step(d) == 5
+    assert checkpoint.read_metadata(d) == extra
+
+
 def test_atomic_write_bytes_replaces_whole_file(tmp_path):
     target = tmp_path / "latest"
     checkpoint.atomic_write_bytes(str(target), b"one")

@@ -133,6 +133,143 @@ def test_prefetch_reraises_producer_errors():
         next(it)
 
 
+class TestLoaderState:
+    def test_serializer_pins_dataclass_fields(self):
+        """Guard (CLAUDE.md blind spot): the canonical serializer must
+        cover exactly the dataclass fields — a field added to LoaderState
+        without surviving to_dict/from_dict would silently break exact
+        resume."""
+        import dataclasses
+
+        state = data_lib.LoaderState(seed=7, step=3, epoch=1,
+                                     bitgen={"bit_generator": "PCG64"})
+        d = state.to_dict()
+        assert set(d) == {f.name for f in dataclasses.fields(
+            data_lib.LoaderState)}
+        assert data_lib.LoaderState.from_dict(d) == state
+        with pytest.raises(ValueError, match="unknown LoaderState fields"):
+            data_lib.LoaderState.from_dict({"seed": 0, "bogus": 1})
+
+    def test_state_is_json_roundtrippable(self):
+        """The state rides inside the checkpoint commit marker as JSON —
+        numpy bit-generator state must survive the trip bit-exactly."""
+        import json
+
+        ds = data_lib.synthetic_dataset(100, size=4096, seed=1)
+        loader = data_lib.CheckpointableBatches(ds, 4, 8, seed=5)
+        for _ in range(3):
+            next(loader)
+        d = json.loads(json.dumps(loader.to_dict()))
+        restored = data_lib.CheckpointableBatches.from_dict(d, ds, 4, 8)
+        np.testing.assert_array_equal(next(loader), next(restored))
+
+
+class TestCheckpointableBatches:
+    def test_resume_mid_stream_is_bit_exact(self):
+        ds = data_lib.synthetic_dataset(100, size=4096, seed=1)
+        ref = data_lib.CheckpointableBatches(ds, 4, 8, seed=3)
+        full = [next(ref) for _ in range(6)]
+        a = data_lib.CheckpointableBatches(ds, 4, 8, seed=3)
+        for _ in range(3):
+            next(a)
+        snap = a.to_dict()
+        b = data_lib.CheckpointableBatches.from_dict(snap, ds, 4, 8)
+        assert b.step == 3
+        for want in full[3:]:
+            np.testing.assert_array_equal(next(b), want)
+
+    def test_skip_matches_next(self):
+        """skip(n) must consume exactly the RNG draws next() would (the
+        rollback path jumps a poisoned batch with it)."""
+        ds = data_lib.synthetic_dataset(100, size=4096, seed=1)
+        a = data_lib.CheckpointableBatches(ds, 4, 8, seed=3)
+        b = data_lib.CheckpointableBatches(ds, 4, 8, seed=3)
+        for _ in range(2):
+            next(a)
+        b.skip(2)
+        assert a.step == b.step == 2
+        np.testing.assert_array_equal(next(a), next(b))
+
+    def test_host_shards_partition_the_global_batch(self):
+        ds = data_lib.synthetic_dataset(100, size=4096, seed=1)
+        shards = [
+            next(data_lib.CheckpointableBatches(
+                ds, 8, 16, process_index=i, process_count=4, seed=7))
+            for i in range(4)
+        ]
+        full = next(data_lib.CheckpointableBatches(ds, 8, 16, seed=7))
+        np.testing.assert_array_equal(np.concatenate(shards), full)
+
+    def test_epoch_tracks_corpus_passes(self):
+        ds = data_lib.synthetic_dataset(50, size=64, seed=1)
+        loader = data_lib.CheckpointableBatches(ds, 2, 8, seed=0)
+        assert loader.epoch == 0
+        for _ in range(4):  # 4 steps x 16 tokens = one 64-token pass
+            next(loader)
+        assert loader.epoch == 1
+
+    def test_indivisible_batch_rejected(self):
+        ds = data_lib.synthetic_dataset(10, size=128)
+        with pytest.raises(ValueError, match="not divisible"):
+            data_lib.CheckpointableBatches(ds, 7, 8, process_count=2)
+
+
+def test_prefetch_stop_event_wakes_blocked_consumer():
+    """A consumer blocked on a wedged producer must wake when the stop
+    event (the supervisor's preemption event) is set — otherwise SIGTERM
+    could never reach the step boundary and the grace period would
+    force-exit instead of checkpointing."""
+    import threading
+    import time
+
+    release = threading.Event()
+
+    def wedged():
+        yield np.zeros((1,), np.int32)
+        release.wait(30.0)  # simulated hung data source
+        yield np.ones((1,), np.int32)
+
+    stop = threading.Event()
+    it = data_lib.prefetch(wedged(), depth=2, stop=stop)
+    try:
+        next(it)
+        threading.Timer(0.1, stop.set).start()
+        t0 = time.monotonic()
+        with pytest.raises(StopIteration):
+            next(it)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        release.set()
+
+
+def test_prefetch_close_with_full_queue_drains_and_reaps_worker():
+    """Closing the consumer while the producer is BLOCKED on the full
+    bounded queue (the supervisor-abort shape) must drain the staged
+    batches and reap the worker promptly — no deadlock, no leak."""
+    import threading
+    import time
+
+    before = set(threading.enumerate())
+
+    def infinite():
+        i = 0
+        while True:
+            yield np.full((2, 2), i, np.int32)
+            i += 1
+
+    it = data_lib.prefetch(infinite(), depth=1)
+    next(it)
+    time.sleep(0.2)  # let the worker fill the queue and block in put()
+    workers = [t for t in threading.enumerate() if t not in before]
+    assert workers
+    t0 = time.monotonic()
+    it.close()
+    assert time.monotonic() - t0 < 3.0, "close() blocked on the full queue"
+    for t in workers:
+        t.join(timeout=5.0)
+    assert not any(t.is_alive() for t in workers)
+
+
 def test_prefetch_abandoned_iterator_stops_worker():
     """Closing the consumer early (the train CLI's normal exit after
     --steps) must signal the producer thread to exit instead of leaving it

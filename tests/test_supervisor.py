@@ -1,0 +1,205 @@
+"""Workload supervisor units (fast, in-process): preemption listener,
+watchdog, divergence guard, fault hooks, rollback budget. The subprocess
+fault-ladder soaks live in tests/test_workload_chaos.py (slow-marked)."""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from hivedscheduler_tpu.parallel import supervisor as sup_lib
+
+
+class TestPreemptionListener:
+    def test_signal_sets_event_and_uninstall_restores_handlers(self):
+        prev_term = signal.getsignal(signal.SIGTERM)
+        prev_int = signal.getsignal(signal.SIGINT)
+        listener = sup_lib.PreemptionListener().install()
+        try:
+            assert not listener.requested
+            os.kill(os.getpid(), signal.SIGTERM)
+            for _ in range(200):  # handler runs between bytecodes
+                if listener.requested:
+                    break
+                time.sleep(0.01)
+            assert listener.requested
+            assert listener.signum == signal.SIGTERM
+        finally:
+            listener.uninstall()
+        assert signal.getsignal(signal.SIGTERM) is prev_term
+        assert signal.getsignal(signal.SIGINT) is prev_int
+
+    def test_trigger_is_programmatic_preemption(self):
+        listener = sup_lib.PreemptionListener()
+        assert not listener.requested
+        listener.trigger()
+        assert listener.requested and listener.event.is_set()
+
+    def test_grace_timer_fires_after_trigger(self):
+        fired = threading.Event()
+        listener = sup_lib.PreemptionListener(
+            grace_secs=0.05, on_grace_exceeded=fired.set)
+        listener.trigger()
+        assert fired.wait(5.0), "grace backstop never fired"
+        listener.uninstall()
+
+    def test_no_grace_timer_without_grace(self):
+        fired = threading.Event()
+        listener = sup_lib.PreemptionListener(
+            grace_secs=0.0, on_grace_exceeded=fired.set)
+        listener.trigger()
+        assert not fired.wait(0.2)
+
+
+class TestWatchdog:
+    def test_fires_on_stall_and_writes_record(self, tmp_path):
+        records = []
+        wd = sup_lib.Watchdog(0.05, first_step_factor=1.0,
+                              record_dir=str(tmp_path), poll_s=0.01,
+                              on_stall=records.append)
+        wd.start()
+        wd.heartbeat(1)
+        wd.heartbeat(2)  # two beats: steady-state deadline armed
+        deadline = time.monotonic() + 5.0
+        while not wd.fired and time.monotonic() < deadline:
+            time.sleep(0.01)
+        wd.stop()
+        assert wd.fired and records
+        assert records[0]["last_step"] == 2
+        assert records[0]["kind"] == "watchdog_stall"
+        import json
+
+        rec = json.loads((tmp_path / sup_lib.STALL_RECORD).read_text())
+        assert rec["last_step"] == 2 and rec["pid"] == os.getpid()
+
+    def test_does_not_fire_while_heartbeating(self):
+        wd = sup_lib.Watchdog(0.2, first_step_factor=1.0, poll_s=0.02,
+                              on_stall=lambda r: None)
+        wd.start()
+        t0 = time.monotonic()
+        step = 0
+        while time.monotonic() - t0 < 0.8:
+            wd.heartbeat(step)
+            step += 1
+            time.sleep(0.02)
+        assert not wd.fired
+        wd.stop()
+
+    def test_first_step_gets_scaled_deadline(self):
+        """Beat #1 lands BEFORE the compile-heavy first step, so the scaled
+        deadline must hold until the SECOND heartbeat."""
+        wd = sup_lib.Watchdog(0.05, first_step_factor=100.0, poll_s=0.01,
+                              on_stall=lambda r: None)
+        wd.start()
+        wd.heartbeat(0)  # one beat only: still inside the "first step"
+        time.sleep(0.3)  # 6x the steady deadline
+        assert not wd.fired, "watchdog fired during the simulated compile"
+        wd.stop()
+
+    def test_rejects_nonpositive_deadline(self):
+        with pytest.raises(ValueError, match="must be > 0"):
+            sup_lib.Watchdog(0.0)
+
+
+class TestDivergenceGuard:
+    def test_nonfinite_always_diverges(self):
+        g = sup_lib.DivergenceGuard()
+        assert g.check(1, float("nan"))
+        assert g.check(2, float("inf"))
+        assert g.check(3, 4.2) is None
+
+    def test_spike_detection_after_warmup(self):
+        g = sup_lib.DivergenceGuard(spike_factor=3.0, warmup_steps=3)
+        for s in range(3):
+            assert g.check(s, 1.0) is None
+        assert g.check(3, 100.0) is not None  # 100 > 3 x EMA(1.0)
+        # reset forgets the history (post-rollback)
+        g.reset()
+        assert g.check(4, 100.0) is None  # warming up again
+
+    def test_no_spike_detection_by_default(self):
+        g = sup_lib.DivergenceGuard()
+        for s in range(10):
+            assert g.check(s, 1.0) is None
+        assert g.check(10, 1e9) is None  # huge but finite: not divergence
+
+
+class TestFaultInjection:
+    def test_from_env_and_one_shot(self, monkeypatch):
+        monkeypatch.setenv(sup_lib.ENV_FAULT_NAN_AT, "3")
+        monkeypatch.setenv(sup_lib.ENV_FAULT_SERVE_PREEMPT_AT, "5")
+        faults = sup_lib.FaultInjection.from_env()
+        assert faults.hang_at is None
+        assert not faults.take_nan(2)
+        assert faults.take_nan(3)
+        assert not faults.take_nan(3)  # one-shot: a rollback replay is safe
+        assert faults.take_serve_preempt(5)
+        assert not faults.take_serve_preempt(5)
+
+    def test_unarmed_is_inert(self, monkeypatch):
+        for name in (sup_lib.ENV_FAULT_HANG_AT, sup_lib.ENV_FAULT_NAN_AT,
+                     sup_lib.ENV_FAULT_SERVE_PREEMPT_AT,
+                     sup_lib.ENV_FAULT_STEP_DELAY):
+            monkeypatch.delenv(name, raising=False)
+        faults = sup_lib.FaultInjection.from_env()
+        assert not faults.take_nan(1)
+        faults.maybe_hang(1)  # returns immediately
+        faults.pace()
+        assert faults.step_delay_s == 0.0
+
+
+class TestSupervisor:
+    def test_context_manager_and_rollback_budget(self):
+        with sup_lib.Supervisor(install_signals=False,
+                                max_rollbacks=2) as sup:
+            assert not sup.preempt_requested
+            assert sup.check_loss(1, 2.5) is None
+            assert sup.check_loss(2, float("nan")) is not None
+            assert sup.note_rollback()
+            assert sup.note_rollback()
+            assert not sup.note_rollback()  # budget exhausted -> halt
+
+    def test_watchdog_wired_through(self):
+        stalls = []
+        with sup_lib.Supervisor(install_signals=False, watchdog_secs=0.05,
+                                first_step_factor=1.0,
+                                on_stall=stalls.append) as sup:
+            sup.heartbeat(0)
+            sup.heartbeat(1)
+            deadline = time.monotonic() + 5.0
+            while not stalls and time.monotonic() < deadline:
+                time.sleep(0.01)
+        assert stalls and stalls[0]["last_step"] == 1
+
+    def test_preemption_event_reaches_prefetch(self):
+        """The supervisor's preemption event is the prefetch stop event:
+        a consumer blocked on a wedged producer must wake when preemption
+        is requested (the grace period cannot be met otherwise)."""
+        import numpy as np
+
+        from hivedscheduler_tpu.parallel import data as data_lib
+
+        release = threading.Event()
+
+        def wedged():
+            yield np.zeros((1,), np.int32)
+            release.wait(30.0)  # simulated hung data source
+            yield np.ones((1,), np.int32)
+
+        # grace_secs=0: an armed grace timer would force-exit THIS process
+        # (the production behavior) — the exit path has its own tests
+        sup = sup_lib.Supervisor(install_signals=False, grace_secs=0.0)
+        it = data_lib.prefetch(wedged(), depth=2,
+                               stop=sup.preemption.event)
+        try:
+            next(it)
+            threading.Timer(0.1, sup.preemption.trigger).start()
+            t0 = time.monotonic()
+            with pytest.raises(StopIteration):
+                next(it)
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            release.set()
+            sup.preemption.uninstall()  # cancels any armed grace timer

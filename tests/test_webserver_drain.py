@@ -1,0 +1,96 @@
+"""Readiness vs liveness split on the scheduler webserver: /readyz flips to
+503 + Retry-After at drain start (stop SENDING work) while /healthz stays
+200 (don't RESTART me — in-flight work is finishing). The acceptance
+ordering of graceful termination: /readyz flips strictly before /healthz
+ever would."""
+
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from hivedscheduler_tpu.api.config import load_config
+from hivedscheduler_tpu.k8s.fake import FakeKubeClient
+from hivedscheduler_tpu.k8s.types import Node
+from hivedscheduler_tpu.runtime.scheduler import HivedScheduler
+from hivedscheduler_tpu.webserver import WebServer
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "example", "config", "design", "tpu-hive.yaml",
+)
+
+
+@pytest.fixture
+def drain_stack():
+    config = load_config(FIXTURE)
+    config.web_server_address = "127.0.0.1:0"  # ephemeral port
+    kube = FakeKubeClient()
+    scheduler = HivedScheduler(config, kube)
+    algo = scheduler.scheduler_algorithm
+    for n in sorted({n for ccl in algo.full_cell_list.values()
+                     for c in ccl[max(ccl)] for n in c.nodes}):
+        kube.create_node(Node(name=n))
+    scheduler.start()
+    server = WebServer(scheduler)
+    host, port = server.async_run()
+    yield server, f"http://{host}:{port}"
+    server.stop()
+
+
+def probe(base, path):
+    """(status, body, headers) without raising on 503."""
+    try:
+        with urllib.request.urlopen(base + path) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def test_readyz_ready_when_healthy(drain_stack):
+    server, base = drain_stack
+    status, body, _ = probe(base, "/readyz")
+    assert (status, body) == (200, b"ready")
+    status, body, _ = probe(base, "/healthz")
+    assert (status, body) == (200, b"ok")
+
+
+def test_drain_flips_readyz_before_healthz(drain_stack):
+    server, base = drain_stack
+    server.begin_drain(retry_after_s=17)
+    status, body, headers = probe(base, "/readyz")
+    assert status == 503 and body == b"draining"
+    assert headers.get("Retry-After") == "17"
+    # liveness is drain-blind: restarting a draining process would lose
+    # exactly the in-flight work the drain exists to finish
+    status, body, _ = probe(base, "/healthz")
+    assert (status, body) == (200, b"ok")
+    # the server still answers real traffic while draining
+    status, _, _ = probe(base, "/v1")
+    assert status == 200
+
+
+def test_readyz_also_fails_on_unhealthy_scheduler(drain_stack):
+    """Readiness implies liveness: a wedged scheduler must not be ready
+    even without a drain."""
+    import threading
+
+    server, base = drain_stack
+    acquired = threading.Event()
+    release = threading.Event()
+
+    def hold_lock():
+        with server.scheduler.scheduler_lock:
+            acquired.set()
+            release.wait(timeout=30)
+
+    t = threading.Thread(target=hold_lock, daemon=True)
+    t.start()
+    assert acquired.wait(timeout=5)
+    try:
+        status, body, _ = probe(base, "/readyz")
+        assert status == 503 and b"unhealthy" in body
+    finally:
+        release.set()
+        t.join(timeout=5)

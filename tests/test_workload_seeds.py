@@ -1,0 +1,69 @@
+"""Guard: the pinned workload-chaos seed replay
+(tools/check_workload_seeds.py) runs clean, the episode plans are genuinely
+deterministic per seed (what makes a pinned seed a faithful permanent
+regression test), and the pinned set keeps covering the full fault ladder."""
+
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_workload_seeds.py")
+
+
+def _load_tool():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_workload_seeds
+    finally:
+        sys.path.pop(0)
+    return check_workload_seeds
+
+
+@pytest.mark.slow
+def test_pinned_seeds_replay_clean():
+    proc = subprocess.run([sys.executable, TOOL], cwd=REPO,
+                          capture_output=True, text=True, timeout=1200)
+    assert proc.returncode == 0, (
+        f"check_workload_seeds failed:\n{proc.stdout}{proc.stderr}"
+    )
+    assert "OK" in proc.stdout
+
+
+def test_episode_plans_are_deterministic(tmp_path):
+    """Same seed => identical episode plan (no subprocesses spawned: the
+    plan is drawn in __init__)."""
+    from hivedscheduler_tpu.chaos.workload import (
+        EPISODE_KINDS,
+        WorkloadChaosHarness,
+    )
+
+    a = WorkloadChaosHarness(seed=9, workdir=str(tmp_path))
+    b = WorkloadChaosHarness(seed=9, workdir=str(tmp_path))
+    assert a.episodes == b.episodes
+    for kind, step in a.episodes:
+        assert kind in EPISODE_KINDS
+        assert a.plan.min_step <= step <= a.steps - 2
+
+
+def test_pinned_set_covers_the_full_fault_ladder(tmp_path):
+    """The pinned seeds must keep covering every episode kind — a plan
+    change that silently drops e.g. the hang rung from the replayed mix
+    fails here instead of rotting coverage."""
+    from hivedscheduler_tpu.chaos.workload import (
+        EPISODE_KINDS,
+        WorkloadFaultPlan,
+    )
+
+    tool = _load_tool()
+    covered = set()
+    for seed, episodes, _why in tool.PINNED_SEEDS:
+        plan = WorkloadFaultPlan(episodes=episodes)
+        for kind, _step in plan.draw(random.Random(seed), steps=8):
+            covered.add(kind)
+    assert covered == set(EPISODE_KINDS), (
+        f"pinned seeds only cover {sorted(covered)}"
+    )
