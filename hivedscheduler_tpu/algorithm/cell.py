@@ -62,6 +62,12 @@ class Cell:
         self.healthy: bool = True
         self.total_leaf_cell_num = total_leaf_cell_num
         self.used_leaf_cell_num_at_priorities: Dict[CellPriority, int] = {}
+        # Monotonic mutation counter driving the persistent cluster views
+        # (algorithm/topology_aware.py): bumped on every used-count change,
+        # healthiness transition, and binding change — anything a view's
+        # per-node scoring reads. A view caches the counter value it last
+        # saw per node and recomputes only nodes whose counter moved.
+        self.view_gen = 0
 
     def set_priority(self, p: CellPriority) -> None:
         self.priority = p
@@ -72,6 +78,7 @@ class Cell:
             self.used_leaf_cell_num_at_priorities.pop(p, None)
         else:
             self.used_leaf_cell_num_at_priorities[p] = n
+        self.view_gen += 1
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return f"<{type(self).__name__} {self.chain}/{self.address} L{self.level} P{self.priority} {self.state}>"
@@ -194,9 +201,11 @@ class PhysicalCell(Cell):
         """Reference: cell.go:302-312."""
         log.info("Cell %s is set to %s", self.address, h)
         self.healthy = h == api.CELL_HEALTHY
+        self.view_gen += 1
         self.api_status.cell_healthiness = h
         if self.virtual_cell is not None:
             self.virtual_cell.healthy = self.healthy
+            self.virtual_cell.view_gen += 1
             self.api_status.virtual_cell.cell_healthiness = h
             self.virtual_cell.api_status.cell_healthiness = h
             self.virtual_cell.api_status.physical_cell.cell_healthiness = h
@@ -249,6 +258,9 @@ class VirtualCell(Cell):
     def set_physical_cell(self, cell: Optional[PhysicalCell]) -> None:
         """Reference: cell.go:398-417."""
         self.physical_cell = cell
+        # a virtual node's health/suggested scoring proxies through the
+        # bound physical cell — binding changes dirty the cluster views
+        self.view_gen += 1
         if cell is None:
             self.api_status.physical_cell = None
             self.state = CELL_FREE

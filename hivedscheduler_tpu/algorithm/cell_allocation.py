@@ -192,10 +192,9 @@ def get_usable_physical_cells(
     preemption (reference: getUsablePhysicalCells, cell_allocation.go:200-243)."""
     usable: List[PhysicalCell] = []
     for cand in candidates:
-        assert isinstance(cand, PhysicalCell)
         if cand.virtual_cell is not None:
             continue
-        nodes, _ = cand.get_physical_placement()
+        nodes = cand.nodes  # == get_physical_placement()[0]
         if len(nodes) == 1 and not cand.healthy:
             continue
         if not ignore_suggested_nodes:
@@ -204,9 +203,10 @@ def get_usable_physical_cells(
         usable.append(cand)
     if len(usable) < num_needed:
         return None
-    usable.sort(
-        key=lambda c: c.used_leaf_cell_num_at_priorities.get(OPPORTUNISTIC_PRIORITY, 0)
-    )
+    if len(usable) > 1:
+        usable.sort(
+            key=lambda c: c.used_leaf_cell_num_at_priorities.get(OPPORTUNISTIC_PRIORITY, 0)
+        )
     return usable
 
 
@@ -332,10 +332,12 @@ def get_unbound_virtual_cell(cl: CellList) -> Optional[VirtualCell]:
 def bind_cell(pc: PhysicalCell, vc: VirtualCell) -> None:
     """Bind a virtual cell chainward up-tree, starting from leaf level
     (reference: bindCell, cell_allocation.go:386-398)."""
+    log_on = log.isEnabledFor(logging.INFO)  # one bind per cell of a gang
     while vc.physical_cell is None:
         pc.set_virtual_cell(vc)
         vc.set_physical_cell(pc)
-        log.info("Virtual cell %s is bound to physical cell %s", vc.address, pc.address)
+        if log_on:
+            log.info("Virtual cell %s is bound to physical cell %s", vc.address, pc.address)
         if vc.parent is None:
             break
         vc = vc.parent  # type: ignore[assignment]
@@ -346,12 +348,14 @@ def unbind_cell(c: PhysicalCell) -> None:
     """Unbind up-tree until an ancestor is pinned or still has bound children
     (reference: unbindCell, cell_allocation.go:402-420)."""
     bound_virtual = c.virtual_cell
+    log_on = log.isEnabledFor(logging.INFO)  # one unbind per cell of a gang
     while not bound_virtual.physical_cell.pinned:
         bound_physical = bound_virtual.physical_cell
-        log.info(
-            "Virtual cell %s is unbound from physical cell %s",
-            bound_virtual.address, bound_physical.address,
-        )
+        if log_on:
+            log.info(
+                "Virtual cell %s is unbound from physical cell %s",
+                bound_virtual.address, bound_physical.address,
+            )
         bound_virtual.set_physical_cell(None)
         bound_physical.set_virtual_cell(None)
         if bound_virtual.parent is None:
@@ -393,6 +397,7 @@ def update_used_leaf_cell_num_at_priority(c: Optional[Cell], p: CellPriority, in
             d.pop(p, None)
         else:
             d[p] = n
+        c.view_gen += 1
         c = c.parent
 
 
@@ -417,19 +422,17 @@ class UsedCountBatch:
     __slots__ = ("_groups",)
 
     def __init__(self) -> None:
-        # priority -> {id(cell): [cell, signed count]} — merged at add time,
-        # so N same-priority ops on one leaf collapse to a single entry
-        self._groups: Dict[CellPriority, Dict[int, list]] = {}
+        # priority -> {cell: signed count} — cells hash by identity (no
+        # __eq__), so keying by the object itself skips the id() indirection;
+        # merged at add time, so N same-priority ops on one leaf collapse to
+        # a single entry
+        self._groups: Dict[CellPriority, Dict[Cell, int]] = {}
 
     def add(self, c: Cell, p: CellPriority, delta: int) -> None:
         g = self._groups.get(p)
         if g is None:
             g = self._groups[p] = {}
-        e = g.get(id(c))
-        if e is None:
-            g[id(c)] = [c, delta]
-        else:
-            e[1] += delta
+        g[c] = g.get(c, 0) + delta
 
     def flush(self) -> None:
         if not self._groups:
@@ -438,28 +441,35 @@ class UsedCountBatch:
         for p, frontier in groups.items():
             # propagate strictly by level so a parent receives every child's
             # contribution before its own dict is touched (virtual and
-            # physical cells mix freely: parent chains are independent)
-            by_level: Dict[CellLevel, Dict[int, list]] = {}
-            for e in frontier.values():
-                by_level.setdefault(e[0].level, {})[id(e[0])] = e
+            # physical cells mix freely: parent chains are independent);
+            # zero net contributions (alloc+release merged in one batch)
+            # are dropped instead of propagated
+            by_level: Dict[CellLevel, Dict[Cell, int]] = {}
+            for c, n in frontier.items():
+                if not n:
+                    continue
+                lv = by_level.get(c.level)
+                if lv is None:
+                    lv = by_level[c.level] = {}
+                lv[c] = n
             while by_level:
                 l = min(by_level)
-                for c, n in by_level.pop(l).values():
-                    if n:
-                        counts = c.used_leaf_cell_num_at_priorities
-                        m = counts.get(p, 0) + n
-                        if m == 0:
-                            counts.pop(p, None)
-                        else:
-                            counts[p] = m
+                for c, n in by_level.pop(l).items():
+                    if not n:  # children's contributions cancelled
+                        continue
+                    counts = c.used_leaf_cell_num_at_priorities
+                    m = counts.get(p, 0) + n
+                    if m == 0:
+                        counts.pop(p, None)
+                    else:
+                        counts[p] = m
+                    c.view_gen += 1
                     parent = c.parent
                     if parent is not None:
-                        lv = by_level.setdefault(parent.level, {})
-                        e = lv.get(id(parent))
-                        if e is None:
-                            lv[id(parent)] = [parent, n]
-                        else:
-                            e[1] += n
+                        lv = by_level.get(parent.level)
+                        if lv is None:
+                            lv = by_level[parent.level] = {}
+                        lv[parent] = lv.get(parent, 0) + n
 
 
 def allocate_cell_walk(
@@ -481,7 +491,20 @@ def allocate_cell_walk(
     gang stops after a step or two)."""
     if batch is not None:
         batch.add(c, p, 1)
-        set_cell_priority(c, p)
+        if p < c.priority:
+            set_cell_priority(c, p)
+        else:
+            # inline raise-only set_cell_priority: with p >= c.priority only
+            # the raise branch can fire, stopping at the first ancestor
+            # already holding >= p (the 2nd..Nth leaf of a gang stops after
+            # a step or two) — saves a recursive call per leaf on the
+            # gang-create hot path
+            cur: Optional[Cell] = c
+            first = True
+            while cur is not None and (first or p > cur.priority):
+                cur.set_priority(p)
+                first = False
+                cur = cur.parent
         return
     if p < c.priority:
         set_cell_priority(c, p)
@@ -501,6 +524,7 @@ def allocate_cell_walk(
                 raising = False
         d = cur.used_leaf_cell_num_at_priorities
         d[p] = d.get(p, 0) + 1
+        cur.view_gen += 1
         first = False
         cur = cur.parent
 
@@ -530,6 +554,7 @@ def release_cell_walk(
             d.pop(old_p, None)
         else:
             d[old_p] = n
+        cur.view_gen += 1
         if prio_active:
             original = cur.priority
             cur.set_priority(target)

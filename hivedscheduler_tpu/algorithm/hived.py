@@ -67,7 +67,6 @@ from hivedscheduler_tpu.algorithm.types import (
 )
 from hivedscheduler_tpu.algorithm.utils import (
     all_pods_released,
-    collect_bad_or_non_suggested_nodes,
     collect_preemption_victims,
     delete_ot_virtual_cell,
     find_physical_leaf_cell,
@@ -122,6 +121,15 @@ class HivedAlgorithm(SchedulerAlgorithm):
         # the annotation-driven slow path.
         self._op_seq = 0
         self._live_stash: Optional[tuple] = None
+        # Per-chain mutation counters (allocate/release of leaf or
+        # preassigned cells, node health transitions) keying the
+        # multi-chain-relax infeasibility cache: a waiting gang re-probed
+        # every cycle skips BOTH relax passes when nothing touched the
+        # involved chains since its last failed attempt.
+        self._chain_gen: Dict[CellChain, int] = {}
+        # group name -> (request sig, chain-gen token, suggested set or
+        # None, failed reason); see _schedule_relaxed_across_chains
+        self._relax_infeasible: Dict[str, tuple] = {}
         # In-flight decision trace (obs.decisions): non-None only inside
         # schedule() when recording is enabled. Single-threaded by the
         # algorithm-lock contract, so a plain attribute is safe.
@@ -270,12 +278,16 @@ class HivedAlgorithm(SchedulerAlgorithm):
             self._op_seq += 1
             self._set_bad_node(node.name)
 
+    def _bump_chain_gen(self, chain: CellChain) -> None:
+        self._chain_gen[chain] = self._chain_gen.get(chain, 0) + 1
+
     def _set_bad_node(self, node_name: str) -> None:
         """Reference: setBadNode, hived_algorithm.go:467-481."""
         if node_name in self.bad_nodes:
             return
         self.bad_nodes.add(node_name)
         for leaf_cell in self._leaves_by_node.get(node_name, []):
+            self._bump_chain_gen(leaf_cell.chain)
             self._set_bad_cell(leaf_cell)
 
     def _set_healthy_node(self, node_name: str) -> None:
@@ -284,6 +296,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
             return
         self.bad_nodes.discard(node_name)
         for leaf_cell in self._leaves_by_node.get(node_name, []):
+            self._bump_chain_gen(leaf_cell.chain)
             self._set_healthy_cell(leaf_cell)
 
     def _set_bad_cell(self, c: PhysicalCell) -> None:
@@ -527,7 +540,11 @@ class HivedAlgorithm(SchedulerAlgorithm):
                 self._decision.vc = s.virtual_cluster
                 self._decision.priority = s.priority
                 self._decision.suggested_nodes = len(suggested_nodes)
-            suggested_node_set = set(suggested_nodes)
+            # built lazily: the existing-ALLOCATED-group fast path (every
+            # pod of a gang after the first) never reads the set, and
+            # materializing thousands of node names per pod dominates that
+            # path's cost at the 4096-chip scale point
+            suggested_node_set: Optional[Set[str]] = None
             group_physical: Optional[GroupPhysicalPlacement] = None
             group_virtual: Optional[GroupVirtualPlacement] = None
             preemption_victims: Dict[str, Dict[str, Pod]] = {}
@@ -536,13 +553,17 @@ class HivedAlgorithm(SchedulerAlgorithm):
 
             g = self.affinity_groups.get(s.affinity_group.name)
             if g is not None:
+                if not (g.ignore_k8s_suggested_nodes and not self.bad_nodes):
+                    suggested_node_set = set(suggested_nodes)
                 (group_physical, group_virtual, preemption_victims, pod_index) = (
                     self._schedule_pod_from_existing_group(
-                        g, s, suggested_node_set, phase, pod
+                        g, s, suggested_node_set or set(), phase, pod
                     )
                 )
             # the group may have been a preempting group deleted just above
             if self.affinity_groups.get(s.affinity_group.name) is None:
+                if suggested_node_set is None:
+                    suggested_node_set = set(suggested_nodes)
                 (group_physical, group_virtual, preemption_victims, wait_reason) = (
                     self._schedule_pod_from_new_group(s, suggested_node_set, phase, pod)
                 )
@@ -556,7 +577,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
                 pod_index,
                 self.affinity_groups.get(s.affinity_group.name),
                 s.affinity_group.name,
-                suggested_node_set,
+                suggested_node_set or set(),
                 pod,
             )
             if (
@@ -628,9 +649,26 @@ class HivedAlgorithm(SchedulerAlgorithm):
                 ):
                     live = (stash[3], stash[4])
                 self._create_allocated_affinity_group(s, info, pod, live=live)
-            self.affinity_groups[s.affinity_group.name].allocated_pods[s.leaf_cell_number][
-                pod_index
-            ] = pod
+                if live is not None:
+                    # seed the bind-info cache from the annotation this very
+                    # placement was encoded into: the first peer pod's
+                    # generate_affinity_group_bind_info then skips a full
+                    # O(gang) rebuild of what Schedule already produced
+                    new_g = self.affinity_groups.get(s.affinity_group.name)
+                    if new_g is not None and new_g._bind_info_cache is None:
+                        new_g._bind_info_cache = (
+                            new_g.placement_version,
+                            info.affinity_group_bind_info,
+                            info.cell_chain,
+                            stash[2],
+                        )
+            g = self.affinity_groups[s.affinity_group.name]
+            pods_list = g.allocated_pods[s.leaf_cell_number]
+            pods_list[pod_index] = pod
+            w = g.pod_index_watermark.get(s.leaf_cell_number, 0)
+            while w < len(pods_list) and pods_list[w] is not None:
+                w += 1
+            g.pod_index_watermark[s.leaf_cell_number] = w
 
     def delete_allocated_pod(self, pod: Pod) -> None:
         """Reference: DeleteAllocatedPod, hived_algorithm.go:272-296."""
@@ -657,6 +695,8 @@ class HivedAlgorithm(SchedulerAlgorithm):
                 )
                 return
             g.allocated_pods[s.leaf_cell_number][pod_index] = None
+            if pod_index < g.pod_index_watermark.get(s.leaf_cell_number, 0):
+                g.pod_index_watermark[s.leaf_cell_number] = pod_index
             if all_pods_released(g.allocated_pods):
                 self._delete_allocated_affinity_group(g, pod)
 
@@ -687,6 +727,40 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     for vcn, vcs in self.api_cluster_status.virtual_clusters.items()
                 },
             )
+
+    # -- copy-on-read inspect: to_dict IS the snapshot -----------------
+    #
+    # The deep_copy() variants above clone the whole status forest per
+    # request only for the webserver to immediately serialize the clone and
+    # throw it away. These build the JSON-ready dicts directly under the
+    # lock — to_dict() produces fresh dicts/lists with no references back
+    # into live objects, so it is itself the copy, and only the requested
+    # subtree is materialized. The object-returning variants stay for
+    # callers that want to hold a snapshot.
+
+    def get_cluster_status_dict(self) -> dict:
+        with self.algorithm_lock:
+            return self.api_cluster_status.to_dict()
+
+    def get_physical_cluster_status_dict(self) -> list:
+        with self.algorithm_lock:
+            return [s.to_dict() for s in self.api_cluster_status.physical_cluster]
+
+    def get_all_virtual_clusters_status_dict(self) -> dict:
+        with self.algorithm_lock:
+            return {
+                vcn: [s.to_dict() for s in vcs]
+                for vcn, vcs in self.api_cluster_status.virtual_clusters.items()
+            }
+
+    def get_virtual_cluster_status_dict(self, vcn: str) -> list:
+        with self.algorithm_lock:
+            if vcn in self.api_cluster_status.virtual_clusters:
+                return [
+                    s.to_dict()
+                    for s in self.api_cluster_status.virtual_clusters[vcn]
+                ]
+            raise api.WebServerError(404, f"VC {vcn} not found")
 
     def get_physical_cluster_status(self) -> List[api.PhysicalCellStatus]:
         with self.algorithm_lock:
@@ -731,13 +805,19 @@ class HivedAlgorithm(SchedulerAlgorithm):
         # ignores suggested nodes and no node is bad, every cell is healthy
         # (leaf healthiness is driven solely by set_bad_node/set_healthy_node
         # under this lock), so the scan can only return empty — skip it.
+        # Otherwise scan the group's DISTINCT node names (cached per
+        # placement version) instead of every leaf cell: a leaf is unhealthy
+        # exactly when its node is in bad_nodes (same single-writer
+        # argument), so the per-node check is equivalent to
+        # collect_bad_or_non_suggested_nodes over the full placement.
         if g.ignore_k8s_suggested_nodes and not self.bad_nodes:
             bad_or_non_suggested: Set[str] = set()
         else:
-            bad_or_non_suggested = collect_bad_or_non_suggested_nodes(
-                g.physical_leaf_cell_placement, suggested_nodes,
-                g.ignore_k8s_suggested_nodes,
-            )
+            bad_or_non_suggested = {
+                n for n in g.placement_node_names()
+                if n in self.bad_nodes
+                or (not g.ignore_k8s_suggested_nodes and n not in suggested_nodes)
+            }
         if g.state == GROUP_ALLOCATED:
             log.info("[%s]: Pod is from an affinity group that is already allocated: %s",
                      internal_utils.key(pod), s.affinity_group.name)
@@ -753,7 +833,10 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     "healthy and within K8s suggested nodes: %s",
                     internal_utils.key(pod), g.name, bad_or_non_suggested,
                 )
-            pod_index = get_new_pod_index(g.allocated_pods.get(s.leaf_cell_number, []))
+            pod_index = get_new_pod_index(
+                g.allocated_pods.get(s.leaf_cell_number, []),
+                g.pod_index_watermark.get(s.leaf_cell_number, 0),
+            )
             if pod_index == -1:
                 raise api.as_bad_request(
                     f"Requesting more pods than the configured number for "
@@ -993,8 +1076,46 @@ class HivedAlgorithm(SchedulerAlgorithm):
         uncommitted cells twice).
         Per-pod cell chains are recorded in the bind info, and recovery
         relies on find_physical_leaf_cell's cross-chain fallback.
+
+        Infeasibility cache (ADVICE.md round 5): a gang that failed to relax
+        waits and is re-probed every scheduling cycle, re-running both the
+        balanced and the fewest pass each time. When NOTHING has touched the
+        involved chains since the last failed attempt (per-chain mutation
+        counters ``_chain_gen``; invalidated by any allocate/release —
+        including the attempt's own lazy-preempt commits and reverts, since
+        the token is captured after the revert — plus health transitions),
+        the same request against the same cell state re-fails
+        deterministically, so the cached wait reason is returned without
+        probing. ``HIVED_RELAX_CACHE=0`` disables it.
         """
+        import os as _os
+
         guaranteed_req = sr.priority >= MIN_GUARANTEED_PRIORITY
+        cache_on = _os.environ.get("HIVED_RELAX_CACHE", "1") != "0"
+        req_sig = (
+            tuple(sorted(sr.affinity_group_pod_nums.items())), sr.priority,
+            sr.vc, sr.multi_chain_relax_policy, tuple(chains),
+            sr.ignore_suggested_nodes,
+        )
+        if cache_on:
+            cached = self._relax_infeasible.get(sr.affinity_group_name)
+            if cached is not None:
+                c_req, c_token, c_sugg, c_reason = cached
+                if (
+                    c_req == req_sig
+                    and c_token == tuple(
+                        self._chain_gen.get(c, 0) for c in chains
+                    )
+                    and (c_sugg is None or c_sugg == sr.suggested_nodes)
+                ):
+                    if self._decision is not None:
+                        self._decision.attempt(
+                            "relax[" + ",".join(str(c) for c in chains) + "]",
+                            "multi-chain-relax", "failed",
+                            c_reason + " (cached infeasibility)",
+                        )
+                    return None, None, c_reason
+                del self._relax_infeasible[sr.affinity_group_name]
 
         def root_available(chain: CellChain) -> List[int]:
             """Per-preassigned-root available leaf counts for a guaranteed
@@ -1183,15 +1304,27 @@ class HivedAlgorithm(SchedulerAlgorithm):
         relax_where = "relax[" + ",".join(str(c) for c in chains) + "]"
         if idx < len(flat):
             revert_lazy(committed_lazy)
+            reason = (
+                "insufficient capacity even after relaxing the affinity group "
+                "across cell chains"
+            )
+            if cache_on:
+                # token captured AFTER the reverts: it describes the state
+                # the next identical attempt would start from
+                if len(self._relax_infeasible) >= 256:
+                    self._relax_infeasible.clear()
+                self._relax_infeasible[sr.affinity_group_name] = (
+                    req_sig,
+                    tuple(self._chain_gen.get(c, 0) for c in req_sig[4]),
+                    None if sr.ignore_suggested_nodes else set(sr.suggested_nodes),
+                    reason,
+                )
             if self._decision is not None:
                 self._decision.attempt(
                     relax_where, "multi-chain-relax", "failed",
                     f"placed {idx}/{len(flat)} pods before running out of chains",
                 )
-            return None, None, (
-                "insufficient capacity even after relaxing the affinity group "
-                "across cell chains"
-            )
+            return None, None, reason
         log.info("Affinity group %s relaxed across chains: %s pods placed",
                  sr.affinity_group_name, len(flat))
         if self._decision is not None:
@@ -1364,16 +1497,23 @@ class HivedAlgorithm(SchedulerAlgorithm):
             leaf_cell_number = len(gms.pod_placements[0].physical_leaf_cell_indices)
             for pod_index in range(len(gms.pod_placements)):
                 node = gms.pod_placements[pod_index].physical_node
+                if live is not None:
+                    # per-pod row hoists for the live (stash) path: the
+                    # [leaf_cell_number][pod_index] indexing otherwise
+                    # repeats per leaf of a gang-sized create
+                    live_gp, live_gv = live
+                    live_prow = live_gp[leaf_cell_number][pod_index]
+                    live_vrow = (None if live_gv is None
+                                 else live_gv[leaf_cell_number][pod_index])
                 for leaf_cell_index in range(
                     len(gms.pod_placements[pod_index].physical_leaf_cell_indices)
                 ):
                     if live is not None:
-                        live_gp, live_gv = live
-                        p_leaf_cell = live_gp[leaf_cell_number][pod_index][leaf_cell_index]
-                        if live_gv is None:
+                        p_leaf_cell = live_prow[leaf_cell_index]
+                        if live_vrow is None:
                             v_leaf_cell, lazy_preempt = None, None
                         else:
-                            v_leaf_cell = live_gv[leaf_cell_number][pod_index][leaf_cell_index]
+                            v_leaf_cell = live_vrow[leaf_cell_index]
                             lazy_preempt = False
                     else:
                         p_leaf_cell, v_leaf_cell, lazy_preempt = self._find_allocated_leaf_cell(
@@ -1810,6 +1950,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
     ) -> Tuple[bool, str]:
         """Reference: allocateLeafCell, hived_algorithm.go:1294-1323."""
         safety_ok, reason = True, ""
+        self._bump_chain_gen(p_leaf_cell.chain)
         if v_leaf_cell is not None:
             allocate_cell_walk(v_leaf_cell, p, batch)
             allocate_cell_walk(p_leaf_cell, p, batch)
@@ -1846,6 +1987,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
         decrements the virtual used-counts at freePriority here, planting a
         permanent ``{freePriority: -1}`` entry that skews cluster-view
         scoring; found by tests/test_invariant_fuzz.py's recount invariant."""
+        self._bump_chain_gen(p_leaf_cell.chain)
         v_leaf_cell = p_leaf_cell.virtual_cell
         doomed_only = (
             v_leaf_cell is not None and v_leaf_cell.priority == FREE_PRIORITY
@@ -1895,6 +2037,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
         level (reference: allocatePreassignedCell, hived_algorithm.go:1356-1427)."""
         safety_ok, reason = True, ""
         chain, level = c.chain, c.level
+        self._bump_chain_gen(chain)
         self.vc_free_cell_num[vcn][chain][level] -= 1
         self.all_vc_free_cell_num[chain][level] -= 1
         self.total_left_cell_num[chain][level] -= 1
@@ -1959,6 +2102,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
     def _release_preassigned_cell(self, c: PhysicalCell, vcn: str, doomed_bad: bool) -> None:
         """Reference: releasePreassignedCell, hived_algorithm.go:1451-1485."""
         chain, level = c.chain, c.level
+        self._bump_chain_gen(chain)
         self.vc_free_cell_num[vcn][chain][level] += 1
         self.all_vc_free_cell_num[chain][level] += 1
         self.total_left_cell_num[chain][level] += 1

@@ -39,7 +39,12 @@ log = logging.getLogger(__name__)
 
 class _Node:
     """One schedulable unit of the cluster view (reference: node struct,
-    topology_aware_scheduler.go:118-154)."""
+    topology_aware_scheduler.go:118-154).
+
+    ``seen_gen``/``seen_priority`` make the view persistent: the node's
+    scoring fields are recomputed only when the underlying cell's
+    ``view_gen`` moved or the probe priority changed since the last
+    refresh (see TopologyAwareScheduler._update_cluster_view)."""
 
     __slots__ = (
         "cell",
@@ -49,6 +54,8 @@ class _Node:
         "healthy",
         "suggested",
         "node_address",
+        "seen_gen",
+        "seen_priority",
     )
 
     def __init__(self, cell: Cell):
@@ -59,6 +66,8 @@ class _Node:
         self.healthy = True
         self.suggested = True
         self.node_address = ""
+        self.seen_gen = -1  # never refreshed
+        self.seen_priority: Optional[CellPriority] = None
 
     def update_used_leaf_cell_num_for_priority(
         self, p: CellPriority, cross_priority_pack: bool
@@ -173,7 +182,12 @@ def _find_nodes_for_pods(
     minimization) — on a mesh chain that enclosing cell is a contiguous ICI
     sub-mesh, so a gang no longer straddles buddy cells in an L-shape while a
     whole free cell exists. Falls back to the reference's flat greedy (which
-    also owns the bad/non-suggested failure reasons)."""
+    also owns the bad/non-suggested failure reasons).
+
+    This rebuild-per-call function is the semantic REFERENCE; the scheduler's
+    hot path runs the incremental equivalent
+    (TopologyAwareScheduler._find_nodes_incremental), which must pick the
+    same nodes (guard: tests/test_incremental_views.py)."""
     sign = -1 if pack else 1
     cv.sort(
         key=lambda n: (
@@ -407,6 +421,14 @@ def find_leaf_cells_in_node(
         _get_leaf_cells_from_node(n, p, free, preemptible)
         available_leaf_cells = free + preemptible
 
+    if leaf_cell_num == len(available_leaf_cells):
+        # taking every candidate: any LCA-minimizing search returns exactly
+        # this set in ascending index order, so skip the search — the common
+        # whole-node allocation on small (e.g. 4-chip) hosts
+        picked = list(available_leaf_cells)
+        del available_leaf_cells[:]
+        return picked, available_leaf_cells
+
     optimal = _get_optimal_affinity(leaf_cell_num, level_leaf_cell_num)
     # Hybrid dispatch: below the threshold (typical mesh hosts hold 4-8
     # chips) the reference's tight backtracking loop has the best constant
@@ -489,8 +511,23 @@ def _remove_picked(leaf_cells: CellList, indices: List[int]) -> None:
         del leaf_cells[index - offset]
 
 
+# below this many nodes the Python packing path beats ctypes marshalling
+_PACK_NATIVE_THRESHOLD = 32
+
+
 class TopologyAwareScheduler:
-    """Reference: topologyAwareScheduler, topology_aware_scheduler.go:36-116."""
+    """Reference: topologyAwareScheduler, topology_aware_scheduler.go:36-116.
+
+    The cluster view is PERSISTENT and incremental: ``self.cv`` keeps its
+    construction order forever, ``self._order`` carries the sorted
+    permutation across calls (re-sorted — stably, seeding ties with the
+    previous order exactly like the old in-place ``cv.sort()`` — only when a
+    node's scoring inputs changed), and the enclosure structure for the
+    multi-pod packing pass is precomputed once from the static topology.
+    ``HIVED_INCR=0`` forces the rebuild-per-call reference path
+    (:func:`_find_nodes_for_pods`); both must pick identical nodes (guards:
+    tests/test_incremental_views.py, chaos.invariants.check_cluster_views).
+    """
 
     def __init__(
         self,
@@ -499,11 +536,30 @@ class TopologyAwareScheduler:
         cross_priority_pack: bool,
         pack: bool = True,
     ):
+        self.ccl = ccl  # kept for from-scratch view rebuilds (invariants)
         self.cv = _new_cluster_view(ccl)
         self.level_leaf_cell_num = level_leaf_cell_num
         self.cross_priority_pack = cross_priority_pack
         # pack=False = "spread" policy: prefer emptier nodes
         self.pack = pack
+        # persistent sorted permutation (static indices into cv) + validity
+        self._order: List[int] = list(range(len(self.cv)))
+        self._order_dirty = True
+        # static enclosure structure: [(ancestor level, [static indices])]
+        # visited tightest level first — ancestors never change after
+        # construction, only the per-call member filtering does
+        enclosures: Dict[Tuple[int, str], List[int]] = {}
+        for i, n in enumerate(self.cv):
+            anc = n.cell.parent
+            while anc is not None:
+                enclosures.setdefault((anc.level, anc.address), []).append(i)
+                anc = anc.parent
+        self._enclosures: List[Tuple[int, List[int]]] = [
+            (lv, members) for (lv, _addr), members in sorted(
+                enclosures.items(), key=lambda kv: kv[0][0]
+            )
+        ]
+        self._native_pack = None  # lazily-built native packing state
 
     def schedule(
         self,
@@ -520,16 +576,17 @@ class TopologyAwareScheduler:
             sorted_pod_nums.extend([leaf_cell_num] * pod_num)
         sorted_pod_nums.sort()
 
+        incremental = os.environ.get("HIVED_INCR", "1") != "0"
         priority = OPPORTUNISTIC_PRIORITY
         self._update_cluster_view(priority, suggested_nodes, ignore_suggested_nodes)
-        picked_indices, failed_reason = _find_nodes_for_pods(
-            self.cv, sorted_pod_nums, self.pack
+        picked_indices, failed_reason = self._find_nodes(
+            sorted_pod_nums, incremental
         )
         if picked_indices is None and p > OPPORTUNISTIC_PRIORITY:
             priority = p
             self._update_cluster_view(priority, suggested_nodes, ignore_suggested_nodes)
-            picked_indices, failed_reason = _find_nodes_for_pods(
-                self.cv, sorted_pod_nums, self.pack
+            picked_indices, failed_reason = self._find_nodes(
+                sorted_pod_nums, incremental
             )
         if picked_indices is None:
             return None, failed_reason
@@ -549,11 +606,220 @@ class TopologyAwareScheduler:
             pod_placements.setdefault(leaf_cell_num, []).append(picked_cells)
         return pod_placements, ""
 
+    # ------------------------------------------------------------------
+    # incremental node selection
+    # ------------------------------------------------------------------
+
+    def _find_nodes(
+        self, sorted_pod_nums: List[int], incremental: bool
+    ) -> Tuple[Optional[List[int]], str]:
+        """Dispatch: native one-call packing (sort + enclosure pass + greedy
+        in C), the incremental Python path (cached order + static
+        enclosures), or the rebuild-per-call reference (HIVED_INCR=0)."""
+        if not incremental:
+            # rebuild-per-call reference: sort a COPY so the static cv order
+            # (which the enclosure structure and native buffers index) is
+            # never disturbed, then translate positional picks back to
+            # static indices
+            cv_copy = list(self.cv)
+            picked, reason = _find_nodes_for_pods(
+                cv_copy, sorted_pod_nums, self.pack
+            )
+            if picked is not None:
+                pos = {id(n): i for i, n in enumerate(self.cv)}
+                picked = [pos[id(cv_copy[k])] for k in picked]
+            return picked, reason
+        native = self._native_pack_state()
+        if native is not None:
+            picked, reason = self._find_nodes_native(native, sorted_pod_nums)
+            if picked is not None or reason:
+                return picked, reason
+            # reason == "": native declined (shouldn't happen) — fall through
+        if self._order_dirty:
+            sign = -1 if self.pack else 1
+            cv = self.cv
+            # stable re-sort of the PREVIOUS order: ties keep their old
+            # relative position, exactly like the reference's repeated
+            # in-place cv.sort()
+            self._order.sort(
+                key=lambda i: (
+                    not cv[i].healthy,
+                    not cv[i].suggested,
+                    sign * cv[i].used_leaf_cell_num_same_priority,
+                    cv[i].used_leaf_cell_num_higher_priority,
+                )
+            )
+            self._order_dirty = False
+        return self._find_nodes_incremental(sorted_pod_nums)
+
+    def _find_nodes_native(self, state, sorted_pod_nums: List[int]):
+        """One C call for the whole cross-node packing loop (stable sort of
+        the persistent order, enclosure pass, greedy assign) — the common
+        single-chain case. Failure strings are formatted here so they stay
+        byte-identical to the Python reference's."""
+        from hivedscheduler_tpu import native
+
+        rc, picked, fail_idx = native.find_nodes_for_pods(
+            state, sorted_pod_nums, self.pack, 1 if self._order_dirty else 0
+        )
+        if self._order_dirty:
+            self._order = list(state["order_buf"])
+            self._order_dirty = False
+        if rc == 0:
+            return picked, ""
+        if rc == 2:
+            return None, (
+                f"have to use at least one bad node "
+                f"{self.cv[fail_idx].node_address}"
+            )
+        if rc == 3:
+            return None, (
+                f"have to use at least one non-suggested node "
+                f"{self.cv[fail_idx].node_address}"
+            )
+        return None, "insufficient capacity"
+
+    def _find_nodes_incremental(
+        self, sorted_pod_nums: List[int]
+    ) -> Tuple[Optional[List[int]], str]:
+        """The reference's findNodesForPods over the cached order + static
+        enclosures; returns STATIC indices into cv. Must pick exactly the
+        nodes :func:`_find_nodes_for_pods` picks."""
+        cv = self.cv
+        order = self._order
+        if len(sorted_pod_nums) > 1 and self._enclosures:
+            total = sum(sorted_pod_nums)
+            rank = [0] * len(cv)
+            for r, j in enumerate(order):
+                rank[j] = r
+            # candidate enclosures: filter members to healthy+suggested,
+            # capacity-check, then visit (level asc, best member rank asc) —
+            # identical to the reference's sorted((level, first-member)) walk
+            candidates: List[Tuple[int, int, List[int]]] = []
+            for lv, members in self._enclosures:
+                cap = 0
+                rs: List[int] = []
+                for j in members:
+                    n = cv[j]
+                    if n.healthy and n.suggested:
+                        cap += n.free_leaf_cell_num_at_priority
+                        rs.append(rank[j])
+                if not rs or cap < total:
+                    continue
+                rs.sort()
+                candidates.append((lv, rs[0], rs))
+            candidates.sort(key=lambda t: (t[0], t[1]))
+            for _lv, _first, rs in candidates:
+                picked, _ = _greedy_assign(
+                    cv, [order[r] for r in rs], sorted_pod_nums
+                )
+                if picked is not None:
+                    return picked, ""
+        return _greedy_assign(cv, order, sorted_pod_nums)
+
+    def _native_pack_state(self):
+        """Build (once) the persistent buffers feeding the native packing
+        call: per-node score arrays in static order plus the static
+        node-level ancestor-id matrix (tentpole: cached ancestor matrices —
+        topology never changes after construction, so the matrix is built
+        exactly once; the score buffers are kept in sync by the same dirty
+        tracking that refreshes the Python view). Returns None when the
+        native library is unavailable or the view is too small to bother;
+        ``False`` is the cached "disabled" marker."""
+        state = self._native_pack
+        if state is not None:
+            return state if state is not False else None
+        import ctypes
+
+        from hivedscheduler_tpu import native
+
+        if (len(self.cv) < _PACK_NATIVE_THRESHOLD
+                or os.environ.get("HIVED_NATIVE", "") == "0"
+                or not native.pack_available()):
+            self._native_pack = False
+            return None
+        n = len(self.cv)
+        # static ancestor-id matrix: columns are ancestor levels ascending
+        # (tightest enclosure first); -1 where a node lacks an ancestor at
+        # that level. Ids are per-(level, address), assigned once.
+        level_set = set()
+        chains = []
+        for node in self.cv:
+            anc_chain = []
+            anc = node.cell.parent
+            while anc is not None:
+                anc_chain.append(anc)
+                level_set.add(anc.level)
+                anc = anc.parent
+            chains.append(anc_chain)
+        levels = sorted(level_set)
+        n_anc = len(levels)
+        col_of = {lv: c for c, lv in enumerate(levels)}
+        ids: Dict[Tuple[int, str], int] = {}
+        anc_buf = (ctypes.c_int32 * max(1, n * n_anc))()
+        for i in range(n * n_anc):
+            anc_buf[i] = -1
+        for i, anc_chain in enumerate(chains):
+            for anc in anc_chain:
+                anc_buf[i * n_anc + col_of[anc.level]] = ids.setdefault(
+                    (anc.level, anc.address), len(ids)
+                )
+        state = {
+            "n": n,
+            "n_anc": n_anc,
+            "n_ids": len(ids),
+            "anc_buf": anc_buf,
+            "order_buf": (ctypes.c_int32 * n)(*self._order),
+            "healthy_buf": (ctypes.c_int32 * n)(),
+            "suggested_buf": (ctypes.c_int32 * n)(),
+            "same_buf": (ctypes.c_int32 * n)(),
+            "higher_buf": (ctypes.c_int32 * n)(),
+            "free_buf": (ctypes.c_int32 * n)(),
+        }
+        for i, node in enumerate(self.cv):
+            state["healthy_buf"][i] = 1 if node.healthy else 0
+            state["suggested_buf"][i] = 1 if node.suggested else 0
+            state["same_buf"][i] = node.used_leaf_cell_num_same_priority
+            state["higher_buf"][i] = node.used_leaf_cell_num_higher_priority
+            state["free_buf"][i] = node.free_leaf_cell_num_at_priority
+        self._native_pack = state
+        return state
+
     def _update_cluster_view(
         self, p: CellPriority, suggested_nodes: Set[str], ignore_suggested_nodes: bool
     ) -> None:
-        for n in self.cv:
-            n.update_used_leaf_cell_num_for_priority(p, self.cross_priority_pack)
-            n.healthy, n.suggested, n.node_address = _node_healthy_and_in_suggested(
-                n, suggested_nodes, ignore_suggested_nodes
-            )
+        """Refresh only nodes whose cell mutated (``view_gen``) or whose
+        probe priority changed; recheck suggested-node membership per call
+        (it arrives from outside the cell trees) unless ignored. Any change
+        marks the cached sort order dirty."""
+        changed = False
+        state = self._native_pack if self._native_pack else None
+        for i, n in enumerate(self.cv):
+            c = n.cell
+            gen = c.view_gen
+            if gen != n.seen_gen or p != n.seen_priority:
+                n.update_used_leaf_cell_num_for_priority(p, self.cross_priority_pack)
+                n.healthy, n.suggested, n.node_address = _node_healthy_and_in_suggested(
+                    n, suggested_nodes, ignore_suggested_nodes
+                )
+                n.seen_gen = gen
+                n.seen_priority = p
+                changed = True
+                if state is not None:
+                    state["healthy_buf"][i] = 1 if n.healthy else 0
+                    state["suggested_buf"][i] = 1 if n.suggested else 0
+                    state["same_buf"][i] = n.used_leaf_cell_num_same_priority
+                    state["higher_buf"][i] = n.used_leaf_cell_num_higher_priority
+                    state["free_buf"][i] = n.free_leaf_cell_num_at_priority
+            elif not ignore_suggested_nodes:
+                healthy, suggested, addr = _node_healthy_and_in_suggested(
+                    n, suggested_nodes, ignore_suggested_nodes
+                )
+                if suggested != n.suggested or healthy != n.healthy:
+                    n.healthy, n.suggested, n.node_address = healthy, suggested, addr
+                    changed = True
+                    if state is not None:
+                        state["healthy_buf"][i] = 1 if healthy else 0
+                        state["suggested_buf"][i] = 1 if suggested else 0
+        if changed:
+            self._order_dirty = True

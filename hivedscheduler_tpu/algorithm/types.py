@@ -149,12 +149,22 @@ def virtual_to_physical_placement(
     return physical
 
 
-@dataclass
 class CellBindingPathVertex:
-    """Vertex of a binding-path tree (reference: types.go:342-347)."""
+    """Vertex of a binding-path tree (reference: types.go:342-347).
+    Slotted plain class: a gang's binding-path build creates one vertex per
+    unbound virtual cell, which puts construction on the schedule hot path."""
 
-    cell: VirtualCell
-    children_to_bind: List["CellBindingPathVertex"] = field(default_factory=list)
+    __slots__ = ("cell", "children_to_bind")
+
+    def __init__(
+        self,
+        cell: VirtualCell,
+        children_to_bind: Optional[List["CellBindingPathVertex"]] = None,
+    ):
+        self.cell = cell
+        self.children_to_bind = (
+            children_to_bind if children_to_bind is not None else []
+        )
 
 
 def to_binding_paths(
@@ -168,29 +178,29 @@ def to_binding_paths(
     Returns (preassigned roots, groups of non-preassigned roots that share an
     already-bound parent — grouped so they can be mapped to buddy physical
     cells together). Already-bound leaf cells are recorded into ``bindings``.
-    """
-    all_vertices: Dict[str, CellBindingPathVertex] = {}
+
+    Vertices are keyed by cell identity: a cell appears at most once per tree,
+    so identity keys are equivalent to the reference's address keys without
+    hashing the (long) hierarchical address strings per leaf."""
+    all_vertices: Dict[int, CellBindingPathVertex] = {}
     preassigned: List[CellBindingPathVertex] = []
     non_preassigned: List[List[CellBindingPathVertex]] = []
     for pod_leaf_cell_num in leaf_cell_nums:
         for pod_placement in p[pod_leaf_cell_num]:
             for leaf_cell in pod_placement:
-                assert isinstance(leaf_cell, VirtualCell)
                 if leaf_cell.physical_cell is not None:
                     bindings[leaf_cell.address] = leaf_cell.physical_cell
                     continue
                 binding_path: List[VirtualCell] = []
                 c: Optional[Cell] = leaf_cell
                 while c is not None:
-                    vc = c
-                    assert isinstance(vc, VirtualCell)
-                    if vc.physical_cell is not None or vc.address in all_vertices:
+                    if c.physical_cell is not None or id(c) in all_vertices:
                         break
-                    binding_path.append(vc)
+                    binding_path.append(c)
                     c = c.parent
                 path_root = binding_path[-1]
                 n = CellBindingPathVertex(cell=path_root)
-                all_vertices[path_root.address] = n
+                all_vertices[id(path_root)] = n
                 parent = path_root.parent
                 if parent is None:
                     preassigned.append(n)
@@ -202,12 +212,12 @@ def to_binding_paths(
                     else:
                         non_preassigned.append([n])
                 else:
-                    parent_node = all_vertices[path_root.parent.address]
+                    parent_node = all_vertices[id(path_root.parent)]
                     parent_node.children_to_bind.append(n)
                 for c2 in reversed(binding_path[:-1]):
                     n2 = CellBindingPathVertex(cell=c2)
-                    all_vertices[c2.parent.address].children_to_bind.append(n2)
-                    all_vertices[c2.address] = n2
+                    all_vertices[id(c2.parent)].children_to_bind.append(n2)
+                    all_vertices[id(c2)] = n2
     return preassigned, non_preassigned
 
 
@@ -246,6 +256,13 @@ class AlgoAffinityGroup:
         # version
         self.placement_version = 0
         self._bind_info_cache = None  # (version, bind_info_list, chain)
+        self._placement_nodes_cache = None  # (version, {node names})
+        # per-leaf-cell-num watermark: every allocated_pods slot below it is
+        # non-None, so the "first free index" scan starts there instead of
+        # rescanning the whole gang per pod (O(gang) instead of O(gang^2)
+        # across a gang's bind sequence). Advanced in add_allocated_pod,
+        # lowered in delete_allocated_pod — "first None" semantics are exact.
+        self.pod_index_watermark: Dict[int, int] = {}
         for leaf_cell_num, pod_num in self.total_pod_nums.items():
             self.physical_leaf_cell_placement[leaf_cell_num] = [
                 [None] * leaf_cell_num for _ in range(pod_num)
@@ -254,6 +271,22 @@ class AlgoAffinityGroup:
                 [None] * leaf_cell_num for _ in range(pod_num)
             ]
             self.allocated_pods[leaf_cell_num] = [None] * pod_num
+
+    def placement_node_names(self) -> Set[str]:
+        """Distinct node names of the physical placement, cached per
+        placement version — the per-pod health/suggested scan reads this
+        instead of walking every leaf cell."""
+        cached = self._placement_nodes_cache
+        if cached is not None and cached[0] == self.placement_version:
+            return cached[1]
+        nodes: Set[str] = set()
+        for pod_placements in self.physical_leaf_cell_placement.values():
+            for pod_placement in pod_placements:
+                for c in pod_placement:
+                    if c is not None:
+                        nodes.add(c.nodes[0])
+        self._placement_nodes_cache = (self.placement_version, nodes)
+        return nodes
 
     def to_affinity_group(self) -> api.AffinityGroup:
         """Reference: ToAffinityGroup, types.go:185-214."""

@@ -274,10 +274,14 @@ def retrieve_virtual_cell(
     return None
 
 
-def get_new_pod_index(pods: List[Optional[Pod]]) -> int:
-    """Reference: getNewPodIndex, utils.go:286-295."""
-    for i, p in enumerate(pods):
-        if p is None:
+def get_new_pod_index(pods: List[Optional[Pod]], start: int = 0) -> int:
+    """Reference: getNewPodIndex, utils.go:286-295.
+
+    ``start`` is a caller-maintained watermark (every slot below it is
+    known non-None — see AlgoAffinityGroup.pod_index_watermark), keeping
+    the "first None index" result exact while skipping the filled prefix."""
+    for i in range(start, len(pods)):
+        if pods[i] is None:
             return i
     return -1
 

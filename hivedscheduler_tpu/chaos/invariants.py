@@ -199,6 +199,114 @@ def check_gang_atomicity(
                            f"never bound")
 
 
+def _all_topology_schedulers(algo):
+    """Every live TopologyAwareScheduler of the algorithm (opportunistic
+    per chain, per-VC non-pinned per chain, per-VC pinned)."""
+    for chain, s in algo.opportunistic_schedulers.items():
+        yield f"opportunistic/{chain}", s
+    for vcn, vcs in algo.vc_schedulers.items():
+        for chain, s in vcs.non_pinned_cell_schedulers.items():
+            yield f"{vcn}/{chain}", s
+        for pid, s in vcs.pinned_cell_schedulers.items():
+            yield f"{vcn}/pinned:{pid}", s
+
+
+def check_cluster_views(algo, ctx: str = "") -> None:
+    """The persistent incremental cluster views must equal a from-scratch
+    rebuild (the perf-PR contract: dirty tracking may defer work, never
+    change results).
+
+    - *Node set*: the static view holds exactly the cells a fresh
+      ``_new_cluster_view`` over the same ChainCellList extracts, in order
+      (topology never changes, so any drift is a bug).
+    - *Scoring state*: for every node the view believes CURRENT
+      (``seen_gen == cell.view_gen``), the cached free/same/higher counters
+      must equal a fresh recompute at the node's ``seen_priority`` — this
+      is precisely what catches a mutation site that forgot to bump
+      ``view_gen`` (stale counters masquerading as current).
+    - *Native buffers*: the persistent score buffers feeding the C packing
+      call are written in lockstep with the node fields, so they must
+      mirror them at all times.
+    - *Cached ancestor/enclosure structure*: rebuilt from the cell parents,
+      the static enclosure member lists must match bit-for-bit.
+    """
+    from hivedscheduler_tpu.algorithm.topology_aware import (
+        _Node,
+        _new_cluster_view,
+        _node_healthy_and_in_suggested,
+    )
+
+    for label, s in _all_topology_schedulers(algo):
+        fresh = _new_cluster_view(s.ccl)
+        if [n.cell.address for n in fresh] != [n.cell.address for n in s.cv]:
+            _fail(ctx, f"cluster view {label}: node set drifted from "
+                       f"from-scratch rebuild")
+        for i, n in enumerate(s.cv):
+            if n.seen_priority is None or n.seen_gen != n.cell.view_gen:
+                continue  # legitimately stale: will refresh before next use
+            ref = _Node(n.cell)
+            ref.update_used_leaf_cell_num_for_priority(
+                n.seen_priority, s.cross_priority_pack
+            )
+            fresh_healthy, _, _ = _node_healthy_and_in_suggested(
+                n, set(), True
+            )
+            if fresh_healthy != n.healthy:
+                _fail(ctx, f"cluster view {label} node {n.cell.address}: "
+                           f"cached healthiness stale while marked current")
+            if (
+                ref.free_leaf_cell_num_at_priority
+                != n.free_leaf_cell_num_at_priority
+                or ref.used_leaf_cell_num_same_priority
+                != n.used_leaf_cell_num_same_priority
+                or ref.used_leaf_cell_num_higher_priority
+                != n.used_leaf_cell_num_higher_priority
+            ):
+                _fail(ctx, f"cluster view {label} node {n.cell.address}: "
+                           f"cached counters stale while marked current "
+                           f"(missed view_gen bump?): cached "
+                           f"({n.free_leaf_cell_num_at_priority}, "
+                           f"{n.used_leaf_cell_num_same_priority}, "
+                           f"{n.used_leaf_cell_num_higher_priority}) != fresh "
+                           f"({ref.free_leaf_cell_num_at_priority}, "
+                           f"{ref.used_leaf_cell_num_same_priority}, "
+                           f"{ref.used_leaf_cell_num_higher_priority})")
+        state = s._native_pack
+        if state and state is not False:
+            for i, n in enumerate(s.cv):
+                if (
+                    state["healthy_buf"][i] != (1 if n.healthy else 0)
+                    or state["suggested_buf"][i] != (1 if n.suggested else 0)
+                    or state["same_buf"][i]
+                    != n.used_leaf_cell_num_same_priority
+                    or state["higher_buf"][i]
+                    != n.used_leaf_cell_num_higher_priority
+                    or state["free_buf"][i]
+                    != n.free_leaf_cell_num_at_priority
+                ):
+                    _fail(ctx, f"cluster view {label} node {n.cell.address}: "
+                               f"native score buffer out of sync with the "
+                               f"Python view")
+            if sorted(state["order_buf"]) != list(range(len(s.cv))):
+                _fail(ctx, f"cluster view {label}: native order buffer is "
+                           f"not a permutation")
+        # static enclosure structure == rebuild from cell parents
+        rebuilt = {}
+        for i, n in enumerate(s.cv):
+            anc = n.cell.parent
+            while anc is not None:
+                rebuilt.setdefault((anc.level, anc.address), []).append(i)
+                anc = anc.parent
+        rebuilt_list = [
+            (lv, members) for (lv, _a), members in sorted(
+                rebuilt.items(), key=lambda kv: kv[0][0]
+            )
+        ]
+        if rebuilt_list != s._enclosures:
+            _fail(ctx, f"cluster view {label}: cached enclosure structure "
+                       f"drifted from topology rebuild")
+
+
 def check_all(
     algo,
     ctx: str = "",
@@ -209,6 +317,7 @@ def check_all(
     check_vc_safety(algo, ctx)
     check_books(algo, ctx)
     check_cell_ownership(algo, ctx)
+    check_cluster_views(algo, ctx)
     check_gang_atomicity(algo, ctx, full_groups=full_groups,
                          allow_partial_placement=allow_partial_placement)
 

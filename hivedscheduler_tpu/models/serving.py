@@ -333,11 +333,14 @@ class Request:
     # unaffected (greedy exactness and the counter-based sampled keys
     # depend on rid/prompt, not admission order).
     #
-    # STARVATION CAVEAT: this is strict priority with no aging. A sustained
-    # stream of higher-priority submissions keeps inserting ahead of
-    # priority-0 waiters, which then never reach the queue head — there is
-    # no bounded-wait guarantee for low-priority traffic. Callers that need
-    # one must bound the high-priority offered load themselves (or
+    # STARVATION CAVEAT: this is strict priority with no aging by default.
+    # A sustained stream of higher-priority submissions keeps inserting
+    # ahead of priority-0 waiters, which then never reach the queue head —
+    # there is no bounded-wait guarantee for low-priority traffic. Callers
+    # that need one can opt into bounded-wait aging
+    # (``ServingEngine(age_boost_secs=...)`` / ``serve --age-boost-secs``:
+    # one effective priority level per age_boost_secs waited), bound the
+    # high-priority offered load themselves (or
     # periodically resubmit aged work at a boosted priority); the per-class
     # TTFT/queue-wait histograms (tpu_hive_serve_*_seconds{priority=...})
     # make starvation visible. ``queue_timeout_s`` converts unbounded
@@ -407,6 +410,7 @@ class ServingEngine:
         prefill_chunk: int = 0,
         kv_dtype: Optional[str] = None,
         queue_timeout_s: Optional[float] = None,
+        age_boost_secs: Optional[float] = None,
         clock=time.perf_counter,
     ):
         """``mesh``: lay the engine out over a dp x tp serving mesh —
@@ -442,12 +446,24 @@ class ServingEngine:
         strict-priority starvation caveat with observable load shedding
         instead of unbounded waits. ``None`` (default) never sheds.
 
+        ``age_boost_secs``: bounded-wait aging for the strict-priority
+        queue (see the starvation caveat on ``submit``/``Request.priority``).
+        When set, a waiter's EFFECTIVE priority at admission time is
+        ``priority + floor(wait / age_boost_secs)`` — every
+        ``age_boost_secs`` seconds in queue buys one priority level, so any
+        waiter eventually outranks a sustained stream of higher-priority
+        arrivals and wait is bounded by
+        ``(p_high - p_low) * age_boost_secs`` plus one admission sweep.
+        Ties keep FIFO order within an effective level. ``None`` (default)
+        keeps strict priority exactly as before.
+
         ``clock``: the engine's wall-clock source (``time.perf_counter``);
         injectable so overload/deadline behavior is testable
         deterministically."""
         self.params = params
         self.cfg = cfg
         self.queue_timeout_s = queue_timeout_s
+        self.age_boost_secs = age_boost_secs
         self._clock = clock
         self.max_batch = max_batch
         self.max_len = max_len
@@ -604,11 +620,14 @@ class ServingEngine:
         preempted — admission ordering only, so every request's stream is
         unchanged).
 
-        Strict priority, NO aging: a sustained stream of higher-priority
-        submissions starves lower-priority waiters indefinitely (each new
-        high-priority request inserts ahead of them). If bounded wait
-        matters, cap the high-priority offered load or re-submit aged
-        requests at a boosted priority — see ``Request.priority``."""
+        Strict priority, NO aging by default: a sustained stream of
+        higher-priority submissions starves lower-priority waiters
+        indefinitely (each new high-priority request inserts ahead of
+        them). If bounded wait matters, construct the engine with
+        ``age_boost_secs`` (one priority level per ``age_boost_secs``
+        seconds waited — ``serve --age-boost-secs``), cap the
+        high-priority offered load, or re-submit aged requests at a
+        boosted priority — see ``Request.priority``."""
         if self.draining:
             metrics.inc("tpu_hive_serve_drain_rejected_total")
             raise EngineDraining(
@@ -717,6 +736,24 @@ class ServingEngine:
                 kept.append(req)
         self.queue = kept
 
+    def _next_waiter(self):
+        """Pop the next request to admit: queue head under strict priority
+        (the insertion order), or the max-effective-priority waiter under
+        ``age_boost_secs`` aging (ties keep FIFO: the queue is already
+        priority-then-FIFO ordered, and a stable max scan returns the
+        earliest of equals)."""
+        if self.age_boost_secs is None or len(self.queue) <= 1:
+            return self.queue.pop(0)
+        now = self._clock()
+        boost = self.age_boost_secs
+        best_i = 0
+        best_eff = None
+        for i, w in enumerate(self.queue):
+            eff = w.priority + int((now - w.submitted_at) / boost)
+            if best_eff is None or eff > best_eff:
+                best_i, best_eff = i, eff
+        return self.queue.pop(best_i)
+
     def _admit(self) -> None:
         self._shed_expired()
         for slot in range(self.max_batch):
@@ -724,7 +761,7 @@ class ServingEngine:
                 return
             if self.slots[slot] is not None:
                 continue
-            req = self.queue.pop(0)
+            req = self._next_waiter()
             req.admitted_at = self._clock()
             hit = self._match_prefix(req.prompt) if self._prefix_cache else None
             if hit is not None:

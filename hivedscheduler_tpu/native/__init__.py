@@ -54,6 +54,20 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int32),
         ]
+        try:
+            p_i32 = ctypes.POINTER(ctypes.c_int32)
+            lib.hived_find_nodes_for_pods.restype = ctypes.c_int32
+            lib.hived_find_nodes_for_pods.argtypes = [
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,  # n, n_anc, n_ids
+                p_i32,                                           # anc_ids
+                p_i32, p_i32, p_i32, p_i32, p_i32,               # scores
+                ctypes.c_int32, ctypes.c_int32,                  # pack, do_sort
+                p_i32,                                           # order (in/out)
+                p_i32, ctypes.c_int32,                           # pod_nums, n_pods
+                p_i32, p_i32,                                    # out_nodes, out_fail
+            ]
+        except AttributeError:  # stale prebuilt .so: packing entry absent
+            pass
         _lib = lib
     except Exception as e:  # toolchain missing / compile error
         if os.environ.get("HIVED_NATIVE") == "1":
@@ -136,6 +150,44 @@ def gather_windows(tokens, starts, seq_len: int, n_threads: int = 4):
 
 def available() -> bool:
     return _load() is not None
+
+
+def pack_available() -> bool:
+    """True when the cross-node packing entry point is loadable (a stale
+    prebuilt .so without the symbol degrades to the Python path)."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "hived_find_nodes_for_pods")
+
+
+def find_nodes_for_pods(state: dict, pod_nums: List[int], pack: bool,
+                        do_sort: int):
+    """One-call cross-node gang packing (sort + enclosure pass + greedy).
+
+    ``state`` holds the scheduler's persistent per-node buffers in static
+    order (see TopologyAwareScheduler._native_pack_state); ``state[
+    "order_buf"]`` is updated in place when ``do_sort`` is set. Returns
+    ``(rc, picked_static_indices_or_None, fail_static_index)`` with rc
+    codes 0=ok, 1=insufficient, 2=bad node, 3=non-suggested — the caller
+    formats the failure strings so they stay identical to the Python
+    reference's."""
+    import ctypes
+
+    lib = _load()
+    assert lib is not None
+    n_pods = len(pod_nums)
+    pods_arr = (ctypes.c_int32 * n_pods)(*pod_nums)
+    out = (ctypes.c_int32 * n_pods)()
+    fail = (ctypes.c_int32 * 1)(-1)
+    rc = lib.hived_find_nodes_for_pods(
+        state["n"], state["n_anc"], state["n_ids"], state["anc_buf"],
+        state["healthy_buf"], state["suggested_buf"], state["same_buf"],
+        state["higher_buf"], state["free_buf"],
+        1 if pack else 0, do_sort, state["order_buf"],
+        pods_arr, n_pods, out, fail,
+    )
+    if rc == 0:
+        return 0, list(out), -1
+    return rc, None, fail[0]
 
 
 def find_leaf_cells(

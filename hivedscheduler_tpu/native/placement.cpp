@@ -15,6 +15,7 @@
 //
 // Build: g++ -O2 -shared -fPIC -o _placement.so placement.cpp
 
+#include <algorithm>
 #include <cstdint>
 #include <vector>
 
@@ -93,6 +94,125 @@ int32_t hived_find_leaf_cells(const int32_t* ancestors, int32_t n_avail,
     }
     avail = current_idx[search] + 1;
   }
+}
+
+// Cross-node packing for a whole gang in ONE call: stable sort of the
+// persistent node order, tightest-enclosure pass, then the flat greedy —
+// the single-chain common case of the Python reference
+// (algorithm/topology_aware.py _find_nodes_for_pods; upstream semantics:
+// topology_aware_scheduler.go:268-306). Inputs are persistent per-scheduler
+// buffers in STATIC node order, kept in sync by the incremental cluster
+// view's dirty tracking; `order` is the in/out sorted permutation whose tie
+// history must match the reference's repeated in-place sort, hence
+// std::stable_sort seeded with the previous order.
+//
+// anc_ids: [n_nodes x n_anc] static ancestor-id matrix, columns = ancestor
+// levels ascending (tightest first), -1 where a node has no ancestor at
+// that level; ids dense in [0, n_ids).
+//
+// Returns 0 on success (out_nodes = picked STATIC node indices, one per
+// pod), 1 = insufficient capacity, 2 = would need a bad node, 3 = would
+// need a non-suggested node (out_fail_node = the offending static index).
+int32_t hived_find_nodes_for_pods(
+    int32_t n_nodes, int32_t n_anc, int32_t n_ids, const int32_t* anc_ids,
+    const int32_t* healthy, const int32_t* suggested,
+    const int32_t* used_same, const int32_t* used_higher,
+    const int32_t* free_at_p, int32_t pack, int32_t do_sort, int32_t* order,
+    const int32_t* pod_nums, int32_t n_pods, int32_t* out_nodes,
+    int32_t* out_fail_node) {
+  if (n_pods <= 0 || n_nodes <= 0) return 1;
+  if (do_sort) {
+    const int64_t sign = pack ? -1 : 1;
+    std::stable_sort(order, order + n_nodes, [&](int32_t a, int32_t b) {
+      // lexicographic (!healthy, !suggested, sign*used_same, used_higher)
+      const int32_t ha = !healthy[a], hb = !healthy[b];
+      if (ha != hb) return ha < hb;
+      const int32_t sa = !suggested[a], sb = !suggested[b];
+      if (sa != sb) return sa < sb;
+      const int64_t ua = sign * static_cast<int64_t>(used_same[a]);
+      const int64_t ub = sign * static_cast<int64_t>(used_same[b]);
+      if (ua != ub) return ua < ub;
+      return used_higher[a] < used_higher[b];
+    });
+  }
+  // greedy walk over nodes given by ranks into `order` (reference:
+  // findNodesForPods inner loop / _greedy_assign): a pod lands on the
+  // current node if it still fits; otherwise the accumulated count resets
+  // and the walk advances
+  auto greedy = [&](const int32_t* ranks, int32_t n_ranks,
+                    bool detect_fail, int32_t* fail_code) -> bool {
+    int32_t pod = 0;
+    int32_t picked_leaf = 0;
+    int32_t oi = 0;
+    while (oi < n_ranks) {
+      const int32_t j = order[ranks[oi]];
+      if (free_at_p[j] - picked_leaf >= pod_nums[pod]) {
+        if (!healthy[j]) {
+          if (detect_fail) { *out_fail_node = j; *fail_code = 2; }
+          return false;
+        }
+        if (!suggested[j]) {
+          if (detect_fail) { *out_fail_node = j; *fail_code = 3; }
+          return false;
+        }
+        out_nodes[pod] = j;
+        picked_leaf += pod_nums[pod];
+        ++pod;
+        if (pod == n_pods) return true;
+      } else {
+        picked_leaf = 0;
+        ++oi;
+      }
+    }
+    if (detect_fail) *fail_code = 1;
+    return false;
+  };
+
+  if (n_pods > 1 && n_anc > 0 && n_ids > 0) {
+    int64_t total = 0;
+    for (int32_t i = 0; i < n_pods; ++i) total += pod_nums[i];
+    std::vector<int32_t> rank(n_nodes);
+    for (int32_t r = 0; r < n_nodes; ++r) rank[order[r]] = r;
+    // per enclosure (discovered in ascending first-member rank, which
+    // matches the reference's (level, first-member) visit order when
+    // columns ascend by level): member ranks + usable capacity; only
+    // healthy+suggested nodes join an enclosure
+    std::vector<int32_t> grp_of(n_ids);
+    std::vector<int64_t> grp_cap;
+    std::vector<std::vector<int32_t>> grp_ranks;
+    for (int32_t col = 0; col < n_anc; ++col) {
+      std::fill(grp_of.begin(), grp_of.end(), -1);
+      grp_cap.clear();
+      grp_ranks.clear();
+      for (int32_t r = 0; r < n_nodes; ++r) {
+        const int32_t j = order[r];
+        if (!healthy[j] || !suggested[j]) continue;
+        const int32_t a = anc_ids[static_cast<int64_t>(j) * n_anc + col];
+        if (a < 0) continue;
+        int32_t gi = grp_of[a];
+        if (gi < 0) {
+          gi = grp_of[a] = static_cast<int32_t>(grp_cap.size());
+          grp_cap.push_back(0);
+          grp_ranks.emplace_back();
+        }
+        grp_cap[gi] += free_at_p[j];
+        grp_ranks[gi].push_back(r);
+      }
+      for (size_t gi = 0; gi < grp_cap.size(); ++gi) {
+        if (grp_cap[gi] < total) continue;
+        if (greedy(grp_ranks[gi].data(),
+                   static_cast<int32_t>(grp_ranks[gi].size()),
+                   /*detect_fail=*/false, nullptr)) {
+          return 0;
+        }
+      }
+    }
+  }
+  std::vector<int32_t> flat(n_nodes);
+  for (int32_t r = 0; r < n_nodes; ++r) flat[r] = r;
+  int32_t fail_code = 1;
+  if (greedy(flat.data(), n_nodes, /*detect_fail=*/true, &fail_code)) return 0;
+  return fail_code;
 }
 
 }  // extern "C"

@@ -15,6 +15,10 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+# the most recently started prefetch worker thread (named "hived-prefetch");
+# tests join/poll this object directly rather than diffing threading state
+_last_prefetch_worker = None
+
 
 class TokenFileDataset:
     """A flat binary token stream, memory-mapped (zero-copy reads)."""
@@ -251,7 +255,13 @@ def prefetch(batches: Iterator[np.ndarray], depth: int = 2,
         except BaseException as e:  # surface in the consumer, not the log
             put(e)
 
-    thread = threading.Thread(target=worker, daemon=True)
+    thread = threading.Thread(target=worker, daemon=True,
+                              name="hived-prefetch")
+    # exposed for tests: poll/join the worker object directly instead of
+    # diffing global thread state (ADVICE.md round 5 — an unrelated library
+    # thread starting mid-test must not flake the assertion)
+    global _last_prefetch_worker
+    _last_prefetch_worker = thread
     thread.start()
     try:
         while True:

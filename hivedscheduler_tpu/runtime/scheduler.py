@@ -547,3 +547,17 @@ class HivedScheduler:
 
     def get_virtual_cluster_status(self, vcn: str):
         return self.scheduler_algorithm.get_virtual_cluster_status(vcn)
+
+    # copy-on-read variants: serialize under the algorithm lock instead of
+    # deep-copying the whole status forest per inspect request
+    def get_cluster_status_dict(self):
+        return self.scheduler_algorithm.get_cluster_status_dict()
+
+    def get_physical_cluster_status_dict(self):
+        return self.scheduler_algorithm.get_physical_cluster_status_dict()
+
+    def get_all_virtual_clusters_status_dict(self):
+        return self.scheduler_algorithm.get_all_virtual_clusters_status_dict()
+
+    def get_virtual_cluster_status_dict(self, vcn: str):
+        return self.scheduler_algorithm.get_virtual_cluster_status_dict(vcn)

@@ -20,7 +20,14 @@ from hivedscheduler_tpu.k8s.types import Node, Pod
 
 
 def key(pod: Pod) -> str:
-    return f"{pod.uid}({pod.namespace}/{pod.name})"
+    # memoized on the pod: built several times per scheduling event (log
+    # prefixes), and pods are effectively immutable once constructed.
+    # Pod.deep_copy builds a fresh object, so copies re-derive it.
+    k = pod.__dict__.get("_key_memo")
+    if k is None:
+        k = f"{pod.uid}({pod.namespace}/{pod.name})"
+        pod._key_memo = k
+    return k
 
 
 def freeze_long_lived_state() -> None:
@@ -108,11 +115,23 @@ def _encode_bind_info(pod_bind_info: api.PodBindInfo) -> str:
     """Serialize a bind info, reusing the pre-encoded gang fragment when the
     scheduler attached one (``_encoded_group``, keyed to the group's
     placement version). Field names come from to_dict — one source of
-    truth."""
+    truth; the hand-rolled head below is pinned byte-identical to the
+    to_dict + to_json composition by
+    tests/test_e2e.py::test_encode_bind_info_head_matches_to_dict."""
     frag = getattr(pod_bind_info, "_encoded_group", None)
     if frag is None:
         frag = encode_group_fragment(pod_bind_info.affinity_group_bind_info)
-    head = common.to_json(pod_bind_info.to_dict(include_group=False))
+    node, iso, chain = (pod_bind_info.node, pod_bind_info.leaf_cell_isolation,
+                        pod_bind_info.cell_chain)
+    if type(node) is str and type(chain) is str and all(
+        type(i) is int for i in iso
+    ):
+        # per-pod hot path: skip the dict build + json.dumps machinery
+        head = '{"node":%s,"leafCellIsolation":[%s],"cellChain":%s}' % (
+            json.dumps(node), ",".join(map(str, iso)), json.dumps(chain)
+        )
+    else:  # pragma: no cover - defensive (fields are typed by extract)
+        head = common.to_json(pod_bind_info.to_dict(include_group=False))
     return head[:-1] + ',"affinityGroupBindInfo":' + frag + "}"
 
 
@@ -125,9 +144,18 @@ def new_binding_pod(pod: Pod, pod_bind_info: api.PodBindInfo) -> Pod:
         pod_bind_info.leaf_cell_isolation
     )
     # JSON is valid YAML: machine-written bind info uses the fast codec
-    binding_pod.annotations[api_constants.ANNOTATION_POD_BIND_INFO] = _encode_bind_info(
-        pod_bind_info
-    )
+    encoded = _encode_bind_info(pod_bind_info)
+    binding_pod.annotations[api_constants.ANNOTATION_POD_BIND_INFO] = encoded
+    # In-process handoff: stash the bind-info object the annotation was just
+    # serialized FROM, so extract_pod_bind_info skips hashing/parsing the
+    # (gang-sized) annotation string when the very same string is still in
+    # place — verified by object identity, so any replaced annotation falls
+    # back to the parse path. Pods arriving over the API server have no
+    # stash and behave as before.
+    frag = getattr(pod_bind_info, "_encoded_group", None)
+    if frag is not None:
+        pod_bind_info._frag = frag
+        binding_pod._bind_info_stash = (encoded, pod_bind_info)
     return binding_pod
 
 
@@ -180,6 +208,19 @@ def extract_pod_bind_info(allocated_pod: Pod) -> api.PodBindInfo:
     construction for a gang replay). Anything not in that exact machine
     format (legacy keys, human YAML) falls back to the full parse."""
     raw = allocated_pod.annotations.get(api_constants.ANNOTATION_POD_BIND_INFO, "")
+    stash = getattr(allocated_pod, "_bind_info_stash", None)
+    if stash is not None and stash[0] is raw:
+        info = stash[1]
+        # seed the gang-fragment memo so pods of the same gang arriving
+        # WITHOUT a stash (e.g. replayed through the API server) still hit
+        # the shared-fragment fast path; the fragment string object is
+        # shared gang-wide, so its hash is computed once per gang. Safe to
+        # skip the legacy-key scan: the fragment came from our own
+        # serializer (canonical to_dict field names).
+        frag = getattr(info, "_frag", None)
+        if frag is not None and frag not in _group_frag_memo:
+            _memo_put(_group_frag_memo, frag, info.affinity_group_bind_info)
+        return info
     cached = _bind_info_memo.get(raw)
     if cached is not None:
         return cached

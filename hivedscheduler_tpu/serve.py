@@ -32,6 +32,13 @@ def main(argv=None) -> int:
     parser.add_argument("--arrival-every", type=int, default=3,
                         help="admit a new request every N engine steps "
                         "(0 = all up front)")
+    parser.add_argument("--age-boost-secs", type=float, default=0.0,
+                        help="bounded-wait aging for the priority queue: a "
+                             "waiter gains one effective priority level per "
+                             "this many seconds queued, so low-priority "
+                             "requests cannot be starved indefinitely by a "
+                             "sustained high-priority stream (0 = strict "
+                             "priority, the default)")
     parser.add_argument("--queue-timeout", type=float, default=0.0,
                         help="shed requests whose queue wait exceeds this "
                              "many seconds (finish_reason=shed, counted in "
@@ -186,6 +193,7 @@ def main(argv=None) -> int:
             prefill_chunk=args.prefill_chunk,
             kv_dtype=None if args.kv_quantize == "none" else args.kv_quantize,
             queue_timeout_s=args.queue_timeout if args.queue_timeout > 0 else None,
+            age_boost_secs=args.age_boost_secs if args.age_boost_secs > 0 else None,
         )
         if args.draft_layers > 0:
             from hivedscheduler_tpu.models.speculative import derive_draft_config

@@ -211,23 +211,38 @@ def _make_handler(scheduler: HivedScheduler, webserver: Optional[WebServer] = No
                     name = full[len(C.AFFINITY_GROUPS_PATH):].rstrip("/")
                     self._reply(200, scheduler.get_affinity_group(name).to_dict())
                 elif path == C.CLUSTER_STATUS_PATH:
-                    self._reply(200, scheduler.get_cluster_status().to_dict())
+                    # copy-on-read: serialize under the scheduler lock
+                    # instead of deep-copying the whole status forest
+                    if hasattr(scheduler, "get_cluster_status_dict"):
+                        self._reply(200, scheduler.get_cluster_status_dict())
+                    else:
+                        self._reply(200, scheduler.get_cluster_status().to_dict())
                 elif path == C.PHYSICAL_CLUSTER_PATH:
-                    self._reply(
-                        200, [s.to_dict() for s in scheduler.get_physical_cluster_status()]
-                    )
+                    if hasattr(scheduler, "get_physical_cluster_status_dict"):
+                        self._reply(200, scheduler.get_physical_cluster_status_dict())
+                    else:
+                        self._reply(
+                            200,
+                            [s.to_dict() for s in scheduler.get_physical_cluster_status()],
+                        )
                 elif path == C.VIRTUAL_CLUSTERS_PATH.rstrip("/"):
-                    vcs = scheduler.get_all_virtual_clusters_status()
-                    self._reply(
-                        200,
-                        {vc: [s.to_dict() for s in lst] for vc, lst in vcs.items()},
-                    )
+                    if hasattr(scheduler, "get_all_virtual_clusters_status_dict"):
+                        self._reply(200, scheduler.get_all_virtual_clusters_status_dict())
+                    else:
+                        vcs = scheduler.get_all_virtual_clusters_status()
+                        self._reply(
+                            200,
+                            {vc: [s.to_dict() for s in lst] for vc, lst in vcs.items()},
+                        )
                 elif full.startswith(C.VIRTUAL_CLUSTERS_PATH):
                     vcn = full[len(C.VIRTUAL_CLUSTERS_PATH):].rstrip("/")
-                    self._reply(
-                        200,
-                        [s.to_dict() for s in scheduler.get_virtual_cluster_status(vcn)],
-                    )
+                    if hasattr(scheduler, "get_virtual_cluster_status_dict"):
+                        self._reply(200, scheduler.get_virtual_cluster_status_dict(vcn))
+                    else:
+                        self._reply(
+                            200,
+                            [s.to_dict() for s in scheduler.get_virtual_cluster_status(vcn)],
+                        )
                 else:
                     self._reply(404, {"code": 404, "message": f"Unknown path {self.path}"})
             except Exception as e:

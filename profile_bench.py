@@ -1,6 +1,12 @@
-"""Profile one measured 256-chip gang decision from bench.py's scenario.
+"""Profile one measured gang decision from bench.py's scenarios.
 
-Usage: python profile_bench.py [--deletes] [--sort tottime] [--rows 40]
+Usage: python profile_bench.py [--scale4096] [--deletes] [--sort tottime]
+                               [--rows 40]
+
+Default: the 256-chip gang on the v5p-1024 cluster (the headline metric).
+``--scale4096``: the 1024-chip gang (256 pods x 4) on the 16x16x16 cluster —
+the ``scale4096_p50_ms`` scale point, so regressions there are profilable
+too. ``--deletes`` profiles the release path instead of schedule+add.
 Not part of the shipped package; a dev tool for finding scheduling fat.
 """
 
@@ -11,21 +17,11 @@ import sys
 import bench
 
 
-def main():
-    rows = 40
-    sort = "cumtime"
-    if "--sort" in sys.argv:
-        sort = sys.argv[sys.argv.index("--sort") + 1]
-    if "--rows" in sys.argv:
-        rows = int(sys.argv[sys.argv.index("--rows") + 1])
-    deletes = "--deletes" in sys.argv
-
+def _profile_1024(pr, deletes):
     cluster = bench.Cluster()
     # warm-up: one full gang, freed again
     cluster.schedule_gang("vc-a", 10, "warm", 64, 4, allow_preempt=True)
     cluster.free_gang("warm")
-
-    pr = cProfile.Profile()
     if deletes:
         for i in range(8):
             cluster.schedule_gang("vc-a", 10, f"g{i}", 64, 4, allow_preempt=True)
@@ -40,6 +36,34 @@ def main():
             cluster.free_gang(f"g{i}")
             pr.enable()
         pr.disable()
+
+
+def _profile_4096(pr, deletes):
+    """The scale4096 point: reuse run_scale_4096's exact cluster by
+    profiling around it — the function owns setup + trials, so the profile
+    includes both; setup shows up under HivedAlgorithm.__init__ and is easy
+    to discount (it runs once)."""
+    if deletes:
+        print("--deletes is only wired for the 1024 scenario", file=sys.stderr)
+    pr.enable()
+    bench.run_scale_4096()
+    pr.disable()
+
+
+def main():
+    rows = 40
+    sort = "cumtime"
+    if "--sort" in sys.argv:
+        sort = sys.argv[sys.argv.index("--sort") + 1]
+    if "--rows" in sys.argv:
+        rows = int(sys.argv[sys.argv.index("--rows") + 1])
+    deletes = "--deletes" in sys.argv
+
+    pr = cProfile.Profile()
+    if "--scale4096" in sys.argv:
+        _profile_4096(pr, deletes)
+    else:
+        _profile_1024(pr, deletes)
     stats = pstats.Stats(pr)
     stats.sort_stats(sort).print_stats(rows)
 

@@ -1,0 +1,52 @@
+"""Tier-1 bench smoke: perf-path regressions fail tests instead of only
+showing up in the end-of-round bench (ISSUE 4 CI satellite).
+
+Runs the REAL headline-bench scenario (bench.py: v5p-1024 multi-VC churn +
+measured 256-chip gang) at a few iterations, CPU-only — asserting the two
+properties the driver metric cares about:
+
+- ``frag_pct == 0.0``: the 256-chip slice always places contiguously while
+  vc-a's guarantee is free (buddy allocation over mesh tilings);
+- a full gang decision completes under a GENEROUS wall-clock ceiling, so an
+  accidental O(n^2) (or a broken fast path falling back to something
+  pathological) trips CI rather than the next bench round. The ceiling is
+  ~50x the expected p50 to stay robust on slow shared CI boxes.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+GENEROUS_CEILING_MS = 500.0  # expected p50 ~5-10 ms on the dev box
+
+
+def test_bench_smoke_frag_zero_and_bounded_latency():
+    p50, p99, frag_pct = bench.run(measure_iters=3)
+    assert frag_pct == 0.0, (
+        f"fragmentation in the measured 256-chip gang: {frag_pct}%"
+    )
+    assert p50 < GENEROUS_CEILING_MS, (
+        f"gang-schedule p50 {p50:.1f} ms blew the generous ceiling "
+        f"({GENEROUS_CEILING_MS} ms) — a perf-path regression"
+    )
+    assert p99 < 4 * GENEROUS_CEILING_MS
+
+
+def test_bench_views_consistent_after_run():
+    """After the bench scenario's churn, every persistent cluster view must
+    still compare equal to a from-scratch rebuild (ties the CI smoke to the
+    incremental-view differential)."""
+    from hivedscheduler_tpu.chaos import invariants
+
+    cluster = bench.Cluster()
+    ok, _, _ = cluster.schedule_gang("vc-a", 10, "g", 64, 4,
+                                    allow_preempt=True)
+    assert ok
+    invariants.check_cluster_views(cluster.algo, ctx="bench smoke")
+    cluster.free_gang("g")
+    invariants.check_cluster_views(cluster.algo, ctx="bench smoke post-free")
+    invariants.check_all(cluster.algo, ctx="bench smoke post-free")

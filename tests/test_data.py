@@ -246,10 +246,7 @@ def test_prefetch_close_with_full_queue_drains_and_reaps_worker():
     """Closing the consumer while the producer is BLOCKED on the full
     bounded queue (the supervisor-abort shape) must drain the staged
     batches and reap the worker promptly — no deadlock, no leak."""
-    import threading
     import time
-
-    before = set(threading.enumerate())
 
     def infinite():
         i = 0
@@ -260,8 +257,10 @@ def test_prefetch_close_with_full_queue_drains_and_reaps_worker():
     it = data_lib.prefetch(infinite(), depth=1)
     next(it)
     time.sleep(0.2)  # let the worker fill the queue and block in put()
-    workers = [t for t in threading.enumerate() if t not in before]
-    assert workers
+    # track the worker OBJECT exposed by prefetch — an enumerate() diff
+    # flakes when an unrelated library thread starts mid-test (ADVICE.md)
+    workers = [data_lib._last_prefetch_worker]
+    assert workers[0] is not None and workers[0].is_alive()
     t0 = time.monotonic()
     it.close()
     assert time.monotonic() - t0 < 3.0, "close() blocked on the full queue"
@@ -285,14 +284,17 @@ def test_prefetch_abandoned_iterator_stops_worker():
             yield np.full((2, 2), i, np.int32)
             i += 1
 
-    # capture the worker thread itself via an enumerate() diff — asserting
-    # on the global active_count() flakes when an unrelated library thread
-    # starts mid-test (ADVICE.md round 5)
-    before = set(threading.enumerate())
+    # track the worker thread OBJECT directly (exposed by prefetch as
+    # data_lib._last_prefetch_worker, named "hived-prefetch") — an
+    # enumerate()/active_count() diff flakes when an unrelated library
+    # thread starts mid-test (ADVICE.md round 5)
     it = data_lib.prefetch(infinite(), depth=2)
     next(it)
-    workers = [t for t in threading.enumerate() if t not in before]
-    assert workers, "prefetch started no worker thread"
+    worker = data_lib._last_prefetch_worker
+    assert worker is not None and worker.name == "hived-prefetch", (
+        "prefetch did not expose its worker thread"
+    )
+    workers = [worker]
     it.close()  # GeneratorExit -> finally -> closed.set()
     for t in workers:
         t.join(timeout=5.0)

@@ -1,0 +1,223 @@
+"""Differential guards for the incremental cluster views (perf PR).
+
+The scheduler's persistent per-(chain, VC) cluster views defer work with
+dirty tracking but must never change results:
+
+- ``chaos.invariants.check_cluster_views`` pins every cached node counter,
+  native score buffer and static enclosure structure bit-equal to a
+  from-scratch rebuild — here driven over randomized allocate/release churn
+  (the chaos soak harness runs the same check on its own seeds via
+  ``check_all``);
+- node SELECTION under the incremental path (cached order + static
+  enclosures + native packing) is compared against the rebuild-per-call
+  reference (:func:`_find_nodes_for_pods`) on identical live state. Equal
+  sort keys make placements interchangeable (the pre-PR code's in-place
+  ``cv.sort`` had history-dependent tie order too), so the comparison is on
+  the picked nodes' score keys, with identity compared when keys are
+  unambiguous.
+"""
+
+import random
+
+import pytest
+
+from hivedscheduler_tpu.api.config import Config, new_config
+from hivedscheduler_tpu.api.types import (
+    CellTypeSpec,
+    MeshLevelSpec,
+    MeshSpec,
+    PhysicalCellSpec,
+    PhysicalClusterSpec,
+    VirtualCellSpec,
+    VirtualClusterSpec,
+)
+from hivedscheduler_tpu.algorithm.constants import OPPORTUNISTIC_PRIORITY
+from hivedscheduler_tpu.algorithm.hived import HivedAlgorithm
+from hivedscheduler_tpu.api import constants as C
+from hivedscheduler_tpu.chaos import invariants
+from hivedscheduler_tpu.common.utils import to_json
+from hivedscheduler_tpu.k8s.types import Container, Node, Pod
+from hivedscheduler_tpu.runtime.types import FILTERING_PHASE
+from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+
+def build_algo():
+    mesh = MeshSpec(
+        topology=(8, 8, 4),
+        chip_type="chip",
+        host_shape=(2, 2, 1),
+        levels=[
+            MeshLevelSpec(name="m8", shape=(2, 2, 2)),
+            MeshLevelSpec(name="m16", shape=(4, 2, 2)),
+            MeshLevelSpec(name="m32", shape=(4, 4, 2)),
+            MeshLevelSpec(name="m64", shape=(4, 4, 4)),
+            MeshLevelSpec(name="m128", shape=(8, 4, 4)),
+        ],
+    )
+    cfg = new_config(Config(
+        physical_cluster=PhysicalClusterSpec(
+            cell_types={"pod256": CellTypeSpec(mesh=mesh)},
+            physical_cells=[PhysicalCellSpec(cell_type="pod256",
+                                             cell_address="p0")],
+        ),
+        virtual_clusters={
+            "vc-a": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=1, cell_type="pod256.m128")]),
+            "vc-b": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=2, cell_type="pod256.m64")]),
+        },
+    ))
+    algo = HivedAlgorithm(cfg)
+    nodes = sorted({
+        n for ccl in algo.full_cell_list.values()
+        for c in ccl[max(ccl)] for n in c.nodes
+    })
+    for n in nodes:
+        algo.add_node(Node(name=n))
+    return algo, nodes
+
+
+def make_pod(name, vc, priority, group, pods, chips):
+    spec = {
+        "virtualCluster": vc,
+        "priority": priority,
+        "leafCellType": "chip",
+        "leafCellNumber": chips,
+        "affinityGroup": {
+            "name": group,
+            "members": [{"podNumber": pods, "leafCellNumber": chips}],
+        },
+    }
+    return Pod(
+        name=name, uid=name,
+        annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: to_json(spec)},
+        containers=[Container(
+            resource_limits={C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})],
+    )
+
+
+def schedule_gang(algo, nodes, vc, prio, group, pods, chips):
+    bound = []
+    for i in range(pods):
+        pod = make_pod(f"{group}-{i}", vc, prio, group, pods, chips)
+        r = algo.schedule(pod, nodes, FILTERING_PHASE)
+        if r.pod_bind_info is None:
+            for bp in bound:
+                algo.delete_allocated_pod(bp)
+            return None
+        bp = new_binding_pod(pod, r.pod_bind_info)
+        algo.add_allocated_pod(bp)
+        bound.append(bp)
+    return bound
+
+
+def _node_key(s, n):
+    sign = -1 if s.pack else 1
+    return (not n.healthy, not n.suggested,
+            sign * n.used_leaf_cell_num_same_priority,
+            n.used_leaf_cell_num_higher_priority,
+            n.free_leaf_cell_num_at_priority)
+
+
+def _all_schedulers(algo):
+    yield from (s for _, s in invariants._all_topology_schedulers(algo))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_views_bit_equal_to_rebuild_under_churn(seed):
+    """Random allocate/release churn; after every step the cached views must
+    compare equal to a from-scratch rebuild (check_cluster_views recomputes
+    every 'current' node counter and the static structures)."""
+    rng = random.Random(seed)
+    algo, nodes = build_algo()
+    live = {}
+    gid = 0
+    for step in range(30):
+        if live and rng.random() < 0.4:
+            name = rng.choice(sorted(live))
+            for bp in live.pop(name):
+                algo.delete_allocated_pod(bp)
+        else:
+            vc = rng.choice(["vc-a", "vc-b"])
+            prio = rng.choice([-1, 0, 5, 10])
+            pods, chips = rng.choice([(1, 4), (2, 4), (4, 4), (8, 4), (1, 8)])
+            name = f"g{gid}"
+            gid += 1
+            bound = schedule_gang(algo, nodes, vc, prio, name, pods, chips)
+            if bound:
+                live[name] = bound
+        # occasional health churn so bad/healthy transitions are covered
+        if rng.random() < 0.15:
+            node = rng.choice(nodes)
+            algo.update_node(
+                Node(name=node),
+                Node(name=node, unschedulable=True),
+            )
+            algo.update_node(
+                Node(name=node, unschedulable=True),
+                Node(name=node),
+            )
+        invariants.check_cluster_views(algo, ctx=f"seed {seed} step {step}")
+        invariants.check_all(algo, ctx=f"seed {seed} step {step}")
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_incremental_node_selection_matches_rebuild(seed):
+    """On identical live state, the incremental path (cached order + static
+    enclosures + native packing when available) must pick nodes with exactly
+    the same score keys as the rebuild-per-call reference — both searches
+    are read-only, so they are compared directly on the live schedulers
+    after every churn step."""
+    rng = random.Random(100 + seed)
+    algo, nodes = build_algo()
+    live = {}
+    gid = 0
+    for step in range(20):
+        if live and rng.random() < 0.4:
+            name = rng.choice(sorted(live))
+            for bp in live.pop(name):
+                algo.delete_allocated_pod(bp)
+        else:
+            vc = rng.choice(["vc-a", "vc-b"])
+            pods, chips = rng.choice([(1, 4), (2, 4), (4, 4), (8, 4)])
+            name = f"g{gid}"
+            gid += 1
+            bound = schedule_gang(algo, nodes, vc,
+                                  rng.choice([-1, 0, 5]), name, pods, chips)
+            if bound:
+                live[name] = bound
+        for s in _all_schedulers(algo):
+            for nums in ([4], [4, 4], [4, 4, 4, 4], [8, 8]):
+                s._update_cluster_view(
+                    OPPORTUNISTIC_PRIORITY, set(), True
+                )
+                picked_inc, reason_inc = s._find_nodes(list(nums), True)
+                picked_ref, reason_ref = s._find_nodes(list(nums), False)
+                if picked_inc is None or picked_ref is None:
+                    assert picked_inc is None and picked_ref is None, (
+                        step, nums, picked_inc, picked_ref)
+                    assert reason_inc == reason_ref, (reason_inc, reason_ref)
+                else:
+                    keys_inc = [_node_key(s, s.cv[i]) for i in picked_inc]
+                    keys_ref = [_node_key(s, s.cv[i]) for i in picked_ref]
+                    assert keys_inc == keys_ref, (step, nums)
+
+
+def test_ancestor_matrix_static_and_cached():
+    """The per-node ancestor matrices feeding the C++ in-node search are
+    built once and must stay valid across health churn (they encode pure
+    topology): same object, same contents."""
+    from hivedscheduler_tpu.algorithm import topology_aware as ta
+
+    algo, nodes = build_algo()
+    chain = next(iter(algo.full_cell_list))
+    node_cell = algo.full_cell_list[chain][3][0]  # some mid-level cell
+    m1 = ta._node_ancestor_matrix(node_cell)
+    # health churn must not invalidate topology
+    algo.update_node(Node(name=nodes[0]),
+                     Node(name=nodes[0], unschedulable=True))
+    m2 = ta._node_ancestor_matrix(node_cell)
+    assert m1 is m2  # cached, not rebuilt per pod
+    row_of, flat, n_levels = m2
+    assert n_levels == node_cell.level
+    assert len(row_of) == node_cell.total_leaf_cell_num

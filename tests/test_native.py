@@ -227,3 +227,105 @@ def test_direct_beats_backtracking_adversarial():
     t_direct = time.perf_counter() - t0
     assert [c.address for c in py_picked] == [c.address for c in d_picked]
     assert t_direct < t_py / 100, (t_direct, t_py)
+
+
+# ---------------------------------------------------------------------------
+# cross-node packing: hived_find_nodes_for_pods parity (perf PR)
+# ---------------------------------------------------------------------------
+
+
+def _packing_cluster():
+    """A multi-node cluster view: one 256-chip pod of 64 4-chip hosts."""
+    mesh = MeshSpec(
+        topology=(8, 8, 4),
+        chip_type="chip",
+        host_shape=(2, 2, 1),
+        levels=[
+            MeshLevelSpec(name="m8", shape=(2, 2, 2)),
+            MeshLevelSpec(name="m16", shape=(4, 2, 2)),
+            MeshLevelSpec(name="m32", shape=(4, 4, 2)),
+            MeshLevelSpec(name="m64", shape=(4, 4, 4)),
+            MeshLevelSpec(name="m128", shape=(8, 4, 4)),
+        ],
+    )
+    cfg = new_config(
+        Config(
+            physical_cluster=PhysicalClusterSpec(
+                cell_types={"pod256": CellTypeSpec(mesh=mesh)},
+                physical_cells=[
+                    PhysicalCellSpec(cell_type="pod256", cell_address="p0")
+                ],
+            ),
+            virtual_clusters={"vc": VirtualClusterSpec()},
+        )
+    )
+    parsed = parse_config(cfg)
+    ccl = parsed.physical_full_list["pod256"]
+    levels = {lv.level: lv.leaf_cell_number
+              for lv in parsed.chain_levels["pod256"]}
+    return ccl, levels
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_packing_native_vs_python_parity(seed):
+    """HIVED_NATIVE=0 vs native parity for the cross-node packing entry
+    point: two schedulers over the SAME cells — one using the one-call C
+    packing (sort + enclosure pass + greedy), one forced onto the Python
+    incremental path — must pick IDENTICAL nodes and produce byte-identical
+    failure reasons across randomized load, health and suggested-node
+    churn. Both maintain their own persistent sort order from the same
+    seed, so strict equality (not just score equality) is the contract."""
+    import random as _random
+
+    from hivedscheduler_tpu.algorithm.cell_allocation import (
+        allocate_cell_walk,
+        release_cell_walk,
+    )
+    from hivedscheduler_tpu.algorithm import topology_aware as ta
+
+    if not native.pack_available():
+        pytest.skip("native packing entry unavailable")
+    rng = _random.Random(seed)
+    ccl, levels = _packing_cluster()
+    s_nat = ta.TopologyAwareScheduler(ccl, levels, cross_priority_pack=False)
+    s_py = ta.TopologyAwareScheduler(ccl, levels, cross_priority_pack=False)
+    s_py._native_pack = False  # force the Python incremental reference
+    assert s_nat._native_pack_state() is not None, "native packing not engaged"
+
+    leaves = ccl[1]
+    all_nodes = sorted({c.nodes[0] for c in leaves})
+    allocated = []
+    for step in range(40):
+        # churn: allocate or release random leaves at random priorities
+        if allocated and rng.random() < 0.45:
+            for _ in range(rng.randint(1, 8)):
+                if not allocated:
+                    break
+                c, p = allocated.pop(rng.randrange(len(allocated)))
+                release_cell_walk(c, p)
+        else:
+            for _ in range(rng.randint(1, 8)):
+                c = leaves[rng.randrange(len(leaves))]
+                p = rng.choice([-1, 0, 5])
+                allocate_cell_walk(c, p)
+                allocated.append((c, p))
+        # health churn
+        if rng.random() < 0.3:
+            c = leaves[rng.randrange(len(leaves))]
+            c.set_healthiness("Bad" if c.healthy else "Healthy")
+        ignore = rng.random() < 0.5
+        if ignore:
+            suggested = set()
+        else:
+            suggested = set(rng.sample(all_nodes,
+                                       rng.randint(0, len(all_nodes))))
+        nums = rng.choice([[4], [4, 4], [4] * 8, [8] * 4, [4] * 64,
+                           [16] * 2, [4] * 63 + [8]])
+        p = rng.choice([-1, 5])
+        for s in (s_nat, s_py):
+            s._update_cluster_view(p, suggested, ignore)
+        picked_nat, reason_nat = s_nat._find_nodes(sorted(nums), True)
+        picked_py, reason_py = s_py._find_nodes(sorted(nums), True)
+        assert picked_nat == picked_py, (step, nums, picked_nat, picked_py)
+        assert reason_nat == reason_py, (step, nums, reason_nat, reason_py)
+        assert s_nat._order == s_py._order, step

@@ -121,3 +121,40 @@ def test_shed_while_slots_busy_then_recycled(setup):
     eng.run_until_drained()
     assert all(r.finish_reason == "shed" for r in stale)
     assert fresh.finish_reason == "length" and len(fresh.tokens_out) == 2
+
+
+def test_age_boost_bounds_low_priority_wait(setup):
+    """``age_boost_secs``: an aged low-priority waiter outranks a fresh
+    high-priority one once its wait buys enough effective levels — the
+    bounded-wait answer to the strict-priority starvation caveat. With
+    max_batch=1: the old priority-0 request (waited 25 s at 10 s/level =
+    +2 levels) is admitted before the fresh priority-1 arrival."""
+    cfg, params = setup
+    clock = FakeClock()
+    eng = serving.ServingEngine(params, cfg, max_batch=1, max_len=64,
+                                age_boost_secs=10.0, clock=clock)
+    running = eng.submit([5, 9, 2], 2)
+    low = eng.submit([7, 8], 2, priority=0)
+    clock.t = 25.0
+    high = eng.submit([9, 9], 2, priority=1)
+    eng.run_until_drained()
+    assert running.done and low.done and high.done
+    # admission order is visible through admitted_at stamps: low (eff 0+2)
+    # beat high (eff 1+0)
+    assert low.admitted_at <= high.admitted_at
+
+
+def test_age_boost_none_keeps_strict_priority(setup):
+    """Default (None): the fresh high-priority request still jumps the
+    aged low-priority waiter — exactly the pre-knob behavior."""
+    cfg, params = setup
+    clock = FakeClock()
+    eng = serving.ServingEngine(params, cfg, max_batch=1, max_len=64,
+                                clock=clock)
+    running = eng.submit([5, 9, 2], 2)
+    low = eng.submit([7, 8], 2, priority=0)
+    clock.t = 1000.0
+    high = eng.submit([9, 9], 2, priority=1)
+    eng.run_until_drained()
+    assert running.done and low.done and high.done
+    assert high.admitted_at <= low.admitted_at
