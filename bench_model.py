@@ -208,7 +208,7 @@ def serving_params(cfg):
 
 
 def bench_decode(cfg, params, batch: int, prompt_len: int, new_tokens: int,
-                 iters: int):
+                 iters: int, decode_steps: int = 1):
     import jax
     import jax.numpy as jnp
 
@@ -219,7 +219,9 @@ def bench_decode(cfg, params, batch: int, prompt_len: int, new_tokens: int,
     import numpy as np
 
     run = jax.jit(
-        lambda p, t: dec.generate(p, t, cfg, new_tokens, max_len=prompt_len + new_tokens)
+        lambda p, t: dec.generate(p, t, cfg, new_tokens,
+                                  max_len=prompt_len + new_tokens,
+                                  decode_steps=decode_steps)
     )
     np.asarray(run(params, prompt))  # compile + host sync
     times = []
@@ -231,17 +233,20 @@ def bench_decode(cfg, params, batch: int, prompt_len: int, new_tokens: int,
     return statistics.median(times)
 
 
-def bench_serving(cfg, params, n_requests: int, max_batch: int, budget: int):
+def bench_serving(cfg, params, n_requests: int, max_batch: int, budget: int,
+                  decode_steps: int = 1):
     """Continuous-batching engine under a staggered synthetic load:
     returns (tokens/sec, occupancy over the measured load only). Shares
     ``params`` with bench_decode so the static-batch number and the churn
-    number describe the same weights."""
+    number describe the same weights. ``decode_steps`` > 1 runs the
+    engine's fused multi-step decode windows."""
     import jax
 
     from hivedscheduler_tpu.models import serving
 
     eng = serving.ServingEngine(params, cfg, max_batch=max_batch,
-                                max_len=128 + budget)
+                                max_len=128 + budget,
+                                decode_steps=decode_steps)
     rng = jax.random.PRNGKey(2)
     prompts = []
     for i in range(n_requests):
@@ -361,6 +366,114 @@ def bench_serving_prefix(cfg, params, n_requests: int, system_len: int,
     return cached_tps / plain_tps, plain_ttft / max(cached_ttft, 1e-9)
 
 
+BREAKDOWN_KEYS = ("embed_ms", "attn_ms", "mlp_ms", "collective_ms",
+                  "sampling_ms")
+
+
+def bench_breakdown(cfg, params, batch: int, seq: int, dec_batch: int,
+                    mesh, iters: int):
+    """Per-phase timings (--breakdown): jitted microbenches of the model's
+    phases on the bench shapes, each iteration recorded as an obs span
+    (``bench_model/<phase>``, exportable with --trace-file) so a
+    train_step_ms delta is attributable to a phase instead of a guess.
+
+    Keys are pinned by tests/test_bench_model.py (hand-rolled-serializer
+    rule): embed/attn/mlp are FORWARD timings (attn/mlp scaled by
+    n_layers; a train step pays roughly 3x forward plus remat),
+    collective is the tp all-reduce of one [B, T, D] projection (exactly
+    0-work on a single-chip mesh — reported honestly), sampling is the
+    filtered categorical pick on one [B, vocab] logits row."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from hivedscheduler_tpu.models import decode as dec
+    from hivedscheduler_tpu.models import transformer as tm
+    from hivedscheduler_tpu.obs import trace as obs_trace
+
+    dtype = cfg.dtype
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(3), (batch, seq), 0, cfg.vocab_size, jnp.int32
+    )
+    x = jax.random.normal(
+        jax.random.PRNGKey(4), (batch, seq, cfg.d_model), dtype
+    )
+    logits = jax.random.normal(
+        jax.random.PRNGKey(5), (dec_batch, cfg.vocab_size), jnp.float32
+    )
+    key = jax.random.PRNGKey(6)
+    lp0 = jax.tree.map(lambda a: a[0], params["layers"])
+    positions = jnp.arange(seq, dtype=jnp.int32)[None, :]
+    attn_fn = tm._resolve_attn_fn(cfg)
+
+    def embed_phase(tok):
+        return dec.embed_tokens(params, tok, dtype)
+
+    def attn_phase(xx):
+        h = tm._rms_norm(xx, lp0["attn_norm"])
+        q, k, v = dec.qkv_proj(lp0, h, positions, cfg.rope_theta, dtype)
+        attn = tm._dispatch_attention(q, k, v, cfg, attn_fn, mesh)
+        return xx + jnp.einsum(
+            "bthk,hkd->btd", attn, tm.load_weight(lp0["wo"], dtype)
+        )
+
+    def mlp_phase(xx):
+        return xx + dec.dense_mlp(lp0, tm._rms_norm(xx, lp0["mlp_norm"]),
+                                  dtype)
+
+    tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
+    if tp > 1:
+        from jax.sharding import PartitionSpec as P
+
+        from hivedscheduler_tpu.parallel.ring_attention import _get_shard_map
+
+        spec = P(None, None, None)
+        kw = dict(mesh=mesh, in_specs=(spec,), out_specs=spec)
+        try:
+            collective_phase = _get_shard_map()(
+                lambda y: lax.psum(y, "tp"), check_vma=False, **kw
+            )
+        except TypeError:
+            collective_phase = _get_shard_map()(
+                lambda y: lax.psum(y, "tp"), check_rep=False, **kw
+            )
+    else:
+        # a 1-chip mesh has no cross-chip collective: time the no-op so
+        # the key is present and honestly ~0
+        def collective_phase(y):
+            return y
+
+    def sampling_phase(lg, k):
+        return jax.vmap(jax.random.categorical)(
+            jax.random.split(k, lg.shape[0]),
+            dec.filter_logits(lg / 0.8, top_k=40, top_p=0.9),
+        )
+
+    def timed(name, fn, *args, scale: float = 1.0):
+        jitted = jax.jit(fn)
+        np.asarray(jax.tree.leaves(jitted(*args))[0])  # compile + sync
+        times = []
+        for _ in range(max(1, iters)):
+            t0 = time.perf_counter()
+            out = jitted(*args)
+            np.asarray(jax.tree.leaves(out)[0])  # axon: block is a no-op
+            t1 = time.perf_counter()
+            obs_trace.complete(f"bench_model/{name}", t0, t1, cat="bench")
+            times.append(t1 - t0)
+        return statistics.median(times) * 1e3 * scale
+
+    return {
+        "embed_ms": round(timed("embed", embed_phase, tokens), 3),
+        "attn_ms": round(
+            timed("attn", attn_phase, x, scale=cfg.n_layers), 3
+        ),
+        "mlp_ms": round(timed("mlp", mlp_phase, x, scale=cfg.n_layers), 3),
+        "collective_ms": round(timed("collective", collective_phase, x), 3),
+        "sampling_ms": round(timed("sampling", sampling_phase, logits, key), 3),
+    }
+
+
 def param_count(cfg) -> int:
     d, dh = cfg.d_model, cfg.head_dim
     attn = d * cfg.n_heads * dh * 2 + d * cfg.kv_heads * dh * 2
@@ -393,6 +506,19 @@ def main(argv=None) -> int:
     parser.add_argument("--batch", type=int, default=None)
     parser.add_argument("--grad-accum", type=int, default=1)
     parser.add_argument("--skip-train", action="store_true")
+    parser.add_argument("--decode-steps", type=int, default=1,
+                        help="decode fusion window: unrolls the static "
+                             "generate loop and fuses K iterations per "
+                             "serving-engine step (exact streams)")
+    parser.add_argument("--breakdown", action="store_true",
+                        help="add a per-phase 'breakdown' dict (embed/attn/"
+                             "mlp/collective/sampling ms, keys pinned by "
+                             "test_bench_model.py) so train_step_ms deltas "
+                             "are attributable; phases run as jitted "
+                             "microbenches recorded as obs spans")
+    parser.add_argument("--trace-file", default="",
+                        help="with --breakdown: write the phase spans as a "
+                             "Chrome-trace/Perfetto JSON to this path")
     args = parser.parse_args(argv)
 
     jax, devices = acquire_backend(args.acquire_timeout)
@@ -435,6 +561,7 @@ def main(argv=None) -> int:
     mesh = topology.make_mesh(axes, jax.devices()[:1])
 
     ce_chunk = args.ce_chunk if args.ce_chunk is not None else (512 if real else 0)
+    eff_accum = args.grad_accum  # the accumulation the train number ran with
     if args.skip_train:
         step_s, loss = None, 0.0
         flops, achieved, mfu, train_tps = 0.0, None, None, None
@@ -445,16 +572,27 @@ def main(argv=None) -> int:
                                        ce_chunk=ce_chunk)
         except Exception as e:
             # the tuned DEFAULT remat policy trades HBM for FLOPs; if it
-            # doesn't fit this chip, fall back to full remat rather than
-            # losing the driver's number entirely. An explicit --remat is a
-            # tuning question — "does it fit" is the answer, so re-raise.
+            # doesn't fit this chip, degrade in MFU order rather than
+            # losing the driver's number entirely: (1) keep dots but halve
+            # activation residency with one extra grad-accum slice (loss
+            # math identical for the dense model), (2) full remat. An
+            # explicit --remat is a tuning question — "does it fit" is the
+            # answer, so re-raise.
             if (args.remat is not None or cfg.remat == "full"
                     or "RESOURCE_EXHAUSTED" not in str(e)):
                 raise
-            cfg = dataclasses.replace(cfg, remat="full")
-            step_s, loss = bench_train(cfg, batch, seq, iters, mesh,
-                                       grad_accum=args.grad_accum,
-                                       ce_chunk=ce_chunk)
+            try:
+                step_s, loss = bench_train(cfg, batch, seq, iters, mesh,
+                                           grad_accum=2 * args.grad_accum,
+                                           ce_chunk=ce_chunk)
+                eff_accum = 2 * args.grad_accum
+            except Exception as e2:
+                if "RESOURCE_EXHAUSTED" not in str(e2):
+                    raise
+                cfg = dataclasses.replace(cfg, remat="full")
+                step_s, loss = bench_train(cfg, batch, seq, iters, mesh,
+                                           grad_accum=args.grad_accum,
+                                           ce_chunk=ce_chunk)
         flops = train_flops_per_step(cfg, batch, seq)
         achieved = flops / step_s
         mfu = achieved / peak_flops if peak_flops else None
@@ -483,7 +621,8 @@ def main(argv=None) -> int:
     if params is not None and not args.skip_decode:
         try:
             dec_s = bench_decode(cfg, params, dec_batch, dec_prompt, dec_new,
-                                 max(1, iters // 2))
+                                 max(1, iters // 2),
+                                 decode_steps=args.decode_steps)
             decode_tps = dec_batch * dec_new / dec_s
             if peak_bw:
                 # roofline: each decode step streams the full bf16 param bytes
@@ -501,6 +640,7 @@ def main(argv=None) -> int:
                 n_requests=16 if real else 3,
                 max_batch=dec_batch,
                 budget=32 if real else 4,
+                decode_steps=args.decode_steps,
             )
         except Exception as e:
             stage_errors["serve_error"] = f"{type(e).__name__}: {str(e)[:200]}"
@@ -530,6 +670,24 @@ def main(argv=None) -> int:
             )
         except Exception as e:
             stage_errors["serve_prefix_error"] = (
+                f"{type(e).__name__}: {str(e)[:200]}"
+            )
+
+    breakdown = None
+    if args.breakdown:
+        from hivedscheduler_tpu.obs import trace as obs_trace
+
+        obs_trace.enable()
+        try:
+            bd_params = params if params is not None else serving_params(cfg)
+            breakdown = bench_breakdown(
+                cfg, bd_params, batch, seq, dec_batch, mesh,
+                max(1, iters // 2),
+            )
+            if args.trace_file:
+                obs_trace.write_chrome_trace(args.trace_file)
+        except Exception as e:
+            stage_errors["breakdown_error"] = (
                 f"{type(e).__name__}: {str(e)[:200]}"
             )
 
@@ -594,10 +752,13 @@ def main(argv=None) -> int:
             "n_heads": cfg.n_heads, "n_kv_heads": cfg.kv_heads,
             "d_ff": cfg.d_ff, "batch": batch, "seq": seq,
             "attn_impl": cfg.attn_impl, "dtype": "bfloat16",
-            "remat": cfg.remat, "grad_accum": args.grad_accum,
-            "ce_chunk": ce_chunk,
+            "remat": cfg.remat, "grad_accum": eff_accum,
+            "ce_chunk": ce_chunk, "decode_steps": args.decode_steps,
             "attn_block_q": cfg.attn_block_q, "attn_block_k": cfg.attn_block_k,
         },
+        # per-phase attribution (--breakdown; keys pinned by
+        # tests/test_bench_model.py::test_breakdown_keys_pinned)
+        **({"breakdown": breakdown} if breakdown is not None else {}),
         "vs_baseline_note": (
             "the reference scheduler ships no workload runtime, so there is "
             "no reference MFU; vs_baseline is MFU relative to the 40% "

@@ -153,7 +153,11 @@ def main(argv=None) -> int:
         "%s windows (%s tokens) in %.2fs (%.0f tok/s)",
         n_steps, n_steps * window, dt, n_steps * window / max(dt, 1e-9),
     )
-    print(f"loss {loss:.4f}  perplexity {ppl:.2f}")
+    # enough digits that exp(printed loss) agrees with printed perplexity
+    # to ~1e-5 relative: consumers (and the guard test) check the pair for
+    # consistency, and a 2-decimal perplexity's rounding grain (±0.005)
+    # is coarser than that check at typical ppl magnitudes
+    print(f"loss {loss:.6f}  perplexity {ppl:.4f}")
     return 0
 
 

@@ -46,6 +46,12 @@ def main(argv=None) -> int:
     parser.add_argument("--top-p", type=float, default=1.0,
                         help="nucleus sampling: smallest prefix with cumulative "
                         "probability >= p (1.0 = off)")
+    parser.add_argument("--decode-steps", type=int, default=1,
+                        help="unroll the decode scan by K iterations "
+                        "inside the single jitted generate loop (XLA "
+                        "software-pipelines consecutive token steps; "
+                        "output identical for any K). Ignored by the "
+                        "speculative path (--draft-layers)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--vocab-size", type=int, default=32000)
     parser.add_argument("--d-model", type=int, default=512)
@@ -198,6 +204,7 @@ def main(argv=None) -> int:
             run, param_shardings, prompt_sharding = decode.make_sharded_generate(
                 cfg, mesh, args.new_tokens, temperature=args.temperature,
                 top_k=args.top_k, top_p=args.top_p, quantized=quantized,
+                decode_steps=args.decode_steps,
             )
         except ValueError as e:
             # user errors (bad dp/tp/batch flags, head counts vs --tp,
@@ -211,7 +218,7 @@ def main(argv=None) -> int:
         out = decode.generate(
             params, prompt, cfg, args.new_tokens,
             temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-            key=key,
+            key=key, decode_steps=args.decode_steps,
         )
     for row in jax.device_get(out):
         print(" ".join(str(int(t)) for t in row))

@@ -217,12 +217,22 @@ def generate(
     top_p: float = 1.0,
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
+    decode_steps: int = 1,
 ) -> jax.Array:
     """Greedy (temperature 0) or sampled continuation of ``prompt`` [B, T],
     with optional top-k / nucleus (top-p) filtering of the sampled
     distribution. Returns [B, max_new_tokens]. The whole decode loop is one
     ``lax.scan`` over a fixed-shape cached step, so it stays inside a single
-    jit."""
+    jit.
+
+    ``decode_steps``: unroll the scan body by K iterations. The loop is
+    already device-resident (no per-token Python dispatch), but each XLA
+    while-loop trip still pays its condition/carry bookkeeping and blocks
+    cross-iteration scheduling; unrolling lets XLA software-pipeline K
+    consecutive token steps (weight prefetch under the previous step's
+    tail) at the cost of a K-times-larger loop body to compile. Pure
+    schedule change — the emitted tokens are identical for any K (guard:
+    test_serving_multistep.py::test_generate_decode_steps_unroll_exact)."""
     b, t = prompt.shape
     total = t + max_new_tokens
     if max_len is None:
@@ -254,7 +264,8 @@ def generate(
         logits, cache = advance(params, cache, tok[:, None], cfg)
         return (logits[:, -1], cache), tok
 
-    (_, _), toks = lax.scan(step, (last, cache), keys)
+    unroll = max(1, min(decode_steps, max_new_tokens))
+    (_, _), toks = lax.scan(step, (last, cache), keys, unroll=unroll)
     return jnp.swapaxes(toks, 0, 1)  # [B, max_new]
 
 
@@ -310,6 +321,7 @@ def make_sharded_generate(
     top_k: int = 0,
     top_p: float = 1.0,
     quantized: bool = False,
+    decode_steps: int = 1,
 ):
     """Sharded serving: returns (jitted_generate, param_shardings,
     prompt_sharding). Params laid out by ``transformer.sharding_specs`` —
@@ -330,6 +342,7 @@ def make_sharded_generate(
     run = functools.partial(
         generate, cfg=cfg, max_new_tokens=max_new_tokens,
         temperature=temperature, top_k=top_k, top_p=top_p,
+        decode_steps=decode_steps,
     )
     jitted = jax.jit(lambda params, prompt, key=None: run(params, prompt, key=key))
     return jitted, param_shardings, prompt_sharding

@@ -411,6 +411,7 @@ class ServingEngine:
         kv_dtype: Optional[str] = None,
         queue_timeout_s: Optional[float] = None,
         age_boost_secs: Optional[float] = None,
+        decode_steps: int = 1,
         clock=time.perf_counter,
     ):
         """``mesh``: lay the engine out over a dp x tp serving mesh —
@@ -456,6 +457,22 @@ class ServingEngine:
         ``(p_high - p_low) * age_boost_secs`` plus one admission sweep.
         Ties keep FIFO order within an effective level. ``None`` (default)
         keeps strict priority exactly as before.
+
+        ``decode_steps``: run up to this many decode iterations inside ONE
+        jitted ``lax.scan`` per engine step (sampling fused on device, the
+        picked token fed straight back into the next iteration, cache
+        donated through the carry) — the per-token Python dispatch + host
+        sync then amortizes over the window, which is the decode tick's
+        dominant cost for small models. The emitted streams are EXACT for
+        any window size: greedy/sampled picks per row depend only on that
+        row's logits and its counter-based key, rows that hit EOS or
+        their budget inside a window have their surplus tokens
+        computed-then-discarded (bounded waste, K-1 tokens), and the
+        window adaptively collapses to 1 when a slot may finish by length
+        inside it, when chunked prefills are mid-flight, or when EOS
+        retirement could free a slot queued work is waiting on (see
+        ``_fused_window``). 1 (default) = the step-by-step engine.
+        Guard: tests/test_serving_multistep.py.
 
         ``clock``: the engine's wall-clock source (``time.perf_counter``);
         injectable so overload/deadline behavior is testable
@@ -550,6 +567,46 @@ class ServingEngine:
         self._decode = jax.jit(decode_step, donate_argnums=(1,))
         # one compile per prompt bucket (tokens' S is static per call shape)
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
+
+        if decode_steps < 1:
+            raise ValueError(f"decode_steps must be >= 1, got {decode_steps}")
+        self.decode_steps = decode_steps
+        self.fused_windows = 0  # multi-step windows executed (k > 1)
+
+        def decode_multi(params, cache, last_tokens, rids, counts, k):
+            """``k`` fused decode iterations in one scan: the pick (argmax
+            or the counter-keyed sampler — identical math to sample_rows)
+            runs on device and feeds straight back, so the host sees one
+            dispatch + one [B, k] transfer per window instead of k."""
+
+            def body(carry, i):
+                cache, last = carry
+                logits, cache = advance_ragged(params, cache, last[:, None],
+                                               cfg)
+                row_logits = logits[:, 0]
+                if temperature == 0.0:
+                    tok = jnp.argmax(row_logits, axis=-1)
+                else:
+                    filtered = filter_logits(
+                        row_logits / temperature, top_k, top_p
+                    )
+                    step_i = i.astype(counts.dtype)
+                    keys = jax.vmap(
+                        lambda r, c: _stream_key(base_key, r, c + step_i)
+                    )(rids, counts)
+                    tok = jax.vmap(jax.random.categorical)(keys, filtered)
+                tok = tok.astype(jnp.int32)
+                return (cache, tok), tok
+
+            (cache, _), toks = lax.scan(
+                body, (cache, last_tokens), jnp.arange(k)
+            )
+            return jnp.swapaxes(toks, 0, 1), cache  # toks [B, k]
+
+        # one compile per distinct window size (bounded by _fused_window's
+        # power-of-two bucketing)
+        self._decode_multi = jax.jit(decode_multi, static_argnums=(5,),
+                                     donate_argnums=(1,))
 
         # -- prompt prefix cache (LRU over device-resident KV rows) --------
         from collections import OrderedDict
@@ -973,26 +1030,77 @@ class ServingEngine:
         return [s for s in range(self.max_batch)
                 if self.slots[s] is not None and s not in self._prefilling]
 
+    def _fused_window(self, active) -> int:
+        """How many decode iterations may run device-side before the host
+        must look again: bounded by the ``decode_steps`` knob and every
+        active row's remaining budget (length-exactness — a window never
+        overruns a budget), and collapsed to 1 while chunked prefills are
+        in flight (their chunk ticks are per engine step) or when EOS
+        retirement could free a slot that QUEUED work is waiting for
+        (admission latency). Rows may still hit EOS inside a window
+        (inherently unpredictable): their surplus tokens are computed and
+        discarded — the emitted stream stays exact, the waste is bounded
+        by K-1 tokens per retiring row. Below-knob windows are rounded
+        down to a power of two so at most log2(decode_steps) + 1 programs
+        ever compile."""
+        if self.decode_steps <= 1 or self._prefilling:
+            return 1
+        if self.eos_id is not None and self.queue:
+            return 1
+        rem = min(
+            self.slots[s].max_new_tokens - len(self.slots[s].tokens_out)
+            for s in active
+        )
+        if rem >= self.decode_steps:
+            return self.decode_steps
+        return 1 << (rem.bit_length() - 1)
+
     def step(self) -> bool:
         """Admit + tick chunked prefills (one bounded chunk while anyone
-        is decoding, else all — see _tick_prefills) + one decode step for
-        all decoding slots. Returns whether any work remains (active
-        slots, in-flight chunked prefills, or queued requests)."""
+        is decoding, else all — see _tick_prefills) + one decode step —
+        or one fused multi-step window (``decode_steps`` > 1, see
+        ``_fused_window``) — for all decoding slots. Returns whether any
+        work remains (active slots, in-flight chunked prefills, or queued
+        requests)."""
         self._admit()
         active = self._tick_prefills()
         if active:
             last = jnp.asarray(self._last_host, jnp.int32)
             if self._token_sharding is not None:
                 last = jax.device_put(last, self._token_sharding)
-            logits, self.cache = self._decode(self.params, self.cache, last)
-            self.steps += 1
-            self.slot_steps += len(active)
-            picked = self._pick_batch(logits, self.slots)
-            for slot in active:
-                req = self.slots[slot]
-                self._emit(req, slot, int(picked[slot]))
-                if req.done:
-                    self.slots[slot] = None  # recycle immediately
+            k = self._fused_window(active)
+            if k == 1:
+                logits, self.cache = self._decode(self.params, self.cache,
+                                                  last)
+                self.steps += 1
+                self.slot_steps += len(active)
+                picked = self._pick_batch(logits, self.slots)
+                for slot in active:
+                    req = self.slots[slot]
+                    self._emit(req, slot, int(picked[slot]))
+                    if req.done:
+                        self.slots[slot] = None  # recycle immediately
+            else:
+                rids, counts = self._sample_coords(self.slots)
+                if self._token_sharding is not None:
+                    rids = jax.device_put(rids, self._token_sharding)
+                    counts = jax.device_put(counts, self._token_sharding)
+                toks_d, self.cache = self._decode_multi(
+                    self.params, self.cache, last, rids, counts, k
+                )
+                self.fused_windows += 1
+                metrics.inc("tpu_hive_serve_fused_decode_windows_total")
+                toks = jax.device_get(toks_d)  # ONE [B, k] transfer
+                self.steps += k
+                self.slot_steps += len(active) * k
+                for slot in active:
+                    req = self.slots[slot]
+                    for j in range(k):
+                        self._emit(req, slot, int(toks[slot, j]))
+                        if req.done:
+                            break  # surplus window tokens are discarded
+                    if req.done:
+                        self.slots[slot] = None
         return bool(self.queue) or any(s is not None for s in self.slots)
 
     def run_until_drained(self, max_steps: int = 100_000) -> None:
@@ -1085,6 +1193,11 @@ class SpeculativeServingEngine(ServingEngine):
     then certain, and the bonus token uses the plain key too (guard:
     test_serving_speculative_sampled.py). Greedy (temperature 0) remains
     bit-exact vs vanilla greedy decode.
+
+    ``decode_steps`` does not apply here: a speculative round already
+    amortizes the host round-trip over up to gamma+1 tokens, and fusing
+    rounds would defeat the per-row acceptance bookkeeping. The knob is
+    accepted (shared constructor) and ignored by this engine's ``step``.
 
     Composes with chunked prefill (``prefill_chunk > 0``): prompt chunks
     absorb into BOTH caches per engine step (the shared chunk tick's

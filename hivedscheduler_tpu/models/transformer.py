@@ -22,7 +22,8 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Dict
+import os
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -75,6 +76,18 @@ class TransformerConfig:
     # overhead on long sequences.
     attn_block_q: int = 128
     attn_block_k: int = 128
+
+    # overlapped tensor parallelism (the collective-matmul path): the
+    # residual stream is sequence-sharded over (sp, tp) and the
+    # all-gather/reduce-scatter around the QKV/out/MLP projections run as
+    # lax.ppermute-pipelined chunks inside shard_map, so each ICI hop
+    # transfers while the previous chunk multiplies on the MXU.
+    # None = auto (on whenever applicable: tp > 1, dense, no LoRA, no
+    # pipeline, divisible shapes); False = always the GSPMD reference
+    # path; True = require it (raises when inapplicable). The env var
+    # HIVED_OVERLAP=0 forces the reference path regardless — the
+    # differential-parity contract (tests/test_overlap.py).
+    overlap: Optional[bool] = None
 
     # grouped-query attention: number of shared k/v heads (0 = n_heads,
     # classic MHA; 1 = MQA). q heads are grouped contiguously: q head i
@@ -385,7 +398,9 @@ def _moe_mlp(
     sp_size = 1
     if manual_sp_axis is not None:
         # static python int inside the shard_map body — capacity is a shape
-        sp_size = lax.axis_size(manual_sp_axis)
+        from hivedscheduler_tpu.parallel.shard_utils import axis_size
+
+        sp_size = axis_size(manual_sp_axis)
     # capacity is defined on the GLOBAL sequence length
     capacity = max(
         1, int(math.ceil(t * sp_size * top_k / E * cfg.expert_capacity_factor))
@@ -515,6 +530,143 @@ def _flash_gspmd(q, k, v, mesh, attn_fn):
     return fn(q, k, v)
 
 
+def _dispatch_attention(q, k, v, cfg: TransformerConfig, attn_fn, mesh,
+                        manual_tp_axis=None, manual_sp_axis=None,
+                        manual_ep_axis=None, manual_vma_axes=(),
+                        device_local: bool = False):
+    """GQA compact-vs-repeat policy + attention implementation dispatch —
+    the ONE home shared by the GSPMD layer body, the pipeline-stage manual
+    body, and the overlapped collective-matmul body (so the three cannot
+    drift). ``device_local=True`` marks q/k/v as already device-local
+    slices inside a manual context, which skips the mesh-level tp
+    divisibility re-check (the local head counts already divided)."""
+    if k.shape[2] != q.shape[2]:
+        # GQA. The ring schedules and Ulysses consume compact k/v directly
+        # via grouped einsums — the ppermute rotation / k,v all_to_all then
+        # ships H_kv/H of the bytes — when the compact head count still
+        # shards evenly over tp (the manual pipeline path rejects
+        # indivisible kv/tp upfront; Ulysses expands locally if H_kv
+        # doesn't split over sp). All other impls (and the indivisible
+        # GSPMD case) materialize each shared k/v head for its q-head
+        # group here, after RoPE so the rotation runs on the small head
+        # count; contiguous grouping keeps groups aligned with tp shards.
+        compact_ok = cfg.attn_impl in (
+            "ring", "ring_flash", "ring_zigzag", "ring_zigzag_flash",
+            "ulysses", "flash",
+        )
+        if (compact_ok and manual_sp_axis is None and mesh is not None
+                and not device_local):
+            tp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
+            compact_ok = k.shape[2] % tp_size == 0
+        if not compact_ok:
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+    if manual_sp_axis is not None:
+        from hivedscheduler_tpu.parallel.ring_attention import (
+            _ring_attention_local,
+            _ring_flash_attention_local,
+            _ulysses_local,
+            _zigzag_flash_attention_local,
+            _zigzag_ring_attention_local,
+        )
+
+        if cfg.attn_impl == "ulysses":
+            attn = _ulysses_local(q, k, v, axis_name=manual_sp_axis, causal=True)
+        elif cfg.attn_impl == "ring_zigzag":
+            attn = _zigzag_ring_attention_local(
+                q, k, v, axis_name=manual_sp_axis, mesh_axes=manual_vma_axes,
+            )
+        elif cfg.attn_impl == "ring_zigzag_flash":
+            attn = _zigzag_flash_attention_local(
+                q, k, v, axis_name=manual_sp_axis, mesh_axes=manual_vma_axes,
+                block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
+            )
+        elif cfg.attn_impl == "ring_flash":
+            attn = _ring_flash_attention_local(
+                q, k, v, axis_name=manual_sp_axis, causal=True,
+                mesh_axes=manual_vma_axes,
+                block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
+            )
+        else:
+            attn = _ring_attention_local(
+                q, k, v, axis_name=manual_sp_axis, causal=True,
+                mesh_axes=manual_vma_axes,
+            )
+    elif cfg.attn_impl in RING_FAMILY:
+        attn = attn_fn(q, k, v, mesh, causal=True)
+    elif cfg.attn_impl == "flash" and mesh is not None:
+        if manual_tp_axis is None and manual_ep_axis is None and not device_local:
+            attn = _flash_gspmd(q, k, v, mesh, attn_fn)
+        else:
+            # GSPMD shard_map cannot open inside a manual (pipeline-stage)
+            # context (CLAUDE.md shard_map rule): arrays are already
+            # device-local, so call the kernel directly — passing the
+            # varying axes so its pallas out_shape avals type under the
+            # enclosing shard_map's vma checker
+            attn = attn_fn(q, k, v, causal=True, vma=manual_vma_axes)
+    else:
+        attn = attn_fn(q, k, v, causal=True)
+    return attn
+
+
+def _apply_layer_overlapped(x, lp, cfg: TransformerConfig, attn_fn, mesh,
+                            tp_axis: str, sp_axis, vma_axes=()):
+    """One transformer block in the overlapped tensor-parallel manual mode
+    (``cfg.overlap`` / HIVED_OVERLAP — see ``forward_with_aux``).
+
+    The residual stream arrives sequence-sharded over (sp, tp) — the
+    Megatron sequence-parallel layout — so the norms and residual adds are
+    token-local, and the tp collectives around the projections run as
+    collective matmuls (``shard_utils``): QKV and gate/up consume the
+    all-gather as a ppermute pipeline (one rotation feeding all fused
+    weights), attention-out and MLP-down produce the reduce-scatter as a
+    pipelined chunk accumulator. Every ICI hop therefore transfers under
+    the previous chunk's MXU work instead of serializing after it.
+
+    Dense layers only: the caller (``_use_overlap``) gates MoE/LoRA/
+    pipeline configs back to the GSPMD reference path. Numerics: each
+    output element is computed by the same local contractions as the
+    reference; only the cross-device reduction order of the row-parallel
+    partial sums differs (bit-identical at tp=2 where the two-term sum is
+    commutative; guard: tests/test_overlap.py)."""
+    from hivedscheduler_tpu.parallel import shard_utils
+
+    dtype = cfg.dtype
+    tp_size = shard_utils.axis_size(tp_axis)
+    t_gather = x.shape[1] * tp_size
+    base = lax.axis_index(sp_axis) * t_gather if sp_axis else 0
+    positions = (base + lax.iota(jnp.int32, t_gather))[None, :]
+
+    h = _rms_norm(x, lp["attn_norm"])
+    q, k, v = shard_utils.allgather_matmul(
+        h,
+        [lp["wq"].astype(dtype), lp["wk"].astype(dtype),
+         lp["wv"].astype(dtype)],
+        tp_axis, "btd,dhk->bthk", vma_axes=vma_axes,
+    )
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    attn = _dispatch_attention(
+        q, k, v, cfg, attn_fn, mesh,
+        manual_tp_axis=tp_axis, manual_sp_axis=sp_axis,
+        manual_vma_axes=vma_axes, device_local=True,
+    )
+    x = x + shard_utils.matmul_reducescatter(
+        attn, lp["wo"].astype(dtype), tp_axis, "bthk,hkd->btd"
+    )
+    h = _rms_norm(x, lp["mlp_norm"])
+    gate, up = shard_utils.allgather_matmul(
+        h, [lp["w_gate"].astype(dtype), lp["w_up"].astype(dtype)],
+        tp_axis, "btd,df->btf", vma_axes=vma_axes,
+    )
+    mid = jax.nn.silu(gate) * up
+    x = x + shard_utils.matmul_reducescatter(
+        mid, lp["w_down"].astype(dtype), tp_axis, "btf,fd->btd"
+    )
+    return x
+
+
 def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
                  manual_tp_axis=None, manual_sp_axis=None, manual_ep_axis=None,
                  manual_vma_axes=()):
@@ -560,72 +712,11 @@ def _apply_layer(x, lp, positions, cfg: TransformerConfig, attn_fn, mesh,
         v = v + lora(h, "lora_wv")
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    if k.shape[2] != q.shape[2]:
-        # GQA. The ring schedules and Ulysses consume compact k/v directly
-        # via grouped einsums — the ppermute rotation / k,v all_to_all then
-        # ships H_kv/H of the bytes — when the compact head count still
-        # shards evenly over tp (the manual pipeline path rejects
-        # indivisible kv/tp upfront; Ulysses expands locally if H_kv
-        # doesn't split over sp). All other impls (and the indivisible
-        # GSPMD case) materialize each shared k/v head for its q-head
-        # group here, after RoPE so the rotation runs on the small head
-        # count; contiguous grouping keeps groups aligned with tp shards.
-        compact_ok = cfg.attn_impl in (
-            "ring", "ring_flash", "ring_zigzag", "ring_zigzag_flash",
-            "ulysses", "flash",
-        )
-        if compact_ok and manual_sp_axis is None and mesh is not None:
-            tp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
-            compact_ok = k.shape[2] % tp_size == 0
-        if not compact_ok:
-            rep = q.shape[2] // k.shape[2]
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-    if manual_sp_axis is not None:
-        from hivedscheduler_tpu.parallel.ring_attention import (
-            _ring_attention_local,
-            _ring_flash_attention_local,
-            _ulysses_local,
-            _zigzag_flash_attention_local,
-            _zigzag_ring_attention_local,
-        )
-
-        if cfg.attn_impl == "ulysses":
-            attn = _ulysses_local(q, k, v, axis_name=manual_sp_axis, causal=True)
-        elif cfg.attn_impl == "ring_zigzag":
-            attn = _zigzag_ring_attention_local(
-                q, k, v, axis_name=manual_sp_axis, mesh_axes=manual_vma_axes,
-            )
-        elif cfg.attn_impl == "ring_zigzag_flash":
-            attn = _zigzag_flash_attention_local(
-                q, k, v, axis_name=manual_sp_axis, mesh_axes=manual_vma_axes,
-                block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
-            )
-        elif cfg.attn_impl == "ring_flash":
-            attn = _ring_flash_attention_local(
-                q, k, v, axis_name=manual_sp_axis, causal=True,
-                mesh_axes=manual_vma_axes,
-                block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
-            )
-        else:
-            attn = _ring_attention_local(
-                q, k, v, axis_name=manual_sp_axis, causal=True,
-                mesh_axes=manual_vma_axes,
-            )
-    elif cfg.attn_impl in RING_FAMILY:
-        attn = attn_fn(q, k, v, mesh, causal=True)
-    elif cfg.attn_impl == "flash" and mesh is not None:
-        if manual_tp_axis is None and manual_ep_axis is None:
-            attn = _flash_gspmd(q, k, v, mesh, attn_fn)
-        else:
-            # GSPMD shard_map cannot open inside a manual (pipeline-stage)
-            # context (CLAUDE.md shard_map rule): arrays are already
-            # device-local, so call the kernel directly — passing the
-            # varying axes so its pallas out_shape avals type under the
-            # enclosing shard_map's vma checker
-            attn = attn_fn(q, k, v, causal=True, vma=manual_vma_axes)
-    else:
-        attn = attn_fn(q, k, v, causal=True)
+    attn = _dispatch_attention(
+        q, k, v, cfg, attn_fn, mesh,
+        manual_tp_axis=manual_tp_axis, manual_sp_axis=manual_sp_axis,
+        manual_ep_axis=manual_ep_axis, manual_vma_axes=manual_vma_axes,
+    )
     o = jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
     if "lora_wo_a" in lp:
         # both the base wo and the adapter's A contract the (sharded) head
@@ -693,6 +784,126 @@ def _remat_wrap(fn, cfg: TransformerConfig):
     )
 
 
+def overlap_applicable(cfg: TransformerConfig, mesh, seq_len=None,
+                       batch=None):
+    """Can the overlapped collective-matmul path serve (cfg, mesh)?
+    Returns (ok, reason) — pure, so CLIs and tests can interrogate the
+    gate without tracing. ``seq_len``/``batch`` add the call-shape
+    divisibility checks when known."""
+    if mesh is None:
+        return False, "no mesh"
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tp = shape.get("tp", 1)
+    sp = shape.get("sp", 1)
+    if tp <= 1:
+        return False, "tp axis is 1: no tensor collectives to overlap"
+    if cfg.pipeline_microbatches > 0:
+        return False, "pipelined stacks run the pipeline's own manual path"
+    if cfg.n_experts > 0:
+        return False, "MoE dispatch is not on the overlapped path"
+    if cfg.lora_rank > 0:
+        return False, "LoRA adapters are not on the overlapped path"
+    if cfg.n_heads % tp or cfg.kv_heads % tp or cfg.d_ff % tp:
+        return False, (
+            f"heads/kv/ff must divide tp: n_heads={cfg.n_heads}, "
+            f"kv_heads={cfg.kv_heads}, d_ff={cfg.d_ff}, tp={tp}"
+        )
+    if sp > 1 and cfg.attn_impl not in RING_FAMILY:
+        return False, (
+            f"sp={sp} needs a ring-family attn_impl, got {cfg.attn_impl!r}"
+        )
+    if cfg.attn_impl == "ulysses" and sp > 1 and (cfg.n_heads // tp) % sp:
+        return False, (
+            f"ulysses needs tp-local heads divisible by sp: "
+            f"{cfg.n_heads} heads / tp={tp} vs sp={sp}"
+        )
+    if seq_len is not None and seq_len % (sp * tp):
+        return False, (
+            f"sequence {seq_len} must divide sp*tp={sp * tp} to "
+            "sequence-shard the residual stream"
+        )
+    if batch is not None and batch % (shape.get("dp", 1) * shape.get("fsdp", 1)):
+        return False, (
+            f"batch {batch} must divide dp*fsdp="
+            f"{shape.get('dp', 1) * shape.get('fsdp', 1)}"
+        )
+    return True, ""
+
+
+def _use_overlap(cfg: TransformerConfig, mesh, seq_len, batch) -> bool:
+    """The HIVED_OVERLAP / cfg.overlap gate: env 0 always forces the
+    GSPMD reference path (the differential-parity contract); cfg.overlap
+    False opts out, True requires (raising when inapplicable), None = on
+    whenever applicable."""
+    if os.environ.get("HIVED_OVERLAP", "") == "0":
+        return False
+    if cfg.overlap is False:
+        return False
+    ok, reason = overlap_applicable(cfg, mesh, seq_len, batch)
+    if cfg.overlap is True and not ok:
+        raise ValueError(
+            f"cfg.overlap=True but the overlapped path cannot serve this "
+            f"config: {reason}"
+        )
+    return ok
+
+
+def _overlapped_stack(x, layers, cfg: TransformerConfig, attn_fn, mesh):
+    """Run the whole layer stack in one shard_map: scan over the stacked
+    layer params with ``_apply_layer_overlapped`` as the body, the
+    residual stream sequence-sharded over (sp, tp) and fsdp weight shards
+    all-gathered per use (ZeRO-style — autodiff turns the gathers into
+    grad reduce-scatters, exactly like the pipeline stage path)."""
+    from hivedscheduler_tpu.parallel.ring_attention import _get_shard_map
+
+    layer_specs = sharding_specs(cfg)["layers"]
+    x_spec = P(("dp", "fsdp"), ("sp", "tp"), None)
+    manual_sp = "sp" if cfg.attn_impl in RING_FAMILY else None
+    vma_axes = ("dp", "fsdp", "tp") + (("sp",) if manual_sp else ())
+
+    def gather_fsdp(lp):
+        def gather(leaf, spec):
+            # spec's first entry is the (scanned-away) layer axis
+            for i, part in enumerate(spec[1:]):
+                parts = part if isinstance(part, tuple) else (part,)
+                if "fsdp" in parts:
+                    return lax.all_gather(leaf, "fsdp", axis=i, tiled=True)
+            return leaf
+
+        return jax.tree.map(gather, lp, layer_specs)
+
+    def stacked(xx, stack):
+        def scan_body(carry, lp):
+            out = _apply_layer_overlapped(
+                carry, gather_fsdp(lp), cfg, attn_fn, mesh, "tp", manual_sp,
+                vma_axes,
+            )
+            return out, None
+
+        out, _ = lax.scan(_remat_wrap(scan_body, cfg), xx, stack)
+        return out
+
+    kw = dict(mesh=mesh, in_specs=(x_spec, layer_specs), out_specs=x_spec)
+    try:
+        # the ppermute pipelines and the pallas kernel's out_shape avals
+        # don't all type under the vma checker (same stance as
+        # _flash_gspmd); numerics are pinned differentially against the
+        # HIVED_OVERLAP=0 reference path in tests/test_overlap.py
+        fn = _get_shard_map()(stacked, check_vma=False, **kw)
+    except TypeError:  # older jax spells it check_rep
+        fn = _get_shard_map()(stacked, check_rep=False, **kw)
+    from jax.sharding import NamedSharding
+
+    # hand the residual stream back in the reference layout (seq over sp
+    # only): the final norm + lm_head then partition exactly as on the
+    # HIVED_OVERLAP=0 path — this is what makes the forward parity
+    # bit-exact end to end, and GSPMD would gather x for the lm_head
+    # contraction anyway
+    return lax.with_sharding_constraint(
+        fn(x, layers), NamedSharding(mesh, activation_spec())
+    )
+
+
 def _resolve_attn_fn(cfg: TransformerConfig):
     if cfg.attn_impl == "flash":
         import functools
@@ -757,7 +968,11 @@ def forward_with_aux(
         return _apply_layer(x, lp, positions, cfg, attn_fn, mesh)
 
     aux_total = jnp.zeros((), jnp.float32)
-    if cfg.pipeline_microbatches > 0:
+    if cfg.pipeline_microbatches == 0 and _use_overlap(cfg, mesh, t, b):
+        # overlapped tensor parallelism: collective-matmul layer stack
+        # (dense-only — aux stays 0, which _use_overlap guarantees)
+        x = _overlapped_stack(x, params["layers"], cfg, attn_fn, mesh)
+    elif cfg.pipeline_microbatches > 0:
         manual_tp = None
         manual_sp = None
         manual_ep = None

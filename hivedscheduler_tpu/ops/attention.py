@@ -33,6 +33,21 @@ NEG_INF = -1e30
 _LANES = 128  # minimum lane width for stored residuals
 
 
+def _sds(shape, dtype, svma=None):
+    """ShapeDtypeStruct with the vma stamp only where the JAX version
+    supports it: pre-vma JAX (0.4.x) has no ``vma`` kwarg at all, and
+    passing it — even as None — raises TypeError, taking the whole
+    compiled-kernel path down with it. There is nothing to stamp on those
+    versions (shard_map does not track varying axes), so dropping it is
+    exact, not a degradation."""
+    if svma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=svma)
+        except TypeError:  # pre-vma JAX
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True) -> jax.Array:
     """Reference attention: q [B, T, H, D], k/v [B, T, H_kv, D] -> [B, T, H, D].
 
@@ -252,8 +267,8 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret, vma=(),
             pl.BlockSpec((None, block_q, _LANES), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), out_dtype or q.dtype, vma=svma),
-            jax.ShapeDtypeStruct((b * h, t, _LANES), jnp.float32, vma=svma),
+            _sds((b * h, t, d), out_dtype or q.dtype, svma),
+            _sds((b * h, t, _LANES), jnp.float32, svma),
         ],
         interpret=interpret,
     )(qf, kf, vf)
@@ -288,9 +303,7 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, block_q, block_k, interpret,
             pl.BlockSpec((None, block_q, _LANES), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct(
-            (b * h, t, d), grad_dtype or q.dtype, vma=svma
-        ),
+        out_shape=_sds((b * h, t, d), grad_dtype or q.dtype, svma),
         interpret=interpret,
     )(qf, kf, vf, of, gf, lse)
 
@@ -314,8 +327,8 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, block_q, block_k, interpret,
             pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), jnp.float32, vma=svma),
-            jax.ShapeDtypeStruct((b * h, t, d), jnp.float32, vma=svma),
+            _sds((b * h, t, d), jnp.float32, svma),
+            _sds((b * h, t, d), jnp.float32, svma),
         ],
         interpret=interpret,
     )(kf, vf, qf, of, gf, lse)

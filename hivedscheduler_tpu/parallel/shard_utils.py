@@ -1,8 +1,33 @@
-"""Small helpers shared by the manual-mode (shard_map) modules."""
+"""Small helpers shared by the manual-mode (shard_map) modules.
+
+Besides the vma-seeding shim this now hosts the **collective matmul**
+primitives of the overlapped tensor-parallel path (HIVED_OVERLAP,
+models/transformer.py): the all-gather and reduce-scatter that GSPMD would
+insert around a column-/row-parallel projection are decomposed into
+``lax.ppermute``-pipelined chunks, so each ICI hop transfers while the
+previous chunk multiplies on the MXU — the standard collective-matmul
+decomposition (Wang et al., ASPLOS'23; used by t5x/maxtext for the same
+projections). Both functions are pure JAX inside a manual shard_map
+context and autodiff cleanly (the transpose of a ppermute is the inverse
+ppermute, so the backward pass is the mirrored pipeline).
+"""
 
 from __future__ import annotations
 
+from typing import List, Sequence, Union
+
+import jax.numpy as jnp
 from jax import lax
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis inside a manual context.
+    ``lax.psum(1, axis)`` constant-folds to a Python int on every JAX
+    version this package supports (``lax.axis_size`` does not exist on
+    0.4.x), which the ring pipelines need for ``range(size)``."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
 
 
 def varying(x, mesh_axes):
@@ -18,3 +43,110 @@ def varying(x, mesh_axes):
     if hasattr(lax, "pvary"):
         return lax.pvary(x, tuple(mesh_axes))
     return x
+
+
+def allgather_matmul(
+    x,
+    ws: Union[jnp.ndarray, Sequence],
+    axis_name: str,
+    einsum_str: str,
+    *,
+    vma_axes=(),
+) -> Union[jnp.ndarray, List]:
+    """Column-parallel collective matmul: ``einsum(all_gather(x), w)``
+    with the gather decomposed into a ppermute pipeline.
+
+    ``x`` is sharded over ``axis_name`` on dim 1 (the sequence dim); each
+    ``w`` is a device-local column shard (its output axis is sharded over
+    the same ring). Instead of a blocking all-gather followed by one big
+    matmul, every device multiplies the sequence chunk it currently holds
+    against its weight shard while ppermuting that chunk one hop around
+    the ring — after ``size`` steps every device has computed the full
+    gathered sequence against its local columns, and each hop's transfer
+    overlapped the previous chunk's matmul.
+
+    Passing several weights computes them all from ONE rotation (the
+    QKV and gate/up fusions: one gather pipeline, N matmuls per hop).
+
+    Chunk results land at their gathered positions (axis-major order), so
+    each output element is produced by the same local dot the un-overlapped
+    path runs — per-element bit-identical to gather-then-matmul.
+
+    Returns one output per weight ([B, T_local*size, ...out]); a bare
+    (non-sequence) ``ws`` returns a bare output.
+    """
+    single = not isinstance(ws, (list, tuple))
+    ws_l = [ws] if single else list(ws)
+    size = axis_size(axis_name)
+    if size == 1:
+        outs = [jnp.einsum(einsum_str, x, w) for w in ws_l]
+        return outs[0] if single else outs
+    idx = lax.axis_index(axis_name)
+    t_loc = x.shape[1]
+    # send backward (i -> i-1): after s hops device i holds the chunk that
+    # originated at (i + s) % size, i.e. gathered position (i + s) * t_loc
+    perm = [(i, (i - 1) % size) for i in range(size)]
+    chunk = x
+    outs = None
+    for s in range(size):
+        if s + 1 < size:
+            # start the next hop BEFORE this chunk's matmuls: the ppermute
+            # has no data dependency on them, so XLA's async collectives
+            # run the transfer under the MXU work
+            nxt = lax.ppermute(chunk, axis_name, perm)
+        parts = [jnp.einsum(einsum_str, chunk, w) for w in ws_l]
+        if outs is None:
+            outs = [
+                varying(
+                    jnp.zeros(
+                        (p.shape[0], t_loc * size) + p.shape[2:], p.dtype
+                    ),
+                    vma_axes,
+                )
+                for p in parts
+            ]
+        src = (idx + s) % size
+        outs = [
+            lax.dynamic_update_slice_in_dim(o, p, src * t_loc, axis=1)
+            for o, p in zip(outs, parts)
+        ]
+        if s + 1 < size:
+            chunk = nxt
+    return outs[0] if single else outs
+
+
+def matmul_reducescatter(x, w, axis_name: str, einsum_str: str):
+    """Row-parallel collective matmul: ``reduce_scatter(einsum(x, w))``
+    with the reduction decomposed into a ppermute-pipelined accumulator.
+
+    The einsum contracts a dimension that is sharded over ``axis_name``
+    (each device holds a partial sum of the true output); the result is
+    returned sequence-sharded over the same ring (dim 1 shrinks by
+    ``size``), ready for the token-local residual/norm of the
+    sequence-parallel layer layout. At step ``s`` device ``i`` computes
+    its partial for output chunk ``(i + s + 1) % size`` and adds it to the
+    traveling accumulator, which then moves one hop backward — the
+    ppermute of the previous accumulator overlaps the next chunk's
+    matmul, and after ``size`` steps each device holds its own chunk with
+    all ``size`` contributions (ring order ``i+1, i+2, ..., i``).
+    """
+    size = axis_size(axis_name)
+    if size == 1:
+        return jnp.einsum(einsum_str, x, w)
+    idx = lax.axis_index(axis_name)
+    t = x.shape[1]
+    assert t % size == 0, (t, size)
+    t_loc = t // size
+    perm = [(i, (i - 1) % size) for i in range(size)]
+    acc = None
+    for s in range(size):
+        c = (idx + s + 1) % size
+        chunk = lax.dynamic_slice_in_dim(x, c * t_loc, t_loc, axis=1)
+        part = jnp.einsum(einsum_str, chunk, w)
+        if acc is None:
+            acc = part
+        else:
+            # ppermute(acc) is independent of this step's einsum: the hop
+            # rides under the matmul
+            acc = lax.ppermute(acc, axis_name, perm) + part
+    return acc

@@ -9,6 +9,7 @@ layer scan trades FLOPs for HBM on long contexts.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Optional
 
@@ -132,6 +133,27 @@ def train_step(params, opt_state, tokens, cfg: tm.TransformerConfig, optimizer,
     return params, opt_state, loss
 
 
+REMAT_POLICIES = ("full", "dots", "none")
+
+
+def _apply_remat_policy(cfg: tm.TransformerConfig, remat_policy):
+    """Resolve the per-factory remat override: ``None`` keeps ``cfg.remat``,
+    anything else replaces it. The policy only changes WHAT the backward
+    pass recomputes — "full" recomputes whole layers (HBM O(1) layers, a
+    full extra forward of FLOPs), "dots" saves matmul outputs and replays
+    only elementwise work (near-zero FLOP overhead — the MFU-tuned
+    choice), "none" saves everything. Loss/grad math is identical across
+    policies (guard: tests/test_overlap.py::TestRematPolicy)."""
+    if remat_policy is None:
+        return cfg
+    if remat_policy not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat_policy {remat_policy!r}; expected one of "
+            f"{REMAT_POLICIES}"
+        )
+    return dataclasses.replace(cfg, remat=remat_policy)
+
+
 def _shardings(cfg: tm.TransformerConfig, mesh):
     """(param_shardings, token_sharding) for `cfg` over `mesh` — the one
     home of the sharding setup shared by the train/eval step factories."""
@@ -158,6 +180,7 @@ def make_sharded_train_step(
     grad_accum: int = 1,
     ce_chunk: int = 0,
     skip_nonfinite: bool = False,
+    remat_policy: Optional[str] = None,
 ):
     """Returns (jitted_step, init_fn, token_sharding).
 
@@ -173,7 +196,14 @@ def make_sharded_train_step(
     poisoned batch cannot NaN the whole state. The returned loss still
     reports the non-finite value for the caller's divergence accounting
     (the ``train --on-nan skip`` policy; no extra sync — the gate is a
-    ``jnp.where`` on the donated carries)."""
+    ``jnp.where`` on the donated carries).
+
+    ``remat_policy``: override ``cfg.remat`` for this step factory
+    ("full" | "dots" | "none"; see ``_apply_remat_policy`` for the
+    trade-offs) — blanket remat is a direct MFU tax paid on every FLOP,
+    so training entry points select the policy here rather than baking
+    it into the model config."""
+    cfg = _apply_remat_policy(cfg, remat_policy)
     optimizer = optimizer or make_optimizer()
     param_shardings, token_sharding = _shardings(cfg, mesh)
 
@@ -248,6 +278,7 @@ def make_sharded_lora_train_step(
     optimizer: Optional[optax.GradientTransformation] = None,
     grad_accum: int = 1,
     ce_chunk: int = 0,
+    remat_policy: Optional[str] = None,
 ):
     """LoRA fine-tuning: the base weights are genuinely frozen — gradients
     are taken w.r.t. the adapter subtree only (no base grads computed, no
@@ -259,8 +290,10 @@ def make_sharded_lora_train_step(
     opt_state, tokens)`` -> (lora_params, opt_state, loss) with the small
     carries donated. ``grad_accum`` splits the batch into that many
     microbatch slices scanned with averaged adapter gradients (same trade
-    and exactness argument as ``train_step``)."""
+    and exactness argument as ``train_step``). ``remat_policy`` overrides
+    ``cfg.remat`` exactly as in ``make_sharded_train_step``."""
     assert cfg.lora_rank > 0, "set cfg.lora_rank to use the LoRA step"
+    cfg = _apply_remat_policy(cfg, remat_policy)
     optimizer = optimizer or make_optimizer()
     param_shardings, token_sharding = _shardings(cfg, mesh)
 

@@ -146,6 +146,9 @@ REGISTRY.describe("tpu_hive_serve_shed_total",
 REGISTRY.describe("tpu_hive_serve_drain_rejected_total",
                   "Serving requests rejected at submit because the engine "
                   "is draining (preemption; the 503 + Retry-After path)")
+REGISTRY.describe("tpu_hive_serve_fused_decode_windows_total",
+                  "Multi-step fused decode windows executed (ServingEngine "
+                  "decode_steps > 1: K tokens per host round-trip)")
 # workload supervisor (parallel/supervisor.py + the train CLI): the
 # preemption-tolerance surface of the training loop
 REGISTRY.describe("tpu_hive_train_resumes_total",

@@ -85,6 +85,14 @@ def main(argv=None) -> int:
     parser.add_argument("--system-prompt-len", type=int, default=24,
                         help="shared prompt prefix length for the synthetic "
                         "load (only with --prefix-cache)")
+    parser.add_argument("--decode-steps", type=int, default=1,
+                        help="fuse up to K decode iterations into one "
+                        "jitted scan per engine step (sampling on device, "
+                        "token fed straight back): the per-token Python "
+                        "dispatch + host sync amortizes over the window. "
+                        "Streams are exact for any K (adaptive fallback "
+                        "to 1 when a slot may finish inside the window); "
+                        "ignored by the speculative engine (--draft-layers)")
     parser.add_argument("--prefill-chunk", type=int, default=0,
                         help="absorb prompts at most this many tokens per "
                         "engine step (0 = whole prompt at admission): a "
@@ -194,7 +202,12 @@ def main(argv=None) -> int:
             kv_dtype=None if args.kv_quantize == "none" else args.kv_quantize,
             queue_timeout_s=args.queue_timeout if args.queue_timeout > 0 else None,
             age_boost_secs=args.age_boost_secs if args.age_boost_secs > 0 else None,
+            decode_steps=args.decode_steps,
         )
+        if args.draft_layers > 0 and args.decode_steps > 1:
+            log.warning("--decode-steps is ignored by the speculative "
+                        "engine (a verify round already amortizes the "
+                        "host round-trip)")
         if args.draft_layers > 0:
             from hivedscheduler_tpu.models.speculative import derive_draft_config
 
@@ -318,6 +331,10 @@ def main(argv=None) -> int:
             len(preempted), args.drain_deadline,
             "fully drained" if drained else "deadline expired",
         )
+    if args.decode_steps > 1 and args.draft_layers == 0:
+        log.info("fused decode: %s multi-step windows (decode_steps=%s) "
+                 "over %s device steps", eng.fused_windows,
+                 args.decode_steps, eng.steps)
     if args.draft_layers > 0:
         log.info("speculation: %s/%s draft tokens accepted (%.0f%%)",
                  eng.accepted, eng.drafted, 100.0 * eng.acceptance)

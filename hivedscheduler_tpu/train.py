@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import time
 
 log = logging.getLogger(__name__)
@@ -58,11 +59,27 @@ def main(argv=None) -> int:
                              "projections (gate/up/down)")
     parser.add_argument("--lora-alpha", type=float, default=16.0,
                         help="LoRA scale (delta = alpha/rank * A B)")
-    parser.add_argument("--remat", choices=("full", "dots", "none"),
+    parser.add_argument("--remat", "--remat-policy", dest="remat",
+                        choices=("full", "dots", "none"),
                         default="full",
-                        help="layer-scan remat policy: full recompute (HBM "
-                             "O(1) layers), dots (save matmul outputs — the "
-                             "MFU-tuned default of bench_model.py), none")
+                        help="layer-scan remat policy (selective remat): "
+                             "full recompute (HBM O(1) layers, but the "
+                             "recompute is a full extra forward — a direct "
+                             "MFU tax), dots (save matmul outputs, replay "
+                             "only elementwise work — the MFU-tuned default "
+                             "of bench_model.py), none (save everything)")
+    parser.add_argument("--overlap", action=argparse.BooleanOptionalAction,
+                        default=None,
+                        help="--overlap/--no-overlap: the collective-matmul "
+                             "tensor-parallel path (sequence-sharded "
+                             "residual stream; ppermute-pipelined "
+                             "all-gather/reduce-scatter around the "
+                             "QKV/out/MLP projections so ICI hops overlap "
+                             "MXU work). Default: auto — on whenever "
+                             "applicable (tp>1, dense, no LoRA/pipeline); "
+                             "--overlap errors if inapplicable; "
+                             "HIVED_OVERLAP=0 forces the reference path "
+                             "regardless")
     parser.add_argument("--ce-chunk", type=int, default=0,
                         help="chunked cross-entropy: compute lm_head+CE in "
                              "sequence chunks of this size so the "
@@ -192,9 +209,20 @@ def main(argv=None) -> int:
         lora_alpha=args.lora_alpha,
         lora_mlp=args.lora_mlp,
         remat=args.remat,
+        overlap=args.overlap,
         attn_block_q=args.block_q,
         attn_block_k=args.block_k,
     )
+    if args.overlap is not False and os.environ.get("HIVED_OVERLAP") != "0":
+        ok, reason = tm.overlap_applicable(cfg, mesh, args.seq_len, args.batch)
+        if ok:
+            log.info("overlapped collective-matmul path enabled (tp=%s)",
+                     args.tp)
+        elif args.overlap is True:
+            parser.error(f"--overlap requested but inapplicable: {reason}")
+        else:
+            log.info("overlapped path not applicable (%s); using the "
+                     "reference GSPMD path", reason)
     lora_mode = args.lora_rank > 0
     if lora_mode:
         step_fn, init_fn, token_sharding = make_sharded_lora_train_step(

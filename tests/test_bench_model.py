@@ -13,7 +13,9 @@ pytest.importorskip("jax")
 def test_bench_model_smoke(capsys):
     import bench_model
 
-    rc = bench_model.main(["--smoke", "--iters", "1"])
+    # one invocation covers the stage metrics AND the --breakdown schema
+    # (a separate breakdown run would repeat the whole smoke bench)
+    rc = bench_model.main(["--smoke", "--iters", "1", "--breakdown"])
     assert rc == 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
     m = json.loads(line)
@@ -26,6 +28,15 @@ def test_bench_model_smoke(capsys):
     assert m["serve_tokens_per_sec"] > 0
     assert 0.0 < m["serve_occupancy"] <= 1.0
     assert m["loss_finite"]
+    # --breakdown's dict is driver-parsed: pin the EXACT key set
+    # (hand-rolled-serializer rule, CLAUDE.md) so it cannot drift silently
+    assert "breakdown_error" not in m, m.get("breakdown_error")
+    assert set(m["breakdown"]) == {"embed_ms", "attn_ms", "mlp_ms",
+                                   "collective_ms", "sampling_ms"}
+    assert set(bench_model.BREAKDOWN_KEYS) == set(m["breakdown"])
+    for key, val in m["breakdown"].items():
+        assert isinstance(val, (int, float)) and val >= 0.0, (key, val)
+    assert m["model"]["decode_steps"] == 1
 
 
 def test_stage_failures_keep_train_number(capsys, monkeypatch):
@@ -43,6 +54,7 @@ def test_stage_failures_keep_train_number(capsys, monkeypatch):
     assert rc == 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
     m = json.loads(line)
+    assert "breakdown" not in m  # only with --breakdown
     assert m["train_tokens_per_sec"] > 0
     assert m["decode_tokens_per_sec"] is None
     assert "synthetic decode crash" in m["decode_error"]

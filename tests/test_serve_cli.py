@@ -26,6 +26,19 @@ def test_basic_run_emits_all_requests(capsys):
     assert all(len(l.split()) >= 2 for l in lines)  # every request got tokens
 
 
+def test_decode_steps_run(capsys):
+    """--decode-steps must reach the engine (recurring blind spot): the
+    fused windows execute and every request still completes."""
+    rc, out = run_serve(MODEL + ["--requests", "3", "--max-batch", "2",
+                                 "--max-len", "64", "--max-new-tokens", "6",
+                                 "--decode-steps", "4",
+                                 "--arrival-every", "0"],
+                        capsys)
+    assert rc == 0
+    lines = [l for l in out.splitlines() if l.startswith("[")]
+    assert len(lines) == 3
+
+
 def test_prefix_cache_run(capsys):
     rc, out = run_serve(
         MODEL + ["--requests", "4", "--max-batch", "2", "--max-len", "96",

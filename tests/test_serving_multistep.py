@@ -1,0 +1,185 @@
+"""Multi-step fused decode (ServingEngine ``decode_steps`` > 1).
+
+The load-bearing property: the emitted token stream of every request is
+IDENTICAL to the step-by-step (decode_steps=1) engine for any window size
+— greedy and sampled (counter-based keys make per-position draws
+independent of windowing), including EOS retirement at and inside window
+boundaries, budgets that don't divide the window, chunked-prefill
+composition, and mid-flight admissions into recycled slots."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from hivedscheduler_tpu.models import decode, serving, transformer as tm  # noqa: E402
+
+
+def cfg_of(**kw):
+    base = dict(vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2,
+                n_layers=2, d_ff=128, max_seq_len=128, dtype=jnp.float32)
+    base.update(kw)
+    return tm.TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = cfg_of()
+    params = tm.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+PROMPTS = [[5, 9, 2], [17, 3, 88, 41, 7], [1], [100, 22, 63, 4]]
+BUDGETS = [6, 4, 9, 5]
+
+
+def run_engine(params, cfg, decode_steps, *, temperature=0.0, eos=None,
+               prefill_chunk=0, max_batch=2, prompts=PROMPTS,
+               budgets=BUDGETS):
+    eng = serving.ServingEngine(
+        params, cfg, max_batch=max_batch, max_len=64,
+        decode_steps=decode_steps, temperature=temperature,
+        top_k=20 if temperature else 0, top_p=0.9 if temperature else 1.0,
+        seed=11, eos_id=eos, prefill_chunk=prefill_chunk,
+    )
+    reqs = [eng.submit(list(p), n) for p, n in zip(prompts, budgets)]
+    eng.run_until_drained()
+    assert all(r.done for r in reqs)
+    return [(r.tokens_out, r.finish_reason) for r in reqs], eng
+
+
+class TestFusedDecodeExactness:
+    def test_greedy_streams_match_k1(self, setup):
+        cfg, params = setup
+        ref, _ = run_engine(params, cfg, 1)
+        out, eng = run_engine(params, cfg, 4)
+        assert out == ref
+        assert eng.fused_windows > 0  # the fused path actually ran
+
+    @pytest.mark.slow
+    def test_greedy_streams_match_k1_nonpow2(self, setup):
+        """A non-power-of-two knob (7): full-knob windows interleave with
+        pow2-bucketed budget tails."""
+        cfg, params = setup
+        ref, _ = run_engine(params, cfg, 1)
+        out, eng = run_engine(params, cfg, 7)
+        assert out == ref and eng.fused_windows > 0
+
+    def test_sampled_streams_match_k1(self, setup):
+        cfg, params = setup
+        ref, _ = run_engine(params, cfg, 1, temperature=0.8)
+        out, eng = run_engine(params, cfg, 4, temperature=0.8)
+        assert out == ref and eng.fused_windows > 0
+
+    def test_eos_at_and_around_window_boundary(self, setup):
+        """Pick reference-stream positions as the EOS token: with
+        decode_steps=4 position 2 lands inside the first fused window,
+        position 3 exactly AT the window boundary (the last slot of the
+        window), and position 4 on the first post-window step. Streams
+        must match the step-by-step engine at each."""
+        cfg, params = setup
+        base, _ = run_engine(params, cfg, 1, prompts=[[5, 9, 2]],
+                             budgets=[8], max_batch=1)
+        stream = base[0][0]
+        tested = 0
+        for pos in (2, 3, 4):
+            eos = stream[pos]
+            if eos in stream[:pos]:
+                continue  # would retire earlier; exact either way, but
+                # not the position under test
+            # the k=1 reference with this eos is DERIVED, not re-run:
+            # greedy picks don't depend on eos_id (it only stops the
+            # stream), so the reference is base truncated at the eos
+            ref = [(stream[:pos + 1], "eos")]
+            out, _ = run_engine(params, cfg, 4, eos=eos,
+                                prompts=[[5, 9, 2]], budgets=[8],
+                                max_batch=1)
+            assert out == ref, pos
+            tested += 1
+        assert tested, "every probe position degenerate — new model seed?"
+
+    def test_budget_not_multiple_of_window(self, setup):
+        """Budgets 6/4/9/5 against a window of 8: the window clamps to the
+        minimum remaining budget (power-of-two bucketed), so no request
+        over-emits and lengths finish exactly."""
+        cfg, params = setup
+        ref, _ = run_engine(params, cfg, 1)
+        out, _ = run_engine(params, cfg, 8)
+        assert out == ref
+        for (toks, reason), budget in zip(out, BUDGETS):
+            assert len(toks) == budget and reason == "length"
+
+    def test_composes_with_chunked_prefill(self, setup):
+        cfg, params = setup
+        long_prompts = [list(range(2, 26)), [17, 3], [7] * 19, [1, 2, 3]]
+        ref, _ = run_engine(params, cfg, 1, prefill_chunk=4,
+                            prompts=long_prompts)
+        out, eng = run_engine(params, cfg, 4, prefill_chunk=4,
+                              prompts=long_prompts)
+        assert out == ref
+        assert eng.prefill_chunks_done > 0
+
+    @pytest.mark.slow
+    def test_single_slot_forced_queueing(self, setup):
+        """max_batch=1: every later request waits on the running one —
+        windows + admission churn must leave all streams exact. (slow:
+        tier-1's greedy match already queues 4 requests through 2 slots)"""
+        cfg, params = setup
+        ref, _ = run_engine(params, cfg, 1)
+        out, _ = run_engine(params, cfg, 4, max_batch=1)
+        assert out == ref
+
+    def test_decode_steps_validation_and_default(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match="decode_steps"):
+            serving.ServingEngine(params, cfg, max_batch=1, max_len=32,
+                                  decode_steps=0)
+        _, eng = run_engine(params, cfg, 1, prompts=[[5, 9, 2]],
+                            budgets=[3], max_batch=1)
+        assert eng.fused_windows == 0  # K=1 never takes the fused path
+
+
+class TestFusedWindowPolicy:
+    def test_window_collapses_for_eos_with_queue(self, setup):
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=1, max_len=64,
+                                    decode_steps=4, eos_id=1)
+        eng.submit([5, 9, 2], 8)
+        eng.submit([17, 3], 4)  # waits in queue
+        eng.step()  # admit + first decode
+        assert eng._fused_window([0]) == 1  # EOS could free the slot
+        eng.queue.clear()
+        assert eng._fused_window([0]) == 4  # nothing waiting: fuse away
+
+    def test_window_power_of_two_bucketing(self, setup):
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=1, max_len=64,
+                                    decode_steps=8)
+        r = eng.submit([5, 9, 2], 6)
+        eng._admit()  # prefill emits token 1; 5 remaining
+        assert len(r.tokens_out) == 1
+        assert eng._fused_window([0]) == 4  # largest pow2 <= 5
+        eng.run_until_drained()
+        assert len(r.tokens_out) == 6
+
+
+def test_generate_decode_steps_unroll_exact(setup=None):
+    """decode.generate(decode_steps=K) is a scan-unroll schedule change:
+    tokens identical for any K, greedy and sampled."""
+    cfg = cfg_of()
+    params = tm.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.asarray([[5, 9, 2], [7, 1, 88]], jnp.int32)
+    ref = decode.generate(params, prompt, cfg, 7, max_len=16)
+    out = decode.generate(params, prompt, cfg, 7, max_len=16,
+                          decode_steps=3)
+    assert (np.asarray(ref) == np.asarray(out)).all()
+    key = jax.random.PRNGKey(4)
+    ref_s = decode.generate(params, prompt, cfg, 7, max_len=16,
+                            temperature=0.7, top_k=20, key=key)
+    out_s = decode.generate(params, prompt, cfg, 7, max_len=16,
+                            temperature=0.7, top_k=20, key=key,
+                            decode_steps=4)
+    assert (np.asarray(ref_s) == np.asarray(out_s)).all()

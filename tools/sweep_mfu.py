@@ -69,12 +69,13 @@ def main() -> int:
         print(json.dumps(rec), flush=True)
         gc.collect()
 
-    def run_decode(tag, dec_batch=16, prompt=128, new=64):
+    def run_decode(tag, dec_batch=16, prompt=128, new=64, decode_steps=1):
         cfg = tm.TransformerConfig(**base)
         try:
             params = bm.serving_params(cfg)
             dec_s = bm.bench_decode(cfg, params, dec_batch, prompt, new,
-                                    max(1, args.iters // 2))
+                                    max(1, args.iters // 2),
+                                    decode_steps=decode_steps)
             param_bytes = 2.0 * bm.param_count(cfg)
             rec = {
                 "tag": tag,
@@ -107,6 +108,12 @@ def main() -> int:
                                             attn_block_k=512)),
         ("dots_b16", lambda: run_train("dots_b16", remat="dots", batch=16)),
         ("decode_b32", lambda: run_decode("decode_b32", dec_batch=32)),
+        # decode-loop unroll (scan unroll=K; exact): does software-
+        # pipelining consecutive token steps move the HBM roofline frac?
+        ("decode_unroll4", lambda: run_decode("decode_unroll4",
+                                              decode_steps=4)),
+        ("decode_unroll8", lambda: run_decode("decode_unroll8",
+                                              decode_steps=8)),
     ]
     only = {t for t in args.only.split(",") if t}
     for tag, fn in experiments:
