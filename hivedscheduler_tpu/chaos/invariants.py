@@ -351,3 +351,62 @@ def check_placement_preserved(before, after, ctx: str = "") -> None:
         if after[name] != chips_before:
             _fail(ctx, f"group {name} placement changed across restart: "
                        f"{chips_before} -> {after[name]}")
+
+
+# ---------------------------------------------------------------------------
+# serving-engine paged KV block pool
+# ---------------------------------------------------------------------------
+
+def check_block_pool(engine, ctx: str = "") -> None:
+    """From-scratch accounting of a paged ``ServingEngine``'s block
+    allocator (models/serving.py): the free-list/refcount books must equal
+    a recount over every holder — no leak, no double-alloc.
+
+    - block 0 (trash) is never allocated, never refcounted, never free;
+    - every other block is EITHER on the free list with refcount 0 OR
+      referenced, and its refcount equals the recount: #slot block-tables
+      holding it + #prefix-cache entries naming it;
+    - the free list holds no duplicates;
+    - each slot's device-visible table row is exactly its owned/shared bid
+      list followed by trash zeros (the jitted programs read the table, so
+      a drifted row would silently mis-address KV);
+    - a parked/idle slot holds no blocks.
+
+    No-op for dense engines (nothing to check). Same raise contract as the
+    scheduler checks: :class:`InvariantViolation`.
+    """
+    if not getattr(engine, "paged", False):
+        return
+    n = engine.num_blocks
+    counts = [0] * n
+    for slot, bids in enumerate(engine._slot_bids):
+        if engine.slots[slot] is None and bids:
+            _fail(ctx, f"retired slot {slot} still holds blocks {bids}")
+        for bid in bids:
+            if not 1 <= bid < n:
+                _fail(ctx, f"slot {slot} holds out-of-range block {bid}")
+            counts[bid] += 1
+        row = list(engine._table[slot])
+        want = bids + [0] * (len(row) - len(bids))
+        if row != want:
+            _fail(ctx, f"slot {slot} table row {row} != owned bids {want}")
+    for key, (payload, _plen) in engine._prefix_cache.items():
+        for bid in engine._entry_bids(payload):
+            if not 1 <= bid < n:
+                _fail(ctx, f"cache entry {key!r} names out-of-range "
+                           f"block {bid}")
+            counts[bid] += 1
+    free = list(engine._free)
+    if len(set(free)) != len(free):
+        _fail(ctx, f"free list has duplicates: {sorted(free)}")
+    if 0 in free or counts[0] or engine._ref[0]:
+        _fail(ctx, "trash block 0 entered the allocator")
+    free_set = set(free)
+    for bid in range(1, n):
+        if int(engine._ref[bid]) != counts[bid]:
+            _fail(ctx, f"block {bid}: refcount {int(engine._ref[bid])} != "
+                       f"recount {counts[bid]}")
+        if counts[bid] == 0 and bid not in free_set:
+            _fail(ctx, f"block {bid} leaked: unreferenced but not free")
+        if counts[bid] > 0 and bid in free_set:
+            _fail(ctx, f"block {bid} double-allocated: referenced AND free")

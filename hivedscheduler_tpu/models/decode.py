@@ -6,7 +6,10 @@ workload runtime built around it. TPU-first choices:
 
 - **Static shapes**: the cache is allocated at ``max_len`` up front and
   attention always scores the full cache with a position mask — no dynamic
-  shapes, one compiled step for the whole decode.
+  shapes, one compiled step for the whole decode. (The serving engine's
+  paged cache, ``serving.advance_paged``, keeps the same static-shape
+  contract — the block-table indirection changes the cache *addressing*,
+  never the compiled program shapes.)
 - **Compact GQA cache**: k/v are cached at ``cfg.kv_heads`` ([L, B, M,
   H_kv, D]) and consumed by grouped einsums, so MQA/GQA cuts cache HBM and
   bandwidth by H/H_kv — the main GQA serving win.

@@ -31,10 +31,23 @@ continuous-batching pattern (the core of modern LLM servers) TPU-first:
   the tail — shared system prompts skip their prefill FLOPs entirely,
   bit-exactly (restored KV is identical to recomputation).
 
-No paging indirection: a TPU gets no benefit from non-contiguous KV blocks
-(there is no per-block allocator to appease, unlike GPU VRAM heaps); the
-fixed per-slot arena + recycling achieves the same utilization with dense,
-layout-friendly slices.
+- **Paged KV cache** (``page_size > 0``): instead of one dense max-length
+  slab per slot, KV lives in a device-resident block pool
+  ([L, n_blocks, block, H_kv, D]) shared by every stream, with a host-side
+  per-slot block table, free-list allocator and refcounts. Admission is
+  gated on *block availability* rather than slot count, so concurrency per
+  chip tracks the actual token footprint of the traffic, not the
+  worst-case sequence length. The prefix cache is rekeyed on block-aligned
+  token chunks: shared system prompts become reference-counted shared
+  blocks (no copies), with copy-on-write the moment a stream writes into a
+  shared block. ``HIVED_PAGED_KV=0`` forces the dense ragged path — the
+  differential reference every paged stream must match token-exactly
+  (guard: tests/test_serving_paged.py).
+
+The dense ragged path (the default) remains the layout XLA likes best when
+slots are short-lived and uniformly sized; paging is the lever for
+mixed-length production traffic where dense slabs strand HBM on the
+worst-case length.
 
 Observability: every finished request publishes per-priority-class
 queue-wait/TTFT/TPOT histograms into the shared Prometheus registry, and —
@@ -47,6 +60,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import time
 from typing import Any, Dict, List, NamedTuple, Optional
 
@@ -70,7 +84,7 @@ from hivedscheduler_tpu.models.transformer import (
     load_weight,
 )
 from hivedscheduler_tpu.obs import trace as obs_trace
-from hivedscheduler_tpu.ops.attention import NEG_INF
+from hivedscheduler_tpu.ops.attention import NEG_INF, block_coords, gather_block_kv
 from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
 
 
@@ -305,6 +319,147 @@ def advance_ragged(
                                k_scale=new_ks, v_scale=new_vs)
 
 
+class PagedKVPool(NamedTuple):
+    """Paged KV: one block pool per layer, k/v [L, n_blocks, block, H_kv,
+    D], shared by every stream. Block 0 is the reserved TRASH block: every
+    unassigned block-table entry points at it, so clamped/idle scatters and
+    padded-prefill garbage land somewhere no live position maps to.
+    Lengths and block tables are HOST state (the engine owns the
+    allocator); the pool itself carries no per-row bookkeeping. With int8
+    KV the ``k_scale``/``v_scale`` pools [L, n_blocks, block, H_kv] travel
+    with their blocks — a shared or COW-copied block is bit-identical to
+    the original, values and scales together, so every exactness argument
+    of :class:`RaggedCache` int8 mode carries over block-wise."""
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def init_paged_pool(cfg: TransformerConfig, n_blocks: int, block: int,
+                    kv_dtype: Optional[str] = None) -> PagedKVPool:
+    shape = (cfg.n_layers, n_blocks, block, cfg.kv_heads, cfg.head_dim)
+    if kv_dtype == "int8":
+        return PagedKVPool(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+            v_scale=jnp.zeros(shape[:-1], jnp.float32),
+        )
+    if kv_dtype is not None:
+        raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+    return PagedKVPool(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+    )
+
+
+def advance_paged(
+    params: Dict[str, Any],
+    pool: PagedKVPool,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    table: jax.Array,
+    lengths: Optional[jax.Array] = None,
+    row: Optional[jax.Array] = None,
+    start: Optional[jax.Array] = None,
+) -> tuple:
+    """Paged twin of :func:`advance_ragged`: absorb ``tokens`` through the
+    block-table indirection and return (logits [B_t, S, vocab] f32, pool).
+
+    Same two modes: decode (``row is None``; tokens [B, S], per-row
+    positions from ``lengths`` [B]) and prefill (``row`` given; tokens
+    [1, S] written through ``table[row]`` from position ``start``). New
+    k/v scatter at :func:`ops.attention.block_coords` (clamped — idle and
+    parked rows write garbage that is rewritten before any query can
+    attend it, the dense path's own invariant); the attention read is
+    :func:`ops.attention.gather_block_kv` over the row's table, whose
+    axis-1 index IS the logical position, so `_ragged_attention` and its
+    int8-scale algebra apply unchanged. The transformer body (norms, QKV +
+    RoPE, grouped attention, MoE/dense MLP) is the SAME shared helpers the
+    dense path uses; the only divergence surface is the cache addressing,
+    and the paged-vs-dense token-exactness differential
+    (tests/test_serving_paged.py) pins that to zero.
+
+    Length bookkeeping is the CALLER's (the engine's host-side allocator
+    advances its own lengths); the returned pool is the only device-state
+    change."""
+    dtype = cfg.dtype
+    cfg = inference_moe_cfg(cfg)  # routing-exact: no-drop capacity
+    b_t, s_len = tokens.shape
+    block = pool.k.shape[2]
+    if row is None:
+        positions = lengths[:, None] + lax.iota(jnp.int32, s_len)[None, :]
+        tbl = table
+    else:
+        offset = jnp.int32(0) if start is None else start
+        positions = (offset + lax.iota(jnp.int32, s_len))[None, :]
+        tbl = lax.dynamic_slice_in_dim(table, row, 1, axis=0)  # [1, nbs]
+    wblk, woff = block_coords(positions, tbl, block)
+
+    x = embed_tokens(params, tokens, dtype)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    quantized = pool.quantized  # static: fixed by the pool's pytree shape
+
+    def layer(x, scanned):
+        if quantized:
+            lp, pk, pv, pks, pvs = scanned
+        else:
+            lp, pk, pv = scanned  # pk/pv [n_blocks, block, H_kv, D]
+            pks = pvs = None
+        h = _rms_norm(x, lp["attn_norm"])
+        q, k_new, v_new = qkv_proj(lp, h, positions, cfg.rope_theta, dtype)
+        if quantized:
+            k_q, k_s = _quant_kv(k_new)
+            v_q, v_s = _quant_kv(v_new)
+        else:
+            k_q, v_q = k_new, v_new
+        # scatter BEFORE the gather/attention, exactly like the dense path:
+        # the gathered view must include this call's own tokens
+        pk = pk.at[wblk, woff].set(k_q.astype(pk.dtype))
+        pv = pv.at[wblk, woff].set(v_q.astype(pv.dtype))
+        if quantized:
+            pks = pks.at[wblk, woff].set(k_s)
+            pvs = pvs.at[wblk, woff].set(v_s)
+        att_k = gather_block_kv(pk, tbl)
+        att_v = gather_block_kv(pv, tbl)
+        att_ks = gather_block_kv(pks, tbl) if quantized else None
+        att_vs = gather_block_kv(pvs, tbl) if quantized else None
+        attn = _ragged_attention(q, att_k, att_v, positions, scale,
+                                 att_ks, att_vs)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, load_weight(lp["wo"], dtype))
+        h = _rms_norm(x, lp["mlp_norm"])
+        if cfg.n_experts > 0:
+            moe_out, _ = _moe_mlp(h, lp, cfg, dtype)
+            x = x + moe_out
+        else:
+            x = x + dense_mlp(lp, h, dtype)
+        if quantized:
+            return x, (pk, pv, pks, pvs)
+        return x, (pk, pv)
+
+    if quantized:
+        xs = (params["layers"], pool.k, pool.v, pool.k_scale, pool.v_scale)
+    else:
+        xs = (params["layers"], pool.k, pool.v)
+    x, scanned_out = lax.scan(
+        lambda carry, scanned: layer(carry, scanned), x, xs
+    )
+    if quantized:
+        new_k, new_v, new_ks, new_vs = scanned_out
+    else:
+        new_k, new_v = scanned_out
+        new_ks = new_vs = None
+    logits = final_logits(params, x, dtype)
+    return logits, PagedKVPool(k=new_k, v=new_v, k_scale=new_ks,
+                               v_scale=new_vs)
+
+
 class EngineDraining(RuntimeError):
     """Raised by ``submit()`` once ``begin_drain()`` was called: the engine
     finishes in-flight work but admits nothing new. The serving front-end
@@ -392,7 +547,21 @@ class ServingEngine:
     sampled streams use counter-based keys (seed x rid x position), so they
     are reproducible across batch interleavings and arrival churn — greedy
     remains the bit-exact-vs-vanilla mode.
+
+    ``ServingEngine(..., spec_decode=SpecDecodeConfig(...))`` constructs the
+    speculative engine (:class:`SpeculativeServingEngine`) — speculative
+    serving is a first-class mode of THIS constructor, composing with
+    continuous batching, chunked prefill, the prefix cache and the paged
+    KV cache, not a separate side engine.
     """
+
+    def __new__(cls, *args, **kw):
+        # first-class speculative mode: spec_decode= routes construction to
+        # the speculative subclass, whose __init__ then receives the same
+        # arguments (Python calls __init__ on whatever __new__ returned)
+        if cls is ServingEngine and kw.get("spec_decode") is not None:
+            return super().__new__(SpeculativeServingEngine)
+        return super().__new__(cls)
 
     def __init__(
         self,
@@ -412,6 +581,9 @@ class ServingEngine:
         queue_timeout_s: Optional[float] = None,
         age_boost_secs: Optional[float] = None,
         decode_steps: int = 1,
+        page_size: int = 0,
+        num_blocks: int = 0,
+        spec_decode=None,
         clock=time.perf_counter,
     ):
         """``mesh``: lay the engine out over a dp x tp serving mesh —
@@ -474,9 +646,37 @@ class ServingEngine:
         ``_fused_window``). 1 (default) = the step-by-step engine.
         Guard: tests/test_serving_multistep.py.
 
+        ``page_size``/``num_blocks``: paged KV cache. ``page_size > 0``
+        replaces the per-slot dense slab with one shared block pool of
+        ``num_blocks`` blocks of ``page_size`` tokens (default
+        ``max_batch * ceil(max_len/page_size) + 1`` — capacity parity with
+        the dense slabs; size it SMALLER with a larger ``max_batch`` to get
+        more concurrent streams out of the same KV HBM, which is the whole
+        point). A host-side free-list allocator + per-slot block tables map
+        logical positions to pool blocks; admission is gated on block
+        availability (prompt-tail blocks + first-decode headroom) instead
+        of slot count, the prefix cache shares reference-counted blocks at
+        block-chunk granularity with copy-on-write on divergence, and pool
+        exhaustion degrades in documented order: reclaim LRU cached prefix
+        blocks, then preempt the youngest lowest-priority stream
+        (``finish_reason="preempted"``). Streams are token-exact vs the
+        dense path (``HIVED_PAGED_KV=0`` forces dense — the differential
+        reference; guard: tests/test_serving_paged.py). Block 0 is the
+        reserved trash block. With a mesh, the pool shards over tp on the
+        kv-head axis; blocks cannot shard over dp (any block may back any
+        slot), so paged + dp>1 raises.
+
+        ``spec_decode``: a ``models.speculative.SpecDecodeConfig`` —
+        constructs the speculative engine (see ``__new__``); None (default)
+        is the plain engine.
+
         ``clock``: the engine's wall-clock source (``time.perf_counter``);
         injectable so overload/deadline behavior is testable
         deterministically."""
+        if spec_decode is not None and type(self) is ServingEngine:
+            raise ValueError("spec_decode requires the speculative engine "
+                             "(ServingEngine.__new__ routes it; do not "
+                             "bypass with a direct __init__ call)")
         self.params = params
         self.cfg = cfg
         self.queue_timeout_s = queue_timeout_s
@@ -511,8 +711,44 @@ class ServingEngine:
 
         self._sample = jax.jit(sample_rows)
         self.kv_dtype = kv_dtype
-        self.cache = init_ragged_cache(cfg, max_batch, max_len,
-                                       kv_dtype=kv_dtype)
+        # -- paged KV cache state (host-side allocator; see class docstring)
+        self.page_size = max(0, page_size)
+        self.paged = (self.page_size > 0
+                      and os.environ.get("HIVED_PAGED_KV", "1") != "0")
+        self._repl_sharding = None
+        if self.paged:
+            self._blocks_per_slot = -(-max_len // self.page_size)
+            if num_blocks <= 0:
+                # capacity parity with the dense slabs (+ the trash block)
+                num_blocks = max_batch * self._blocks_per_slot + 1
+            if num_blocks < self._blocks_per_slot + 1:
+                raise ValueError(
+                    f"num_blocks {num_blocks} cannot back one max_len "
+                    f"stream: need >= ceil(max_len/page_size) + 1 "
+                    f"(= {self._blocks_per_slot + 1}, incl. the reserved "
+                    f"trash block)"
+                )
+            self.num_blocks = num_blocks
+            # parked/idle rows write at the last addressable position; like
+            # the dense sentinel it is at/past every live row's length, so
+            # the garbage is rewritten before any query attends it
+            self._park_pos = self._blocks_per_slot * self.page_size - 1
+            self.pool = init_paged_pool(cfg, num_blocks, self.page_size,
+                                        kv_dtype=kv_dtype)
+            self._table = np.zeros((max_batch, self._blocks_per_slot),
+                                   np.int32)
+            self._host_len = np.full((max_batch,), self._park_pos, np.int32)
+            self._slot_bids: List[List[int]] = [[] for _ in range(max_batch)]
+            self._free: List[int] = list(range(1, num_blocks))
+            self._ref = np.zeros((num_blocks,), np.int64)
+            self.blocks_cow = 0
+            self.pool_preempted = 0
+            self.prefix_block_hits = 0
+            self.cache = None
+        else:
+            self.pool = None
+            self.cache = init_ragged_cache(cfg, max_batch, max_len,
+                                           kv_dtype=kv_dtype)
         self.slots: List[Optional[Request]] = [None] * max_batch
         # host-side staging for the per-row feedback tokens: slots emit into
         # this array and ONE upload per decode step feeds the jitted program
@@ -538,10 +774,32 @@ class ServingEngine:
                 params, serving_shardings(cfg, mesh, quantized=quantized)
             )
             row = ("dp", "fsdp")
-            kv_sh = NamedSharding(mesh, P(None, row, None, "tp", None))
             self._len_sharding = NamedSharding(mesh, P(row))
-            self.cache = jax.device_put(self.cache, self._cache_shardings(
-                kv_sh, self._len_sharding))
+            if self.paged:
+                # blocks are fungible across slots, so the pool cannot shard
+                # over a batch axis — only the compact kv-head axis over tp
+                if dp != 1:
+                    raise ValueError(
+                        f"paged KV cache cannot shard blocks over dp/fsdp "
+                        f"(axis size {dp}): any block may back any slot; "
+                        f"use tp"
+                    )
+                pool_sh = NamedSharding(mesh, P(None, None, None, "tp", None))
+                scale_sh = NamedSharding(mesh, P(None, None, None, "tp"))
+                self.pool = jax.device_put(
+                    self.pool,
+                    PagedKVPool(
+                        k=pool_sh, v=pool_sh,
+                        k_scale=scale_sh if self.kv_dtype == "int8" else None,
+                        v_scale=scale_sh if self.kv_dtype == "int8" else None,
+                    ),
+                )
+                self._repl_sharding = NamedSharding(mesh, P())
+            else:
+                kv_sh = NamedSharding(mesh, P(None, row, None, "tp", None))
+                self.cache = jax.device_put(
+                    self.cache,
+                    self._cache_shardings(kv_sh, self._len_sharding))
             self._token_sharding = NamedSharding(mesh, P(row))
         self.mesh = mesh
         self.queue: List[Request] = []
@@ -608,6 +866,78 @@ class ServingEngine:
         self._decode_multi = jax.jit(decode_multi, static_argnums=(5,),
                                      donate_argnums=(1,))
 
+        # -- paged twins of the three programs (block table + host lengths
+        # travel as arguments; the pool is donated like the dense cache) ---
+        if self.paged:
+            park = self._park_pos
+
+            def paged_decode(params, pool, last_tokens, table, lengths):
+                logits, pool = advance_paged(params, pool,
+                                             last_tokens[:, None], cfg,
+                                             table, lengths)
+                return logits[:, 0], pool
+
+            def paged_prefill(params, pool, tokens, table, row, start):
+                logits, pool = advance_paged(params, pool, tokens, cfg,
+                                             table, row=row, start=start)
+                return logits[0], pool
+
+            def paged_decode_multi(params, pool, last_tokens, table,
+                                   lengths, rids, counts, k):
+                """Paged fused window: same pick math as decode_multi, with
+                the per-iteration lengths carried in the scan (the host
+                advances its own copy by k afterwards). Idle rows clamp at
+                the park sentinel — their writes stay in trash."""
+
+                def body(carry, i):
+                    pool, last, lens = carry
+                    logits, pool = advance_paged(params, pool, last[:, None],
+                                                 cfg, table, lens)
+                    row_logits = logits[:, 0]
+                    if temperature == 0.0:
+                        tok = jnp.argmax(row_logits, axis=-1)
+                    else:
+                        filtered = filter_logits(
+                            row_logits / temperature, top_k, top_p
+                        )
+                        step_i = i.astype(counts.dtype)
+                        keys = jax.vmap(
+                            lambda r, c: _stream_key(base_key, r, c + step_i)
+                        )(rids, counts)
+                        tok = jax.vmap(jax.random.categorical)(keys, filtered)
+                    tok = tok.astype(jnp.int32)
+                    lens = jnp.minimum(lens + 1, jnp.int32(park))
+                    return (pool, tok, lens), tok
+
+                (pool, _, _), toks = lax.scan(
+                    body, (pool, last_tokens, lengths), jnp.arange(k)
+                )
+                return jnp.swapaxes(toks, 0, 1), pool  # toks [B, k]
+
+            quant_pool = kv_dtype == "int8"
+
+            def copy_block(pool, src, dst):
+                """COW: duplicate block ``src`` into the freshly allocated
+                ``dst`` across every layer (values AND scales — the copy is
+                bit-identical, so a diverging stream's history matches the
+                shared original exactly up to its divergence point)."""
+
+                def cp(a):
+                    return a.at[:, dst].set(a[:, src])
+
+                upd = dict(k=cp(pool.k), v=cp(pool.v))
+                if quant_pool:
+                    upd["k_scale"] = cp(pool.k_scale)
+                    upd["v_scale"] = cp(pool.v_scale)
+                return pool._replace(**upd)
+
+            self._paged_decode = jax.jit(paged_decode, donate_argnums=(1,))
+            self._paged_prefill = jax.jit(paged_prefill, donate_argnums=(1,))
+            self._paged_decode_multi = jax.jit(
+                paged_decode_multi, static_argnums=(7,), donate_argnums=(1,)
+            )
+            self._copy_block = jax.jit(copy_block, donate_argnums=(0,))
+
         # -- prompt prefix cache (LRU over device-resident KV rows) --------
         from collections import OrderedDict
 
@@ -668,6 +998,196 @@ class ServingEngine:
         scale_sh = NamedSharding(kv_sh.mesh, P(*kv_sh.spec[:-1]))
         return RaggedCache(k=kv_sh, v=kv_sh, lengths=len_sh,
                            k_scale=scale_sh, v_scale=scale_sh)
+
+    # -- paged block allocator (host-side; device state is only the pool) --
+    #
+    # Invariants (pinned by chaos.invariants.check_block_pool):
+    # - block 0 (trash) is never allocated, never refcounted;
+    # - every other block is either on the free list (ref 0) or referenced
+    #   (ref = #slots holding it in their block table + #prefix-cache
+    #   entries naming it) — no leak, no double-alloc;
+    # - a slot's table row is exactly its owned/shared bids then trash;
+    # - a block a stream WRITES into has ref 1 (copy-on-write splits any
+    #   shared block before the write reaches it).
+
+    @property
+    def blocks_in_use(self) -> int:
+        return (self.num_blocks - 1 - len(self._free)) if self.paged else 0
+
+    def _table_dev(self):
+        t = jnp.asarray(self._table)
+        if self._repl_sharding is not None:
+            t = jax.device_put(t, self._repl_sharding)
+        return t
+
+    def _len_dev(self):
+        ln = jnp.asarray(self._host_len)
+        if self._repl_sharding is not None:
+            ln = jax.device_put(ln, self._repl_sharding)
+        return ln
+
+    def _blocks_admit(self, req: Request, hit) -> bool:
+        """Admission control by block availability: the prompt needs
+        ``cover - full_shared`` new blocks (fresh tail blocks, plus the COW
+        replacement of a partially-shared boundary block), and one spare
+        when the first decode token starts a fresh block. LRU cached prefix
+        blocks are reclaimed to make room (the matched entry is protected);
+        False leaves the waiter queued — head-of-line, so admission order
+        is preserved."""
+        bs = self.page_size
+        plen = hit[1][1] if hit is not None else 0
+        cover = -(-len(req.prompt) // bs)
+        want = cover - plen // bs
+        if len(req.prompt) % bs == 0:
+            want += 1
+        protect = hit[0] if hit is not None else None
+        while len(self._free) < want and self._reclaim_cache_block(protect):
+            pass
+        return len(self._free) >= want
+
+    def _reclaim_cache_block(self, protect=None) -> bool:
+        """Evict ONE LRU prefix-cache entry (never ``protect``) under pool
+        pressure. Returns whether an entry was evicted — its blocks only
+        actually free when no live stream still shares them."""
+        for key in list(self._prefix_cache):  # OrderedDict: LRU first
+            if key == protect:
+                continue
+            payload, _plen = self._prefix_cache.pop(key)
+            self._drop_entry(payload)
+            return True
+        return False
+
+    def _preempt_for_blocks(self, protect_slot: Optional[int]) -> bool:
+        """Last-resort pool-pressure relief: truncate the youngest stream
+        of the lowest priority class (never ``protect_slot``) with
+        ``finish_reason="preempted"`` and free its blocks. The shed
+        ordering mirrors queue shedding: low-priority work degrades first,
+        observably (tpu_hive_serve_pool_preempted_total)."""
+        victims = [s for s in range(self.max_batch)
+                   if s != protect_slot and self.slots[s] is not None
+                   and self._slot_bids[s]]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda s: (
+            -self.slots[s].priority, self.slots[s].admitted_at or 0.0))
+        req = self.slots[victim]
+        req.done = True
+        req.done_at = self._clock()
+        req.finish_reason = "preempted"
+        self._observe_request(req)
+        metrics.inc("tpu_hive_serve_pool_preempted_total")
+        self.pool_preempted += 1
+        self._retire(victim)
+        return True
+
+    def _alloc_block(self, protect_slot: Optional[int] = None) -> int:
+        while not self._free:
+            if self._reclaim_cache_block():
+                continue
+            if not self._preempt_for_blocks(protect_slot):
+                raise RuntimeError(
+                    "paged KV pool exhausted with nothing reclaimable — "
+                    "num_blocks cannot back even one stream (constructor "
+                    "validation should have caught this)"
+                )
+        bid = self._free.pop()
+        self._ref[bid] = 1
+        return bid
+
+    def _decref(self, bid: int) -> None:
+        self._ref[bid] -= 1
+        assert self._ref[bid] >= 0, f"negative refcount on block {bid}"
+        if self._ref[bid] == 0:
+            self._free.append(bid)
+
+    def _ensure_writable(self, slot: int, lo: int, hi: int) -> None:
+        """Make positions [lo, hi] of ``slot`` writable: allocate blocks up
+        to hi's cover, and copy-on-write any block in the write range that
+        is still shared (ref > 1). Every engine write path runs through
+        this first — admission/tail prefill, the decode boundary, fused
+        windows, speculative verify — so a shared block is never written."""
+        bs = self.page_size
+        bids = self._slot_bids[slot]
+        hi_cover = min(hi // bs + 1, self._blocks_per_slot)
+        while len(bids) < hi_cover:
+            bid = self._alloc_block(slot)
+            self._table[slot, len(bids)] = bid
+            bids.append(bid)
+        for j in range(max(0, lo // bs), hi_cover):
+            if self._ref[bids[j]] > 1:
+                dst = self._alloc_block(slot)
+                self.pool = self._copy_block(self.pool, jnp.int32(bids[j]),
+                                             jnp.int32(dst))
+                self._decref(bids[j])
+                bids[j] = dst
+                self._table[slot, j] = dst
+                self.blocks_cow += 1
+                metrics.inc("tpu_hive_serve_block_cow_total")
+
+    def _trim_blocks(self, slot: int, keep_tokens: int) -> None:
+        """Roll the block table back past ``keep_tokens`` (speculative
+        rollback: rejected-tail blocks return to the pool; NO cache copy —
+        kept blocks' stale tail entries are rewritten by the next
+        contiguous window before any query reaches them)."""
+        keep = -(-keep_tokens // self.page_size)
+        bids = self._slot_bids[slot]
+        while len(bids) > keep:
+            bid = bids.pop()
+            self._table[slot, len(bids)] = 0
+            self._decref(bid)
+
+    def _release_blocks(self, slot: int) -> None:
+        for bid in self._slot_bids[slot]:
+            self._decref(bid)
+        self._slot_bids[slot] = []
+        self._table[slot, :] = 0
+        self._host_len[slot] = self._park_pos
+
+    def _retire(self, slot: int) -> None:
+        """Free the slot (request finished or preempted): ONE home for the
+        recycle so the paged allocator cannot leak a retired row's blocks."""
+        self.slots[slot] = None
+        self._prefilling.pop(slot, None)
+        if self.paged:
+            self._release_blocks(slot)
+
+    def _set_row_length(self, slot: int, n: int) -> None:
+        if self.paged:
+            self._host_len[slot] = n
+        else:
+            self.cache = self.cache._replace(
+                lengths=self.cache.lengths.at[slot].set(n)
+            )
+
+    def _run_prefill(self, slot: int, tokens, start: int):
+        """Dispatch one (possibly offset) prefill through the active cache
+        backend; returns the [S, vocab] logits."""
+        if self.paged:
+            logits, self.pool = self._paged_prefill(
+                self.params, self.pool, tokens, self._table_dev(),
+                jnp.int32(slot), jnp.int32(start)
+            )
+            return logits
+        logits, self.cache = self._prefill(
+            self.params, self.cache, tokens, jnp.int32(slot),
+            jnp.int32(start)
+        )
+        return logits
+
+    def _store_payload(self, slot: int, bids, plen: int):
+        """Paged prefix-cache entry payload for ``slot``'s first ``plen``
+        tokens (the speculative engine bundles a draft-KV copy alongside
+        the shared target block ids)."""
+        return tuple(bids)
+
+    def _drop_entry(self, payload) -> None:
+        """Release one evicted prefix-cache entry's block references."""
+        if self.paged:
+            for bid in self._entry_bids(payload):
+                self._decref(bid)
+
+    def _entry_bids(self, payload):
+        return payload
 
     # -- request lifecycle -------------------------------------------------
     def submit(self, prompt: List[int], max_new_tokens: int,
@@ -742,35 +1262,66 @@ class ServingEngine:
         return self._extract_prefix(self.cache, jnp.int32(slot), pb)
 
     def _prefix_restore(self, slot: int, payload) -> None:
-        """Write a cached payload back into slot ``slot``."""
+        """Write a cached payload back into slot ``slot``. Paged: the
+        payload IS the shared block ids — the slot takes a reference on
+        each and points its table at them; no device copy (divergence
+        copies later, on write, via _ensure_writable's COW)."""
+        if self.paged:
+            # payload here is the bids tuple itself (the speculative
+            # override unpacks its bundled draft copy before delegating)
+            bids = list(payload)
+            assert not self._slot_bids[slot], "restore into an occupied row"
+            for j, bid in enumerate(bids):
+                self._ref[bid] += 1
+                self._table[slot, j] = bid
+            self._slot_bids[slot] = bids
+            self.prefix_block_hits += len(bids)
+            metrics.inc("tpu_hive_serve_prefix_block_hits_total", len(bids))
+            return
         self.cache = self._restore_prefix(self.cache, payload, jnp.int32(slot))
 
     def _store_prefix(self, slot: int, prompt: List[int]) -> None:
-        """Cache the row's KV under the full prompt AND every power-of-two
-        boundary below it: two prompts sharing only a system prompt never
-        prefix each other wholly, but they match at block granularity —
-        the same reason paged prefix caches hash block-aligned chunks.
-        ``prefix_cache_size`` counts entries (a prompt inserts up to
-        log2(len) of them)."""
+        """Cache the row's KV under the full prompt AND interior
+        boundaries below it: two prompts sharing only a system prompt
+        never prefix each other wholly, but they match at chunk
+        granularity. The dense path snapshots power-of-two boundaries
+        (each entry is a real device copy, so the count must stay
+        logarithmic); the paged path registers EVERY full-block boundary —
+        an entry is just refcounts on the live blocks (O(1), no copy), and
+        block-aligned chunk keys are exactly what block-granular sharing
+        can serve. ``prefix_cache_size`` counts entries either way."""
         pl = len(prompt)
         lens = {pl}
-        pb = 2
-        while pb < pl:
-            lens.add(pb)
-            pb <<= 1
+        if self.paged:
+            pb = self.page_size
+            while pb < pl:
+                lens.add(pb)
+                pb += self.page_size
+        else:
+            pb = 2
+            while pb < pl:
+                lens.add(pb)
+                pb <<= 1
         # ascending, capped at capacity: the LONGEST prefixes insert last so
         # LRU eviction discards the short (least valuable) entries first,
         # and entries this very batch would evict are never extracted (each
-        # extraction is a real [L, Pb, H_kv, D] x2 device copy)
+        # dense extraction is a real [L, Pb, H_kv, D] x2 device copy)
         for plen in sorted(lens)[-self.prefix_cache_size:]:
             key = tuple(prompt[:plen])
             if key in self._prefix_cache:
                 self._prefix_cache.move_to_end(key)
                 continue
-            payload = self._prefix_extract(slot, self._bucket(plen))
+            if self.paged:
+                bids = self._slot_bids[slot][: -(-plen // self.page_size)]
+                for bid in bids:
+                    self._ref[bid] += 1
+                payload = self._store_payload(slot, bids, plen)
+            else:
+                payload = self._prefix_extract(slot, self._bucket(plen))
             self._prefix_cache[key] = (payload, plen)
         while len(self._prefix_cache) > self.prefix_cache_size:
-            self._prefix_cache.popitem(last=False)  # evict LRU; frees HBM
+            _, (payload, _plen) = self._prefix_cache.popitem(last=False)
+            self._drop_entry(payload)  # paged: drop the block references
 
     def _shed_expired(self) -> None:
         """Queue-wait deadline: finish expired waiters with
@@ -793,14 +1344,15 @@ class ServingEngine:
                 kept.append(req)
         self.queue = kept
 
-    def _next_waiter(self):
-        """Pop the next request to admit: queue head under strict priority
-        (the insertion order), or the max-effective-priority waiter under
-        ``age_boost_secs`` aging (ties keep FIFO: the queue is already
-        priority-then-FIFO ordered, and a stable max scan returns the
-        earliest of equals)."""
+    def _next_waiter_index(self) -> int:
+        """Index of the next request to admit: queue head under strict
+        priority (the insertion order), or the max-effective-priority
+        waiter under ``age_boost_secs`` aging (ties keep FIFO: the queue is
+        already priority-then-FIFO ordered, and a stable max scan returns
+        the earliest of equals). Peek-only — the paged admission gate must
+        inspect the candidate BEFORE committing to pop it."""
         if self.age_boost_secs is None or len(self.queue) <= 1:
-            return self.queue.pop(0)
+            return 0
         now = self._clock()
         boost = self.age_boost_secs
         best_i = 0
@@ -809,7 +1361,7 @@ class ServingEngine:
             eff = w.priority + int((now - w.submitted_at) / boost)
             if best_eff is None or eff > best_eff:
                 best_i, best_eff = i, eff
-        return self.queue.pop(best_i)
+        return best_i
 
     def _admit(self) -> None:
         self._shed_expired()
@@ -818,9 +1370,17 @@ class ServingEngine:
                 return
             if self.slots[slot] is not None:
                 continue
-            req = self._next_waiter()
-            req.admitted_at = self._clock()
+            at = self._next_waiter_index()
+            req = self.queue[at]
             hit = self._match_prefix(req.prompt) if self._prefix_cache else None
+            if self.paged and not self._blocks_admit(req, hit):
+                # admission by BLOCK availability, not slot count: the
+                # waiter stays queued (head-of-line — admission order is
+                # never reshuffled by footprint) until retirements or
+                # cache reclaim free enough blocks
+                return
+            self.queue.pop(at)
+            req.admitted_at = self._clock()
             if hit is not None:
                 payload, plen = hit[1]
                 self.prefix_hits += 1
@@ -829,6 +1389,11 @@ class ServingEngine:
                 tail = req.prompt[plen:]
             else:
                 plen, tail = 0, req.prompt
+            if self.paged:
+                # allocate the prompt's whole block cover up front (the
+                # admission gate counted it) and COW a partially-shared
+                # boundary block the tail will write into mid-block
+                self._ensure_writable(slot, plen, len(req.prompt) - 1)
             if self.prefill_chunk > 0 and len(tail) > self.prefill_chunk:
                 # chunked admission: the slot is occupied but decodes only
                 # after its chunks complete (one per step). Park the device
@@ -842,10 +1407,7 @@ class ServingEngine:
                 self._park(slot)
                 continue
             tokens = self._padded_tokens(tail)
-            logits, self.cache = self._prefill(
-                self.params, self.cache, tokens, jnp.int32(slot),
-                jnp.int32(plen)
-            )
+            logits = self._run_prefill(slot, tokens, plen)
             self._on_prefill(slot, tokens, len(req.prompt), plen)
             # the row's true length is the unpadded prompt (padded tail
             # positions are never attended: mask keys > length-1)
@@ -853,24 +1415,22 @@ class ServingEngine:
             self._finish_prefill(req, slot, logits, len(tail) - 1)
 
     def _park(self, slot: int) -> None:
-        """Pin the slot's device length at the parked sentinel while its
-        chunked prefill is in flight (see the invariant note in _admit).
-        Subclasses with auxiliary caches park those rows too — an unparked
-        auxiliary row would let concurrent decode/verify scatters land at
-        the slot's STALE length, possibly inside the prompt region being
-        chunked in."""
-        self.cache = self.cache._replace(
-            lengths=self.cache.lengths.at[slot].set(self.max_len - 1)
-        )
+        """Pin the slot's length at the parked sentinel while its chunked
+        prefill is in flight (see the invariant note in _admit); the paged
+        sentinel is the last table-addressable position, which maps to the
+        trash block for unassigned entries. Subclasses with auxiliary
+        caches park those rows too — an unparked auxiliary row would let
+        concurrent decode/verify scatters land at the slot's STALE length,
+        possibly inside the prompt region being chunked in."""
+        self._set_row_length(
+            slot, self._park_pos if self.paged else self.max_len - 1)
 
     def _finish_prefill(self, req: Request, slot: int, logits,
                         last_idx: int) -> None:
         """Shared post-prefill tail of the monolithic and chunked paths:
         set the row's true length, store the prefix (after _on_prefill has
         populated subclass caches), pick + emit the first token."""
-        self.cache = self.cache._replace(
-            lengths=self.cache.lengths.at[slot].set(len(req.prompt))
-        )
+        self._set_row_length(slot, len(req.prompt))
         self._on_ready(slot, len(req.prompt))
         if self.prefix_cache_size > 0:
             # store even on a hit: the row now holds valid KV for the FULL
@@ -880,7 +1440,7 @@ class ServingEngine:
         tok = self._pick(logits[last_idx], req)
         self._emit(req, slot, tok)
         if req.done:
-            self.slots[slot] = None
+            self._retire(slot)
 
     def _padded_tokens(self, toks: List[int]):
         """Right-pad to the prefill bucket — ONE home for the padding rule
@@ -909,9 +1469,7 @@ class ServingEngine:
             size = self._bucket(size) // 2
         chunk = tail[pos: pos + size]
         tokens = self._padded_tokens(chunk)
-        logits, self.cache = self._prefill(
-            self.params, self.cache, tokens, jnp.int32(slot), jnp.int32(off)
-        )
+        logits = self._run_prefill(slot, tokens, off)
         self._on_prefill(slot, tokens, len(req.prompt), off)
         self.prefill_chunks_done += 1
         pos += len(chunk)
@@ -1064,35 +1622,64 @@ class ServingEngine:
         requests)."""
         self._admit()
         active = self._tick_prefills()
+        if active and self.paged:
+            k_plan = self._fused_window(active)
+            for slot in active:
+                if self.slots[slot] is None:
+                    continue  # retired by an earlier slot's pool preemption
+                lo = int(self._host_len[slot])
+                self._ensure_writable(slot, lo, lo + k_plan - 1)
+            # block-pressure preemption inside _ensure_writable may have
+            # retired another active slot; a SMALLER window than planned is
+            # always exact, so re-filter rather than re-plan
+            active = [s for s in active if self.slots[s] is not None]
         if active:
             last = jnp.asarray(self._last_host, jnp.int32)
             if self._token_sharding is not None:
                 last = jax.device_put(last, self._token_sharding)
-            k = self._fused_window(active)
+            k = self._fused_window(active) if not self.paged else k_plan
             if k == 1:
-                logits, self.cache = self._decode(self.params, self.cache,
-                                                  last)
+                if self.paged:
+                    logits, self.pool = self._paged_decode(
+                        self.params, self.pool, last, self._table_dev(),
+                        self._len_dev()
+                    )
+                else:
+                    logits, self.cache = self._decode(self.params,
+                                                      self.cache, last)
                 self.steps += 1
                 self.slot_steps += len(active)
                 picked = self._pick_batch(logits, self.slots)
+                if self.paged:
+                    for slot in active:
+                        self._host_len[slot] += 1
                 for slot in active:
                     req = self.slots[slot]
                     self._emit(req, slot, int(picked[slot]))
                     if req.done:
-                        self.slots[slot] = None  # recycle immediately
+                        self._retire(slot)  # recycle immediately
             else:
                 rids, counts = self._sample_coords(self.slots)
                 if self._token_sharding is not None:
                     rids = jax.device_put(rids, self._token_sharding)
                     counts = jax.device_put(counts, self._token_sharding)
-                toks_d, self.cache = self._decode_multi(
-                    self.params, self.cache, last, rids, counts, k
-                )
+                if self.paged:
+                    toks_d, self.pool = self._paged_decode_multi(
+                        self.params, self.pool, last, self._table_dev(),
+                        self._len_dev(), rids, counts, k
+                    )
+                else:
+                    toks_d, self.cache = self._decode_multi(
+                        self.params, self.cache, last, rids, counts, k
+                    )
                 self.fused_windows += 1
                 metrics.inc("tpu_hive_serve_fused_decode_windows_total")
                 toks = jax.device_get(toks_d)  # ONE [B, k] transfer
                 self.steps += k
                 self.slot_steps += len(active) * k
+                if self.paged:
+                    for slot in active:
+                        self._host_len[slot] += k
                 for slot in active:
                     req = self.slots[slot]
                     for j in range(k):
@@ -1100,7 +1687,12 @@ class ServingEngine:
                         if req.done:
                             break  # surplus window tokens are discarded
                     if req.done:
-                        self.slots[slot] = None
+                        self._retire(slot)
+        if self.paged:
+            metrics.set_gauge(
+                "tpu_hive_serve_block_pool_occupancy",
+                self.blocks_in_use / max(1, self.num_blocks - 1),
+            )
         return bool(self.queue) or any(s is not None for s in self.slots)
 
     def run_until_drained(self, max_steps: int = 100_000) -> None:
@@ -1148,7 +1740,9 @@ class ServingEngine:
                     req.done_at = now
                     req.finish_reason = "preempted"
                 self.queue.clear()
-                self.slots = [None] * self.max_batch
+                for slot in range(self.max_batch):
+                    if self.slots[slot] is not None:
+                        self._retire(slot)  # paged: return the blocks
                 self._prefilling.clear()
                 return False
         return True
@@ -1207,8 +1801,25 @@ class SpeculativeServingEngine(ServingEngine):
     touch the prompt region being built. Exactness guard:
     tests/test_serving_chunked.py + the chunked speculative fuzz."""
 
-    def __init__(self, params, cfg, draft_params, draft_cfg, *, gamma: int = 4,
-                 **kw):
+    def __init__(self, params, cfg, draft_params=None, draft_cfg=None, *,
+                 gamma: int = 4, spec_decode=None, **kw):
+        if spec_decode is not None:
+            # first-class construction: ServingEngine(spec_decode=...)
+            # routed here via __new__ — unpack the config
+            if draft_params is not None or draft_cfg is not None:
+                raise ValueError(
+                    "pass either spec_decode= or explicit draft_params/"
+                    "draft_cfg, not both"
+                )
+            draft_params = spec_decode.draft_params
+            draft_cfg = spec_decode.draft_cfg
+            gamma = spec_decode.gamma
+        if draft_params is None or draft_cfg is None:
+            raise ValueError(
+                "speculative serving needs a draft model: pass "
+                "spec_decode=SpecDecodeConfig(...) (or legacy positional "
+                "draft_params/draft_cfg)"
+            )
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError("target and draft vocabs must match")
         if gamma < 1:
@@ -1250,28 +1861,48 @@ class SpeculativeServingEngine(ServingEngine):
                                        row=row, start=start)
             return dcache
 
-        def spec_round(tparams, dparams, tcache, dcache, last):
-            def draft_step(carry, _):
-                dc, tok = carry
-                logits, dc = advance_ragged(dparams, dc, tok[:, None], draft_cfg)
-                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-                return (dc, nxt), nxt
+        def make_spec_round(paged: bool):
+            """Greedy speculative round; the draft side is identical for
+            both cache backends (the draft stays a dense slab — it is a
+            fraction of the target's size), only the target verify pass
+            addresses its cache differently. Paged callers append the
+            block table + host lengths."""
 
-            (dcache, last_d), props = jax.lax.scan(
-                draft_step, (dcache, last), None, length=gamma
-            )
-            # extra absorb so the draft cache holds its last proposal when a
-            # row accepts everything (models/speculative.py:128-143)
-            _, dcache = advance_ragged(dparams, dcache, last_d[:, None],
-                                       draft_cfg)
-            props = jnp.swapaxes(props, 0, 1)  # [B, gamma]
-            tgt_in = jnp.concatenate([last[:, None], props], axis=1)
-            tlogits, tcache = advance_ragged(tparams, tcache, tgt_in, cfg)
-            emit = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # [B, g+1]
-            return tcache, dcache, props, emit
+            def spec_round(tparams, dparams, tcache, dcache, last, *extra):
+                def draft_step(carry, _):
+                    dc, tok = carry
+                    logits, dc = advance_ragged(dparams, dc, tok[:, None],
+                                                draft_cfg)
+                    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                    return (dc, nxt), nxt
+
+                (dcache, last_d), props = jax.lax.scan(
+                    draft_step, (dcache, last), None, length=gamma
+                )
+                # extra absorb so the draft cache holds its last proposal
+                # when a row accepts everything (models/speculative.py:128-143)
+                _, dcache = advance_ragged(dparams, dcache, last_d[:, None],
+                                           draft_cfg)
+                props = jnp.swapaxes(props, 0, 1)  # [B, gamma]
+                tgt_in = jnp.concatenate([last[:, None], props], axis=1)
+                if paged:
+                    table, lengths = extra
+                    tlogits, tcache = advance_paged(tparams, tcache, tgt_in,
+                                                    cfg, table, lengths)
+                else:
+                    tlogits, tcache = advance_ragged(tparams, tcache, tgt_in,
+                                                     cfg)
+                emit = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)
+                return tcache, dcache, props, emit  # emit [B, g+1]
+
+            return spec_round
 
         self._draft_prefill = jax.jit(draft_prefill, donate_argnums=(1,))
-        self._spec_round = jax.jit(spec_round, donate_argnums=(2, 3))
+        self._spec_round = jax.jit(make_spec_round(False),
+                                   donate_argnums=(2, 3))
+        if self.paged:
+            self._spec_round_paged = jax.jit(make_spec_round(True),
+                                             donate_argnums=(2, 3))
 
         if self.temperature > 0.0:
             temp, topk, topp = self.temperature, self.top_k, self.top_p
@@ -1284,7 +1915,10 @@ class SpeculativeServingEngine(ServingEngine):
                 return _stream_key(base_key, r, c, tag)
 
             def spec_round_sampled(tparams, dparams, tcache, dcache, last,
-                                   rids, counts):
+                                   rids, counts, *extra):
+                # paged callers append (table, lengths) and pass the block
+                # pool as tcache; the presence of the extras is part of the
+                # jit trace signature, so this branch is static
                 def fdist(logits):
                     return filter_logits(logits / temp, topk, topp)
 
@@ -1308,7 +1942,13 @@ class SpeculativeServingEngine(ServingEngine):
                 props = jnp.swapaxes(props, 0, 1).astype(jnp.int32)  # [B,g]
                 qf = jnp.swapaxes(qf, 0, 1)                      # [B,g,V]
                 tgt_in = jnp.concatenate([last[:, None], props], axis=1)
-                tlogits, tcache = advance_ragged(tparams, tcache, tgt_in, cfg)
+                if extra:
+                    table, lengths = extra
+                    tlogits, tcache = advance_paged(tparams, tcache, tgt_in,
+                                                    cfg, table, lengths)
+                else:
+                    tlogits, tcache = advance_ragged(tparams, tcache, tgt_in,
+                                                     cfg)
                 pf = fdist(tlogits)                              # [B,g+1,V]
                 p = jax.nn.softmax(pf, axis=-1)
                 q = jax.nn.softmax(qf, axis=-1)
@@ -1410,6 +2050,18 @@ class SpeculativeServingEngine(ServingEngine):
             self.draft_cache, dft, jnp.int32(slot)
         )
 
+    def _store_payload(self, slot: int, bids, plen: int):
+        # paged target prefix = shared block ids (refcounted, no copy); the
+        # draft has no paged pool, so bundle a dense draft-KV copy — a
+        # restored prefix must leave BOTH models exactly as a full prefill
+        # would, which is what the paged differential pins
+        return (tuple(bids),
+                self._extract_prefix(self.draft_cache, jnp.int32(slot),
+                                     self._bucket(plen)))
+
+    def _entry_bids(self, payload):
+        return payload[0]
+
     def submit(self, prompt, max_new_tokens: int,
                priority: int = 0) -> Request:
         # a verify round writes up to gamma past the accepted prefix before
@@ -1425,29 +2077,58 @@ class SpeculativeServingEngine(ServingEngine):
     def step(self) -> bool:
         self._admit()
         active = self._tick_prefills()
+        if active and self.paged:
+            # a verify round writes [len, len+gamma]: allocate/COW that
+            # cover up front ("accepted draft tokens append blocks"); the
+            # rejected tail's blocks roll back via _trim_blocks below
+            for slot in active:
+                if self.slots[slot] is None:
+                    continue  # retired by an earlier slot's pool preemption
+                lo = int(self._host_len[slot])
+                self._ensure_writable(slot, lo, lo + self.gamma)
+            active = [s for s in active if self.slots[s] is not None]
         if active:
             last = jnp.asarray(self._last_host, jnp.int32)
             if self._token_sharding is not None:
                 last = jax.device_put(last, self._token_sharding)
-            lengths_before = jax.device_get(self.cache.lengths)
+            if self.paged:
+                lengths_before = self._host_len.copy()
+                extra = (self._table_dev(), self._len_dev())
+            else:
+                lengths_before = jax.device_get(self.cache.lengths)
+                extra = ()
             if self.temperature > 0.0:
                 rids, counts = self._sample_coords(self.slots)
                 if self._token_sharding is not None:
                     rids = jax.device_put(rids, self._token_sharding)
                     counts = jax.device_put(counts, self._token_sharding)
-                self.cache, self.draft_cache, emit_d, acc_d = (
-                    self._spec_round_sampled(
-                        self.params, self.draft_params, self.cache,
-                        self.draft_cache, last, rids, counts,
-                    ))
+                if self.paged:
+                    self.pool, self.draft_cache, emit_d, acc_d = (
+                        self._spec_round_sampled(
+                            self.params, self.draft_params, self.pool,
+                            self.draft_cache, last, rids, counts, *extra,
+                        ))
+                else:
+                    self.cache, self.draft_cache, emit_d, acc_d = (
+                        self._spec_round_sampled(
+                            self.params, self.draft_params, self.cache,
+                            self.draft_cache, last, rids, counts,
+                        ))
                 emit, acc_row = jax.device_get((emit_d, acc_d))
                 props = None  # device already resolved per-row acceptance
             else:
-                self.cache, self.draft_cache, props_d, emit_d = (
-                    self._spec_round(
-                        self.params, self.draft_params, self.cache,
-                        self.draft_cache, last,
-                    ))
+                if self.paged:
+                    self.pool, self.draft_cache, props_d, emit_d = (
+                        self._spec_round_paged(
+                            self.params, self.draft_params, self.pool,
+                            self.draft_cache, last, *extra,
+                        ))
+                else:
+                    self.cache, self.draft_cache, props_d, emit_d = (
+                        self._spec_round(
+                            self.params, self.draft_params, self.cache,
+                            self.draft_cache, last,
+                        ))
                 props, emit = jax.device_get((props_d, emit_d))
             self.steps += 1
             self.slot_steps += len(active)
@@ -1464,6 +2145,8 @@ class SpeculativeServingEngine(ServingEngine):
                         acc += 1
                 self.drafted += self.gamma
                 self.accepted += acc
+                metrics.observe("tpu_hive_serve_spec_acceptance_ratio",
+                                acc / self.gamma)
                 # emit accepted prefix + correction, respecting budget/eos
                 for tok in emit[slot, : acc + 1]:
                     self._emit(req, slot, int(tok))
@@ -1472,8 +2155,13 @@ class SpeculativeServingEngine(ServingEngine):
                 # roll the row back to feedback + accepted prefix; idle rows
                 # keep lengths_before (their absorbed garbage never advances)
                 new_len[slot] = lengths_before[slot] + 1 + acc
+                if self.paged and not req.done:
+                    # speculative rollback, block form: keep the accepted
+                    # cover, return the rejected tail's blocks to the pool
+                    self._host_len[slot] = new_len[slot]
+                    self._trim_blocks(slot, int(new_len[slot]))
                 if req.done:
-                    self.slots[slot] = None
+                    self._retire(slot)
             # two distinct buffers: both caches are donated to the next
             # round, and donating one shared lengths array twice is an error
             def upload(arr):
@@ -1482,9 +2170,15 @@ class SpeculativeServingEngine(ServingEngine):
                     arr = jax.device_put(arr, self._len_sharding)
                 return arr
 
-            self.cache = self.cache._replace(lengths=upload(new_len))
+            if not self.paged:
+                self.cache = self.cache._replace(lengths=upload(new_len))
             self.draft_cache = self.draft_cache._replace(
                 lengths=upload(new_len))
+        if self.paged:
+            metrics.set_gauge(
+                "tpu_hive_serve_block_pool_occupancy",
+                self.blocks_in_use / max(1, self.num_blocks - 1),
+            )
         return bool(self.queue) or any(s is not None for s in self.slots)
 
     @property

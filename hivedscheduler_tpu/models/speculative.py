@@ -33,6 +33,7 @@ holds w.r.t. the *filtered* target distribution.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -46,6 +47,23 @@ from hivedscheduler_tpu.models.decode import (
     init_kv_cache,
 )
 from hivedscheduler_tpu.models.transformer import TransformerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecDecodeConfig:
+    """First-class speculative serving: pass
+    ``ServingEngine(..., spec_decode=SpecDecodeConfig(...))`` and the
+    engine constructor routes to the speculative engine — composing with
+    continuous batching, chunked prefill, the prefix cache and the paged
+    KV cache (``page_size``/``num_blocks``), instead of requiring callers
+    to pick a separate engine class. ``gamma`` is the number of draft
+    proposals per verify round; the per-row acceptance, exactness and
+    counter-keyed sampling contracts are documented on
+    ``serving.SpeculativeServingEngine``."""
+
+    draft_params: Any
+    draft_cfg: TransformerConfig
+    gamma: int = 4
 
 
 class SpecStats(NamedTuple):

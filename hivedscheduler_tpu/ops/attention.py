@@ -420,3 +420,43 @@ def flash_attention(
         # compiled kernel is opaque and the vma-stamped out_shapes type it.
         return xla_attention(q, k, v, causal=causal)
     return _flash_core(causal, block_q, block_k, interpret, tuple(vma))(q, k, v)
+
+
+# -- paged KV reads ----------------------------------------------------------
+# The serving engine's paged cache (models/serving.py) stores KV as a block
+# pool [n_blocks, block, ...] shared by every stream; a per-row block table
+# maps logical token positions to pool blocks. The attention read is then a
+# gather through the table — these helpers are the ONE home for that
+# indirection so the decode, prefill and speculative-verify programs cannot
+# disagree about the position <-> (block, offset) mapping.
+
+
+def gather_block_kv(pool: jax.Array, table: jax.Array) -> jax.Array:
+    """Per-row KV view of a paged block pool.
+
+    ``pool``: [n_blocks, block, ...tail] (k/v: tail = [H_kv, D]; int8
+    scales: tail = [H_kv]). ``table``: int32 [B, nbs] of block ids — entry
+    ``j`` backs logical positions [j*block, (j+1)*block). Returns
+    [B, nbs*block, ...tail] where axis 1 IS the logical token position, so
+    the caller's causal position mask (key_pos <= query position) applies
+    unchanged; unassigned table entries point at the reserved trash block
+    (id 0) whose garbage only ever sits at masked positions.
+    """
+    g = pool[table]  # [B, nbs, block, ...tail]
+    return g.reshape(g.shape[0], g.shape[1] * g.shape[2], *g.shape[3:])
+
+
+def block_coords(positions: jax.Array, table: jax.Array, block: int):
+    """(block id, in-block offset) scatter coordinates for writing new KV
+    at ``positions`` [B, S] through ``table`` [B, nbs] (prefill callers pass
+    a [1, nbs] row slice). Positions are clamped to the table's addressable
+    range [0, nbs*block): idle/parked rows sit AT the clamp and write into
+    whatever their last table entry points at — the trash block for
+    unassigned entries, or a position at/past the row's live length for an
+    owned block — which no query ever attends before the row itself
+    rewrites it (the same drop-the-garbage invariant the dense ragged
+    cache's out-of-bounds scatters rely on)."""
+    nbs = table.shape[-1]
+    pos = jnp.minimum(positions, nbs * block - 1)
+    blk = jnp.take_along_axis(table, pos // block, axis=1)
+    return blk, pos % block

@@ -149,6 +149,25 @@ REGISTRY.describe("tpu_hive_serve_drain_rejected_total",
 REGISTRY.describe("tpu_hive_serve_fused_decode_windows_total",
                   "Multi-step fused decode windows executed (ServingEngine "
                   "decode_steps > 1: K tokens per host round-trip)")
+# paged KV cache (ServingEngine page_size > 0): block-pool allocator and
+# block-granular prefix sharing
+REGISTRY.describe("tpu_hive_serve_block_pool_occupancy",
+                  "Fraction of allocatable KV blocks currently referenced "
+                  "(paged serving; 1.0 = pool pressure, admission gates)")
+REGISTRY.describe("tpu_hive_serve_prefix_block_hits_total",
+                  "KV blocks reused by reference from the block-granular "
+                  "prefix cache at admission (each is a whole block of "
+                  "prompt prefill skipped AND not re-stored)")
+REGISTRY.describe("tpu_hive_serve_block_cow_total",
+                  "Copy-on-write block copies (a stream wrote into a "
+                  "block still shared with the prefix cache or another "
+                  "stream)")
+REGISTRY.describe("tpu_hive_serve_pool_preempted_total",
+                  "Streams truncated (finish_reason=preempted) to relieve "
+                  "KV block-pool exhaustion after cache reclaim ran dry")
+REGISTRY.describe("tpu_hive_serve_spec_acceptance_ratio",
+                  "Per-verify-round speculative acceptance fraction "
+                  "(accepted draft tokens / gamma) as a histogram")
 # workload supervisor (parallel/supervisor.py + the train CLI): the
 # preemption-tolerance surface of the training loop
 REGISTRY.describe("tpu_hive_train_resumes_total",

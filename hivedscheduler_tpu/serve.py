@@ -67,6 +67,26 @@ def main(argv=None) -> int:
     parser.add_argument("--dp", type=int, default=1,
                         help="shard engine slots over a dp mesh axis "
                         "(--max-batch must divide it)")
+    parser.add_argument("--page-size", type=int, default=0,
+                        help="paged KV cache: tokens per block (0 = dense "
+                        "per-slot slabs). Blocks come from a shared pool "
+                        "with a free-list allocator; admission is gated on "
+                        "block availability, the prefix cache shares "
+                        "reference-counted blocks with copy-on-write, and "
+                        "streams stay token-exact vs the dense path "
+                        "(HIVED_PAGED_KV=0 forces dense)")
+    parser.add_argument("--num-blocks", type=int, default=0,
+                        help="paged KV pool size in blocks (0 = capacity "
+                        "parity with the dense slabs: max_batch * "
+                        "ceil(max_len/page_size) + 1). Size it SMALLER "
+                        "with a larger --max-batch to serve more "
+                        "concurrent streams from the same KV HBM")
+    parser.add_argument("--spec-decode", action="store_true",
+                        help="first-class speculative serving: construct "
+                        "the engine with ServingEngine(spec_decode=...) "
+                        "(composes with paging, chunked prefill and the "
+                        "prefix cache); uses --draft-layers (default 2 "
+                        "when unset) and --gamma for the draft model")
     parser.add_argument("--draft-layers", type=int, default=0,
                         help="speculative serving: draft-model layers "
                         "(0 = off; per-row acceptance — no batch-min "
@@ -203,22 +223,34 @@ def main(argv=None) -> int:
             queue_timeout_s=args.queue_timeout if args.queue_timeout > 0 else None,
             age_boost_secs=args.age_boost_secs if args.age_boost_secs > 0 else None,
             decode_steps=args.decode_steps,
+            page_size=args.page_size, num_blocks=args.num_blocks,
         )
-        if args.draft_layers > 0 and args.decode_steps > 1:
+        speculative = args.spec_decode or args.draft_layers > 0
+        if speculative and args.decode_steps > 1:
             log.warning("--decode-steps is ignored by the speculative "
                         "engine (a verify round already amortizes the "
                         "host round-trip)")
-        if args.draft_layers > 0:
-            from hivedscheduler_tpu.models.speculative import derive_draft_config
+        if speculative:
+            from hivedscheduler_tpu.models.speculative import (
+                SpecDecodeConfig,
+                derive_draft_config,
+            )
 
-            dft_cfg = derive_draft_config(cfg, args.draft_layers,
+            dft_cfg = derive_draft_config(cfg, args.draft_layers or 2,
                                           args.draft_d_model)
             dft_params = tm.cast_params(
                 tm.init_params(dft_cfg, jax.random.PRNGKey(args.seed + 3)),
                 dft_cfg.dtype,
             )
-            eng = serving.SpeculativeServingEngine(
-                params, cfg, dft_params, dft_cfg, gamma=args.gamma, **kw
+            # the first-class construction path: one constructor, every
+            # composition (paging, chunked prefill, prefix cache)
+            eng = serving.ServingEngine(
+                params, cfg,
+                spec_decode=SpecDecodeConfig(
+                    draft_params=dft_params, draft_cfg=dft_cfg,
+                    gamma=args.gamma,
+                ),
+                **kw,
             )
         else:
             eng = serving.ServingEngine(params, cfg, **kw)
@@ -331,11 +363,11 @@ def main(argv=None) -> int:
             len(preempted), args.drain_deadline,
             "fully drained" if drained else "deadline expired",
         )
-    if args.decode_steps > 1 and args.draft_layers == 0:
+    if args.decode_steps > 1 and not speculative:
         log.info("fused decode: %s multi-step windows (decode_steps=%s) "
                  "over %s device steps", eng.fused_windows,
                  args.decode_steps, eng.steps)
-    if args.draft_layers > 0:
+    if speculative:
         log.info("speculation: %s/%s draft tokens accepted (%.0f%%)",
                  eng.accepted, eng.drafted, 100.0 * eng.acceptance)
     if args.prefix_cache > 0:
@@ -343,6 +375,11 @@ def main(argv=None) -> int:
                  "(%s entries held)",
                  eng.prefix_hits, eng.prefix_tokens_reused,
                  len(eng._prefix_cache))
+    if eng.paged:
+        log.info("paged KV: %s/%s blocks in use at exit, %s prefix block "
+                 "hits, %s COW copies, %s pool preemptions",
+                 eng.blocks_in_use, eng.num_blocks - 1,
+                 eng.prefix_block_hits, eng.blocks_cow, eng.pool_preempted)
     if args.metrics_dump:
         from hivedscheduler_tpu.obs import trace as obs_trace
         from hivedscheduler_tpu.runtime.metrics import REGISTRY

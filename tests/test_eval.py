@@ -33,6 +33,7 @@ def run_eval(args, capsys):
     return float(line.split()[1]), float(line.split()[3])
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
 def test_trained_checkpoint_beats_random_init(tmp_path, corpus, capsys):
     from hivedscheduler_tpu import train
 

@@ -66,6 +66,7 @@ class TestLoRA:
         base_out = tm.forward(base_params, tokens, base_cfg)
         assert np.abs(np.asarray(adapted) - np.asarray(base_out)).max() > 1e-4
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_mlp_adapters_identity_merge_and_training(self):
         """lora_mlp=True: zero-init is exactly the base model; merge folds
         gate/up/down deltas exactly; a tp-sharded LoRA step trains the MLP
@@ -143,6 +144,7 @@ class TestLoRA:
         assert moved > 0.0
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_lora_grad_accum_matches_full_batch(self):
         """One LoRA update with grad_accum=4 must equal the full-batch
         update exactly (same argument as the dense train step: the LM loss

@@ -223,6 +223,7 @@ class TestTrainCliWiring:
 
 
 class TestRematPolicy:
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_remat_policies_compute_identical_step(self):
         """The remat policy trades recompute for HBM only: one train step
         under each policy must produce the SAME loss and (numerically)

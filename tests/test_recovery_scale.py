@@ -7,9 +7,12 @@ same nodes but different chips (broken ICI contiguity) counts as lost — in
 bounded time (reference behavior: hived_algorithm_test.go:1042-1092, tested
 there at toy scale)."""
 
+import pytest
+
 import bench
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
 def test_recovery_barrier_at_v5p1024_scale():
     rec_ms, n_pods, n_groups, preserved_pct = bench.run_recovery()
     # the random gang mix packs the full 1024-chip pod (256 x 4-chip pods)

@@ -144,6 +144,7 @@ def _zigzag_flash(mesh, block=4):
 
 
 @pytest.mark.parametrize("h_kv", [H, 2])
+@pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
 def test_zigzag_flash_matches_dense(h_kv):
     """The zigzag schedule's quarter-blocks are all diagonal-or-fully-
     visible, so the same two flash kernels cover it: forward and gradients

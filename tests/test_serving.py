@@ -190,6 +190,7 @@ class TestServingEngine:
         with pytest.raises(ValueError, match="max_batch"):
             serving.ServingEngine(params, cfg, max_batch=3, mesh=mesh)
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_fuzz_random_interleavings(self, setup):
         """Randomized schedule fuzz (same spirit as the scheduler's
         invariant harness): random prompts/budgets submitted at random step

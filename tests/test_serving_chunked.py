@@ -40,7 +40,7 @@ def run_all(cfg, params, prompts, budget=5, **kw):
     return eng, [r.tokens_out for r in reqs]
 
 
-@pytest.mark.parametrize("chunk", [4, 16])
+@pytest.mark.parametrize("chunk", [4, pytest.param(16, marks=pytest.mark.slow)])  # 16: tier-1 wall-time budget
 def test_chunked_matches_monolithic(setup, chunk):
     cfg, params = setup
     prompts = [LONG, [7, 8, 9], LONG + [5], list(range(90))]
@@ -129,7 +129,7 @@ class TestSpeculativeComposition:
         eng.run_until_drained()
         return eng, [r.tokens_out for r in reqs]
 
-    @pytest.mark.parametrize("chunk", [4, 16])
+    @pytest.mark.parametrize("chunk", [4, pytest.param(16, marks=pytest.mark.slow)])  # 16: tier-1 wall-time budget
     def test_chunked_speculative_matches_unchunked(self, spec_setup, chunk):
         prompts = [LONG, [7, 8, 9], LONG + [5], list(range(80))]
         _, plain = self.run_spec(spec_setup, prompts)
@@ -172,6 +172,7 @@ class TestSpeculativeComposition:
         eng.run_until_drained()
         assert long_req.done
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_chunked_speculative_with_prefix_cache(self, spec_setup):
         prompts = [LONG + [1], LONG + [2, 3], LONG + [1, 4]]
         _, plain = self.run_spec(spec_setup, prompts)

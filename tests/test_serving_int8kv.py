@@ -102,6 +102,7 @@ def test_int8_prefix_cache_matches_int8_plain(setup):
     assert eng.prefix_hits >= 1
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
 def test_int8_speculation_matches_int8_plain_greedy(setup):
     """Greedy speculation inside the int8 world equals plain int8 decode:
     the verify window quantizes and attends the same entries step-by-step
@@ -138,6 +139,7 @@ def test_int8_mesh_sharded_matches_unsharded(setup):
     assert [r.tokens_out for r in reqs] == plain
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
 def test_kitchen_sink_composition(setup):
     """Every serving feature at once — MoE target, int8 KV, chunked
     prefill, prefix cache, greedy speculation with a dense draft — must

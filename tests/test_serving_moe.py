@@ -42,6 +42,7 @@ def vanilla(params, cfg, prompt, n):
 
 
 class TestMoEServing:
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_interleaved_streams_match_moe_decode(self, setup):
         cfg, params = setup
         eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64)
@@ -81,6 +82,7 @@ class TestMoEServing:
         assert [r.tokens_out for r in reqs] == [r.tokens_out for r in refs]
         assert eng.prefix_hits >= 1
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_moe_target_dense_draft_speculation_exact(self, setup):
         """Speculative serving with an MoE target and a small dense draft:
         greedy streams still equal vanilla MoE decode."""

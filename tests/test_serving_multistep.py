@@ -68,6 +68,7 @@ class TestFusedDecodeExactness:
         out, eng = run_engine(params, cfg, 7)
         assert out == ref and eng.fused_windows > 0
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_sampled_streams_match_k1(self, setup):
         cfg, params = setup
         ref, _ = run_engine(params, cfg, 1, temperature=0.8)
@@ -166,6 +167,7 @@ class TestFusedWindowPolicy:
         assert len(r.tokens_out) == 6
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
 def test_generate_decode_steps_unroll_exact(setup=None):
     """decode.generate(decode_steps=K) is a scan-unroll schedule change:
     tokens identical for any K, greedy and sampled."""

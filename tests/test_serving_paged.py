@@ -1,0 +1,560 @@
+"""Paged KV cache (models/serving.py page_size > 0).
+
+The load-bearing property is the differential: a paged engine's every
+stream must be TOKEN-EXACT vs the dense ragged reference
+(``HIVED_PAGED_KV=0`` / ``page_size=0``) under every composition — prefix
+sharing with copy-on-write, chunked prefill, fused decode windows with EOS
+at the window boundary, int8 KV, sampling, speculative serving — plus the
+allocator's own books: admission gated on block availability, pool
+exhaustion degrading reclaim-then-preempt, and the free-list/refcount
+invariants (``chaos.invariants.check_block_pool``) holding after every
+engine step."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from hivedscheduler_tpu.chaos.invariants import (  # noqa: E402
+    InvariantViolation,
+    check_block_pool,
+)
+from hivedscheduler_tpu.models import decode, serving, transformer as tm  # noqa: E402
+from hivedscheduler_tpu.models.speculative import (  # noqa: E402
+    SpecDecodeConfig,
+    derive_draft_config,
+)
+
+
+def cfg_of(**kw):
+    base = dict(vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2,
+                n_layers=2, d_ff=128, max_seq_len=128, dtype=jnp.float32)
+    base.update(kw)
+    return tm.TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = cfg_of()
+    params = tm.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def vanilla(params, cfg, prompt, n):
+    out = decode.generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg, n,
+        max_len=len(prompt) + n,
+    )
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+PROMPTS = [[5, 9, 2], [17, 3, 88, 41, 7], [1], [100, 22, 63, 4]]
+BUDGETS = [6, 4, 8, 5]
+
+# a 10-token shared "system prompt": with page_size=8 it spans one full
+# block + a partial block, so block-chunk matching AND mid-block COW both
+# exercise
+SYSTEM = [7, 11, 23, 42, 5, 9, 81, 2, 64, 33]
+
+
+def run_both(params, cfg, prompts=PROMPTS, budgets=BUDGETS, *, checked=True,
+             **kw):
+    """The differential harness: run the same load through the paged engine
+    and the dense reference engine, assert stream equality, return the
+    paged engine (for counter/invariant asserts). ``checked`` runs the
+    block-pool invariant after every paged step."""
+    outs = []
+    engines = []
+    for page_size in (8, 0):
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                    page_size=page_size, **kw)
+        reqs = [eng.submit(list(p), n) for p, n in zip(prompts, budgets)]
+        while eng.step():
+            if checked and page_size:
+                check_block_pool(eng, "differential churn")
+        outs.append([(r.tokens_out, r.finish_reason) for r in reqs])
+        engines.append(eng)
+    assert outs[0] == outs[1], "paged streams diverged from dense reference"
+    return engines[0], outs[0]
+
+
+class TestPagedDifferential:
+    def test_interleaved_matches_dense_and_vanilla(self, setup):
+        cfg, params = setup
+        _, out = run_both(params, cfg)
+        for (toks, _reason), p, n in zip(out, PROMPTS, BUDGETS):
+            assert toks == vanilla(params, cfg, p, n)
+
+    def test_prefix_sharing_and_cow_mid_block(self, setup):
+        """Three prompts sharing the 10-token system prefix: the second
+        matches the cached blocks (one full + one partial), COWs the
+        partial block mid-block at divergence, and every stream stays
+        exact. Blocks are shared by REFERENCE: the hit must not copy the
+        full block."""
+        cfg, params = setup
+        prompts = [SYSTEM + [100, 101], SYSTEM + [120, 90, 3],
+                   SYSTEM + [100, 101, 55]]
+        budgets = [5, 6, 4]
+        eng, out = run_both(params, cfg, prompts, budgets,
+                            prefix_cache_size=16)
+        for (toks, _), p, n in zip(out, prompts, budgets):
+            assert toks == vanilla(params, cfg, p, n)
+        assert eng.prefix_block_hits >= 1, "no block was shared by reference"
+        assert eng.blocks_cow >= 1, "mid-block divergence did not COW"
+        check_block_pool(eng, "after prefix/COW load")
+
+    def test_chunked_prefill_composition(self, setup):
+        cfg, params = setup
+        prompts = [SYSTEM + [100, 101], [17, 3, 88, 41, 7, 6, 2, 91, 55, 44],
+                   SYSTEM + [120, 90, 3, 4, 8, 15]]
+        budgets = [5, 4, 6]
+        eng, out = run_both(params, cfg, prompts, budgets,
+                            prefix_cache_size=16, prefill_chunk=3)
+        for (toks, _), p, n in zip(out, prompts, budgets):
+            assert toks == vanilla(params, cfg, p, n)
+        assert eng.prefill_chunks_done > 0
+
+    def test_fused_window_eos_at_boundary(self, setup):
+        """decode_steps=4 with the EOS probed inside the window, exactly AT
+        the window boundary, and on the first post-window step (the
+        test_serving_multistep pattern, on the paged engine)."""
+        cfg, params = setup
+        stream = vanilla(params, cfg, [5, 9, 2], 8)
+        tested = 0
+        for pos in (2, 3, 4):
+            eos = stream[pos]
+            if eos in stream[:pos]:
+                continue  # would retire earlier: not the position under test
+            eng, out = run_both(params, cfg, [[5, 9, 2]], [8],
+                                decode_steps=4, eos_id=eos)
+            assert out[0] == (stream[:pos + 1], "eos"), pos
+            tested += 1
+        assert tested, "every probe position degenerate — new model seed?"
+
+    def test_int8_kv_paged_matches_int8_dense(self, setup):
+        cfg, params = setup
+        run_both(params, cfg, kv_dtype="int8", prefix_cache_size=8)
+
+    def test_sampled_paged_matches_sampled_dense(self, setup):
+        """Counter-based keys make sampled streams a pure function of
+        (seed, rid, prompt) — the cache layout must not leak into them."""
+        cfg, params = setup
+        run_both(params, cfg, temperature=0.9, top_k=20, seed=7)
+
+
+class TestPagedAdmission:
+    def test_admission_gated_on_block_availability(self, setup):
+        """8 usable blocks, 17-token prompts (3 blocks each, growing to 4):
+        at most two streams fit at once even though 4 slots exist, nothing
+        is preempted, and every stream is exact — long-tail prompts no
+        longer reserve max-length HBM, short pools just queue."""
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=4, max_len=64,
+                                    page_size=8, num_blocks=9)
+        reqs = [eng.submit([40 + i] * 17, 15) for i in range(4)]
+        max_conc = 0
+        while eng.step():
+            check_block_pool(eng, "admission churn")
+            max_conc = max(max_conc, sum(s is not None for s in eng.slots))
+        assert max_conc <= 2, max_conc
+        assert eng.pool_preempted == 0
+        for i, r in enumerate(reqs):
+            assert r.finish_reason == "length"
+            assert r.tokens_out == vanilla(params, cfg, [40 + i] * 17, 15), i
+
+    def test_pool_exhaustion_preempts_and_survivor_exact(self, setup):
+        """Both streams admitted, then decode growth exhausts the pool:
+        exactly one stream is truncated (finish_reason="preempted",
+        counted), the survivor finishes token-exact, and every block
+        returns to the free list."""
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=32,
+                                    page_size=4, num_blocks=9)
+        r1 = eng.submit([3] * 10, 20)
+        r2 = eng.submit([9] * 10, 20)
+        while eng.step():
+            check_block_pool(eng, "exhaustion churn")
+        reasons = sorted((r1.finish_reason, r2.finish_reason))
+        assert reasons == ["length", "preempted"], reasons
+        assert eng.pool_preempted == 1
+        survivor, p = (r1, [3] * 10) if r1.finish_reason == "length" \
+            else (r2, [9] * 10)
+        assert survivor.tokens_out == vanilla(params, cfg, p, 20)
+        assert len(eng._free) == eng.num_blocks - 1  # all blocks returned
+        check_block_pool(eng, "after exhaustion drain")
+
+    def test_cache_blocks_reclaimed_before_preemption(self, setup):
+        """Pool pressure must evict LRU cached prefix blocks BEFORE
+        touching live streams: a full cache plus a block-hungry load
+        completes with zero preemptions."""
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=32,
+                                    page_size=4, num_blocks=13,
+                                    prefix_cache_size=16)
+        warm = [eng.submit([60 + i] * 9, 2) for i in range(2)]
+        eng.run_until_drained()
+        assert all(w.done for w in warm)
+        assert len(eng._prefix_cache) > 0  # cached blocks now pin the pool
+        # 10-token prompts + 13 new tokens = 6 blocks each: both streams
+        # fit the 12 usable blocks ONLY once the cached blocks are evicted
+        big = [eng.submit([80 + i] * 10, 13) for i in range(2)]
+        while eng.step():
+            check_block_pool(eng, "reclaim churn")
+        assert all(r.finish_reason == "length" for r in big)
+        assert eng.pool_preempted == 0
+        for i, r in enumerate(big):
+            assert r.tokens_out == vanilla(params, cfg, [80 + i] * 10, 13)
+
+    def test_env_kill_switch_forces_dense(self, setup, monkeypatch):
+        """HIVED_PAGED_KV=0 is the reference-path contract: paging knobs
+        are ignored and the dense engine serves (exactly)."""
+        cfg, params = setup
+        monkeypatch.setenv("HIVED_PAGED_KV", "0")
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                    page_size=8, num_blocks=17)
+        assert not eng.paged and eng.cache is not None
+        r = eng.submit([5, 9, 2], 6)
+        eng.run_until_drained()
+        assert r.tokens_out == vanilla(params, cfg, [5, 9, 2], 6)
+
+    def test_num_blocks_validation(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match="cannot back one max_len"):
+            serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                  page_size=8, num_blocks=8)
+
+    def test_drain_returns_blocks(self, setup):
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                    page_size=8)
+        eng.submit([5, 9, 2], 40)
+        eng.step()
+        assert eng.blocks_in_use > 0
+        assert eng.drain(deadline_s=0.0) is False  # truncates in-flight work
+        assert eng.blocks_in_use == 0
+        check_block_pool(eng, "after drain")
+
+    def test_invariant_checker_catches_seeded_leak(self, setup):
+        """The guard must actually guard: seed a leak / a double-alloc and
+        check_block_pool has to raise."""
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                    page_size=8)
+        r = eng.submit([5, 9, 2], 4)
+        eng.step()
+        check_block_pool(eng, "clean")
+        bid = eng._slot_bids[0][0]
+        eng._free.append(bid)  # referenced AND free
+        with pytest.raises(InvariantViolation, match="double-alloc"):
+            check_block_pool(eng, "seeded")
+        eng._free.remove(bid)
+        eng._ref[bid] += 1  # refcount drifts from recount
+        with pytest.raises(InvariantViolation, match="refcount"):
+            check_block_pool(eng, "seeded")
+        eng._ref[bid] -= 1
+        eng.run_until_drained()
+        assert r.done
+
+
+class TestSpecDecodeFirstClass:
+    @pytest.fixture(scope="class")
+    def draft(self, setup):
+        cfg, _params = setup
+        dcfg = derive_draft_config(cfg, 1, 32)
+        dparams = tm.init_params(dcfg, jax.random.PRNGKey(3))
+        return SpecDecodeConfig(draft_params=dparams, draft_cfg=dcfg,
+                                gamma=3)
+
+    def test_spec_decode_kwarg_routes(self, setup, draft):
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                    spec_decode=draft)
+        assert isinstance(eng, serving.SpeculativeServingEngine)
+        assert eng.gamma == draft.gamma
+
+    def test_spec_paged_greedy_exact_with_prefix(self, setup, draft):
+        """First-class speculative serving on the paged cache: greedy
+        streams bit-match vanilla, target prefix blocks are shared by
+        reference (draft KV rides the entry as a dense copy), and the
+        verify-round block rollback keeps the allocator's books clean."""
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                    page_size=8, prefix_cache_size=8,
+                                    spec_decode=draft)
+        reqs = [eng.submit(list(p), n) for p, n in zip(PROMPTS, BUDGETS)]
+        while eng.step():
+            check_block_pool(eng, "spec churn")
+        for r, p, n in zip(reqs, PROMPTS, BUDGETS):
+            assert r.tokens_out == vanilla(params, cfg, p, n), r.rid
+        hit = eng.submit(PROMPTS[0] + [77], 4)  # extends a cached prompt
+        eng.run_until_drained()
+        assert hit.tokens_out == vanilla(params, cfg, PROMPTS[0] + [77], 4)
+        assert eng.prefix_block_hits >= 1
+        check_block_pool(eng, "after spec prefix")
+
+    def test_spec_sampled_paged_matches_spec_dense(self, setup, draft):
+        cfg, params = setup
+        outs = []
+        for page_size in (8, 0):
+            eng = serving.ServingEngine(params, cfg, max_batch=2,
+                                        max_len=64, page_size=page_size,
+                                        temperature=0.8, top_k=30,
+                                        spec_decode=draft)
+            reqs = [eng.submit(list(p), n) for p, n in zip(PROMPTS, BUDGETS)]
+            eng.run_until_drained()
+            outs.append([r.tokens_out for r in reqs])
+        assert outs[0] == outs[1]
+
+
+class TestPagedUnits:
+    """Fast host-side units: no engine stepping, no jit dispatch."""
+
+    def test_block_coords_and_gather_mapping(self):
+        from hivedscheduler_tpu.ops.attention import (
+            block_coords,
+            gather_block_kv,
+        )
+        pool = jnp.arange(4 * 4 * 2).reshape(4, 4, 2)  # [blocks, block, tail]
+        table = jnp.asarray([[2, 0, 3]], jnp.int32)
+        view = gather_block_kv(pool, table)  # [1, 12, 2]
+        assert view.shape == (1, 12, 2)
+        # logical position 1 lives in block 2 offset 1; position 9 in
+        # block 3 offset 1 (entry 1 is trash)
+        assert np.array_equal(np.asarray(view[0, 1]), np.asarray(pool[2, 1]))
+        assert np.array_equal(np.asarray(view[0, 9]), np.asarray(pool[3, 1]))
+        blk, off = block_coords(jnp.asarray([[1, 9, 99]], jnp.int32), table, 4)
+        assert np.asarray(blk).tolist() == [[2, 3, 3]]  # 99 clamps to last
+        assert np.asarray(off).tolist() == [[1, 1, 3]]
+
+    def test_admission_math(self, setup):
+        """needed = cover - floor(plen/page) (+1 spare when the first
+        decode token opens a fresh block) — the documented admission
+        formula, probed through _blocks_admit with a pinched free list."""
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                    page_size=8)
+        req = serving.Request(0, list(range(17)), 4)  # 17 tokens -> 3 blocks
+        eng._free = [1, 2, 3]
+        assert eng._blocks_admit(req, None)
+        eng._free = [1, 2]
+        assert not eng._blocks_admit(req, None)
+        req16 = serving.Request(1, list(range(16)), 4)  # 16 -> 2 blocks + spare
+        eng._free = [1, 2, 3]
+        assert eng._blocks_admit(req16, None)
+        eng._free = [1, 2]
+        assert not eng._blocks_admit(req16, None)
+
+    def test_store_prefix_registers_block_boundaries(self, setup):
+        """Paged entries sit at every full-block boundary + the full
+        prompt (the block-chunk rekey the dense pow2 scheme approximated)."""
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                    page_size=8, prefix_cache_size=16)
+        prompt = list(range(20))
+        eng.slots[0] = serving.Request(0, prompt, 4)  # occupy the slot
+        eng._slot_bids[0] = [eng._alloc_block() for _ in range(3)]
+        for j, bid in enumerate(eng._slot_bids[0]):
+            eng._table[0, j] = bid
+        eng._store_prefix(0, prompt)
+        lens = sorted(plen for _, plen in eng._prefix_cache.values())
+        assert lens == [8, 16, 20]
+        for key, (payload, plen) in eng._prefix_cache.items():
+            assert len(payload) == -(-plen // 8)
+        check_block_pool(eng, "after boundary store")
+
+    def test_spec_decode_conflicting_args_raise(self, setup):
+        cfg, params = setup
+        dcfg = derive_draft_config(cfg, 1, 32)
+        dparams = tm.init_params(dcfg, jax.random.PRNGKey(3))
+        sd = SpecDecodeConfig(draft_params=dparams, draft_cfg=dcfg)
+        with pytest.raises(ValueError, match="not both"):
+            serving.SpeculativeServingEngine(
+                params, cfg, dparams, dcfg, spec_decode=sd,
+                max_batch=2, max_len=64)
+        with pytest.raises(ValueError, match="needs a draft model"):
+            serving.SpeculativeServingEngine(params, cfg, max_batch=2,
+                                             max_len=64)
+
+    def test_paged_dp_mesh_rejected(self, setup):
+        """Blocks are fungible across slots — a dp-sharded pool cannot
+        exist; the constructor must say so instead of mis-sharding."""
+        cfg, params = setup
+        from hivedscheduler_tpu.parallel import topology
+
+        axes = topology.MeshAxes(dp=2)
+        mesh = topology.make_mesh(axes, jax.devices("cpu")[:2])
+        with pytest.raises(ValueError, match="dp"):
+            serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                  page_size=8, mesh=mesh)
+
+
+class TestAllocatorUnits:
+    """Host-only allocator behaviors: no decode stepping, minimal jit."""
+
+    def make_engine(self, setup, **kw):
+        cfg, params = setup
+        base = dict(max_batch=2, max_len=64, page_size=8)
+        base.update(kw)
+        return serving.ServingEngine(params, cfg, **base)
+
+    def test_trim_blocks_returns_rejected_tail(self, setup):
+        eng = self.make_engine(setup)
+        eng.slots[0] = serving.Request(0, [1, 2, 3], 4)
+        eng._ensure_writable(0, 0, 30)  # 4 blocks
+        assert len(eng._slot_bids[0]) == 4
+        free_before = len(eng._free)
+        eng._trim_blocks(0, 17)  # keep ceil(17/8) = 3
+        assert len(eng._slot_bids[0]) == 3
+        assert len(eng._free) == free_before + 1
+        assert eng._table[0, 3] == 0
+        check_block_pool(eng, "after trim")
+
+    def test_retire_frees_and_parks(self, setup):
+        eng = self.make_engine(setup)
+        eng.slots[0] = serving.Request(0, [1, 2, 3], 4)
+        eng._ensure_writable(0, 0, 10)
+        eng._retire(0)
+        assert eng.slots[0] is None and not eng._slot_bids[0]
+        assert all(b == 0 for b in eng._table[0])
+        assert eng._host_len[0] == eng._park_pos
+        assert len(eng._free) == eng.num_blocks - 1
+        check_block_pool(eng, "after retire")
+
+    def test_blocks_in_use_tracks_alloc_free(self, setup):
+        eng = self.make_engine(setup)
+        assert eng.blocks_in_use == 0
+        a, b = eng._alloc_block(), eng._alloc_block()
+        assert eng.blocks_in_use == 2
+        eng._decref(a)
+        assert eng.blocks_in_use == 1
+        eng._decref(b)
+        assert eng.blocks_in_use == 0
+
+    def test_checker_catches_seeded_leak(self, setup):
+        eng = self.make_engine(setup)
+        bid = eng._alloc_block()
+        eng._ref[bid] = 0  # unreferenced but not returned to the free list
+        with pytest.raises(InvariantViolation, match="leaked"):
+            check_block_pool(eng, "seeded leak")
+
+    def test_checker_catches_table_drift(self, setup):
+        eng = self.make_engine(setup)
+        eng.slots[0] = serving.Request(0, [1, 2, 3], 4)
+        eng._ensure_writable(0, 0, 10)
+        eng._table[0, 0] = eng._table[0, 1]  # device view != owned bids
+        with pytest.raises(InvariantViolation, match="table row"):
+            check_block_pool(eng, "seeded drift")
+
+    def test_block_gate_keeps_waiter_queued(self, setup):
+        """A gated admission must NOT pop the waiter (head-of-line): the
+        queue is intact and the request admits later when blocks free."""
+        eng = self.make_engine(setup, max_len=24, num_blocks=4)  # 3 usable
+        holder = serving.Request(9, [1] * 17, 4)  # 3 blocks
+        eng.slots[0] = holder
+        eng._slot_bids[0] = [eng._alloc_block() for _ in range(3)]
+        for j, bid in enumerate(eng._slot_bids[0]):
+            eng._table[0, j] = bid
+        req = eng.submit([2] * 17, 4)
+        eng._admit()
+        assert eng.queue and eng.queue[0] is req  # still queued, still first
+        eng._retire(0)
+        eng._admit()
+        assert req not in eng.queue and eng.slots[1] is req or eng.slots[0] is req
+
+    def test_env_value_one_keeps_paging(self, setup, monkeypatch):
+        monkeypatch.setenv("HIVED_PAGED_KV", "1")
+        eng = self.make_engine(setup)
+        assert eng.paged and eng.pool is not None
+
+    def test_gather_scales_tail_shape(self):
+        from hivedscheduler_tpu.ops.attention import gather_block_kv
+        pool = jnp.arange(3 * 4 * 2, dtype=jnp.float32).reshape(3, 4, 2)
+        scales = pool[..., 0]  # [blocks, block] — int8 scale layout minus H
+        table = jnp.asarray([[1, 2]], jnp.int32)
+        assert gather_block_kv(scales, table).shape == (1, 8)
+        assert gather_block_kv(pool, table).shape == (1, 8, 2)
+
+    def test_occupancy_gauge_exported(self, setup):
+        from hivedscheduler_tpu.runtime.metrics import REGISTRY
+        eng = self.make_engine(setup)
+        eng.submit([5, 9, 2], 3)
+        eng.step()
+        assert "tpu_hive_serve_block_pool_occupancy" in REGISTRY.render()
+
+
+class TestQueueAndWindowUnits:
+    """Host-side queue/window behaviors under paging: no decode dispatch."""
+
+    def test_shed_fires_while_block_gated(self, setup):
+        """A waiter stuck behind the block gate still sheds on its
+        queue-wait deadline — exhaustion must not turn the deadline off."""
+        cfg, params = setup
+        t = [0.0]
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=24,
+                                    page_size=8, num_blocks=4,
+                                    queue_timeout_s=5.0,
+                                    clock=lambda: t[0])
+        eng.slots[0] = serving.Request(9, [1] * 17, 4)  # holds all 3 blocks
+        eng._slot_bids[0] = [eng._alloc_block() for _ in range(3)]
+        for j, bid in enumerate(eng._slot_bids[0]):
+            eng._table[0, j] = bid
+        req = eng.submit([2] * 17, 4)
+        eng._admit()
+        assert eng.queue, "should be gated, not admitted"
+        t[0] = 6.0
+        eng._admit()
+        assert req.finish_reason == "shed" and not eng.queue
+
+    def test_match_prefix_clamp_guard_applies_paged(self, setup):
+        """A cached prefix whose bucketed tail write would clamp against
+        the arena is skipped (the same guard as dense — an offset bucket
+        past max_len would silently mis-place the chunk)."""
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=24,
+                                    page_size=8, prefix_cache_size=8)
+        eng._prefix_cache[tuple(range(20))] = ((1, 2, 3), 20)
+        # tail of 3 tokens buckets to 4: 20 + 4 = 24 <= max_len — OK
+        assert eng._match_prefix(list(range(20)) + [9, 9, 9]) is not None
+        # tail of 17 buckets to 24 (clamped): 20 + 24 > 24 — skipped
+        assert eng._match_prefix(list(range(20)) + [9] * 17) is None
+
+    def test_spec_decode_gamma_validation(self, setup):
+        cfg, params = setup
+        dcfg = derive_draft_config(cfg, 1, 32)
+        dparams = tm.init_params(dcfg, jax.random.PRNGKey(3))
+        sd = SpecDecodeConfig(draft_params=dparams, draft_cfg=dcfg, gamma=0)
+        with pytest.raises(ValueError, match="gamma"):
+            serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                  spec_decode=sd)
+
+    def test_fused_window_collapses_during_chunked_prefill(self, setup):
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                    page_size=8, decode_steps=8,
+                                    prefill_chunk=2)
+        eng.slots[0] = serving.Request(0, [1, 2, 3], 16)
+        assert eng._fused_window([0]) == 8
+        eng._prefilling[1] = ([4] * 6, 0, 0)  # chunk in flight elsewhere
+        assert eng._fused_window([0]) == 1
+
+    def test_request_latency_properties(self, setup):
+        r = serving.Request(0, [1], 4)
+        assert r.ttft_s is None and r.tpot_s is None and r.queue_wait_s is None
+        r.submitted_at, r.admitted_at = 1.0, 2.0
+        r.first_token_at, r.done_at = 3.0, 7.0
+        r.tokens_out = [5, 6, 7]
+        assert r.queue_wait_s == 1.0 and r.ttft_s == 2.0
+        assert r.tpot_s == 2.0  # (7-3) / (3-1)
+
+    def test_priority_insert_keeps_fifo_within_level(self, setup):
+        cfg, params = setup
+        eng = serving.ServingEngine(params, cfg, max_batch=1, max_len=64,
+                                    page_size=8)
+        a = eng.submit([1], 2)
+        b = eng.submit([2], 2, priority=5)
+        c = eng.submit([3], 2, priority=5)
+        d = eng.submit([4], 2)
+        assert eng.queue == [b, c, a, d]

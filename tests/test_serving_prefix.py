@@ -145,6 +145,7 @@ def test_staggered_mixed_traffic_exact(setup):
             assert eng.prefix_hits >= 4
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
 def test_int8_engine_prefix_exact(setup):
     """Prefix caching composes with weight-only int8 serving: the cache
     stores KV (activations), not weights, so quantization is orthogonal —
@@ -161,6 +162,7 @@ def test_int8_engine_prefix_exact(setup):
     assert eng.prefix_hits >= 2
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
 def test_speculative_engine_prefix_exact(setup):
     """Prefix caching composes with speculative serving: the payload carries
     target AND draft KV, so restored rows verify identically — the greedy

@@ -43,6 +43,7 @@ def vanilla(params, cfg, prompt, n):
 
 
 class TestSpeculativeServing:
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_interleaved_exact_vs_vanilla_with_weak_draft(self, setup):
         cfg, params, dft_cfg, dft_params = setup
         eng = serving.SpeculativeServingEngine(
@@ -98,6 +99,7 @@ class TestSpeculativeServing:
         assert b.tokens_out == vanilla(params, cfg, [100, 22, 63, 4], 6)
 
     @pytest.mark.parametrize("prefill_chunk", [0, 3])
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_fuzz_random_interleavings(self, setup, prefill_chunk):
         """Random prompts/budgets at random arrival offsets through the
         speculative engine (weak draft): every request still equals its solo
@@ -132,6 +134,7 @@ class TestSpeculativeServing:
             assert req.done
             assert req.tokens_out == vanilla(params, cfg, p, n), req.rid
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_mesh_sharded_engine_exact(self, setup):
         """Speculative serving over a dp x tp mesh: the target shards
         tensor-parallel, the draft shards when its kv heads divide tp

@@ -47,6 +47,7 @@ SAMPLING = dict(temperature=0.8, top_k=20, top_p=0.9, seed=5)
 
 
 class TestSampledSpeculativeServing:
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_perfect_draft_matches_plain_sampled_engine(self, setup):
         cfg, params, _, _ = setup
         prompts = [[5, 9, 2], [17, 3, 88], [1, 4]]
@@ -78,6 +79,7 @@ class TestSampledSpeculativeServing:
             assert all(0 <= t < cfg.vocab_size for t in req.tokens_out)
         assert 0.0 <= eng.acceptance <= 1.0
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_sampled_streams_reproducible_under_interleaving(self, setup):
         cfg, params, dft_cfg, dft_params = setup
 
@@ -101,6 +103,7 @@ class TestSampledSpeculativeServing:
         assert a0.tokens_out == b0.tokens_out
         assert a1.tokens_out == b1.tokens_out
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_sampled_composes_with_chunked_prefill(self, setup):
         """Chunking stays a pure scheduling change for the SAMPLED
         speculative engine too: same streams with and without it."""

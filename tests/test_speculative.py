@@ -64,6 +64,7 @@ class TestSpeculative:
         assert got.shape == (2, 12)
         assert int(stats.accepted) == int(stats.drafted)
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_sampled_output_is_valid_and_deterministic(self):
         tgt_cfg = cfg_of()
         dft_cfg = cfg_of(d_model=16, n_layers=1, n_heads=2, d_ff=32)
@@ -79,6 +80,7 @@ class TestSpeculative:
         assert np.asarray(a).min() >= 0 and np.asarray(a).max() < tgt_cfg.vocab_size
         assert int(stats.drafted) == 3 * int(stats.rounds)
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_greedy_exact_with_gqa_target(self):
         """Compact-GQA target + dense draft still greedy-exact."""
         tgt_cfg = cfg_of(n_heads=4, n_kv_heads=2)
@@ -107,6 +109,7 @@ class TestSpeculative:
 
 
 class TestShardedSpeculative:
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_tp_sharded_speculation_matches_single_device(self):
         """dp=2 x tp=2 speculative greedy == single-device speculative ==
         vanilla greedy (the draft here shards over tp too)."""
@@ -133,6 +136,7 @@ class TestShardedSpeculative:
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
         assert int(stats.rounds) >= 1
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_indivisible_draft_heads_replicate(self):
         """A draft whose heads don't divide tp is replicated, not rejected."""
         from hivedscheduler_tpu.models.speculative import make_sharded_speculative

@@ -94,6 +94,7 @@ class TestDivergenceLadder:
                         "--max-rollbacks", "0"])
         assert rc == sup_lib.EXIT_DIVERGED
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_skip_nonfinite_gate_passes_state_through(self):
         """--on-nan skip compiles the update gate into the jitted step: a
         non-finite loss must leave params AND opt_state (including the
@@ -168,6 +169,7 @@ class TestSupervisorCliReachability:
             run_train(["--steps", "1", "--lora-rank", "2",
                        "--on-nan", "skip"])
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; fast cousins stay tier-1
     def test_resume_records_loader_state_and_counts(self, tmp_path):
         """A resumed incarnation bumps tpu_hive_train_resumes_total and the
         commit marker carries the canonical loader state."""
