@@ -15,12 +15,12 @@ additionally serializes scheduling via its own lock (reference contract:
 from __future__ import annotations
 
 import logging
-import threading
 from datetime import datetime, timezone
 from typing import Dict, List, Optional, Set, Tuple
 
 from hivedscheduler_tpu.api import types as api
 from hivedscheduler_tpu.api.config import Config
+from hivedscheduler_tpu.common import lockcheck
 from hivedscheduler_tpu.algorithm.cell import (
     CellChain,
     CellLevel,
@@ -109,7 +109,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
         self.leaf_cell_nums = parsed.cell_level_to_leaf_cell_num
         self.mesh_chains = parsed.mesh_chains
         self.api_cluster_status = api.ClusterStatus()
-        self.algorithm_lock = threading.RLock()
+        self.algorithm_lock = lockcheck.make_rlock("algorithm_lock")
         # Live-placement handoff: the optimistic AddAllocatedPod that follows
         # a Schedule under the same scheduler lock re-derives the placement
         # from the annotation (reference behavior). When NOTHING has happened
@@ -256,6 +256,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
     # ------------------------------------------------------------------
 
     def add_node(self, node: Node) -> None:
+        lockcheck.assert_serialized(self)
         with self.algorithm_lock:
             self._op_seq += 1
             if not internal_utils.is_node_healthy(node):
@@ -264,6 +265,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
                 self._set_healthy_node(node.name)
 
     def update_node(self, old_node: Node, new_node: Node) -> None:
+        lockcheck.assert_serialized(self)
         with self.algorithm_lock:
             self._op_seq += 1
             old_healthy = internal_utils.is_node_healthy(old_node)
@@ -274,6 +276,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
                     self._set_healthy_node(new_node.name)
 
     def delete_node(self, node: Node) -> None:
+        lockcheck.assert_serialized(self)
         with self.algorithm_lock:
             self._op_seq += 1
             self._set_bad_node(node.name)
@@ -497,6 +500,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
         When decision recording is enabled (``obs.decisions``), every call
         additionally produces a structured explanation of the placement
         attempts made — the disabled path pays one bool check."""
+        lockcheck.assert_serialized(self)
         with self.algorithm_lock:
             rec = obs_decisions.RECORDER
             if not rec.enabled:
@@ -595,11 +599,12 @@ class HivedAlgorithm(SchedulerAlgorithm):
             return result
 
     def add_unallocated_pod(self, pod: Pod) -> None:
-        pass
+        lockcheck.assert_serialized(self)
 
     def delete_unallocated_pod(self, pod: Pod) -> None:
         """Cancels a preemption when its last preempting pod dies (reference:
         DeleteUnallocatedPod, hived_algorithm.go:229-245)."""
+        lockcheck.assert_serialized(self)
         with self.algorithm_lock:
             self._op_seq += 1
             s = internal_utils.extract_pod_scheduling_spec(pod)
@@ -618,6 +623,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
 
     def add_allocated_pod(self, pod: Pod) -> None:
         """Reference: AddAllocatedPod, hived_algorithm.go:247-269."""
+        lockcheck.assert_serialized(self)
         with self.algorithm_lock:
             stash, self._live_stash = self._live_stash, None
             self._op_seq += 1
@@ -672,6 +678,7 @@ class HivedAlgorithm(SchedulerAlgorithm):
 
     def delete_allocated_pod(self, pod: Pod) -> None:
         """Reference: DeleteAllocatedPod, hived_algorithm.go:272-296."""
+        lockcheck.assert_serialized(self)
         with self.algorithm_lock:
             self._op_seq += 1
             s = internal_utils.extract_pod_scheduling_spec(pod)

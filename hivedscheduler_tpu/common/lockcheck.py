@@ -1,0 +1,241 @@
+"""Lock registry + opt-in runtime lock-discipline sanitizer.
+
+This module is the single source of truth for the package's lock hierarchy
+(the CLAUDE.md concurrency contract, machine-checked by ``tools/hivedlint``
+and documented in ``doc/design/concurrency.md``):
+
+- every ``threading.Lock``/``RLock`` in the package is created through
+  :func:`make_lock` / :func:`make_rlock` with a name registered in
+  :data:`LOCK_HIERARCHY` (hivedlint flags direct ``threading.Lock()`` calls
+  and unregistered names — adding a lock means adding a registry row, which
+  IS the documented hierarchy);
+- with ``HIVED_LOCKCHECK=1`` the factories return :class:`CheckedLock`
+  wrappers that track per-thread held-lock sets and assert lock-order
+  consistency: a thread may only acquire a lock whose level is strictly
+  greater than every *other* lock it already holds (re-acquiring a held
+  RLock is always fine). Firing a fake-ApiServer handler while holding the
+  store leaf lock, or any other inversion, raises :class:`LockOrderError`
+  instead of deadlocking some soak 20 minutes later;
+- :func:`assert_serialized` enforces the algorithm layer's single-threaded
+  contract at runtime: the runtime registers its scheduler lock on the
+  algorithm instance (:func:`serialize_under`) and every algorithm mutating
+  entry point asserts that lock is held by the calling thread. Standalone
+  algorithm tests (no runtime attached) are unaffected.
+
+The sanitizer is wired into the chaos soaks (tests/test_hivedlint.py), so
+every soak doubles as a race/deadlock detector. Overhead when disabled is
+one env read per lock *creation* — acquire/release stay native. Module-level
+singletons (metrics REGISTRY, obs TRACER/RECORDER) only get checked locks
+when ``HIVED_LOCKCHECK=1`` is set before first import; the per-instance
+locks (scheduler/algorithm/store/watchdog) honor the env var at
+construction time, which is what the soaks exercise.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# The declared lock hierarchy. Level = acquisition order: a thread holding a
+# lock at level L may only acquire locks at levels > L. Low levels are the
+# outermost (coarsest) locks; the highest levels are leaves — nothing may be
+# acquired while holding them. Gaps are deliberate (room for new locks).
+# ---------------------------------------------------------------------------
+LOCK_HIERARCHY: Dict[str, int] = {
+    # runtime/scheduler.py — ONE coarse lock serializes scheduling; every
+    # mutating call into the algorithm layer happens under it.
+    "scheduler_lock": 10,
+    # algorithm/hived.py — the algorithm's own serialization (defense in
+    # depth below the scheduler lock; also covers embedders that drive the
+    # algorithm directly).
+    "algorithm_lock": 20,
+    # parallel/supervisor.py — watchdog beat state.
+    "watchdog_lock": 40,
+    # k8s/fake.py — the fake-ApiServer object store. LEAF towards handlers:
+    # informer handlers (which take the scheduler lock) must never run under
+    # it; the only things legal under it are pure store mutations.
+    "store_lock": 50,
+    # observability leaves: nothing is ever acquired under these.
+    "metrics_lock": 80,
+    "trace_lock": 82,
+    "decisions_lock": 84,
+}
+
+# Which file may create each lock (repo-relative); consumed by hivedlint's
+# lock-registry rule. Creating a registered lock elsewhere — or any lock
+# outside this table — is a lint violation.
+LOCK_SITES: Dict[str, str] = {
+    "scheduler_lock": "hivedscheduler_tpu/runtime/scheduler.py",
+    "algorithm_lock": "hivedscheduler_tpu/algorithm/hived.py",
+    "watchdog_lock": "hivedscheduler_tpu/parallel/supervisor.py",
+    "store_lock": "hivedscheduler_tpu/k8s/fake.py",
+    "metrics_lock": "hivedscheduler_tpu/runtime/metrics.py",
+    "trace_lock": "hivedscheduler_tpu/obs/trace.py",
+    "decisions_lock": "hivedscheduler_tpu/obs/decisions.py",
+}
+
+# Files allowed to spawn threads (hivedlint's thread-spawn rule). Every
+# thread here either only touches leaf state or enters the runtime through
+# the scheduler lock.
+THREAD_SITES = frozenset({
+    "hivedscheduler_tpu/runtime/scheduler.py",   # force-bind executor
+    "hivedscheduler_tpu/k8s/rest.py",            # watch threads
+    "hivedscheduler_tpu/api/config.py",          # config-watch poller
+    "hivedscheduler_tpu/parallel/supervisor.py", # watchdog heartbeat
+    "hivedscheduler_tpu/parallel/data.py",       # prefetch worker
+    "hivedscheduler_tpu/webserver/server.py",    # HTTP serve thread
+})
+
+
+class LockOrderError(RuntimeError):
+    """A lock-discipline violation: out-of-hierarchy acquisition, release of
+    an unheld checked lock, or an algorithm mutator entered without the
+    serializing lock."""
+
+
+def enabled() -> bool:
+    return os.environ.get("HIVED_LOCKCHECK", "") == "1"
+
+
+_tls = threading.local()
+
+
+def _stack() -> List["_Held"]:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+class _Held:
+    __slots__ = ("lock", "count")
+
+    def __init__(self, lock: "CheckedLock"):
+        self.lock = lock
+        self.count = 1
+
+
+class CheckedLock:
+    """Order-asserting wrapper around a ``threading.Lock``/``RLock``.
+
+    Exposes the subset of the lock API the package uses (``acquire`` with
+    ``blocking``/``timeout``, ``release``, context manager, ``locked``,
+    ``_is_owned``) and keeps a per-thread stack of held checked locks to
+    assert the :data:`LOCK_HIERARCHY` order on every acquisition."""
+
+    def __init__(self, name: str, level: int, inner):
+        self.name = name
+        self.level = level
+        self._inner = inner
+
+    # -- core ------------------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        st = _stack()
+        held = next((h for h in st if h.lock is self), None)
+        if held is None:
+            worst = max((h for h in st if h.lock.level >= self.level),
+                        key=lambda h: h.lock.level, default=None)
+            if worst is not None:
+                raise LockOrderError(
+                    f"lock-order violation: acquiring {self.name!r} (level "
+                    f"{self.level}) while holding {worst.lock.name!r} (level "
+                    f"{worst.lock.level}); held: "
+                    f"{[h.lock.name for h in st]} — see LOCK_HIERARCHY in "
+                    f"common/lockcheck.py and doc/design/concurrency.md"
+                )
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            if held is not None:
+                held.count += 1
+            else:
+                st.append(_Held(self))
+        return ok
+
+    def release(self) -> None:
+        st = _stack()
+        held = next((h for h in st if h.lock is self), None)
+        if held is None:
+            raise LockOrderError(
+                f"release of {self.name!r} which this thread does not hold"
+            )
+        self._inner.release()
+        held.count -= 1
+        if held.count == 0:
+            st.remove(held)
+
+    # -- sugar the package relies on -------------------------------------
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def _is_owned(self) -> bool:
+        """RLock ownership probe (the fake ApiServer's leaf-lock assertion
+        chokepoint uses it); falls back to the held stack for plain locks."""
+        inner_probe = getattr(self._inner, "_is_owned", None)
+        if inner_probe is not None:
+            return inner_probe()
+        return any(h.lock is self for h in _stack())
+
+    def __repr__(self) -> str:
+        return f"<CheckedLock {self.name!r} level={self.level} {self._inner!r}>"
+
+
+def _make(name: str, factory):
+    if not enabled():
+        return factory()
+    if name not in LOCK_HIERARCHY:
+        raise LockOrderError(
+            f"lock name {name!r} is not in LOCK_HIERARCHY — register it (and "
+            f"its creating file in LOCK_SITES) before use"
+        )
+    return CheckedLock(name, LOCK_HIERARCHY[name], factory())
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` registered as ``name`` (checked under
+    ``HIVED_LOCKCHECK=1``, plain otherwise)."""
+    return _make(name, threading.Lock)
+
+
+def make_rlock(name: str):
+    """A ``threading.RLock`` registered as ``name`` (checked under
+    ``HIVED_LOCKCHECK=1``, plain otherwise)."""
+    return _make(name, threading.RLock)
+
+
+def held(name: str) -> bool:
+    """True when the calling thread holds a checked lock named ``name``."""
+    st = getattr(_tls, "stack", None)
+    return bool(st) and any(h.lock.name == name for h in st)
+
+
+def serialize_under(obj, name: str) -> None:
+    """Declare that ``obj``'s mutating entry points are serialized by the
+    checked lock ``name`` (the runtime calls this on its algorithm)."""
+    try:
+        obj._lockcheck_serialized_by = name
+    except AttributeError:  # slots/frozen implementations: contract unchecked
+        pass
+
+
+def assert_serialized(obj) -> None:
+    """Assert the serializing lock declared on ``obj`` is held. No-op unless
+    ``HIVED_LOCKCHECK=1`` AND a runtime registered one via
+    :func:`serialize_under` (standalone algorithm tests pass through)."""
+    if not enabled():
+        return
+    name: Optional[str] = getattr(obj, "_lockcheck_serialized_by", None)
+    if name is None or held(name):
+        return
+    raise LockOrderError(
+        f"{type(obj).__name__} mutating entry point called without the "
+        f"serializing lock {name!r} — the algorithm layer is single-threaded "
+        f"by contract (doc/design/concurrency.md)"
+    )

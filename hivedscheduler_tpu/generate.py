@@ -53,6 +53,12 @@ def main(argv=None) -> int:
                         "output identical for any K). Ignored by the "
                         "speculative path (--draft-layers)")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--expert-capacity-factor", type=float, default=1.25,
+                        help="MoE expert capacity factor (must match the "
+                        "checkpoint's training value)")
+    parser.add_argument("--rope-theta", type=float, default=10000.0,
+                        help="RoPE base frequency (must match the "
+                        "checkpoint's training value)")
     parser.add_argument("--vocab-size", type=int, default=32000)
     parser.add_argument("--d-model", type=int, default=512)
     parser.add_argument("--n-layers", type=int, default=8)
@@ -106,6 +112,8 @@ def main(argv=None) -> int:
         max_seq_len=args.prompt_len + args.new_tokens,
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,
+        expert_capacity_factor=args.expert_capacity_factor,
+        rope_theta=args.rope_theta,
     )
     from hivedscheduler_tpu.parallel import checkpoint as ckpt
 

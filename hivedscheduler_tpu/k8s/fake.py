@@ -15,17 +15,17 @@ semantics:
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 from typing import Dict, List, Optional
 
+from hivedscheduler_tpu.common import lockcheck
 from hivedscheduler_tpu.k8s.client import KubeClient
 from hivedscheduler_tpu.k8s.types import Binding, Node, Pod
 
 
 class FakeKubeClient(KubeClient):
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = lockcheck.make_rlock("store_lock")
         self._nodes: Dict[str, Node] = {}
         self._pods: Dict[str, Pod] = {}  # key: namespace/name
         self._node_handlers = []

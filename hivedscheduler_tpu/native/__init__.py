@@ -25,11 +25,52 @@ _SO = os.path.join(_HERE, "_placement.so")
 _lib = None
 _tried = False
 
+# Strict warnings are part of the sanitize build contract: the sources are
+# kept -Wall -Wextra -Werror clean (guarded by tests/test_native_asan.py).
+_STRICT_FLAGS = ["-Wall", "-Wextra", "-Werror"]
+_SANITIZE_FLAGS = ["-O1", "-g", "-fno-omit-frame-pointer",
+                   "-fsanitize=address,undefined"]
+
+
+def sanitize_mode() -> bool:
+    """Opt-in ASan/UBSan build mode (``HIVED_NATIVE_SANITIZE=1``): the .so
+    compiles with ``-fsanitize=address,undefined`` plus strict warnings and
+    loads from a separate ``*.asan.so`` cache. The loading process must
+    preload the sanitizer runtimes (see :func:`sanitizer_preload`) — ctypes
+    dlopens the library into an uninstrumented CPython, so ASan's runtime
+    has to come first via LD_PRELOAD in a fresh process."""
+    return os.environ.get("HIVED_NATIVE_SANITIZE", "") == "1"
+
+
+def sanitizer_preload():
+    """LD_PRELOAD value (space-separated libasan/libubsan paths) for a
+    process that loads the sanitized .so, or None when the toolchain lacks
+    the shared sanitizer runtimes (callers skip cleanly)."""
+    paths = []
+    for lib in ("libasan.so", "libubsan.so"):
+        try:
+            out = subprocess.run(
+                ["g++", f"-print-file-name={lib}"],
+                capture_output=True, text=True, check=True,
+            )
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        p = out.stdout.strip()
+        if not p or p == lib or not os.path.exists(p):
+            return None
+        paths.append(p)
+    return " ".join(paths)
+
 
 def _build_and_load(src: str, so: str) -> ctypes.CDLL:
+    if sanitize_mode():
+        so = so[: -len(".so")] + ".asan.so"
+        flags = _SANITIZE_FLAGS + _STRICT_FLAGS
+    else:
+        flags = ["-O2"]
     if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", so, src],
+            ["g++", *flags, "-shared", "-fPIC", "-o", so, src],
             check=True,
             capture_output=True,
         )

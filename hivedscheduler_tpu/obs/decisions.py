@@ -22,12 +22,12 @@ object being non-None), so ``bench.py``'s schedule hot path is unchanged.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from hivedscheduler_tpu.common import lockcheck
 from hivedscheduler_tpu.obs import trace
 
 _DEFAULT_CAPACITY = 256
@@ -117,7 +117,7 @@ class DecisionRecorder:
     """Bounded ring of the last N decisions + optional commit callback."""
 
     def __init__(self, capacity: int = _DEFAULT_CAPACITY):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("decisions_lock")
         self._ring: deque = deque(maxlen=capacity)
         self.enabled = False
         self.on_commit: Optional[Callable[[Decision], None]] = None

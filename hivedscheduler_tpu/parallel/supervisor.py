@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from hivedscheduler_tpu.common import lockcheck
 from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
 
 log = logging.getLogger(__name__)
@@ -185,7 +186,7 @@ class Watchdog:
         self._clock = clock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("watchdog_lock")
         self._last_beat: Optional[float] = None
         self._last_step: Optional[int] = None
         self._beats = 0

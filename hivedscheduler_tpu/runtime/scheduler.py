@@ -21,6 +21,7 @@ from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
 from hivedscheduler_tpu.api import config as api_config
 from hivedscheduler_tpu.api import types as api
 from hivedscheduler_tpu.algorithm.hived import HivedAlgorithm
+from hivedscheduler_tpu.common import lockcheck
 from hivedscheduler_tpu.k8s.client import KubeClient
 from hivedscheduler_tpu.k8s.types import Binding, Node, Pod
 from hivedscheduler_tpu.runtime import extender as ei
@@ -56,10 +57,13 @@ class HivedScheduler:
         self.kube_client = kube_client
         # One coarse lock serializes scheduling (reference: schedulerLock,
         # scheduler.go:104-108); bind reads take it shared.
-        self.scheduler_lock = threading.RLock()
+        self.scheduler_lock = lockcheck.make_rlock("scheduler_lock")
         # uid -> PodScheduleStatus: ground truth of in-flight pods
         self.pod_schedule_statuses: Dict[str, PodScheduleStatus] = {}
         self.scheduler_algorithm: SchedulerAlgorithm = algorithm or HivedAlgorithm(config)
+        # single-threaded contract: every mutating call into the algorithm
+        # happens under the scheduler lock (asserted when HIVED_LOCKCHECK=1)
+        lockcheck.serialize_under(self.scheduler_algorithm, "scheduler_lock")
         self._started = False
 
         kube_client.on_node_event(self._add_node, self._update_node, self._delete_node)
@@ -96,17 +100,26 @@ class HivedScheduler:
     # informer callbacks
     # ------------------------------------------------------------------
 
+    # Node events mutate the algorithm too, so they hold the scheduler lock
+    # like the pod handlers do: the contract is that ONE lock serializes all
+    # mutating calls (found by hivedlint's scheduler-lock path rule — the
+    # algorithm lock alone covered these, but the stated contract is the
+    # scheduler lock, and ROADMAP item 3 refactors against that contract).
+
     def _add_node(self, node: Node) -> None:
-        self.scheduler_algorithm.add_node(node)
-        self._update_bad_node_gauge()
+        with self.scheduler_lock:
+            self.scheduler_algorithm.add_node(node)
+            self._update_bad_node_gauge()
 
     def _update_node(self, old_node: Node, new_node: Node) -> None:
-        self.scheduler_algorithm.update_node(old_node, new_node)
-        self._update_bad_node_gauge()
+        with self.scheduler_lock:
+            self.scheduler_algorithm.update_node(old_node, new_node)
+            self._update_bad_node_gauge()
 
     def _delete_node(self, node: Node) -> None:
-        self.scheduler_algorithm.delete_node(node)
-        self._update_bad_node_gauge()
+        with self.scheduler_lock:
+            self.scheduler_algorithm.delete_node(node)
+            self._update_bad_node_gauge()
 
     def _update_bad_node_gauge(self) -> None:
         bad = getattr(self.scheduler_algorithm, "bad_nodes", None)

@@ -62,6 +62,12 @@ def main(argv=None) -> int:
                         help="serve a MoE model (routing-exact: no-drop "
                         "inference capacity)")
     parser.add_argument("--moe-top-k", type=int, default=1)
+    parser.add_argument("--expert-capacity-factor", type=float, default=1.25,
+                        help="MoE expert capacity factor (must match the "
+                        "checkpoint's training value)")
+    parser.add_argument("--rope-theta", type=float, default=10000.0,
+                        help="RoPE base frequency (must match the "
+                        "checkpoint's training value)")
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel serving over a tp mesh axis")
     parser.add_argument("--dp", type=int, default=1,
@@ -180,6 +186,8 @@ def main(argv=None) -> int:
         max_seq_len=args.max_len,
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,
+        expert_capacity_factor=args.expert_capacity_factor,
+        rope_theta=args.rope_theta,
     )
     from hivedscheduler_tpu.parallel import checkpoint as ckpt
 

@@ -48,6 +48,14 @@ def main(argv=None) -> int:
     parser.add_argument("--moe-top-k", type=int, default=1)
     parser.add_argument("--moe-zloss", type=float, default=0.0,
                         help="ST-MoE router z-loss weight (0 disables)")
+    parser.add_argument("--moe-aux-weight", type=float, default=0.01,
+                        help="Switch load-balancing auxiliary-loss weight")
+    parser.add_argument("--expert-capacity-factor", type=float, default=1.25,
+                        help="MoE expert capacity factor (tokens kept per "
+                        "expert relative to an even split)")
+    parser.add_argument("--rope-theta", type=float, default=10000.0,
+                        help="RoPE base frequency (long-context runs raise "
+                        "it; must match at eval/serving time)")
     parser.add_argument("--grad-accum", type=int, default=1,
                         help="gradient-accumulation slices per batch "
                         "(batch must divide evenly)")
@@ -203,7 +211,10 @@ def main(argv=None) -> int:
         attn_impl=attn,
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,
+        moe_aux_weight=args.moe_aux_weight,
         moe_zloss_weight=args.moe_zloss,
+        expert_capacity_factor=args.expert_capacity_factor,
+        rope_theta=args.rope_theta,
         pipeline_microbatches=args.microbatches if args.pp > 1 else 0,
         lora_rank=args.lora_rank,
         lora_alpha=args.lora_alpha,

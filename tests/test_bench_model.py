@@ -39,6 +39,8 @@ def test_bench_model_smoke(capsys):
     assert m["model"]["decode_steps"] == 1
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ISSUE 7): fault-ladder
+# variant of the driver; test_bench_model_smoke is the tier-1 cousin
 def test_stage_failures_keep_train_number(capsys, monkeypatch):
     """Decode/serve failures degrade into per-stage error notes — the train
     MFU number (the driver's deliverable) must survive them, and the driver

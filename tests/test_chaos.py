@@ -38,6 +38,16 @@ def _mute_logs():
     logging.disable(logging.NOTSET)
 
 
+@pytest.fixture(autouse=True)
+def _lockcheck(monkeypatch):
+    """Every chaos soak doubles as a race/deadlock detector: the runtime
+    lock-order sanitizer (common/lockcheck.py) is active for all harness
+    runs in this module — out-of-hierarchy acquisitions and algorithm
+    mutators entered without the scheduler lock raise LockOrderError
+    instead of deadlocking or corrupting state silently (ISSUE 7)."""
+    monkeypatch.setenv("HIVED_LOCKCHECK", "1")
+
+
 SOAK_PLAN = FaultPlan(
     drop_event_p=0.08, delay_event_p=0.15, reorder_p=0.35,
     error_p=0.2, max_consecutive_errors=2, bind_fail_after_p=0.5,

@@ -1,0 +1,454 @@
+"""hivedlint guards: the static-analysis suite runs clean on the real tree,
+every rule catches its seeded-violation fixture, and the runtime lock-order
+sanitizer (HIVED_LOCKCHECK=1) both catches inversions and passes a chaos
+soak on the real runtime (ISSUE 7)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.hivedlint import blindspots, concurrency  # noqa: E402
+from hivedscheduler_tpu.common import lockcheck  # noqa: E402
+
+
+def _write(tmp_path, rel, source):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# the real tree is clean (tier-1, mirrors test_check_metrics)
+# ---------------------------------------------------------------------------
+
+def test_hivedlint_clean_on_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.hivedlint"], cwd=REPO,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, (
+        f"hivedlint found violations:\n{proc.stdout}{proc.stderr}"
+    )
+    assert "OK" in proc.stdout
+
+
+def test_lock_registry_is_consistent():
+    """Every hierarchy entry has a creation site and vice versa; levels are
+    unique enough to define an order (distinct per name)."""
+    assert set(lockcheck.LOCK_HIERARCHY) == set(lockcheck.LOCK_SITES)
+    assert len(set(lockcheck.LOCK_HIERARCHY.values())) == len(
+        lockcheck.LOCK_HIERARCHY)
+
+
+# ---------------------------------------------------------------------------
+# LCK001 / LCK002 fixtures
+# ---------------------------------------------------------------------------
+
+_HIER = {"good_lock": 10}
+_SITES = {"good_lock": "pkg/owner.py"}
+
+
+def test_lck001_direct_threading_lock_flagged(tmp_path):
+    _write(tmp_path, "pkg/owner.py",
+           "import threading\nL = threading.Lock()\n")
+    got = concurrency.check_lock_registry(
+        str(tmp_path / "pkg"), _HIER, _SITES, frozenset())
+    assert [f.rule for f in got] == ["LCK001"]
+    assert "make_lock" in got[0].message
+
+
+def test_lck001_unregistered_name_and_wrong_file_flagged(tmp_path):
+    _write(tmp_path, "pkg/owner.py",
+           "from x import lockcheck\nA = lockcheck.make_lock('good_lock')\n"
+           "B = lockcheck.make_lock('rogue_lock')\n")
+    _write(tmp_path, "pkg/other.py",
+           "from x import lockcheck\nC = lockcheck.make_rlock('good_lock')\n"
+           "D = lockcheck.make_lock(name_var)\n")
+    got = concurrency.check_lock_registry(
+        str(tmp_path / "pkg"), _HIER, _SITES, frozenset())
+    msgs = sorted(f.message for f in got)
+    assert len(got) == 3 and all(f.rule == "LCK001" for f in got)
+    assert any("'rogue_lock' is not in" in m for m in msgs)
+    assert any("registers it to" in m for m in msgs)
+    assert any("non-literal" in m for m in msgs)
+
+
+def test_lck002_thread_spawn_outside_allowlist_flagged(tmp_path):
+    _write(tmp_path, "pkg/spawner.py",
+           "import threading\nt = threading.Thread(target=print)\n")
+    got = concurrency.check_lock_registry(
+        str(tmp_path / "pkg"), _HIER, _SITES, frozenset())
+    assert [f.rule for f in got] == ["LCK002"]
+    got = concurrency.check_lock_registry(
+        str(tmp_path / "pkg"), _HIER, _SITES, frozenset({"pkg/spawner.py"}))
+    assert got == []
+
+
+# ---------------------------------------------------------------------------
+# CON001: algorithm mutators
+# ---------------------------------------------------------------------------
+
+_MUTS = ["mutate", "noop"]
+
+
+def test_con001_missing_assert_and_leaked_statement_flagged(tmp_path):
+    path = _write(tmp_path, "hived.py", """
+        class Algo:
+            def mutate(self, x):
+                with self.algorithm_lock:
+                    self.state = x
+            def noop(self):
+                lockcheck.assert_serialized(self)
+        """)
+    got = concurrency.check_algorithm_mutators(path, _MUTS, class_name="Algo")
+    assert [f.rule for f in got] == ["CON001"]
+    assert "assert_serialized" in got[0].message
+
+    path = _write(tmp_path, "hived2.py", """
+        class Algo:
+            def mutate(self, x):
+                lockcheck.assert_serialized(self)
+                self.state = x  # outside the lock!
+                with self.algorithm_lock:
+                    pass
+            def noop(self):
+                lockcheck.assert_serialized(self)
+        """)
+    got = concurrency.check_algorithm_mutators(path, _MUTS, class_name="Algo")
+    assert len(got) == 1 and "outside the lock" in got[0].message
+
+
+def test_con001_clean_shape_passes(tmp_path):
+    path = _write(tmp_path, "hived.py", """
+        class Algo:
+            def mutate(self, x):
+                '''doc'''
+                lockcheck.assert_serialized(self)
+                with self.algorithm_lock:
+                    self.state = x
+            def noop(self):
+                lockcheck.assert_serialized(self)
+        """)
+    assert concurrency.check_algorithm_mutators(
+        path, _MUTS, class_name="Algo") == []
+
+
+# ---------------------------------------------------------------------------
+# CON002: scheduler lock paths (direct + transitive)
+# ---------------------------------------------------------------------------
+
+def test_con002_unguarded_handler_flagged(tmp_path):
+    path = _write(tmp_path, "sched.py", """
+        class Sched:
+            def __init__(self, kc):
+                kc.on_pod_event(self._add, self._upd, self._del)
+            def _add(self, pod):
+                self.scheduler_algorithm.mutate(pod)   # no lock!
+            def _upd(self, a, b):
+                with self.scheduler_lock:
+                    self.scheduler_algorithm.mutate(b)
+            def _del(self, pod):
+                with self.scheduler_lock:
+                    self._helper(pod)
+            def _helper(self, pod):
+                self.scheduler_algorithm.mutate(pod)
+        """)
+    got = concurrency.check_scheduler_lock_paths(
+        path, ["mutate"], class_name="Sched")
+    assert [f.rule for f in got] == ["CON002"]
+    assert "_add()" in got[0].message
+
+
+def test_con002_transitive_unguarded_path_flagged(tmp_path):
+    path = _write(tmp_path, "sched.py", """
+        class Sched:
+            def public(self, pod):
+                self._helper(pod)        # enters helper with no lock
+            def _locked_path(self, pod):
+                with self.scheduler_lock:
+                    self._helper(pod)
+            def _helper(self, pod):
+                self.scheduler_algorithm.mutate(pod)
+        """)
+    got = concurrency.check_scheduler_lock_paths(
+        path, ["mutate"], class_name="Sched")
+    assert len(got) == 1 and "_helper()" in got[0].message
+
+
+def test_con002_thread_target_flagged_and_clean_passes(tmp_path):
+    path = _write(tmp_path, "sched.py", """
+        import threading
+        class Sched:
+            def _spawn(self):
+                threading.Thread(target=self._worker).start()
+            def _worker(self):
+                self.scheduler_algorithm.mutate(None)
+        """)
+    got = concurrency.check_scheduler_lock_paths(
+        path, ["mutate"], class_name="Sched")
+    assert len(got) == 1 and "_worker()" in got[0].message
+
+    path = _write(tmp_path, "clean.py", """
+        class Sched:
+            def public(self, pod):
+                with self.scheduler_lock:
+                    self._helper(pod)
+            def _helper(self, pod):
+                self.scheduler_algorithm.mutate(pod)
+        """)
+    assert concurrency.check_scheduler_lock_paths(
+        path, ["mutate"], class_name="Sched") == []
+
+
+def test_con003_bypass_flagged(tmp_path):
+    _write(tmp_path, "pkg/webby.py", """
+        def handler(s):
+            s.scheduler_algorithm.mutate(None)
+            s.scheduler_algorithm.get_cluster_status()  # reads are fine
+        """)
+    got = concurrency.check_algorithm_bypass(str(tmp_path / "pkg"), ["mutate"])
+    assert [f.rule for f in got] == ["CON003"]
+
+
+def test_con004_fire_under_store_lock_flagged(tmp_path):
+    path = _write(tmp_path, "fake.py", """
+        class Fake:
+            def bad_emit(self, key):
+                with self._lock:
+                    self._fire(print, ())
+            def good_emit(self, key):
+                with self._lock:
+                    ev = self._queues[key]
+                self._fire(print, ())
+            def _fire(self, fire, copies):
+                fire(*copies)
+        """)
+    got = concurrency.check_store_leaf_fire(path)
+    assert [f.rule for f in got] == ["CON004"]
+    assert "bad_emit" in got[0].message
+
+
+# ---------------------------------------------------------------------------
+# CLI001 / CLI002 fixtures
+# ---------------------------------------------------------------------------
+
+def test_cli001_unreachable_and_stale_allowlist_flagged(tmp_path):
+    _write(tmp_path, "cli.py", """
+        def main(args):
+            cfg = TransformerConfig(alpha=args.alpha, beta=args.beta)
+        """)
+    fields = ["alpha", "beta", "gamma", "delta"]
+    got = blindspots.check_cli_reachability(
+        str(tmp_path), fields,
+        sites=[("cli.py", {"delta": "internal policy"})])
+    assert [f.rule for f in got] == ["CLI001"]
+    assert "'gamma'" in got[0].message
+
+    got = blindspots.check_cli_reachability(
+        str(tmp_path), ["alpha", "beta"],
+        sites=[("cli.py", {"beta": "stale: it IS passed"})])
+    assert len(got) == 1 and "stale" in got[0].message
+
+
+def test_cli002_dead_flag_flagged(tmp_path):
+    _write(tmp_path, "cli.py", """
+        import argparse
+        def main():
+            p = argparse.ArgumentParser()
+            p.add_argument("--used-flag", type=int)
+            p.add_argument("--dead-flag", type=int)
+            p.add_argument("--renamed", dest="kept", type=int)
+            args = p.parse_args()
+            print(args.used_flag, args.kept)
+        """)
+    got = blindspots.check_dead_flags(str(tmp_path), ["cli.py"])
+    assert [f.rule for f in got] == ["CLI002"]
+    assert "'dead_flag'" in got[0].message
+
+
+# ---------------------------------------------------------------------------
+# GRD001: guard drift
+# ---------------------------------------------------------------------------
+
+def test_grd001_fragment_extraction():
+    frags = blindspots.regex_literal_fragments(
+        r"Pod binding node mismatch: expected .* received \d+", min_len=8)
+    assert frags == ["Pod binding node mismatch: expected ", " received "]
+    # escapes become literals; classes/operators split
+    assert blindspots.regex_literal_fragments(
+        r"chain \(relaxed\) rejected", min_len=8) == [
+        "chain (relaxed) rejected"]
+
+
+def test_grd001_reworded_message_flagged(tmp_path):
+    _write(tmp_path, "pkg/mod.py", """
+        def f():
+            raise ValueError("the gang cannot be placed on this chain")
+        """)
+    _write(tmp_path, "tests/test_mod.py", """
+        import pytest
+        def test_guard():
+            with pytest.raises(ValueError,
+                               match="gang cannot be placed"):
+                pass
+            with pytest.raises(ValueError,
+                               match="some stale reworded text"):
+                pass
+        """)
+    got = blindspots.check_guard_drift(
+        str(tmp_path / "pkg"), str(tmp_path / "tests"))
+    assert [f.rule for f in got] == ["GRD001"]
+    assert "stale reworded" in got[0].message
+
+
+# ---------------------------------------------------------------------------
+# SER001: serializer drift
+# ---------------------------------------------------------------------------
+
+def test_ser001_drifted_head_and_unregistered_template_flagged(tmp_path):
+    _write(tmp_path, "hivedscheduler_tpu/runtime/utils.py", """
+        HEAD = '{"node":%s,"chipIsolation":[%s],"cellChain":%s}'
+        """)
+    _write(tmp_path, "hivedscheduler_tpu/rogue.py", """
+        BLOB = '{"sneaky":%s}'
+        """)
+    got = blindspots.check_serializer_drift(
+        str(tmp_path),
+        canonical_head_keys=["node", "leafCellIsolation", "cellChain"])
+    rules = sorted((f.rule, f.file) for f in got)
+    assert ("SER001", "hivedscheduler_tpu/rogue.py") in rules
+    assert any("drifted from the canonical serializer" in f.message
+               for f in got)
+
+
+def test_ser001_handrolled_loader_state_flagged(tmp_path):
+    _write(tmp_path, "hivedscheduler_tpu/runtime/utils.py", """
+        HEAD = '{"node":%s}'
+        """)
+    _write(tmp_path, "hivedscheduler_tpu/parallel/data.py", """
+        class LoaderState:
+            def to_dict(self):
+                return {"seed": self.seed}  # hand-rolled: drift magnet
+            @classmethod
+            def from_dict(cls, d):
+                return cls(**d)
+        """)
+    got = blindspots.check_serializer_drift(
+        str(tmp_path), canonical_head_keys=["node"])
+    msgs = [f.message for f in got]
+    assert any("dataclasses.asdict" in m for m in msgs)
+    assert any("dataclasses.fields" in m for m in msgs)
+
+
+def test_met001_fixture_flagged(tmp_path):
+    _write(tmp_path, "pkg/mod.py", """
+        REGISTRY.inc('tpu_hive_orphan_total')
+        """)
+    got = blindspots.check_metrics_catalogue(
+        REPO, package_root=str(tmp_path / "pkg"))
+    assert [f.rule for f in got] == ["MET001"]
+    assert "tpu_hive_orphan_total" in got[0].message
+
+
+# ---------------------------------------------------------------------------
+# HIVED_LOCKCHECK runtime sanitizer
+# ---------------------------------------------------------------------------
+
+def test_lockcheck_disabled_returns_plain_lock(monkeypatch):
+    monkeypatch.delenv("HIVED_LOCKCHECK", raising=False)
+    lk = lockcheck.make_lock("metrics_lock")
+    assert not isinstance(lk, lockcheck.CheckedLock)
+
+
+def test_lockcheck_order_violation_raises(monkeypatch):
+    monkeypatch.setenv("HIVED_LOCKCHECK", "1")
+    sched = lockcheck.make_rlock("scheduler_lock")
+    store = lockcheck.make_rlock("store_lock")
+    with sched:
+        with store:  # 10 -> 50: fine
+            pass
+    with pytest.raises(lockcheck.LockOrderError, match="lock-order violation"):
+        with store:
+            with sched:
+                pass
+
+
+def test_lockcheck_reentrant_and_timeout_acquire(monkeypatch):
+    monkeypatch.setenv("HIVED_LOCKCHECK", "1")
+    sched = lockcheck.make_rlock("scheduler_lock")
+    with sched:
+        with sched:  # reentrant: no order check against itself
+            assert sched._is_owned()
+        assert sched.acquire(timeout=0.1)
+        sched.release()
+    assert not sched._is_owned()
+    with pytest.raises(lockcheck.LockOrderError, match="does not hold"):
+        sched.release()
+
+
+def test_lockcheck_unregistered_name_rejected(monkeypatch):
+    monkeypatch.setenv("HIVED_LOCKCHECK", "1")
+    with pytest.raises(lockcheck.LockOrderError, match="not in LOCK_HIERARCHY"):
+        lockcheck.make_lock("never_registered_lock")
+
+
+def test_lockcheck_contended_acquire_failure_not_recorded(monkeypatch):
+    monkeypatch.setenv("HIVED_LOCKCHECK", "1")
+    lk = lockcheck.make_lock("metrics_lock")
+    hold = threading.Event()
+    done = threading.Event()
+
+    def holder():
+        with lk:
+            hold.set()
+            done.wait(5)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert hold.wait(5)
+    assert lk.acquire(timeout=0.05) is False
+    assert not lk._is_owned()  # failed acquire must not leak into the stack
+    done.set()
+    t.join(5)
+
+
+def test_lockcheck_assert_serialized_contract(monkeypatch):
+    monkeypatch.setenv("HIVED_LOCKCHECK", "1")
+    sched = lockcheck.make_rlock("scheduler_lock")
+
+    class Algo:
+        pass
+
+    algo = Algo()
+    lockcheck.assert_serialized(algo)  # unowned: standalone use is fine
+    lockcheck.serialize_under(algo, "scheduler_lock")
+    with pytest.raises(lockcheck.LockOrderError, match="single-threaded"):
+        lockcheck.assert_serialized(algo)
+    with sched:
+        lockcheck.assert_serialized(algo)
+
+
+def test_lockcheck_chaos_soak_smoke(monkeypatch):
+    """The wired-in detector: a short chaos soak on the real runtime under
+    HIVED_LOCKCHECK=1. Lock-order and scheduler-lock-held assertions are
+    live on every schedule/bind/flap/restart; any inversion raises instead
+    of deadlocking. (The full soak ladder runs in test_chaos.py; every soak
+    becomes a race detector when the env var is set.)"""
+    monkeypatch.setenv("HIVED_LOCKCHECK", "1")
+    from hivedscheduler_tpu.chaos.harness import ChaosHarness
+
+    h = ChaosHarness(seed=3)
+    assert isinstance(h.scheduler.scheduler_lock, lockcheck.CheckedLock)
+    assert isinstance(h.algo.algorithm_lock, lockcheck.CheckedLock)
+    assert h.algo._lockcheck_serialized_by == "scheduler_lock"
+    report = h.run(6)
+    assert report["violations"] == []
+    assert report["schedules"] == 6

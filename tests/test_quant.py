@@ -132,6 +132,8 @@ class TestQuant:
                 and set(specs["layers"][k]) == {"qi8", "scale"}
             ), k
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 7): quant x spec
+    # composition; core quant exactness tests stay tier-1
     def test_quantized_target_speculation(self):
         """An int8 target verifies a float draft: greedy speculative output
         equals vanilla greedy decoding of the QUANTIZED target (exactness is

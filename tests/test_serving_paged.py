@@ -295,6 +295,8 @@ class TestSpecDecodeFirstClass:
         assert eng.prefix_block_hits >= 1
         check_block_pool(eng, "after spec prefix")
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 7): sampled
+    # variant; the greedy spec paged differentials stay tier-1
     def test_spec_sampled_paged_matches_spec_dense(self, setup, draft):
         cfg, params = setup
         outs = []

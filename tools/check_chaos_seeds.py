@@ -59,9 +59,22 @@ def _plans():
 def replay(seed: int, plan_name: str = "soak-v1", schedules: int = 8) -> dict:
     from hivedscheduler_tpu.chaos import ChaosHarness
 
-    harness = ChaosHarness(seed=seed, plan=_plans()[plan_name],
-                           restart_every=3)
-    return harness.run(schedules)
+    # every replay doubles as a race/deadlock detector: the lock-order
+    # sanitizer (common/lockcheck.py) raises on inversions instead of
+    # wedging; HIVED_LOCKCHECK=0 opts out for bisecting. Restored after
+    # the run so in-process callers (the determinism guard test) don't
+    # leak the env var into their process.
+    prev = os.environ.get("HIVED_LOCKCHECK")
+    os.environ.setdefault("HIVED_LOCKCHECK", "1")
+    try:
+        harness = ChaosHarness(seed=seed, plan=_plans()[plan_name],
+                               restart_every=3)
+        return harness.run(schedules)
+    finally:
+        if prev is None:
+            os.environ.pop("HIVED_LOCKCHECK", None)
+        else:
+            os.environ["HIVED_LOCKCHECK"] = prev
 
 
 def main(argv=None) -> int:

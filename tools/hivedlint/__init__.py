@@ -1,0 +1,97 @@
+"""hivedlint: project-specific static analysis for the tpu-hive tree.
+
+Machine-checks the concurrency contract and the CLAUDE.md "recurring blind
+spots" that verify passes repeatedly caught by hand. One entry point::
+
+    python -m tools.hivedlint          # exit 1 on any finding
+
+Rule catalogue (documented in doc/design/concurrency.md):
+
+Concurrency (tools/hivedlint/concurrency.py):
+
+- **LCK001 lock-registry** — every lock is created through
+  ``common.lockcheck.make_lock/make_rlock`` with a literal name registered
+  in ``LOCK_HIERARCHY``, from the file ``LOCK_SITES`` assigns it. Direct
+  ``threading.Lock()``/``RLock()``/``Condition()``/``Semaphore()`` calls in
+  the package are forbidden (the factory is what makes the runtime
+  lock-order sanitizer, ``HIVED_LOCKCHECK=1``, cover the lock).
+- **LCK002 thread-spawn** — ``threading.Thread(...)`` only in the
+  allowlisted spawn sites (``lockcheck.THREAD_SITES``).
+- **CON001 algorithm-mutator-lock** — every mutating entry point of the
+  ``SchedulerAlgorithm`` contract implemented by ``HivedAlgorithm`` calls
+  ``lockcheck.assert_serialized(self)`` and wraps its whole body in
+  ``with self.algorithm_lock``.
+- **CON002 scheduler-lock-path** — every path inside ``HivedScheduler``
+  from an entry point (public routine, informer callback, thread target)
+  to a ``scheduler_algorithm`` mutating call holds ``scheduler_lock``.
+- **CON003 algorithm-bypass** — no file outside ``runtime/scheduler.py``
+  calls a mutating method on a ``scheduler_algorithm`` attribute (the
+  runtime is the single serialization chokepoint).
+- **CON004 store-leaf-fire** — the fake ApiServer never invokes informer
+  handlers while lexically holding its store (leaf) lock.
+
+Blind spots (tools/hivedlint/blindspots.py):
+
+- **CLI001 config-reachability** — every ``TransformerConfig`` field is
+  either passed from ``args`` at each CLI's construction site or
+  allowlisted with a reason (the twice-caught unreachable-feature bug).
+- **CLI002 dead-flag** — every ``add_argument`` dest is read somewhere in
+  its CLI module.
+- **GRD001 guard-drift** — every ``pytest.raises(match=...)`` literal's
+  long literal fragments still appear in some string literal of the
+  package (or the test's own file): rewording a ``ValueError`` without
+  updating its guard fails here instead of at 3 a.m.
+- **SER001 serializer-drift** — the hand-rolled bind-info JSON head stays
+  key-exact with ``PodBindInfo.to_dict``, ``LoaderState`` keeps its
+  canonical ``dataclasses.asdict`` round-trip, and no NEW hand-rolled JSON
+  object template appears outside the registered sites.
+- **MET001 metrics-catalogue** — ``tools/check_metrics.py`` folded in:
+  every emitted metric described, no dead describes, no dynamic names.
+
+Each rule has a seeded-violation fixture in ``tests/test_hivedlint.py`` and
+the suite is pinned clean on the real tree in tier-1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import List
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    file: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+
+def repo_root() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def run_all(root: str) -> List[Finding]:
+    from tools.hivedlint import blindspots, concurrency
+
+    findings: List[Finding] = []
+    findings += concurrency.check(root)
+    findings += blindspots.check(root)
+    return findings
+
+
+def main(argv=None) -> int:
+    root = repo_root()
+    findings = run_all(root)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"hivedlint: {len(findings)} finding(s)")
+        return 1
+    print("hivedlint: OK")
+    return 0

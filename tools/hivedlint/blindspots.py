@@ -1,0 +1,423 @@
+"""Recurring-blind-spot rules (CLI001/002, GRD001, SER001, MET001).
+
+These encode the CLAUDE.md "recurring blind spots" that verify passes have
+repeatedly caught by hand: features unreachable from the CLIs, error
+messages reworded out from under their ``pytest.raises(match=...)`` guards,
+hand-rolled serializers drifting from the canonical ``to_dict``/dataclass
+fields, and metric-catalogue drift (folded in from tools/check_metrics.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.hivedlint import Finding
+
+# ---------------------------------------------------------------------------
+# CLI001: config-field -> CLI-flag reachability
+#
+# Every TransformerConfig field must either be passed (from args) at the
+# CLI's construction site or be allowlisted here WITH a reason. An
+# allowlisted field that IS passed is flagged too — the registry must not
+# rot. The twice-caught bug this encodes: a new model capability (pipeline,
+# moe_top_k) landing without a train flag, unreachable from
+# `python -m hivedscheduler_tpu.train`.
+# ---------------------------------------------------------------------------
+
+_SERVING_ONLY_REASONS = {
+    "dtype": "compute dtype is jnp policy, not a scalar flag",
+    "attn_impl": "decode path has its own ragged attention; train-side impl "
+                 "selection does not apply",
+    "moe_aux_weight": "training-only auxiliary loss",
+    "moe_zloss_weight": "training-only router z-loss",
+    "pipeline_microbatches": "GPipe is a training construct",
+    "remat": "backward-pass policy; no backward at inference",
+    "attn_block_q": "flash tiling applies to the training attention kernels",
+    "attn_block_k": "flash tiling applies to the training attention kernels",
+    "overlap": "collective-matmul overlap gates on the training path",
+    "lora_rank": "adapters merge into base weights at checkpoint load "
+                 "(restore_serving_params), not a live config field",
+    "lora_alpha": "merged at checkpoint load",
+    "lora_mlp": "merged at checkpoint load",
+}
+
+CLI_CONFIG_SITES: List[Tuple[str, Dict[str, str]]] = [
+    ("hivedscheduler_tpu/train.py", {
+        "dtype": "compute dtype is jnp policy, not a scalar flag",
+    }),
+    ("hivedscheduler_tpu/serve.py", dict(_SERVING_ONLY_REASONS)),
+    ("hivedscheduler_tpu/generate.py", dict(_SERVING_ONLY_REASONS)),
+    ("hivedscheduler_tpu/eval.py", {
+        **{k: v for k, v in _SERVING_ONLY_REASONS.items()
+           if k not in ("attn_impl",)},
+        "rope_theta": "eval consumes train checkpoints; geometry knobs ride "
+                      "the restore path (smoke tool, not a product surface)",
+        "expert_capacity_factor": "same: eval mirrors the checkpoint config",
+    }),
+]
+
+
+def config_fields(transformer_path: str,
+                  class_name: str = "TransformerConfig") -> List[str]:
+    with open(transformer_path) as f:
+        tree = ast.parse(f.read(), filename=transformer_path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return [
+                s.target.id for s in node.body
+                if isinstance(s, ast.AnnAssign) and isinstance(s.target, ast.Name)
+            ]
+    raise AssertionError(f"{class_name} not found in {transformer_path}")
+
+
+def check_cli_reachability(
+    root: str,
+    fields: List[str],
+    sites: Optional[List[Tuple[str, Dict[str, str]]]] = None,
+    class_name: str = "TransformerConfig",
+) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, allow in (sites if sites is not None else CLI_CONFIG_SITES):
+        path = os.path.join(root, rel)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        passed: Set[str] = set()
+        site_line = 1
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                func = node.func
+                name = func.attr if isinstance(func, ast.Attribute) else (
+                    func.id if isinstance(func, ast.Name) else None)
+                if name == class_name:
+                    site_line = node.lineno
+                    passed.update(kw.arg for kw in node.keywords if kw.arg)
+        for field in fields:
+            if field not in passed and field not in allow:
+                out.append(Finding(
+                    "CLI001", rel, site_line,
+                    f"config field {field!r} is unreachable from this CLI: "
+                    f"pass it at the {class_name}(...) site (add a flag) or "
+                    f"allowlist it with a reason in tools/hivedlint/"
+                    f"blindspots.py",
+                ))
+            elif field in passed and field in allow:
+                out.append(Finding(
+                    "CLI001", rel, site_line,
+                    f"config field {field!r} is allowlisted as unreachable "
+                    f"but IS passed — drop the stale allowlist entry",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI002: dead flags — every add_argument dest is read in its module
+# ---------------------------------------------------------------------------
+
+def check_dead_flags(root: str, cli_files: Iterable[str]) -> List[Finding]:
+    out: List[Finding] = []
+    for rel in cli_files:
+        path = os.path.join(root, rel)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        attr_reads: Set[str] = {
+            n.attr for n in ast.walk(tree) if isinstance(n, ast.Attribute)
+        }
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_argument"):
+                continue
+            dest = None
+            for kw in node.keywords:
+                if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                    dest = kw.value.value
+            if dest is None:
+                longopts = [
+                    a.value for a in node.args
+                    if isinstance(a, ast.Constant) and isinstance(a.value, str)
+                    and a.value.startswith("--")
+                ]
+                if not longopts:
+                    continue  # positional / short-only: skip
+                dest = longopts[0][2:].replace("-", "_")
+            if dest not in attr_reads:
+                out.append(Finding(
+                    "CLI002", rel, node.lineno,
+                    f"flag dest {dest!r} is parsed but never read in this "
+                    f"module — dead flag (or the handler forgot to use it)",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GRD001: pytest.raises(match=...) guards vs raise-message literals
+#
+# For each match= string literal we extract its LITERAL fragments (what is
+# left after removing regex operators); every fragment of >= min_len chars
+# must appear in some string literal of the package tree or of the guard's
+# own test file. Rewording a ValueError breaks the fragment lookup and
+# fails here — before the guard silently stops matching.
+# ---------------------------------------------------------------------------
+
+_REGEX_META = set(".^$*+?()[]{}|")
+
+
+def regex_literal_fragments(pattern: str, min_len: int = 8) -> List[str]:
+    frags: List[str] = []
+    cur: List[str] = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "\\" and i + 1 < len(pattern):
+            nxt = pattern[i + 1]
+            if nxt.isalnum():  # \d, \s, \b ... a regex class, not a literal
+                if cur:
+                    frags.append("".join(cur))
+                    cur = []
+            else:
+                cur.append(nxt)
+            i += 2
+            continue
+        if ch in _REGEX_META:
+            if cur:
+                frags.append("".join(cur))
+                cur = []
+        else:
+            cur.append(ch)
+        i += 1
+    if cur:
+        frags.append("".join(cur))
+    return [f for f in frags if len(f) >= min_len]
+
+
+def _string_constants(tree: ast.AST) -> Iterable[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value
+
+
+def _iter_py(base: str) -> Iterable[str]:
+    for dirpath, _, files in os.walk(base):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def check_guard_drift(
+    package_root: str,
+    tests_root: str,
+    min_len: int = 8,
+) -> List[Finding]:
+    corpus: List[str] = []
+    for path in _iter_py(package_root):
+        with open(path) as f:
+            corpus.extend(_string_constants(ast.parse(f.read(), filename=path)))
+    blob = "\x00".join(corpus)
+
+    out: List[Finding] = []
+    for path in _iter_py(tests_root):
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        rel = os.path.relpath(path, os.path.dirname(tests_root)).replace(os.sep, "/")
+        guards: List[Tuple[ast.Call, ast.Constant]] = []
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "raises"):
+                for kw in node.keywords:
+                    if kw.arg == "match" and (
+                        isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)
+                    ):
+                        guards.append((node, kw.value))
+        # the guards' own match literals must not vouch for themselves
+        pattern_nodes = {id(c) for _, c in guards}
+        local_blob = "\x00".join(
+            n.value for n in ast.walk(tree)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            and id(n) not in pattern_nodes
+        )
+        for node, const in guards:
+            for frag in regex_literal_fragments(const.value, min_len):
+                if frag not in blob and frag not in local_blob:
+                    out.append(Finding(
+                        "GRD001", rel, node.lineno,
+                        f"match fragment {frag!r} appears in no package "
+                        f"(or local) string literal — the guarded "
+                        f"message was likely reworded; update the guard "
+                        f"or the message",
+                    ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SER001: hand-rolled serializer drift
+# ---------------------------------------------------------------------------
+
+# files allowed to contain a hand-rolled JSON object template ('{"k":...')
+SERIALIZER_SITES = frozenset({
+    "hivedscheduler_tpu/runtime/utils.py",  # bind-info head fast path
+})
+
+_JSON_TEMPLATE_RE = re.compile(r'^\{"\w+":')
+
+
+def check_serializer_drift(
+    root: str,
+    canonical_head_keys: Optional[List[str]] = None,
+    serializer_sites: frozenset = SERIALIZER_SITES,
+) -> List[Finding]:
+    out: List[Finding] = []
+    pkg = os.path.join(root, "hivedscheduler_tpu")
+
+    # (a) no unregistered hand-rolled JSON templates anywhere in the package
+    templates: Dict[str, List[Tuple[int, str]]] = {}
+    for path in _iter_py(pkg):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                    and _JSON_TEMPLATE_RE.match(node.value)):
+                templates.setdefault(rel, []).append((node.lineno, node.value))
+    for rel, sites in sorted(templates.items()):
+        if rel not in serializer_sites:
+            for line, _ in sites:
+                out.append(Finding(
+                    "SER001", rel, line,
+                    "hand-rolled JSON object template outside the "
+                    "registered serializer sites — use common.to_json over "
+                    "to_dict(), or register the site WITH a key-drift check "
+                    "and a pinning guard test",
+                ))
+
+    # (b) the bind-info head template stays key-exact with PodBindInfo.to_dict
+    if canonical_head_keys is None:
+        import sys
+
+        sys.path.insert(0, root)
+        try:
+            from hivedscheduler_tpu.api.types import PodBindInfo
+        finally:
+            sys.path.pop(0)
+        canonical_head_keys = list(
+            PodBindInfo(node="n").to_dict(include_group=False))
+    utils_rel = "hivedscheduler_tpu/runtime/utils.py"
+    head_templates = templates.get(utils_rel, [])
+    if not head_templates:
+        out.append(Finding(
+            "SER001", utils_rel, 1,
+            "bind-info head template not found — if the fast path was "
+            "removed, drop the site from SERIALIZER_SITES",
+        ))
+    for line, lit in head_templates:
+        keys = re.findall(r'"(\w+)":', lit)
+        if keys != canonical_head_keys:
+            out.append(Finding(
+                "SER001", utils_rel, line,
+                f"hand-rolled head keys {keys} != PodBindInfo.to_dict("
+                f"include_group=False) keys {canonical_head_keys} — the "
+                f"fast path drifted from the canonical serializer",
+            ))
+
+    # (c) LoaderState keeps the canonical dataclasses round-trip
+    data_path = os.path.join(pkg, "parallel", "data.py")
+    if os.path.exists(data_path):
+        with open(data_path) as f:
+            tree = ast.parse(f.read(), filename=data_path)
+        cls = next((n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef) and n.name == "LoaderState"),
+                   None)
+        if cls is not None:
+            def _method_calls(name: str) -> Set[str]:
+                fn = next((m for m in cls.body
+                           if isinstance(m, ast.FunctionDef) and m.name == name),
+                          None)
+                if fn is None:
+                    return set()
+                return {
+                    n.func.attr for n in ast.walk(fn)
+                    if isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                }
+            if "asdict" not in _method_calls("to_dict"):
+                out.append(Finding(
+                    "SER001", "hivedscheduler_tpu/parallel/data.py", cls.lineno,
+                    "LoaderState.to_dict must stay dataclasses.asdict — a "
+                    "hand-rolled field list here is exactly the drift the "
+                    "checkpoint-resume contract forbids",
+                ))
+            if "fields" not in _method_calls("from_dict"):
+                out.append(Finding(
+                    "SER001", "hivedscheduler_tpu/parallel/data.py", cls.lineno,
+                    "LoaderState.from_dict must validate against "
+                    "dataclasses.fields — unknown-key rejection is the "
+                    "resume-compat guard",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# MET001: metrics catalogue (tools/check_metrics.py folded in)
+# ---------------------------------------------------------------------------
+
+def check_metrics_catalogue(root: str,
+                            package_root: Optional[str] = None) -> List[Finding]:
+    import sys
+
+    sys.path.insert(0, os.path.join(root, "tools"))
+    try:
+        import check_metrics
+    finally:
+        sys.path.pop(0)
+    emitted, described, dynamic = check_metrics.collect(
+        package_root or os.path.join(root, "hivedscheduler_tpu"))
+    out: List[Finding] = []
+    for name in sorted(set(emitted) - described):
+        out.append(Finding(
+            "MET001", emitted[name][0].split(":")[0],
+            int(emitted[name][0].rsplit(":", 1)[1]),
+            f"metric {name!r} emitted without a describe() entry",
+        ))
+    for name in sorted(described - set(emitted)):
+        out.append(Finding(
+            "MET001", "hivedscheduler_tpu", 1,
+            f"metric {name!r} described but never emitted",
+        ))
+    for site in dynamic:
+        file, line = site.split(":")[0], site.split(":")[1]
+        out.append(Finding(
+            "MET001", file, int(line),
+            "metric emit with a non-literal name — use a string literal",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+CLI_FILES = [
+    "hivedscheduler_tpu/train.py",
+    "hivedscheduler_tpu/serve.py",
+    "hivedscheduler_tpu/generate.py",
+    "hivedscheduler_tpu/eval.py",
+    "hivedscheduler_tpu/cli.py",
+]
+
+
+def check(root: str) -> List[Finding]:
+    fields = config_fields(
+        os.path.join(root, "hivedscheduler_tpu", "models", "transformer.py"))
+    out: List[Finding] = []
+    out += check_cli_reachability(root, fields)
+    out += check_dead_flags(root, CLI_FILES)
+    out += check_guard_drift(
+        os.path.join(root, "hivedscheduler_tpu"),
+        os.path.join(root, "tests"))
+    out += check_serializer_drift(root)
+    out += check_metrics_catalogue(root)
+    return out

@@ -1,0 +1,415 @@
+"""Concurrency-contract rules (LCK001/002, CON001-004).
+
+All rules are AST-based and parameterized on paths/registries so the seeded
+-violation fixtures in tests/test_hivedlint.py can drive them against tiny
+synthetic trees; ``check(root)`` wires them to the real package and the
+registry in ``hivedscheduler_tpu/common/lockcheck.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.hivedlint import Finding
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_MAKE_FUNCS = {"make_lock", "make_rlock"}
+
+
+def _walk_py(package_root: str) -> Iterable[Tuple[str, ast.AST]]:
+    base = os.path.dirname(package_root)
+    for dirpath, _, files in os.walk(package_root):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, base).replace(os.sep, "/")
+            with open(path) as f:
+                yield rel, ast.parse(f.read(), filename=path)
+
+
+def _is_threading_call(node: ast.Call, names: Set[str]) -> Optional[str]:
+    func = node.func
+    if (isinstance(func, ast.Attribute) and func.attr in names
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "threading"):
+        return func.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# LCK001 / LCK002: lock creation registry + thread-spawn allowlist
+# ---------------------------------------------------------------------------
+
+def check_lock_registry(
+    package_root: str,
+    hierarchy: Dict[str, int],
+    sites: Dict[str, str],
+    thread_sites: frozenset,
+    factory_file: str = "hivedscheduler_tpu/common/lockcheck.py",
+) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, tree in _walk_py(package_root):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            prim = _is_threading_call(node, _LOCK_FACTORIES)
+            if prim is not None and rel != factory_file:
+                out.append(Finding(
+                    "LCK001", rel, node.lineno,
+                    f"direct threading.{prim}() — create locks through "
+                    f"common.lockcheck.make_lock/make_rlock with a name "
+                    f"registered in LOCK_HIERARCHY",
+                ))
+                continue
+            if _is_threading_call(node, {"Thread"}) is not None:
+                if rel not in thread_sites:
+                    out.append(Finding(
+                        "LCK002", rel, node.lineno,
+                        f"threading.Thread() outside the allowlisted spawn "
+                        f"sites (lockcheck.THREAD_SITES) — register {rel} "
+                        f"with a rationale or restructure",
+                    ))
+                continue
+            func = node.func
+            if (isinstance(func, ast.Attribute) and func.attr in _MAKE_FUNCS
+                    and rel != factory_file):
+                if not node.args or not (
+                    isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    out.append(Finding(
+                        "LCK001", rel, node.lineno,
+                        f"{func.attr}() with a non-literal lock name — the "
+                        f"registry and sanitizer need a literal",
+                    ))
+                    continue
+                name = node.args[0].value
+                if name not in hierarchy:
+                    out.append(Finding(
+                        "LCK001", rel, node.lineno,
+                        f"lock name {name!r} is not in lockcheck."
+                        f"LOCK_HIERARCHY — add it with a level",
+                    ))
+                elif sites.get(name) != rel:
+                    out.append(Finding(
+                        "LCK001", rel, node.lineno,
+                        f"lock {name!r} created in {rel} but LOCK_SITES "
+                        f"registers it to {sites.get(name)!r}",
+                    ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# mutator discovery: the SchedulerAlgorithm contract
+# ---------------------------------------------------------------------------
+
+def contract_mutators(types_path: str) -> List[str]:
+    """Mutating methods of the SchedulerAlgorithm interface = every method
+    that is not an inspect getter (``get_*``) and not a dunder. A new method
+    added to the contract is covered automatically."""
+    with open(types_path) as f:
+        tree = ast.parse(f.read(), filename=types_path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "SchedulerAlgorithm":
+            return [
+                n.name for n in node.body
+                if isinstance(n, ast.FunctionDef)
+                and not n.name.startswith("get_")
+                and not n.name.startswith("__")
+            ]
+    raise AssertionError(f"SchedulerAlgorithm not found in {types_path}")
+
+
+# ---------------------------------------------------------------------------
+# CON001: algorithm mutators assert the contract and hold their own lock
+# ---------------------------------------------------------------------------
+
+def _is_assert_serialized(stmt: ast.stmt) -> bool:
+    return (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)
+            and isinstance(stmt.value.func, ast.Attribute)
+            and stmt.value.func.attr == "assert_serialized")
+
+
+def _is_docstring(stmt: ast.stmt) -> bool:
+    return (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str))
+
+
+def _with_on(stmt: ast.stmt, attr: str) -> bool:
+    return (isinstance(stmt, ast.With) and len(stmt.items) == 1
+            and isinstance(stmt.items[0].context_expr, ast.Attribute)
+            and stmt.items[0].context_expr.attr == attr)
+
+
+def check_algorithm_mutators(
+    hived_path: str,
+    mutators: List[str],
+    class_name: str = "HivedAlgorithm",
+    rel: str = "hivedscheduler_tpu/algorithm/hived.py",
+) -> List[Finding]:
+    out: List[Finding] = []
+    with open(hived_path) as f:
+        tree = ast.parse(f.read(), filename=hived_path)
+    cls = next((n for n in ast.walk(tree)
+                if isinstance(n, ast.ClassDef) and n.name == class_name), None)
+    if cls is None:
+        return [Finding("CON001", rel, 1, f"class {class_name} not found")]
+    methods = {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
+    for name in mutators:
+        fn = methods.get(name)
+        if fn is None:
+            out.append(Finding(
+                "CON001", rel, cls.lineno,
+                f"contract mutator {name}() not implemented on {class_name}",
+            ))
+            continue
+        body = [s for s in fn.body if not _is_docstring(s)]
+        if not body or not _is_assert_serialized(body[0]):
+            out.append(Finding(
+                "CON001", rel, fn.lineno,
+                f"{name}() must start with lockcheck.assert_serialized(self) "
+                f"(the single-threaded contract assertion)",
+            ))
+            continue
+        rest = body[1:]
+        if not rest:
+            continue  # contract-only stub (no state touched)
+        if len(rest) != 1 or not _with_on(rest[0], "algorithm_lock"):
+            out.append(Finding(
+                "CON001", rel, fn.lineno,
+                f"{name}() body must be exactly `with self.algorithm_lock:` "
+                f"after the contract assertion — statements outside the lock "
+                f"mutate shared state unserialized",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CON002: every path to a scheduler_algorithm mutating call holds the lock
+# ---------------------------------------------------------------------------
+
+class _MethodScan(ast.NodeVisitor):
+    """Per-method scan: mutator call sites and intra-class call edges, each
+    tagged with whether the site is lexically under `with self.<lock>`."""
+
+    def __init__(self, mutators: Set[str], lock_attr: str):
+        self.mutators = mutators
+        self.lock_attr = lock_attr
+        self.depth = 0
+        self.mutator_sites: List[Tuple[int, bool]] = []  # (line, guarded)
+        self.calls: List[Tuple[str, bool]] = []          # (callee, guarded)
+        self.thread_targets: List[str] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(
+            isinstance(i.context_expr, ast.Attribute)
+            and i.context_expr.attr == self.lock_attr
+            for i in node.items
+        )
+        if locked:
+            self.depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.depth -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            recv = func.value
+            if (isinstance(recv, ast.Attribute)
+                    and recv.attr == "scheduler_algorithm"
+                    and func.attr in self.mutators):
+                self.mutator_sites.append((node.lineno, self.depth > 0))
+            elif (isinstance(recv, ast.Name) and recv.id == "self"):
+                self.calls.append((func.attr, self.depth > 0))
+            if _is_threading_call(node, {"Thread"}) is not None:
+                for kw in node.keywords:
+                    if (kw.arg == "target"
+                            and isinstance(kw.value, ast.Attribute)
+                            and isinstance(kw.value.value, ast.Name)
+                            and kw.value.value.id == "self"):
+                        self.thread_targets.append(kw.value.attr)
+        self.generic_visit(node)
+
+
+def check_scheduler_lock_paths(
+    scheduler_path: str,
+    mutators: List[str],
+    class_name: str = "HivedScheduler",
+    lock_attr: str = "scheduler_lock",
+    rel: str = "hivedscheduler_tpu/runtime/scheduler.py",
+) -> List[Finding]:
+    out: List[Finding] = []
+    with open(scheduler_path) as f:
+        tree = ast.parse(f.read(), filename=scheduler_path)
+    cls = next((n for n in ast.walk(tree)
+                if isinstance(n, ast.ClassDef) and n.name == class_name), None)
+    if cls is None:
+        return [Finding("CON002", rel, 1, f"class {class_name} not found")]
+    scans: Dict[str, _MethodScan] = {}
+    handler_regs: Set[str] = set()
+    for fn in (n for n in cls.body if isinstance(n, ast.FunctionDef)):
+        scan = _MethodScan(set(mutators), lock_attr)
+        for stmt in fn.body:
+            scan.visit(stmt)
+        scans[fn.name] = scan
+        # informer registrations: on_*_event(self._a, self._b, self._c)
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr.startswith("on_")
+                    and node.func.attr.endswith("_event")):
+                for arg in node.args:
+                    if (isinstance(arg, ast.Attribute)
+                            and isinstance(arg.value, ast.Name)
+                            and arg.value.id == "self"):
+                        handler_regs.add(arg.attr)
+
+    # roots: externally-invocable frames that start with no lock held
+    roots = {m for m in scans if not m.startswith("_")}
+    roots |= handler_regs
+    for scan in scans.values():
+        roots.update(t for t in scan.thread_targets if t in scans)
+    roots &= set(scans)
+
+    # BFS: which methods can be ENTERED with the lock not held?
+    unlocked_entry: Set[str] = set(roots)
+    frontier = list(roots)
+    while frontier:
+        m = frontier.pop()
+        for callee, guarded in scans[m].calls:
+            if not guarded and callee in scans and callee not in unlocked_entry:
+                unlocked_entry.add(callee)
+                frontier.append(callee)
+
+    for name in sorted(unlocked_entry):
+        for line, guarded in scans[name].mutator_sites:
+            if not guarded:
+                out.append(Finding(
+                    "CON002", rel, line,
+                    f"{class_name}.{name}() reaches a scheduler_algorithm "
+                    f"mutating call without holding {lock_attr} on some "
+                    f"path from an entry point",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CON003: no algorithm-mutator calls bypassing the runtime chokepoint
+# ---------------------------------------------------------------------------
+
+def check_algorithm_bypass(
+    package_root: str,
+    mutators: List[str],
+    chokepoint: str = "hivedscheduler_tpu/runtime/scheduler.py",
+) -> List[Finding]:
+    out: List[Finding] = []
+    muts = set(mutators)
+    for rel, tree in _walk_py(package_root):
+        if rel == chokepoint:
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in muts
+                    and isinstance(node.func.value, ast.Attribute)
+                    and node.func.value.attr == "scheduler_algorithm"):
+                out.append(Finding(
+                    "CON003", rel, node.lineno,
+                    f".scheduler_algorithm.{node.func.attr}() outside the "
+                    f"runtime chokepoint ({chokepoint}) bypasses the "
+                    f"scheduler lock",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CON004: fake ApiServer never fires handlers under the store leaf lock
+# ---------------------------------------------------------------------------
+
+class _LeafFireScan(ast.NodeVisitor):
+    def __init__(self, lock_attr: str, fire_names: Set[str]):
+        self.lock_attr = lock_attr
+        self.fire_names = fire_names
+        self.depth = 0
+        self.violations: List[int] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(
+            isinstance(i.context_expr, ast.Attribute)
+            and i.context_expr.attr == self.lock_attr
+            for i in node.items
+        )
+        if locked:
+            self.depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.depth -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        name = None
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name) \
+                and func.value.id == "self":
+            name = func.attr
+        elif isinstance(func, ast.Name):
+            name = func.id
+        if name in self.fire_names and self.depth > 0:
+            self.violations.append(node.lineno)
+        self.generic_visit(node)
+
+
+def check_store_leaf_fire(
+    fake_path: str,
+    lock_attr: str = "_lock",
+    fire_names: Set[str] = frozenset({"_fire", "fire"}),
+    rel: str = "hivedscheduler_tpu/k8s/fake.py",
+) -> List[Finding]:
+    with open(fake_path) as f:
+        tree = ast.parse(f.read(), filename=fake_path)
+    out: List[Finding] = []
+    for fn in (n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)):
+        if fn.name in fire_names:
+            continue  # the chokepoint itself delegates to the handler
+        scan = _LeafFireScan(lock_attr, set(fire_names))
+        for stmt in fn.body:
+            scan.visit(stmt)
+        for line in scan.violations:
+            out.append(Finding(
+                "CON004", rel, line,
+                f"handler fired while lexically holding the store leaf lock "
+                f"({lock_attr}) in {fn.name}() — deliver through _emit, "
+                f"which releases the lock first",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+def check(root: str) -> List[Finding]:
+    import sys
+
+    sys.path.insert(0, root)
+    try:
+        from hivedscheduler_tpu.common import lockcheck
+    finally:
+        sys.path.pop(0)
+    pkg = os.path.join(root, "hivedscheduler_tpu")
+    mutators = contract_mutators(
+        os.path.join(pkg, "runtime", "types.py"))
+    out: List[Finding] = []
+    out += check_lock_registry(
+        pkg, lockcheck.LOCK_HIERARCHY, lockcheck.LOCK_SITES,
+        lockcheck.THREAD_SITES)
+    out += check_algorithm_mutators(
+        os.path.join(pkg, "algorithm", "hived.py"), mutators)
+    out += check_scheduler_lock_paths(
+        os.path.join(pkg, "runtime", "scheduler.py"), mutators)
+    out += check_algorithm_bypass(pkg, mutators)
+    out += check_store_leaf_fire(os.path.join(pkg, "k8s", "fake.py"))
+    return out
