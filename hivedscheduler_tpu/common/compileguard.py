@@ -1,0 +1,175 @@
+"""Opt-in runtime recompile sanitizer (``HIVED_COMPILE_GUARD=1``).
+
+Silent jit recompiles are the model layer's deadlock-equivalent: nothing
+is wrong with the numbers, but a shape or static-arg leak makes every
+serving tick pay a compile, and nobody notices until a soak is 100x slow.
+This module wraps the package's jit entry points the way
+``common.lockcheck`` wraps locks:
+
+- :func:`jit` is a drop-in for ``jax.jit`` taking an extra
+  ``guard_label``. Disabled (the default), it returns the raw jitted
+  function — zero overhead, identical object semantics. With
+  ``HIVED_COMPILE_GUARD=1`` at wrap time it returns a counting proxy that
+  attributes every jit-cache miss to its label.
+- :func:`counts`/:func:`total` read the per-label miss counters;
+  :func:`reset` zeroes them (e.g. after warmup).
+- :func:`budget` is the assertion chokepoint: a ``with`` block that
+  raises :class:`RecompileError` when more than ``max_new`` compiles land
+  inside it. Steady-state serving/decode tests run their warmed loop
+  under ``budget(0)`` — every soak doubles as a recompile detector — and
+  the fused-window tests pin the ``log2(K)+1`` variant bound that
+  ``ServingEngine._fused_window``'s pow2 bucketing promises
+  (doc/design/shard-contract.md).
+
+Cache misses are read from the jitted function's ``_cache_size()`` probe
+when the JAX version exposes it; otherwise the proxy falls back to
+counting distinct abstract call signatures (shape/dtype of array leaves +
+values of hashable scalars), which is exactly the jit cache key modulo
+sharding. Flag registry row: ``common/envflags.py``; catalogued in
+``doc/design/flags.md``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Dict, Optional
+
+from hivedscheduler_tpu.common import lockcheck
+
+# leaf lock: counter updates only — nothing is ever acquired under it
+_lock = lockcheck.make_lock("compileguard_lock", late=True)
+_counts: Dict[str, int] = {}
+
+
+class RecompileError(RuntimeError):
+    """A compile-budget violation: more jit cache misses inside a
+    :func:`budget` block than the caller declared legal."""
+
+
+def enabled() -> bool:
+    return os.environ.get("HIVED_COMPILE_GUARD", "") == "1"
+
+
+def jit(fun, *, guard_label: Optional[str] = None, **jit_kwargs):
+    """``jax.jit`` with compile accounting. ``guard_label`` names the
+    entry point in :func:`counts` (defaults to the function's __name__);
+    all other kwargs pass through to ``jax.jit``. Like the lockcheck
+    factories, the env var is honored at WRAP time: construct engines
+    after setting ``HIVED_COMPILE_GUARD=1`` (the tests' monkeypatch
+    pattern) — flipping it later does not retrofit existing wrappers."""
+    import jax
+
+    jitted = jax.jit(fun, **jit_kwargs)
+    if not enabled():
+        return jitted
+    label = guard_label or getattr(fun, "__name__", "<jit>")
+    return _CountingJit(jitted, label)
+
+
+class _CountingJit:
+    """Counting proxy over a jitted callable: attributes every cache miss
+    to its label, delegates everything else to the wrapped function."""
+
+    def __init__(self, inner, label: str):
+        self._inner = inner
+        self._label = label
+        self._sigs: set = set()  # fallback signature cache
+
+    def _misses_around(self, args, kwargs):
+        probe = getattr(self._inner, "_cache_size", None)
+        if probe is not None:
+            before = probe()
+            return lambda: probe() - before
+        # pre-vma JAX without the probe: distinct abstract signatures.
+        # Computed BEFORE the call — donated buffers are dead after it.
+        sig = _signature(args, kwargs)
+        fresh = sig not in self._sigs
+
+        def delta():
+            if fresh:
+                self._sigs.add(sig)
+                return 1
+            return 0
+
+        return delta
+
+    def __call__(self, *args, **kwargs):
+        delta = self._misses_around(args, kwargs)
+        out = self._inner(*args, **kwargs)
+        new = delta()
+        if new:
+            with _lock:
+                _counts[self._label] = _counts.get(self._label, 0) + new
+        return out
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"<compileguard {self._label!r} wrapping {self._inner!r}>"
+
+
+def _signature(args, kwargs):
+    import jax
+
+    def leaf_key(leaf):
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            return ("arr", tuple(shape), str(getattr(leaf, "dtype", "?")))
+        try:
+            hash(leaf)
+        except TypeError:
+            return ("obj", type(leaf).__name__)
+        return ("val", leaf)
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (str(treedef),) + tuple(leaf_key(x) for x in leaves)
+
+
+def counts() -> Dict[str, int]:
+    """Per-label jit cache-miss counters since the last :func:`reset`."""
+    with _lock:
+        return dict(_counts)
+
+
+def total() -> int:
+    with _lock:
+        return sum(_counts.values())
+
+
+def reset() -> None:
+    """Zero the counters (the warmup/steady-state boundary)."""
+    with _lock:
+        _counts.clear()
+
+
+@contextlib.contextmanager
+def budget(max_new: int = 0, label: Optional[str] = None):
+    """Assert at most ``max_new`` compiles (for ``label``, or in total)
+    happen inside the block. No-op unless the guard is enabled — safe to
+    leave in production test paths."""
+    if not enabled():
+        yield
+        return
+    before = counts()
+    yield
+    after = counts()
+    if label is not None:
+        new = after.get(label, 0) - before.get(label, 0)
+        what = f"entry point {label!r}"
+    else:
+        new = sum(after.values()) - sum(before.values())
+        what = "all guarded entry points"
+    if new > max_new:
+        grew = {
+            k: after.get(k, 0) - before.get(k, 0)
+            for k in after
+            if after.get(k, 0) > before.get(k, 0)
+        }
+        raise RecompileError(
+            f"compile budget exceeded: {new} jit cache miss(es) for {what} "
+            f"inside a budget({max_new}) block — per-label growth {grew}; "
+            f"a steady-state loop must not recompile "
+            f"(doc/design/shard-contract.md)"
+        )

@@ -1,0 +1,203 @@
+"""The ``HIVED_*`` environment-flag registry — single source of truth.
+
+Every environment flag the package (or its test/bench harnesses) reads is
+declared here as a :class:`Flag` row: name, default, one-line doc, and the
+module that owns the read. Two hivedlint rules key off this table
+(``tools/hivedlint/shardlint.py``):
+
+- **ENV001** — any ``HIVED_*`` token appearing in package code or
+  docstrings must be a registered flag (or a registered-family prefix such
+  as ``HIVED_FAULT_``). An unregistered read — or a docstring advertising a
+  flag that does not exist — fails lint instead of rotting silently.
+- **ENV002** — every registered flag must actually be read somewhere in
+  the tree (package, tests, tools, or the repo-root bench/driver scripts).
+  A flag whose last reader was deleted fails lint until the row is dropped.
+
+The registry also renders ``doc/design/flags.md``
+(:func:`render_markdown`); a guard test pins the file to the render, so
+the human catalogue cannot drift from the machine-checked table::
+
+    python -m hivedscheduler_tpu.common.envflags --write   # regenerate
+
+Flags follow the package's conventions: tri-state gates read ``""`` as
+auto, ``"0"`` as force-off, ``"1"`` as force-on; boolean opt-ins treat
+exactly ``"1"`` as enabled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    name: str
+    default: str       # effective value when unset (rendered verbatim)
+    doc: str           # one line; shows in doc/design/flags.md
+    module: str        # repo-relative owning module (the canonical reader)
+
+
+def _f(name: str, default: str, doc: str, module: str) -> Flag:
+    return Flag(name, default, doc, module)
+
+
+REGISTRY: Dict[str, Flag] = {f.name: f for f in [
+    # -- model / parallel layer -------------------------------------------
+    _f("HIVED_OVERLAP", "auto",
+       "Collective-matmul tensor parallelism gate: `0` forces the GSPMD "
+       "reference path (the differential-parity contract); unset/`1` = on "
+       "whenever `overlap_applicable` holds.",
+       "hivedscheduler_tpu/models/transformer.py"),
+    _f("HIVED_PAGED_KV", "1",
+       "`0` forces the dense ragged KV cache — the differential reference "
+       "for the paged block-pool path.",
+       "hivedscheduler_tpu/models/serving.py"),
+    # -- scheduler core ---------------------------------------------------
+    _f("HIVED_NATIVE", "auto",
+       "C++ placement fast path: `0` forces pure Python, `1` requires the "
+       "native library (build failure raises instead of degrading).",
+       "hivedscheduler_tpu/native/__init__.py"),
+    _f("HIVED_NATIVE_SANITIZE", "0",
+       "`1` builds the native library with ASan/UBSan into separate "
+       "`*.asan.so` caches (see doc/design/concurrency.md).",
+       "hivedscheduler_tpu/native/__init__.py"),
+    _f("HIVED_INCR", "1",
+       "`0` forces the rebuild-per-call cluster-view reference path "
+       "instead of the incremental dirty-tracked views.",
+       "hivedscheduler_tpu/algorithm/topology_aware.py"),
+    _f("HIVED_DIRECT", "1",
+       "`0` disables the direct single-chain packing shortcut (escape "
+       "hatch; the differential tests pin the two paths equal).",
+       "hivedscheduler_tpu/algorithm/topology_aware.py"),
+    _f("HIVED_RELAX_CACHE", "1",
+       "`0` disables the multi-chain-relax infeasibility cache (waiting "
+       "gangs then re-probe every cycle).",
+       "hivedscheduler_tpu/algorithm/hived.py"),
+    _f("HIVED_GC_FREEZE", "1",
+       "`0` opts out of gc.freeze() after scheduler warmup (the scheduler "
+       "then pays the gen-2 collection cost).",
+       "hivedscheduler_tpu/runtime/utils.py"),
+    # -- sanitizers (opt-in, each wired into tier-1 by its own tests) -----
+    _f("HIVED_LOCKCHECK", "0",
+       "`1` swaps registry locks to CheckedLock: per-thread lock-order "
+       "assertions + the algorithm single-threaded contract "
+       "(doc/design/concurrency.md).",
+       "hivedscheduler_tpu/common/lockcheck.py"),
+    _f("HIVED_COMPILE_GUARD", "0",
+       "`1` counts jit cache misses per labelled entry point "
+       "(common/compileguard.py): steady-state serving/decode tests "
+       "assert zero recompiles and the fused-window log2(K)+1 bound.",
+       "hivedscheduler_tpu/common/compileguard.py"),
+    # -- observability ----------------------------------------------------
+    _f("HIVED_TRACE", "0",
+       "`1` enables the span tracer at import time (ad-hoc runs; "
+       "programmatic `trace.enable()` otherwise).",
+       "hivedscheduler_tpu/obs/trace.py"),
+    # -- chaos fault hooks (one-shot per process; unset = unarmed) --------
+    _f("HIVED_FAULT_HANG_AT", "unarmed",
+       "Wedge the workload at this step index (watchdog-ladder chaos "
+       "hook; fires at most once per process).",
+       "hivedscheduler_tpu/parallel/supervisor.py"),
+    _f("HIVED_FAULT_NAN_AT", "unarmed",
+       "Poison the loss with NaN at this step index (on-nan ladder hook).",
+       "hivedscheduler_tpu/parallel/supervisor.py"),
+    _f("HIVED_FAULT_SERVE_PREEMPT_AT", "unarmed",
+       "Trigger the serving drain path deterministically at this engine "
+       "step.",
+       "hivedscheduler_tpu/parallel/supervisor.py"),
+    _f("HIVED_FAULT_STEP_DELAY", "0.0",
+       "Pad every workload step by this many seconds so the chaos harness "
+       "can land signals at deterministic step windows.",
+       "hivedscheduler_tpu/parallel/supervisor.py"),
+    # -- test / bench harness (outside the package) -----------------------
+    _f("HIVED_TEST_TPU", "0",
+       "`1` lets the test session touch the real (single-grant) TPU "
+       "backend; default pins tests to the 8-device CPU mesh.",
+       "tests/conftest.py"),
+    _f("HIVED_ULYSSES_TRAIN_TEST", "0",
+       "`1` opts in the standalone ulysses full-train-step test (XLA:CPU "
+       "collective rendezvous can trip on the 1-core dev box).",
+       "tests/test_parallel.py"),
+    _f("HIVED_TPU_ACQUIRE_TIMEOUT_S", "240",
+       "Bounded-acquisition budget for the safe TPU backend dial "
+       "(`bench_model.acquire_backend`; sweep_mfu raises it to 600).",
+       "bench_model.py"),
+    _f("HIVED_DRYRUN_CHILD", "0",
+       "Internal recursion guard for the driver entry dry-run "
+       "(`__graft_entry__.py` re-execs itself once with this set).",
+       "__graft_entry__.py"),
+]}
+
+
+def get(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Registered-flag environment read. Raises ``KeyError`` for a name
+    not in :data:`REGISTRY` — new flags must add a row first (that row is
+    what ENV001/ENV002 and doc/design/flags.md key off)."""
+    if name not in REGISTRY:
+        raise KeyError(
+            f"{name!r} is not a registered HIVED flag — add it to "
+            f"common/envflags.py REGISTRY (ENV001)")
+    return os.environ.get(name, default)
+
+
+# ---------------------------------------------------------------------------
+# doc/design/flags.md renderer
+# ---------------------------------------------------------------------------
+
+_HEADER = """\
+# HIVED_* environment flags
+
+<!-- GENERATED from hivedscheduler_tpu/common/envflags.py — do not edit.
+     Regenerate: python -m hivedscheduler_tpu.common.envflags --write -->
+
+Machine-checked catalogue of every environment flag the tree reads. The
+registry in `common/envflags.py` is the source of truth: hivedlint's
+ENV001 fails on any unregistered `HIVED_*` token in the package, ENV002
+fails on a registered flag nothing reads, and a guard test pins this file
+to the registry render — so this page cannot rot. See
+[shard-contract.md](shard-contract.md) for the lint rule family and
+[concurrency.md](concurrency.md) for the sanitizer flags' semantics.
+
+| Flag | Default | Owner | Meaning |
+|---|---|---|---|
+"""
+
+
+def render_markdown() -> str:
+    rows = []
+    for flag in sorted(REGISTRY.values(), key=lambda f: f.name):
+        rows.append(
+            f"| `{flag.name}` | `{flag.default}` | `{flag.module}` "
+            f"| {flag.doc} |"
+        )
+    return _HEADER + "\n".join(rows) + "\n"
+
+
+def flags_md_path(root: str) -> str:
+    return os.path.join(root, "doc", "design", "flags.md")
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--write", action="store_true",
+                   help="rewrite doc/design/flags.md from the registry")
+    args = p.parse_args(argv)
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(os.path.dirname(here))
+    path = flags_md_path(root)
+    text = render_markdown()
+    if args.write:
+        with open(path, "w") as f:
+            f.write(text)
+        print(f"wrote {path}")
+    else:
+        print(text, end="")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

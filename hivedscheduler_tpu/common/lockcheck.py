@@ -24,11 +24,14 @@ and documented in ``doc/design/concurrency.md``):
 
 The sanitizer is wired into the chaos soaks (tests/test_hivedlint.py), so
 every soak doubles as a race/deadlock detector. Overhead when disabled is
-one env read per lock *creation* — acquire/release stay native. Module-level
-singletons (metrics REGISTRY, obs TRACER/RECORDER) only get checked locks
-when ``HIVED_LOCKCHECK=1`` is set before first import; the per-instance
-locks (scheduler/algorithm/store/watchdog) honor the env var at
-construction time, which is what the soaks exercise.
+one env read per lock *creation* — acquire/release stay native — except
+for the module-level singleton locks (metrics REGISTRY, obs
+TRACER/RECORDER, compileguard counters), which are created with
+``late=True``: they return a :class:`SwitchableLock` that re-reads the
+env var per acquisition, so enabling ``HIVED_LOCKCHECK=1`` *after* first
+import still puts them under the sanitizer (the ISSUE 7 gap). The
+per-instance locks (scheduler/algorithm/store/watchdog) honor the env var
+at construction time, which is what the soaks exercise.
 """
 
 from __future__ import annotations
@@ -61,6 +64,8 @@ LOCK_HIERARCHY: Dict[str, int] = {
     "metrics_lock": 80,
     "trace_lock": 82,
     "decisions_lock": 84,
+    # common/compileguard.py — jit cache-miss counters. LEAF.
+    "compileguard_lock": 86,
 }
 
 # Which file may create each lock (repo-relative); consumed by hivedlint's
@@ -74,6 +79,7 @@ LOCK_SITES: Dict[str, str] = {
     "metrics_lock": "hivedscheduler_tpu/runtime/metrics.py",
     "trace_lock": "hivedscheduler_tpu/obs/trace.py",
     "decisions_lock": "hivedscheduler_tpu/obs/decisions.py",
+    "compileguard_lock": "hivedscheduler_tpu/common/compileguard.py",
 }
 
 # Files allowed to spawn threads (hivedlint's thread-spawn rule). Every
@@ -187,7 +193,77 @@ class CheckedLock:
         return f"<CheckedLock {self.name!r} level={self.level} {self._inner!r}>"
 
 
-def _make(name: str, factory):
+class SwitchableLock:
+    """Late-enabling wrapper for module-level singleton locks.
+
+    A singleton created at first import froze the sanitizer decision
+    before any test could set the env var (the ISSUE 7 "NOT done" gap).
+    This proxy re-reads ``HIVED_LOCKCHECK`` on every acquisition: when
+    enabled it routes through a lazily-built :class:`CheckedLock` over the
+    SAME underlying lock (so waiters on either path contend correctly);
+    when disabled it acquires the raw lock. Each successful acquisition
+    records which path it took so a release always pairs with its acquire
+    even if the env var flips mid-hold. Singleton locks are leaves in
+    :data:`LOCK_HIERARCHY`, so the extra env read per acquire is off every
+    scheduling hot path."""
+
+    __slots__ = ("name", "_inner", "_checked", "_modes")
+
+    def __init__(self, name: str, inner):
+        if name not in LOCK_HIERARCHY:
+            raise LockOrderError(
+                f"lock name {name!r} is not in LOCK_HIERARCHY — register it "
+                f"(and its creating file in LOCK_SITES) before use"
+            )
+        self.name = name
+        self._inner = inner
+        self._checked: Optional[CheckedLock] = None
+        self._modes: List = []  # acquisition path stack (GIL-guarded)
+
+    def _target(self):
+        if not enabled():
+            return self._inner
+        if self._checked is None:
+            self._checked = CheckedLock(
+                self.name, LOCK_HIERARCHY[self.name], self._inner)
+        return self._checked
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        tgt = self._target()
+        ok = tgt.acquire(blocking, timeout)
+        if ok:
+            self._modes.append(tgt)
+        return ok
+
+    def release(self) -> None:
+        tgt = self._modes.pop() if self._modes else self._target()
+        tgt.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def _is_owned(self) -> bool:
+        inner_probe = getattr(self._inner, "_is_owned", None)
+        if inner_probe is not None:
+            return inner_probe()
+        return any(
+            isinstance(m, CheckedLock) and m._is_owned() for m in self._modes
+        ) or bool(self._modes)
+
+    def __repr__(self) -> str:
+        return (f"<SwitchableLock {self.name!r} "
+                f"checked={self._checked is not None} {self._inner!r}>")
+
+
+def _make(name: str, factory, late: bool):
+    if late:
+        return SwitchableLock(name, factory())
     if not enabled():
         return factory()
     if name not in LOCK_HIERARCHY:
@@ -198,16 +274,19 @@ def _make(name: str, factory):
     return CheckedLock(name, LOCK_HIERARCHY[name], factory())
 
 
-def make_lock(name: str):
+def make_lock(name: str, late: bool = False):
     """A ``threading.Lock`` registered as ``name`` (checked under
-    ``HIVED_LOCKCHECK=1``, plain otherwise)."""
-    return _make(name, threading.Lock)
+    ``HIVED_LOCKCHECK=1``, plain otherwise). ``late=True`` — for
+    module-level singletons — returns a :class:`SwitchableLock` honoring
+    the env var per acquisition instead of at creation."""
+    return _make(name, threading.Lock, late)
 
 
-def make_rlock(name: str):
+def make_rlock(name: str, late: bool = False):
     """A ``threading.RLock`` registered as ``name`` (checked under
-    ``HIVED_LOCKCHECK=1``, plain otherwise)."""
-    return _make(name, threading.RLock)
+    ``HIVED_LOCKCHECK=1``, plain otherwise). ``late=True`` as in
+    :func:`make_lock`."""
+    return _make(name, threading.RLock, late)
 
 
 def held(name: str) -> bool:

@@ -347,5 +347,10 @@ def make_sharded_generate(
         temperature=temperature, top_k=top_k, top_p=top_p,
         decode_steps=decode_steps,
     )
-    jitted = jax.jit(lambda params, prompt, key=None: run(params, prompt, key=key))
+    from hivedscheduler_tpu.common import compileguard
+
+    jitted = compileguard.jit(
+        lambda params, prompt, key=None: run(params, prompt, key=key),
+        guard_label="decode.generate",
+    )
     return jitted, param_shardings, prompt_sharding

@@ -69,6 +69,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from hivedscheduler_tpu.common import compileguard
 from hivedscheduler_tpu.models.decode import (
     dense_mlp,
     embed_tokens,
@@ -709,7 +710,7 @@ class ServingEngine:
                 lambda r, c: _stream_key(base_key, r, c))(rids, counts)
             return jax.vmap(jax.random.categorical)(keys, filtered)
 
-        self._sample = jax.jit(sample_rows)
+        self._sample = compileguard.jit(sample_rows, guard_label="serve.sample")
         self.kv_dtype = kv_dtype
         # -- paged KV cache state (host-side allocator; see class docstring)
         self.page_size = max(0, page_size)
@@ -822,9 +823,11 @@ class ServingEngine:
                                            start=start)
             return logits[0], cache
 
-        self._decode = jax.jit(decode_step, donate_argnums=(1,))
+        self._decode = compileguard.jit(
+            decode_step, guard_label="serve.decode", donate_argnums=(1,))
         # one compile per prompt bucket (tokens' S is static per call shape)
-        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+        self._prefill = compileguard.jit(
+            prefill, guard_label="serve.prefill", donate_argnums=(1,))
 
         if decode_steps < 1:
             raise ValueError(f"decode_steps must be >= 1, got {decode_steps}")
@@ -863,8 +866,9 @@ class ServingEngine:
 
         # one compile per distinct window size (bounded by _fused_window's
         # power-of-two bucketing)
-        self._decode_multi = jax.jit(decode_multi, static_argnums=(5,),
-                                     donate_argnums=(1,))
+        self._decode_multi = compileguard.jit(
+            decode_multi, guard_label="serve.decode_multi",
+            static_argnums=(5,), donate_argnums=(1,))
 
         # -- paged twins of the three programs (block table + host lengths
         # travel as arguments; the pool is donated like the dense cache) ---
@@ -931,12 +935,19 @@ class ServingEngine:
                     upd["v_scale"] = cp(pool.v_scale)
                 return pool._replace(**upd)
 
-            self._paged_decode = jax.jit(paged_decode, donate_argnums=(1,))
-            self._paged_prefill = jax.jit(paged_prefill, donate_argnums=(1,))
-            self._paged_decode_multi = jax.jit(
-                paged_decode_multi, static_argnums=(7,), donate_argnums=(1,)
+            self._paged_decode = compileguard.jit(
+                paged_decode, guard_label="serve.paged_decode",
+                donate_argnums=(1,))
+            self._paged_prefill = compileguard.jit(
+                paged_prefill, guard_label="serve.paged_prefill",
+                donate_argnums=(1,))
+            self._paged_decode_multi = compileguard.jit(
+                paged_decode_multi, guard_label="serve.paged_decode_multi",
+                static_argnums=(7,), donate_argnums=(1,)
             )
-            self._copy_block = jax.jit(copy_block, donate_argnums=(0,))
+            self._copy_block = compileguard.jit(
+                copy_block, guard_label="serve.copy_block",
+                donate_argnums=(0,))
 
         # -- prompt prefix cache (LRU over device-resident KV rows) --------
         from collections import OrderedDict
@@ -983,8 +994,12 @@ class ServingEngine:
                 return k, v, ks, vs
             return k, v
 
-        self._restore_prefix = jax.jit(restore_prefix, donate_argnums=(0,))
-        self._extract_prefix = jax.jit(extract_prefix, static_argnums=(2,))
+        self._restore_prefix = compileguard.jit(
+            restore_prefix, guard_label="serve.restore_prefix",
+            donate_argnums=(0,))
+        self._extract_prefix = compileguard.jit(
+            extract_prefix, guard_label="serve.extract_prefix",
+            static_argnums=(2,))
 
     def _cache_shardings(self, kv_sh, len_sh):
         """Sharding pytree matching this engine's cache structure. The
@@ -1897,12 +1912,16 @@ class SpeculativeServingEngine(ServingEngine):
 
             return spec_round
 
-        self._draft_prefill = jax.jit(draft_prefill, donate_argnums=(1,))
-        self._spec_round = jax.jit(make_spec_round(False),
-                                   donate_argnums=(2, 3))
+        self._draft_prefill = compileguard.jit(
+            draft_prefill, guard_label="serve.draft_prefill",
+            donate_argnums=(1,))
+        self._spec_round = compileguard.jit(
+            make_spec_round(False), guard_label="serve.spec_round",
+            donate_argnums=(2, 3))
         if self.paged:
-            self._spec_round_paged = jax.jit(make_spec_round(True),
-                                             donate_argnums=(2, 3))
+            self._spec_round_paged = compileguard.jit(
+                make_spec_round(True), guard_label="serve.spec_round_paged",
+                donate_argnums=(2, 3))
 
         if self.temperature > 0.0:
             temp, topk, topp = self.temperature, self.top_k, self.top_p
@@ -2005,8 +2024,9 @@ class SpeculativeServingEngine(ServingEngine):
                 )
                 return tcache, dcache, emit, acc
 
-            self._spec_round_sampled = jax.jit(
-                spec_round_sampled, donate_argnums=(2, 3)
+            self._spec_round_sampled = compileguard.jit(
+                spec_round_sampled, guard_label="serve.spec_round_sampled",
+                donate_argnums=(2, 3)
             )
 
     def _park(self, slot: int) -> None:

@@ -322,4 +322,7 @@ def make_sharded_speculative(
             max_new_tokens, key=key,
         )
 
-    return jax.jit(wrapped), target_shardings, draft_shardings, prompt_sharding
+    from hivedscheduler_tpu.common import compileguard
+
+    return (compileguard.jit(wrapped, guard_label="speculative.generate"),
+            target_shardings, draft_shardings, prompt_sharding)

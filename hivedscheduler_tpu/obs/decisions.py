@@ -117,7 +117,7 @@ class DecisionRecorder:
     """Bounded ring of the last N decisions + optional commit callback."""
 
     def __init__(self, capacity: int = _DEFAULT_CAPACITY):
-        self._lock = lockcheck.make_lock("decisions_lock")
+        self._lock = lockcheck.make_lock("decisions_lock", late=True)
         self._ring: deque = deque(maxlen=capacity)
         self.enabled = False
         self.on_commit: Optional[Callable[[Decision], None]] = None

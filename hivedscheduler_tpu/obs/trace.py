@@ -57,7 +57,7 @@ class Tracer:
     module-level singleton ``TRACER`` is what the stack shares."""
 
     def __init__(self, capacity: int = _DEFAULT_CAPACITY):
-        self._lock = lockcheck.make_lock("trace_lock")
+        self._lock = lockcheck.make_lock("trace_lock", late=True)
         self._events: deque = deque(maxlen=capacity)
         # perf_counter anchor: all ts are relative to tracer creation so
         # callers' own perf_counter timestamps convert with one subtraction

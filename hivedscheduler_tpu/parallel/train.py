@@ -19,6 +19,7 @@ import optax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from hivedscheduler_tpu.common import compileguard
 from hivedscheduler_tpu.models import transformer as tm
 
 
@@ -247,7 +248,8 @@ def make_sharded_train_step(
                 lambda n, o: jnp.where(ok, n, o), new_opt, opt_state)
         return new_params, new_opt, loss
 
-    jitted = jax.jit(step, donate_argnums=(0, 1))
+    jitted = compileguard.jit(
+        step, guard_label="train.step", donate_argnums=(0, 1))
     return jitted, init_fn, token_sharding
 
 
@@ -269,7 +271,8 @@ def make_sharded_eval_step(cfg: tm.TransformerConfig, mesh, ce_chunk: int = 0):
         return loss_fn(params, tokens, cfg, mesh, ce_chunk=ce_chunk,
                        include_aux=False)
 
-    return jax.jit(eval_step), init_fn, token_sharding
+    return (compileguard.jit(eval_step, guard_label="train.eval_step"),
+            init_fn, token_sharding)
 
 
 def make_sharded_lora_train_step(
@@ -318,5 +321,6 @@ def make_sharded_lora_train_step(
         lora = optax.apply_updates(lora, updates)
         return lora, opt_state, loss
 
-    jitted = jax.jit(step, donate_argnums=(1, 2))
+    jitted = compileguard.jit(
+        step, guard_label="train.lora_step", donate_argnums=(1, 2))
     return jitted, init_fn, token_sharding

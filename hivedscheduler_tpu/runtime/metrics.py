@@ -18,7 +18,7 @@ _LATENCY_BUCKETS = [0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5
 
 class Registry:
     def __init__(self):
-        self._lock = lockcheck.make_lock("metrics_lock")
+        self._lock = lockcheck.make_lock("metrics_lock", late=True)
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._gauges: Dict[str, float] = {}
         # (name, labels) -> (bucket counts, sum, count)

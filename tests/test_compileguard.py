@@ -1,0 +1,180 @@
+"""HIVED_COMPILE_GUARD runtime recompile sanitizer (ISSUE 8).
+
+The two load-bearing bounds, measured instead of promised:
+- a fused-window serving engine (``decode_steps=K``) compiles at most
+  ``log2(K) + 1`` distinct ``serve.decode_multi`` programs (the PR 5
+  pow2-bucketing claim);
+- a warmed engine re-running an identical workload compiles ZERO new
+  programs across every guarded entry point — every steady-state
+  serving/decode loop is a recompile detector under the guard.
+
+Everything runs on the CPU backend with tiny models; the guard itself
+(``common/compileguard.py``) is env-gated at wrap time, so engines are
+constructed after the monkeypatch sets the flag."""
+
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from hivedscheduler_tpu.common import compileguard  # noqa: E402
+from hivedscheduler_tpu.models import decode, serving, transformer as tm  # noqa: E402
+
+
+def cfg_of(**kw):
+    base = dict(vocab_size=64, d_model=32, n_heads=2, n_kv_heads=2,
+                n_layers=1, d_ff=64, max_seq_len=64, dtype=jnp.float32)
+    base.update(kw)
+    return tm.TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = cfg_of()
+    params = tm.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture()
+def guard(monkeypatch):
+    monkeypatch.setenv("HIVED_COMPILE_GUARD", "1")
+    compileguard.reset()
+    yield
+    compileguard.reset()
+
+
+# ---------------------------------------------------------------------------
+# unit behavior
+# ---------------------------------------------------------------------------
+
+def test_disabled_returns_raw_jit(monkeypatch):
+    monkeypatch.delenv("HIVED_COMPILE_GUARD", raising=False)
+    f = compileguard.jit(lambda x: x + 1)
+    assert not isinstance(f, compileguard._CountingJit)
+    assert not compileguard.enabled()
+
+
+def test_counts_per_label_and_budget(guard):
+    f = compileguard.jit(lambda x: x * 2, guard_label="t.double")
+    f(jnp.ones(3))
+    assert compileguard.counts() == {"t.double": 1}
+    f(jnp.ones(3))  # cache hit
+    assert compileguard.counts() == {"t.double": 1}
+    f(jnp.ones(4))  # new shape -> new program
+    assert compileguard.counts() == {"t.double": 2}
+    assert compileguard.total() == 2
+
+    with compileguard.budget(0):
+        f(jnp.ones(4))  # warm: fine
+    with pytest.raises(compileguard.RecompileError,
+                       match="compile budget exceeded"):
+        with compileguard.budget(0):
+            f(jnp.ones(5))
+    with compileguard.budget(1, label="t.double"):
+        f(jnp.ones(6))
+    compileguard.reset()
+    assert compileguard.counts() == {}
+
+
+def test_budget_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv("HIVED_COMPILE_GUARD", raising=False)
+    with compileguard.budget(0):
+        pass  # must not raise or probe anything
+
+
+def test_static_args_count_as_variants(guard):
+    f = compileguard.jit(lambda x, k: x[:k], guard_label="t.slice",
+                        static_argnums=(1,))
+    f(jnp.arange(8), 2)
+    f(jnp.arange(8), 4)
+    f(jnp.arange(8), 2)
+    assert compileguard.counts()["t.slice"] == 2
+
+
+# ---------------------------------------------------------------------------
+# the fused-window bound: log2(K) + 1 decode_multi programs
+# ---------------------------------------------------------------------------
+
+def test_fused_window_compile_bound(guard, setup):
+    cfg, params = setup
+    K = 8
+    eng = serving.ServingEngine(params, cfg, max_batch=1, max_len=64,
+                                decode_steps=K, seed=3)
+    eng.submit([5, 9, 2], 15)  # windows 8 -> 4 -> 2 -> 1
+    eng.run_until_drained()
+    c = compileguard.counts()
+    bound = int(math.log2(K)) + 1
+    assert 2 <= c.get("serve.decode_multi", 0) <= bound, c
+    assert eng.fused_windows >= 3
+    assert c.get("serve.prefill", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# steady state: a warmed engine compiles nothing
+# ---------------------------------------------------------------------------
+
+PROMPTS = [[5, 9, 2], [17, 3, 8], [1, 4, 7], [11, 2, 6]]
+BUDGETS = [8, 8, 8, 8]
+
+
+def _run_workload(eng):
+    reqs = [eng.submit(list(p), n) for p, n in zip(PROMPTS, BUDGETS)]
+    eng.run_until_drained()
+    assert all(r.done for r in reqs)
+    return [r.tokens_out for r in reqs]
+
+
+def test_serving_steady_state_zero_recompiles(guard, setup):
+    cfg, params = setup
+    eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                decode_steps=4, seed=7)
+    first = _run_workload(eng)  # warmup: compiles prefill/decode variants
+    assert compileguard.total() > 0
+    compileguard.reset()
+    with compileguard.budget(0):
+        second = _run_workload(eng)  # identical workload: fully warmed
+    assert second == first  # same slots, same greedy streams
+    assert compileguard.counts() == {}
+
+
+@pytest.mark.slow  # tier-1 wall-time budget: the dense steady-state cousin stays tier-1
+def test_paged_engine_steady_state_zero_recompiles(guard, setup):
+    cfg, params = setup
+    eng = serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                decode_steps=4, seed=7, page_size=8)
+    first = _run_workload(eng)
+    compileguard.reset()
+    with compileguard.budget(0):
+        second = _run_workload(eng)
+    assert second == first
+
+
+def test_decode_generate_steady_state(guard):
+    """The batch-decode entry point: the second identical call runs the
+    cached program (zero compiles) on the dp=2 x tp=2 CPU mesh."""
+    from hivedscheduler_tpu.parallel import topology
+
+    cfg = cfg_of()
+    params = tm.init_params(cfg, jax.random.PRNGKey(1))
+    axes = topology.MeshAxes(dp=2, tp=2)
+    mesh = topology.make_mesh(axes, topology.get_devices(axes.size))
+    run, param_sh, prompt_sh = decode.make_sharded_generate(
+        cfg, mesh, max_new_tokens=4)
+    sharded_params = jax.device_put(params, param_sh)
+    prompt = jax.device_put(
+        jnp.asarray(np.tile([[3, 1, 4]], (2, 1)), jnp.int32), prompt_sh)
+    out1 = run(sharded_params, prompt)
+    assert compileguard.counts().get("decode.generate") == 1
+    with compileguard.budget(0):
+        out2 = run(sharded_params, prompt)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))

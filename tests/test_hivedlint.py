@@ -288,6 +288,49 @@ def test_grd001_fragment_extraction():
         "chain (relaxed) rejected"]
 
 
+def test_grd001_short_fragments_checked(tmp_path):
+    """min_len dropped to 4 (ISSUE 8): a 4-char reworded fragment now
+    fails instead of passing under the old 8-char floor."""
+    _write(tmp_path, "pkg/mod.py", """
+        def f(n):
+            raise ValueError(f"need pow2 got {n} here")
+        """)
+    _write(tmp_path, "tests/test_mod.py", """
+        import pytest
+        def test_guard():
+            with pytest.raises(ValueError, match=r"got \\d+ here"):
+                pass
+            with pytest.raises(ValueError, match=r"pow3"):
+                pass
+        """)
+    got = blindspots.check_guard_drift(
+        str(tmp_path / "pkg"), str(tmp_path / "tests"))
+    assert [f.rule for f in got] == ["GRD001"]
+    assert "'pow3'" in got[0].message
+
+
+def test_grd001_pure_regex_guard_not_vacuous(tmp_path):
+    """A match pattern with no literal fragment >=4 chars used to vouch
+    for nothing; it must now re.search-match some package literal."""
+    _write(tmp_path, "pkg/mod.py", """
+        def f(n):
+            raise ValueError(f"rank {n} oob")
+        """)
+    _write(tmp_path, "tests/test_mod.py", """
+        import pytest
+        def test_guard():
+            with pytest.raises(ValueError, match=r"\\d+ oob"):
+                pass
+            with pytest.raises(ValueError, match=r"x\\d+y"):
+                pass
+        """)
+    got = blindspots.check_guard_drift(
+        str(tmp_path / "pkg"), str(tmp_path / "tests"))
+    assert [f.rule for f in got] == ["GRD001"]
+    assert "pure-regex" in got[0].message
+    assert "matches no package" in got[0].message
+
+
 def test_grd001_reworded_message_flagged(tmp_path):
     _write(tmp_path, "pkg/mod.py", """
         def f():
@@ -434,6 +477,56 @@ def test_lockcheck_assert_serialized_contract(monkeypatch):
         lockcheck.assert_serialized(algo)
     with sched:
         lockcheck.assert_serialized(algo)
+
+
+def test_lockcheck_late_enable_switchable(monkeypatch):
+    """ISSUE 8 satellite (PR 7's "NOT done" gap): a late=True singleton
+    lock honors HIVED_LOCKCHECK enabled AFTER creation."""
+    monkeypatch.delenv("HIVED_LOCKCHECK", raising=False)
+    lk = lockcheck.make_lock("metrics_lock", late=True)
+    assert isinstance(lk, lockcheck.SwitchableLock)
+    with lk:
+        pass  # plain path while disabled
+    monkeypatch.setenv("HIVED_LOCKCHECK", "1")
+    sched = lockcheck.make_rlock("scheduler_lock")
+    with pytest.raises(lockcheck.LockOrderError, match="lock-order violation"):
+        with lk:        # leaf level 80
+            with sched:  # level 10 under 80: inversion
+                pass
+    with sched:
+        with lk:  # legal order still fine
+            pass
+
+
+def test_lockcheck_late_enable_covers_import_time_singletons(monkeypatch):
+    """The REAL metrics REGISTRY singleton — imported long before the env
+    var is set — still comes under the sanitizer."""
+    monkeypatch.delenv("HIVED_LOCKCHECK", raising=False)
+    from hivedscheduler_tpu.runtime.metrics import REGISTRY
+
+    assert isinstance(REGISTRY._lock, lockcheck.SwitchableLock)
+    monkeypatch.setenv("HIVED_LOCKCHECK", "1")
+    sched = lockcheck.make_rlock("scheduler_lock")
+    with pytest.raises(lockcheck.LockOrderError, match="lock-order violation"):
+        with REGISTRY._lock:
+            with sched:
+                pass
+    with sched:  # the routine scheduler(10) -> metrics(80) chain
+        with REGISTRY._lock:
+            pass
+
+
+def test_lockcheck_late_flip_mid_hold(monkeypatch):
+    """Enabling the sanitizer while a switchable lock is held must pair
+    the release with its (plain) acquire instead of raising."""
+    monkeypatch.delenv("HIVED_LOCKCHECK", raising=False)
+    lk = lockcheck.make_lock("trace_lock", late=True)
+    assert lk.acquire()
+    monkeypatch.setenv("HIVED_LOCKCHECK", "1")
+    lk.release()  # paired with the plain-path acquire
+    with lk:  # checked from here on
+        assert lk._is_owned()
+    assert not lk.locked()
 
 
 def test_lockcheck_chaos_soak_smoke(monkeypatch):

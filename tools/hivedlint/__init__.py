@@ -1,63 +1,40 @@
 """hivedlint: project-specific static analysis for the tpu-hive tree.
 
-Machine-checks the concurrency contract and the CLAUDE.md "recurring blind
-spots" that verify passes repeatedly caught by hand. One entry point::
+Machine-checks the concurrency contract, the shard_map/collective
+contract, the env-flag surface, and the CLAUDE.md "recurring blind spots"
+that verify passes repeatedly caught by hand. One entry point::
 
-    python -m tools.hivedlint          # exit 1 on any finding
+    python -m tools.hivedlint                    # exit 1 on any finding
+    python -m tools.hivedlint --rule SHD001      # run a rule subset
+    python -m tools.hivedlint --rule SHD001 --explain   # per-rule doc
+    python -m tools.hivedlint --json             # machine-readable output
 
-Rule catalogue (documented in doc/design/concurrency.md):
+Rule families (each rule has a seeded-violation fixture and the suite is
+pinned clean on the real tree in tier-1):
 
-Concurrency (tools/hivedlint/concurrency.py):
+- Concurrency (``concurrency.py``): LCK001/002 lock registry + thread
+  spawn sites, CON001-004 scheduler/algorithm lock-path fixpoints —
+  documented in ``doc/design/concurrency.md``.
+- Shard contract (``shardlint.py``): SHD001-004 vma loop carries,
+  shard_map-inside-manual-context, collective axis declaration, donated
+  buffer reads; ENV001/002 the ``common/envflags.py`` registry —
+  documented in ``doc/design/shard-contract.md``.
+- Blind spots (``blindspots.py``): CLI001/002 config/flag reachability,
+  GRD001 pytest.raises(match=) guard drift, SER001 serializer drift,
+  MET001 metrics catalogue.
 
-- **LCK001 lock-registry** — every lock is created through
-  ``common.lockcheck.make_lock/make_rlock`` with a literal name registered
-  in ``LOCK_HIERARCHY``, from the file ``LOCK_SITES`` assigns it. Direct
-  ``threading.Lock()``/``RLock()``/``Condition()``/``Semaphore()`` calls in
-  the package are forbidden (the factory is what makes the runtime
-  lock-order sanitizer, ``HIVED_LOCKCHECK=1``, cover the lock).
-- **LCK002 thread-spawn** — ``threading.Thread(...)`` only in the
-  allowlisted spawn sites (``lockcheck.THREAD_SITES``).
-- **CON001 algorithm-mutator-lock** — every mutating entry point of the
-  ``SchedulerAlgorithm`` contract implemented by ``HivedAlgorithm`` calls
-  ``lockcheck.assert_serialized(self)`` and wraps its whole body in
-  ``with self.algorithm_lock``.
-- **CON002 scheduler-lock-path** — every path inside ``HivedScheduler``
-  from an entry point (public routine, informer callback, thread target)
-  to a ``scheduler_algorithm`` mutating call holds ``scheduler_lock``.
-- **CON003 algorithm-bypass** — no file outside ``runtime/scheduler.py``
-  calls a mutating method on a ``scheduler_algorithm`` attribute (the
-  runtime is the single serialization chokepoint).
-- **CON004 store-leaf-fire** — the fake ApiServer never invokes informer
-  handlers while lexically holding its store (leaf) lock.
-
-Blind spots (tools/hivedlint/blindspots.py):
-
-- **CLI001 config-reachability** — every ``TransformerConfig`` field is
-  either passed from ``args`` at each CLI's construction site or
-  allowlisted with a reason (the twice-caught unreachable-feature bug).
-- **CLI002 dead-flag** — every ``add_argument`` dest is read somewhere in
-  its CLI module.
-- **GRD001 guard-drift** — every ``pytest.raises(match=...)`` literal's
-  long literal fragments still appear in some string literal of the
-  package (or the test's own file): rewording a ``ValueError`` without
-  updating its guard fails here instead of at 3 a.m.
-- **SER001 serializer-drift** — the hand-rolled bind-info JSON head stays
-  key-exact with ``PodBindInfo.to_dict``, ``LoaderState`` keeps its
-  canonical ``dataclasses.asdict`` round-trip, and no NEW hand-rolled JSON
-  object template appears outside the registered sites.
-- **MET001 metrics-catalogue** — ``tools/check_metrics.py`` folded in:
-  every emitted metric described, no dead describes, no dynamic names.
-
-Each rule has a seeded-violation fixture in ``tests/test_hivedlint.py`` and
-the suite is pinned clean on the real tree in tier-1.
+The runtime halves of the contract are the opt-in sanitizers:
+``HIVED_LOCKCHECK=1`` (lock order, ``common/lockcheck.py``) and
+``HIVED_COMPILE_GUARD=1`` (jit recompiles, ``common/compileguard.py``).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import sys
-from typing import List
+from typing import Dict, List, Optional, Sequence
 
 
 @dataclasses.dataclass
@@ -70,6 +47,64 @@ class Finding:
     def __str__(self) -> str:
         return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
 
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+# rule id -> (one-line doc, implementing module). --explain prints this;
+# test_shardlint pins every implemented rule to a registry row.
+RULES: Dict[str, tuple] = {
+    "LCK001": ("every lock is created through lockcheck.make_lock/"
+               "make_rlock with a literal name registered in LOCK_HIERARCHY "
+               "from the file LOCK_SITES assigns it", "concurrency"),
+    "LCK002": ("threading.Thread(...) only in the allowlisted spawn sites "
+               "(lockcheck.THREAD_SITES)", "concurrency"),
+    "CON001": ("every SchedulerAlgorithm mutator asserts the serialized "
+               "contract and wraps its body in the algorithm lock",
+               "concurrency"),
+    "CON002": ("every HivedScheduler path from an entry point to an "
+               "algorithm mutating call holds scheduler_lock",
+               "concurrency"),
+    "CON003": ("no file outside runtime/scheduler.py calls a mutating "
+               "method on a scheduler_algorithm attribute", "concurrency"),
+    "CON004": ("the fake ApiServer never fires informer handlers while "
+               "lexically holding its store leaf lock", "concurrency"),
+    "SHD001": ("fresh arrays (jnp.zeros/ones/full/empty[_like]) flowing "
+               "into a shard_map loop carry must pass through "
+               "shard_utils.varying(...) — the vma blind spot",
+               "shardlint"),
+    "SHD002": ("call-graph fixpoint: no shard_map/_get_shard_map call is "
+               "reachable from inside a manual (pipeline/shard_map) "
+               "context; only _local bodies may be called there",
+               "shardlint"),
+    "SHD003": ("every literal collective axis name inside a shard_map "
+               "body must be declared by the install's PartitionSpec "
+               "literals (typo'd axes otherwise fail only at trace time)",
+               "shardlint"),
+    "SHD004": ("buffers named at a donate_argnums position must not be "
+               "read after the donating call in the same statement "
+               "sequence", "shardlint"),
+    "ENV001": ("every HIVED_* token in the package is registered in "
+               "common/envflags.py (the doc/design/flags.md source)",
+               "shardlint"),
+    "ENV002": ("every registered HIVED_* flag is actually read somewhere "
+               "in the tree (package, tests, tools, root scripts)",
+               "shardlint"),
+    "CLI001": ("every TransformerConfig field is passed from args at each "
+               "CLI construction site or allowlisted with a reason",
+               "blindspots"),
+    "CLI002": ("every add_argument dest is read somewhere in its CLI "
+               "module", "blindspots"),
+    "GRD001": ("pytest.raises(match=...) literal fragments (>=4 chars) "
+               "still appear in package string literals; pure-regex "
+               "guards must match some package literal", "blindspots"),
+    "SER001": ("hand-rolled serializers stay key-exact with the canonical "
+               "to_dict/dataclass fields; no unregistered JSON templates",
+               "blindspots"),
+    "MET001": ("every emitted metric is described, no dead describes, no "
+               "dynamic metric names", "blindspots"),
+}
+
 
 def repo_root() -> str:
     here = os.path.dirname(os.path.abspath(__file__))
@@ -77,17 +112,75 @@ def repo_root() -> str:
 
 
 def run_all(root: str) -> List[Finding]:
-    from tools.hivedlint import blindspots, concurrency
+    from tools.hivedlint import blindspots, concurrency, shardlint
 
     findings: List[Finding] = []
     findings += concurrency.check(root)
+    findings += shardlint.check(root)
     findings += blindspots.check(root)
     return findings
 
 
-def main(argv=None) -> int:
+def _parse_rules(values: Sequence[str]) -> List[str]:
+    rules: List[str] = []
+    for v in values:
+        rules.extend(r.strip().upper() for r in v.split(",") if r.strip())
+    unknown = [r for r in rules if r not in RULES]
+    if unknown:
+        raise SystemExit(
+            f"hivedlint: unknown rule(s) {unknown}; known: "
+            f"{', '.join(sorted(RULES))}"
+        )
+    return rules
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.hivedlint",
+        description="project-specific static analysis for the tpu-hive "
+                    "tree (concurrency + shard/collective contract + "
+                    "env flags + recurring blind spots)",
+    )
+    parser.add_argument(
+        "--rule", action="append", default=[], metavar="ID[,ID...]",
+        help="restrict output to these rule ids (repeatable, "
+             "comma-separable); the full suite still runs")
+    parser.add_argument(
+        "--explain", action="store_true",
+        help="print the selected rules' documentation and exit")
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings (or --explain docs) as JSON for tooling")
+    args = parser.parse_args(argv)
+
+    rules = _parse_rules(args.rule)
+    selected = rules or sorted(RULES)
+
+    if args.explain:
+        if args.as_json:
+            print(json.dumps(
+                {r: {"doc": RULES[r][0], "module": RULES[r][1]}
+                 for r in selected},
+                indent=2))
+        else:
+            for r in selected:
+                doc, module = RULES[r]
+                print(f"{r}  (tools/hivedlint/{module}.py)\n    {doc}")
+        return 0
+
     root = repo_root()
     findings = run_all(root)
+    if rules:
+        findings = [f for f in findings if f.rule in rules]
+    if args.as_json:
+        print(json.dumps(
+            {"findings": [f.to_dict() for f in findings],
+             "count": len(findings),
+             "rules": selected},
+            indent=2))
+        return 1 if findings else 0
     for f in findings:
         print(f)
     if findings:

@@ -156,9 +156,12 @@ def check_dead_flags(root: str, cli_files: Iterable[str]) -> List[Finding]:
 # GRD001: pytest.raises(match=...) guards vs raise-message literals
 #
 # For each match= string literal we extract its LITERAL fragments (what is
-# left after removing regex operators); every fragment of >= min_len chars
-# must appear in some string literal of the package tree or of the guard's
-# own test file. Rewording a ValueError breaks the fragment lookup and
+# left after removing regex operators, escape-aware); every fragment of
+# >= min_len (4) chars must appear in some string literal of the package
+# tree or of the guard's own test file. Guards whose pattern yields NO
+# checkable fragment (pure regex / only short literals) used to pass
+# vacuously — they now must re.search-match at least one package (or
+# local) string literal. Rewording a ValueError breaks the lookup and
 # fails here — before the guard silently stops matching.
 # ---------------------------------------------------------------------------
 
@@ -209,7 +212,7 @@ def _iter_py(base: str) -> Iterable[str]:
 def check_guard_drift(
     package_root: str,
     tests_root: str,
-    min_len: int = 8,
+    min_len: int = 4,
 ) -> List[Finding]:
     corpus: List[str] = []
     for path in _iter_py(package_root):
@@ -240,8 +243,10 @@ def check_guard_drift(
             if isinstance(n, ast.Constant) and isinstance(n.value, str)
             and id(n) not in pattern_nodes
         )
+        local_strings = local_blob.split("\x00")
         for node, const in guards:
-            for frag in regex_literal_fragments(const.value, min_len):
+            frags = regex_literal_fragments(const.value, min_len)
+            for frag in frags:
                 if frag not in blob and frag not in local_blob:
                     out.append(Finding(
                         "GRD001", rel, node.lineno,
@@ -250,6 +255,28 @@ def check_guard_drift(
                         f"message was likely reworded; update the guard "
                         f"or the message",
                     ))
+            if frags:
+                continue
+            # pure-regex guard (no fragment long enough to pin): it must at
+            # least MATCH something — otherwise it vouches for nothing
+            try:
+                pat = re.compile(const.value)
+            except re.error:
+                out.append(Finding(
+                    "GRD001", rel, node.lineno,
+                    f"match pattern {const.value!r} does not compile — the "
+                    f"guard can never match",
+                ))
+                continue
+            if not any(pat.search(s) for s in corpus) and not any(
+                    pat.search(s) for s in local_strings):
+                out.append(Finding(
+                    "GRD001", rel, node.lineno,
+                    f"pure-regex match pattern {const.value!r} matches no "
+                    f"package (or local) string literal — previously this "
+                    f"guard passed vacuously; update the pattern or the "
+                    f"message",
+                ))
     return out
 
 

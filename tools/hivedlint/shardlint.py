@@ -1,0 +1,708 @@
+"""shard_map/collective-contract + env-flag rules (SHD001-004, ENV001/002).
+
+The model/parallel layer's correctness contract is conventions the vma
+checker and trace-time errors only police on the meshes CI happens to run
+— this module machine-checks them on every tree, mirroring PR 7's
+concurrency rules (same pattern: each rule parameterized so the seeded
+fixtures in tests/test_shardlint.py drive it against tiny synthetic
+trees; ``check(root)`` wires the real package). The rules encode the
+CLAUDE.md "shard_map vma rules" blind spots and the contract written down
+in ``doc/design/shard-contract.md``:
+
+- **SHD001 vma-loop-carry** — inside a manual (shard_map) function, a
+  fresh array (``jnp.zeros/ones/full/empty[_like]``) flowing into a
+  ``lax.scan``/``fori_loop``/``while_loop`` carry must pass through
+  ``shard_utils.varying(...)`` first (the twice-bitten vma blind spot:
+  unvaried fresh carries trip the checker only on multi-axis meshes).
+- **SHD002 manual-context-purity** — call-graph fixpoint from every
+  shard_map body (and every function passed as a pipeline stage body):
+  no reachable call opens ``shard_map``/``_get_shard_map`` — only the
+  ``_local`` bodies may be called inside a manual context. A call
+  lexically guarded by an ``if`` on a ``manual_*``/``device_local``
+  condition is the sanctioned dual-mode dispatch pattern and prunes the
+  path (the guard proves the callee runs in GSPMD mode).
+- **SHD003 collective-axis-declared** — a string-literal axis name at a
+  collective call site (``psum``/``ppermute``/``all_gather``/
+  ``axis_index``/``pvary``/...) inside a shard_map body must be declared
+  by a ``PartitionSpec`` literal of the installing function — a typo'd
+  axis otherwise only fails at trace time on a mesh that has the real
+  one. Threaded parameters (``axis_name=...``) are always fine.
+- **SHD004 donated-buffer-read** — an argument at a ``donate_argnums``
+  position of a jitted entry point must not be read again after the call
+  in the same statement sequence: the buffer is dead (JAX may or may not
+  have reused it — the read works on CPU and corrupts on TPU).
+- **ENV001 env-flag-registered** — every ``HIVED_*`` token in package
+  code or docstrings is a row (or family prefix) of
+  ``common/envflags.py`` REGISTRY.
+- **ENV002 env-flag-read** — every registered flag is actually read
+  somewhere in the tree (package, tests, tools, repo-root scripts).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.hivedlint import Finding
+
+# subpackages the shard rules police (SHD004 adds the train-step factory's
+# home implicitly — parallel/ is in the list)
+SHARD_SCOPE = ("parallel", "models", "ops")
+
+# collective -> positional index of its axis-name argument
+COLLECTIVES: Dict[str, int] = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "ppermute": 1,
+    "all_gather": 1, "all_to_all": 1, "psum_scatter": 1,
+    "pvary": 1, "pcast": 1, "axis_index": 0, "axis_size": 0,
+}
+_FRESH = {"zeros", "ones", "full", "empty",
+          "zeros_like", "ones_like", "full_like", "empty_like"}
+_FRESH_RECV = {"jnp", "np", "numpy"}
+_VARYING = {"varying", "_varying", "pvary", "pcast"}
+_OPENERS = {"shard_map", "_get_shard_map"}
+# functions whose Nth positional argument runs in a manual context
+MANUAL_BODY_PARAMS: Dict[str, int] = {"pipeline_apply": 0}
+
+
+# ---------------------------------------------------------------------------
+# shared AST plumbing
+# ---------------------------------------------------------------------------
+
+def _walk_py(scan_root: str) -> Iterable[Tuple[str, ast.AST]]:
+    base = os.path.dirname(scan_root.rstrip(os.sep))
+    for dirpath, _, files in os.walk(scan_root):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, base).replace(os.sep, "/")
+            with open(path) as f:
+                yield rel, ast.parse(f.read(), filename=path)
+
+
+def _own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Nodes of ``fn``'s body excluding nested function/lambda bodies —
+    what actually executes in this frame."""
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.append(c)
+
+
+def _functions(tree: ast.AST) -> List[ast.FunctionDef]:
+    return [n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)]
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def _is_opener_call(node: ast.Call) -> bool:
+    """``shard_map(...)`` / ``_get_shard_map(...)`` /
+    ``_get_shard_map()(body, ...)``."""
+    name = _call_name(node)
+    if name in _OPENERS:
+        return True
+    return isinstance(node.func, ast.Call) and _call_name(node.func) in _OPENERS
+
+
+def _install_body_arg(node: ast.Call) -> Optional[ast.AST]:
+    """For a shard_map install site, the body expression (arg 0 of
+    ``shard_map(...)`` or of ``_get_shard_map()(...)``)."""
+    if _call_name(node) == "shard_map" and node.args:
+        return node.args[0]
+    if (isinstance(node.func, ast.Call)
+            and _call_name(node.func) in _OPENERS and node.args):
+        return node.args[0]
+    return None
+
+
+def _body_names_of(expr: ast.AST) -> List[str]:
+    """Function names referenced by a body expression: a bare Name, or the
+    first argument of a ``functools.partial(...)``."""
+    if isinstance(expr, ast.Name):
+        return [expr.id]
+    if (isinstance(expr, ast.Call) and _call_name(expr) == "partial"
+            and expr.args and isinstance(expr.args[0], ast.Name)):
+        return [expr.args[0].id]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# SHD001: fresh arrays in manual loop carries must be vma-seeded
+# ---------------------------------------------------------------------------
+
+def _taint(expr: ast.AST, env: Dict[str, bool]) -> bool:
+    """True when ``expr`` is (built from nothing but) fresh unvaried
+    arrays/constants. Any dependence on real data or a varying() wrapper
+    clears it."""
+    if isinstance(expr, ast.Call):
+        name = _call_name(expr)
+        if name in _VARYING:
+            return False
+        if (name in _FRESH and isinstance(expr.func, ast.Attribute)
+                and isinstance(expr.func.value, ast.Name)
+                and expr.func.value.id in _FRESH_RECV):
+            return True
+        return False
+    if isinstance(expr, ast.Name):
+        return env.get(expr.id, False)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return any(_taint(e, env) for e in expr.elts)
+    if isinstance(expr, ast.BinOp):
+        return _taint(expr.left, env) and _taint(expr.right, env)
+    if isinstance(expr, ast.UnaryOp):
+        return _taint(expr.operand, env)
+    if isinstance(expr, ast.IfExp):
+        return _taint(expr.body, env) and _taint(expr.orelse, env)
+    if isinstance(expr, ast.Starred):
+        return _taint(expr.value, env)
+    if isinstance(expr, ast.Constant):
+        return True  # vma-neutral: zeros(...) * 2 stays fresh
+    return False
+
+
+_LOOP_INIT = {"scan": 1, "fori_loop": 3, "while_loop": 2}
+_LOOP_INIT_KW = {"scan": "init", "fori_loop": "init_val",
+                 "while_loop": "init_val"}
+
+
+def check_vma_carries(scan_root: str) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, tree in _walk_py(scan_root):
+        # shard_map bodies installed in this module count as manual even
+        # when the collectives live in their callees
+        installed: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                body = _install_body_arg(node)
+                if body is not None:
+                    installed.update(_body_names_of(body))
+        for fn in _functions(tree):
+            own = list(_own_nodes(fn))
+            manual = fn.name in installed or any(
+                isinstance(n, ast.Call) and _call_name(n) in COLLECTIVES
+                for n in own
+            )
+            if not manual:
+                continue
+            env: Dict[str, bool] = {}
+            assigns = [n for n in own if isinstance(n, ast.Assign)]
+            assigns.sort(key=lambda n: n.lineno)
+            for a in assigns:
+                for tgt in a.targets:
+                    if isinstance(tgt, ast.Name):
+                        env[tgt.id] = _taint(a.value, env)
+                    elif (isinstance(tgt, ast.Tuple)
+                          and isinstance(a.value, ast.Tuple)
+                          and len(tgt.elts) == len(a.value.elts)):
+                        for t, v in zip(tgt.elts, a.value.elts):
+                            if isinstance(t, ast.Name):
+                                env[t.id] = _taint(v, env)
+            for node in own:
+                if not (isinstance(node, ast.Call)
+                        and _call_name(node) in _LOOP_INIT):
+                    continue
+                name = _call_name(node)
+                idx = _LOOP_INIT[name]
+                init = (node.args[idx] if len(node.args) > idx else None)
+                if init is None:
+                    for kw in node.keywords:
+                        if kw.arg == _LOOP_INIT_KW[name]:
+                            init = kw.value
+                if init is None:
+                    continue
+                elts = (init.elts if isinstance(init, (ast.Tuple, ast.List))
+                        else [init])
+                for e in elts:
+                    if _taint(e, env):
+                        out.append(Finding(
+                            "SHD001", rel, e.lineno,
+                            f"fresh array flows into a lax.{name} carry "
+                            f"inside a manual (shard_map) context without "
+                            f"shard_utils.varying(...) — unvaried carries "
+                            f"break the vma checker on multi-axis meshes "
+                            f"(doc/design/shard-contract.md)",
+                        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SHD002: no shard_map reachable from inside a manual context
+# ---------------------------------------------------------------------------
+
+class _FrameScan(ast.NodeVisitor):
+    """One function frame: opener call sites and callee references, each
+    tagged with whether they sit under a manual-axis guard."""
+
+    def __init__(self):
+        self.guard = 0
+        self.openers: List[Tuple[int, bool]] = []      # (line, guarded)
+        self.refs: List[Tuple[str, bool]] = []         # (name, guarded)
+
+    def visit_FunctionDef(self, node):  # nested frames scan separately
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_If(self, node: ast.If) -> None:
+        g = any(
+            isinstance(n, ast.Name)
+            and (n.id == "device_local" or n.id.startswith("manual_"))
+            for n in ast.walk(node.test)
+        )
+        if g:
+            self.guard += 1
+        self.generic_visit(node)
+        if g:
+            self.guard -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        guarded = self.guard > 0
+        if _is_opener_call(node):
+            # `_get_shard_map()(body)` matches as outer AND inner call:
+            # count the site once
+            if (node.lineno, guarded) not in self.openers:
+                self.openers.append((node.lineno, guarded))
+        else:
+            name = _call_name(node)
+            if name:
+                self.refs.append((name, guarded))
+            # function references passed as arguments (lax.cond branches,
+            # functools.partial bodies) keep the manual taint
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    self.refs.append((arg.id, guarded))
+        self.generic_visit(node)
+
+
+def check_manual_context(scan_roots) -> List[Finding]:
+    if isinstance(scan_roots, str):
+        scan_roots = [scan_roots]
+    # index every named function and per-module import aliases
+    table: Dict[Tuple[str, str], ast.FunctionDef] = {}
+    mod_funcs: Dict[str, Dict[str, List[str]]] = {}
+    imports: Dict[str, Dict[str, Tuple[str, str]]] = {}
+    trees: Dict[str, ast.AST] = {}
+    for rel, tree in (pair for sr in scan_roots for pair in _walk_py(sr)):
+        trees[rel] = tree
+        funcs: Dict[str, List[str]] = {}
+        for fn in _functions(tree):
+            table[(rel, fn.name)] = fn
+            funcs.setdefault(fn.name, []).append(fn.name)
+        mod_funcs[rel] = funcs
+        imp: Dict[str, Tuple[str, str]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                mod_rel = node.module.replace(".", "/") + ".py"
+                for alias in node.names:
+                    imp[alias.asname or alias.name] = (mod_rel, alias.name)
+        imports[rel] = imp
+
+    def resolve(rel: str, name: str) -> Optional[Tuple[str, str]]:
+        if name in mod_funcs.get(rel, {}):
+            return (rel, name)
+        tgt = imports.get(rel, {}).get(name)
+        if tgt:
+            mod_rel, fname = tgt
+            for cand in table:
+                if cand[1] == fname and mod_rel.endswith(cand[0]):
+                    return cand
+        return None
+
+    scans: Dict[Tuple[str, str], _FrameScan] = {}
+
+    def scan_of(key: Tuple[str, str]) -> _FrameScan:
+        if key not in scans:
+            s = _FrameScan()
+            for stmt in table[key].body:
+                s.visit(stmt)
+            scans[key] = s
+        return scans[key]
+
+    # roots: shard_map bodies + pipeline stage bodies
+    roots: Set[Tuple[str, str]] = set()
+    for rel, tree in trees.items():
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            body = _install_body_arg(node)
+            if body is None and _call_name(node) in MANUAL_BODY_PARAMS:
+                idx = MANUAL_BODY_PARAMS[_call_name(node)]
+                body = node.args[idx] if len(node.args) > idx else None
+            if body is None:
+                continue
+            for name in _body_names_of(body):
+                key = resolve(rel, name)
+                if key:
+                    roots.add(key)
+
+    out: List[Finding] = []
+    seen: Set[Tuple[str, str]] = set()
+    frontier = sorted(roots)
+    while frontier:
+        key = frontier.pop()
+        if key in seen:
+            continue
+        seen.add(key)
+        rel = key[0]
+        s = scan_of(key)
+        for line, guarded in s.openers:
+            if not guarded:
+                out.append(Finding(
+                    "SHD002", rel, line,
+                    f"shard_map opened on a path reachable from the manual "
+                    f"(shard_map/pipeline-stage) body "
+                    f"{'.'.join(key[::-1][:1])}() — GSPMD shard_map cannot "
+                    f"open inside a manual context; call the _local body "
+                    f"directly, or guard the call on the manual_* axes "
+                    f"being None (doc/design/shard-contract.md)",
+                ))
+        for name, guarded in s.refs:
+            if guarded:
+                continue  # dual-mode dispatch: this branch is GSPMD-only
+            callee = resolve(rel, name)
+            if callee and callee not in seen:
+                frontier.append(callee)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SHD003: literal collective axes must be declared by the install's specs
+# ---------------------------------------------------------------------------
+
+def _spec_literals(fn: ast.AST) -> Set[str]:
+    """String constants inside P(...)/PartitionSpec(...) calls anywhere in
+    ``fn`` (the axes this install site demonstrably knows about)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and _call_name(node) in ("P", "PartitionSpec")):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    out.add(sub.value)
+    return out
+
+
+def check_collective_axes(scan_root: str) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, tree in _walk_py(scan_root):
+        # which top-level functions install a shard_map, and what axes
+        # their specs declare; which body functions they install
+        installer_axes: Dict[str, Set[str]] = {}
+        body_axes: Dict[str, Set[str]] = {}
+        top_funcs = [n for n in tree.body if isinstance(n, ast.FunctionDef)]
+        for fn in top_funcs:
+            installs = [n for n in ast.walk(fn)
+                        if isinstance(n, ast.Call)
+                        and _install_body_arg(n) is not None]
+            if not installs:
+                continue
+            axes = _spec_literals(fn)
+            installer_axes[fn.name] = axes
+            for call in installs:
+                for name in _body_names_of(_install_body_arg(call)):
+                    body_axes.setdefault(name, set()).update(axes)
+        for fn in top_funcs:
+            if fn.name in installer_axes:
+                declared: Optional[Set[str]] = installer_axes[fn.name]
+            elif fn.name in body_axes:
+                declared = body_axes[fn.name]
+            else:
+                continue  # not demonstrably a manual context: skip
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and _call_name(node) in COLLECTIVES):
+                    continue
+                idx = COLLECTIVES[_call_name(node)]
+                axis = node.args[idx] if len(node.args) > idx else None
+                if axis is None:
+                    for kw in node.keywords:
+                        if kw.arg == "axis_name":
+                            axis = kw.value
+                if axis is None:
+                    continue
+                literals = []
+                if isinstance(axis, ast.Constant) and isinstance(axis.value, str):
+                    literals = [(axis.value, axis.lineno)]
+                elif isinstance(axis, (ast.Tuple, ast.List)):
+                    literals = [
+                        (e.value, e.lineno) for e in axis.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    ]
+                for lit, line in literals:
+                    if lit not in declared:
+                        out.append(Finding(
+                            "SHD003", rel, line,
+                            f"collective axis {lit!r} at a "
+                            f"{_call_name(node)}() site is not declared by "
+                            f"any PartitionSpec literal of the installing "
+                            f"shard_map — a typo'd axis only fails at trace "
+                            f"time on a mesh that has the real one; thread "
+                            f"the axis as a parameter or fix the spec",
+                        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SHD004: donated buffers must not be read after the donating call
+# ---------------------------------------------------------------------------
+
+def _donated_indices(call: ast.Call) -> Optional[Set[int]]:
+    if _call_name(call) != "jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return {e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)}
+    return None
+
+
+def _ref_key(expr: ast.AST) -> Optional[Tuple[str, str]]:
+    """A trackable buffer reference: a bare Name or ``self.attr``."""
+    if isinstance(expr, ast.Name):
+        return ("", expr.id)
+    if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        return ("self", expr.attr)
+    return None
+
+
+def _reads_writes(stmt: ast.AST) -> Tuple[Set[Tuple[str, str]],
+                                          Set[Tuple[str, str]]]:
+    reads: Set[Tuple[str, str]] = set()
+    writes: Set[Tuple[str, str]] = set()
+    for node in ast.walk(stmt):
+        key = _ref_key(node)
+        if key is None:
+            continue
+        ctx = getattr(node, "ctx", None)
+        if isinstance(ctx, (ast.Store, ast.Del)):
+            writes.add(key)
+        elif isinstance(ctx, ast.Load):
+            # self.attr Load: only count the attribute access itself, not
+            # the bare `self` read inside it
+            reads.add(key)
+    return reads, writes
+
+
+def check_donation(scan_root: str) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, tree in _walk_py(scan_root):
+        # jitted-callable name -> donated positional indices
+        registry: Dict[Tuple[str, str], Set[int]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            donated = _donated_indices(node.value)
+            if not donated:
+                continue
+            for tgt in node.targets:
+                key = _ref_key(tgt)
+                if key:
+                    registry[key] = donated
+        if not registry:
+            continue
+
+        def call_in(stmt: ast.AST) -> Optional[ast.Call]:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call):
+                    fkey = _ref_key(n.func)
+                    if fkey in registry:
+                        return n
+            return None
+
+        for node in ast.walk(tree):
+            for field in ("body", "orelse", "finalbody"):
+                seq = getattr(node, field, None)
+                if not isinstance(seq, list):
+                    continue
+                for i, stmt in enumerate(seq):
+                    call = call_in(stmt)
+                    if call is None:
+                        continue
+                    fkey = _ref_key(call.func)
+                    _, own_writes = _reads_writes(stmt)
+                    for idx in sorted(registry[fkey]):
+                        if idx >= len(call.args):
+                            continue
+                        bkey = _ref_key(call.args[idx])
+                        if bkey is None:
+                            continue
+                        if bkey in own_writes:
+                            continue  # x = f(x): rebound by the call stmt
+                        for later in seq[i + 1:]:
+                            reads, writes = _reads_writes(later)
+                            if bkey in reads:
+                                buf = (bkey[1] if not bkey[0]
+                                       else f"self.{bkey[1]}")
+                                fname = (fkey[1] if not fkey[0]
+                                         else f"self.{fkey[1]}")
+                                out.append(Finding(
+                                    "SHD004", rel, later.lineno,
+                                    f"{buf} is read after being donated to "
+                                    f"{fname}() (donate_argnums index "
+                                    f"{idx}) — the buffer is dead after the "
+                                    f"call; rebind it from the call's "
+                                    f"result first",
+                                ))
+                                break
+                            if bkey in writes:
+                                break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ENV001 / ENV002: the HIVED_* flag registry is exact
+# ---------------------------------------------------------------------------
+
+_FLAG_TOKEN = re.compile(r"HIVED_[A-Z0-9_]+")
+_REGISTRY_FILE = "hivedscheduler_tpu/common/envflags.py"
+
+
+def _env_read_names(tree: ast.AST) -> Tuple[Set[str], Dict[str, str],
+                                            Set[str]]:
+    """(direct literal env-read names, module consts NAME->flag, symbol
+    loads) for one module."""
+    direct: Set[str] = set()
+    consts: Dict[str, str] = {}
+    loads: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and _FLAG_TOKEN.fullmatch(node.value.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    consts[tgt.id] = node.value.value
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            loads.add(node.id)
+        if isinstance(node, ast.Attribute):
+            loads.add(node.attr)
+
+        arg = None
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            is_environ = (isinstance(recv, ast.Attribute)
+                          and recv.attr == "environ") or (
+                isinstance(recv, ast.Name) and recv.id == "environ")
+            if node.func.attr == "get" and is_environ and node.args:
+                arg = node.args[0]
+            elif node.func.attr == "getenv" and node.args:
+                arg = node.args[0]
+        elif isinstance(node, ast.Subscript):
+            v = node.value
+            if (isinstance(v, ast.Attribute) and v.attr == "environ") or (
+                    isinstance(v, ast.Name) and v.id == "environ"):
+                arg = node.slice
+        if arg is not None and isinstance(arg, ast.Constant) \
+                and isinstance(arg.value, str):
+            direct.add(arg.value)
+    return direct, consts, loads
+
+
+def check_env_flags(
+    root: str,
+    names: Optional[Set[str]] = None,
+    package_rel: str = "hivedscheduler_tpu",
+    read_rels: Sequence[str] = ("hivedscheduler_tpu", "tests", "tools"),
+) -> List[Finding]:
+    if names is None:
+        import sys
+
+        sys.path.insert(0, root)
+        try:
+            from hivedscheduler_tpu.common import envflags
+        finally:
+            sys.path.pop(0)
+        names = set(envflags.REGISTRY)
+
+    def ok(token: str) -> bool:
+        return token in names or any(n.startswith(token) for n in names)
+
+    out: List[Finding] = []
+
+    # ENV001: every HIVED_* token in the package is registered
+    for rel, tree in _walk_py(os.path.join(root, package_rel)):
+        if rel == _REGISTRY_FILE:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                for token in sorted(set(_FLAG_TOKEN.findall(node.value))):
+                    if not ok(token):
+                        out.append(Finding(
+                            "ENV001", rel, node.lineno,
+                            f"{token} is not a registered flag — add a row "
+                            f"to common/envflags.py REGISTRY (the "
+                            f"doc/design/flags.md catalogue renders from "
+                            f"it)",
+                        ))
+
+    # ENV002: every registered flag is read somewhere in the tree
+    direct: Set[str] = set()
+    consts: Dict[str, str] = {}
+    load_counts: Set[str] = set()
+    scan_files: List[Tuple[str, ast.AST]] = []
+    for rel_dir in read_rels:
+        base = os.path.join(root, rel_dir)
+        if os.path.isdir(base):
+            scan_files.extend(_walk_py(base))
+    for fn in sorted(os.listdir(root)):
+        if fn.endswith(".py"):
+            with open(os.path.join(root, fn)) as f:
+                scan_files.append((fn, ast.parse(f.read(), filename=fn)))
+    for rel, tree in scan_files:
+        if rel == _REGISTRY_FILE:
+            continue
+        d, c, l = _env_read_names(tree)
+        direct |= d
+        consts.update(c)
+        load_counts |= l
+    reads = set(direct)
+    reads |= {flag for const, flag in consts.items() if const in load_counts}
+    for name in sorted(names - reads):
+        out.append(Finding(
+            "ENV002", _REGISTRY_FILE, 1,
+            f"flag {name} is registered but never read anywhere in the "
+            f"tree — drop the registry row (and its doc/design/flags.md "
+            f"entry regenerates without it)",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+def check(root: str) -> List[Finding]:
+    pkg = os.path.join(root, "hivedscheduler_tpu")
+    scans = [os.path.join(pkg, sub) for sub in SHARD_SCOPE]
+    out: List[Finding] = []
+    for scan in scans:
+        out += check_vma_carries(scan)
+        out += check_collective_axes(scan)
+        out += check_donation(scan)
+    out += check_manual_context(scans)  # one unit: cross-module call graph
+    out += check_env_flags(root)
+    return out
