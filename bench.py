@@ -498,7 +498,84 @@ def make_trace_jobs(n_jobs: int, seed: int):
     return jobs
 
 
-def replay_trace(cluster, jobs, gang_chips_fn):
+class TraceDefrag:
+    """Sim-side adapter of the defrag subsystem for :func:`replay_trace`.
+
+    Holds the real planner/probe/backfill objects
+    (:mod:`hivedscheduler_tpu.defrag` — the same code the runtime executor
+    drives) plus the sim's economics: ``DOWNTIME`` is the checkpoint ->
+    re-place -> resume cost charged to every moved gang, in trace time
+    units (job durations average ~140, so 3.0 models a few-percent
+    checkpoint/restore round-trip — the supervisor's SIGTERM
+    checkpoint-and-exit contract, PR 3).
+
+    Only constructed when ``HIVED_DEFRAG`` is on and the cluster is the
+    real HiveD one; ``replay_trace(defrag=None)`` executes exactly the
+    pre-defrag statements (the kill-switch differential).
+    """
+
+    DOWNTIME = 3.0
+
+    def __init__(self, cluster):
+        from hivedscheduler_tpu import defrag as defrag_pkg
+        from hivedscheduler_tpu.defrag import (
+            BackfillPolicy,
+            GangSpec,
+            MigrationPlanner,
+            RunningGroup,
+            WhatIfProbe,
+        )
+        from hivedscheduler_tpu.defrag.planner import vc_quota_chips
+
+        self.GangSpec = GangSpec
+        self.RunningGroup = RunningGroup
+        self.cluster = cluster
+        self.probe = WhatIfProbe(cluster.algo, cluster.nodes)
+        self.planner = MigrationPlanner(move_downtime=self.DOWNTIME)
+        self.policy = BackfillPolicy()
+        self.backfill_on = defrag_pkg.backfill_enabled()
+        self.quota = {
+            vc: vc_quota_chips(cluster.algo, vc)
+            for vc in cluster.algo.vc_schedulers
+        }
+        self.downgraded = {}  # group name -> original (guaranteed) priority
+        self.migrations = 0
+        self.promotions = 0
+        self.backfills = 0
+        self.migrated_chips = 0
+        self.overhead_chip_time = 0.0
+        self.rejections = {}  # planner rejection reason -> count
+
+    def spec_of(self, job, priority=None):
+        return self.GangSpec(
+            name=job["name"], vc=job["vc"],
+            priority=job["priority"] if priority is None else priority,
+            leaf_cell_type="v5p-chip",
+            members=((job["pods"], job["chips"]),),
+        )
+
+    def running_groups(self, job_by_name):
+        """Current gangs as the planner sees them: a downgraded gang's live
+        incarnation is opportunistic, whatever its original priority."""
+        out = []
+        for name, pods in self.cluster.groups.items():
+            job = job_by_name[name]
+            prio = (OPPORTUNISTIC if name in self.downgraded
+                    else job["priority"])
+            out.append(self.RunningGroup(
+                name=name, spec=self.spec_of(job, priority=prio),
+                bound_pods=list(pods),
+            ))
+        return out
+
+    def reject(self, reason):
+        self.rejections[reason] = self.rejections.get(reason, 0) + 1
+
+
+OPPORTUNISTIC = -1  # api.constants.OPPORTUNISTIC_PRIORITY, numerically
+
+
+def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
     """Event-driven replay of ``jobs`` through ``cluster``; shared between
     the HiveD run and the strawman so the comparison is apples-to-apples.
 
@@ -511,6 +588,27 @@ def replay_trace(cluster, jobs, gang_chips_fn):
     - ``wasted`` chip-time: work preempted gangs had accrued when killed
       (they produce no completed job, but occupied chips);
     - offered load, for reading utilization against what arrived.
+
+    With a :class:`TraceDefrag` adapter (``HIVED_DEFRAG`` on), the replay
+    additionally drives the defrag subsystem the way the runtime executor
+    would:
+
+    - a *packing*-blocked waiter first gets a **migration** plan (probe-
+      validated relocation of same-VC guaranteed gangs when its quota is
+      fragmented, of opportunistic gangs when it is opportunistic); an
+      executed move charges every moved gang ``DOWNTIME`` (checkpoint ->
+      re-place -> resume) and the overhead is *subtracted* from busy time
+      so utilization never counts restore windows as work;
+    - a *quota*-blocked guaranteed waiter is **backfilled**: admitted
+      opportunistically into idle capacity (HiveD's beyond-quota
+      mechanism — preemptible, so it can never delay a guarantee owner),
+      bounded by the quota's estimated free-up time so a near-term start
+      is awaited rather than paying two checkpoint round-trips;
+    - when its quota frees, a backfilled gang is **promoted** back to its
+      guaranteed priority through the same work-preserving machinery;
+      if a guarantee owner preempts it first, its accrued work is NOT
+      wasted — it re-queues with only its remaining duration (+ restore
+      downtime), the bit-exact kill-and-resume contract.
     """
     import heapq
 
@@ -531,6 +629,14 @@ def replay_trace(cluster, jobs, gang_chips_fn):
     inflations = []
     wait_chip_time = {"capacity": 0.0, "packing": 0.0}
     wasted_chip_time = 0.0
+    # snapshot before the replay: defrag-mode rescues rewrite a preempted
+    # job's duration to its checkpointed remainder, and offered load means
+    # what ARRIVED, not what was re-run
+    offered = sum(j["pods"] * j["chips"] * j["duration"] for j in jobs)
+    # -- defrag-mode state (untouched when defrag is None) -----------------
+    job_by_name = {j["name"]: j for j in jobs}
+    entry_gen = {}  # heap seq -> job generation at push (stale-entry filter)
+    completes_at = {}  # live group name -> its current completion time
 
     def advance(to):
         nonlocal busy_chip_time, last_t
@@ -545,32 +651,237 @@ def replay_trace(cluster, jobs, gang_chips_fn):
             wait_chip_time[w["block_reason"]] += w["pods"] * w["chips"] * dt
         last_t = to
 
+    def push_completion(job, at):
+        nonlocal seq
+        seq += 1
+        if defrag is not None:
+            entry_gen[seq] = job.get("gen", 0)
+            completes_at[job["name"]] = at
+        heapq.heappush(events, (at, seq, job))
+
+    def register_success(job, dt):
+        nonlocal scheduled, contiguous
+        if not job.get("_admitted"):
+            # stats count each job once; a work-preserving re-admission
+            # (defrag mode) is a resume, not a new schedule
+            latencies.append(dt)
+            waits.append(clock - job["arrival"])
+            scheduled += 1
+            job["_admitted"] = True
+            is_contig, infl = _gang_geometry(
+                gang_chips_fn(cluster, job["name"]))
+            contiguous += 1 if is_contig else 0
+            inflations.append(infl)
+            if defrag is not None:
+                job["_geom"] = (is_contig, infl)
+        chips_of[job["name"]] = job["pods"] * job["chips"]
+        push_completion(job, clock + job["duration"])
+
+    def free_chips():
+        return total_chips - sum(
+            chips_of.get(name, 0) for name in cluster.groups
+        )
+
     def try_schedule(job):
-        nonlocal seq, preempt_events, scheduled, contiguous
+        nonlocal preempt_events
         ok, dt, preempted = cluster.schedule_gang(
             job["vc"], job["priority"], job["name"], job["pods"], job["chips"],
             allow_preempt=job["priority"] >= 0,
         )
         # victims die even when the preemptor ultimately fails to place
         preempt_events += 1 if preempted else 0
+        if defrag is not None and preempted:
+            rescue_preempted_downgrades()
         if not ok:
-            free = total_chips - sum(
-                chips_of.get(name, 0) for name in cluster.groups
-            )
+            free = free_chips()
             job["block_reason"] = (
                 "capacity" if free < job["pods"] * job["chips"] else "packing"
             )
+            if (defrag is not None and job["block_reason"] == "packing"
+                    and attempt_defrag(job)):
+                return True
             return False
-        latencies.append(dt)
-        waits.append(clock - job["arrival"])
-        chips_of[job["name"]] = job["pods"] * job["chips"]
-        is_contig, infl = _gang_geometry(gang_chips_fn(cluster, job["name"]))
-        contiguous += 1 if is_contig else 0
-        inflations.append(infl)
-        seq += 1
-        heapq.heappush(events, (clock + job["duration"], seq, job))
-        scheduled += 1
+        register_success(job, dt)
         return True
+
+    # -- defrag-mode mechanics (every closure below is only reachable with
+    # a TraceDefrag adapter; the legacy path never enters them) ------------
+
+    def guar_quota_free(vc):
+        used = sum(
+            chips_of.get(name, 0) for name in cluster.groups
+            if job_by_name[name]["vc"] == vc
+            and job_by_name[name]["priority"] >= 0
+            and name not in defrag.downgraded
+        )
+        return defrag.quota[vc] - used
+
+    def quota_eta(vc, need):
+        """When will ``vc``'s guaranteed quota have ``need`` chips free?
+        Scan pending completions of its guaranteed (non-downgraded) gangs
+        in time order. None = not within the current horizon."""
+        acc = guar_quota_free(vc)
+        if acc >= need:
+            return clock
+        for at, s, job in sorted(events):
+            if entry_gen.get(s) != job.get("gen", 0):
+                continue  # stale entry (migrated/preempted/promoted)
+            if (job["vc"] == vc and job["priority"] >= 0
+                    and job["name"] in cluster.groups
+                    and job["name"] not in defrag.downgraded):
+                acc += job["pods"] * job["chips"]
+                if acc >= need:
+                    return at
+        return None
+
+    def charge_move(name):
+        """A moved gang pays the checkpoint->restore downtime: completion
+        slips by DOWNTIME and the overhead never counts as useful work."""
+        job = job_by_name[name]
+        job["gen"] = job.get("gen", 0) + 1
+        push_completion(job, completes_at[name] + defrag.DOWNTIME)
+        defrag.overhead_chip_time += (
+            defrag.DOWNTIME * job["pods"] * job["chips"])
+        defrag.migrated_chips += job["pods"] * job["chips"]
+
+    def execute_migration(plan, waiter_job, t0):
+        """Replay the probe-validated sequence for real: evict movers,
+        place the waiter, re-place each mover (deterministic: same state,
+        same order as the probe)."""
+        moved = [(m.group.name, m.group.spec) for m in plan.moves]
+        for name, _spec in moved:
+            cluster.free_gang(name)
+        ok, _, _ = cluster.schedule_gang(
+            waiter_job["vc"], waiter_job["priority"], waiter_job["name"],
+            waiter_job["pods"], waiter_job["chips"])
+        if not ok:  # pragma: no cover - probe guarantees feasibility
+            for name, spec in moved:
+                job = job_by_name[name]
+                cluster.schedule_gang(job["vc"], spec.priority, name,
+                                      job["pods"], job["chips"])
+            defrag.reject("execute-drift")
+            return False
+        for name, spec in moved:
+            job = job_by_name[name]
+            ok2, _, _ = cluster.schedule_gang(
+                job["vc"], spec.priority, name, job["pods"], job["chips"])
+            assert ok2, f"mover {name} unplaceable after probe said placeable"
+            charge_move(name)
+            geom_update(name)
+        defrag.migrations += 1
+        register_success(waiter_job, time.perf_counter() - t0)
+        return True
+
+    def geom_update(name):
+        """A moved gang's final geometry replaces its admission-time sample
+        (the placement-quality stats describe where gangs actually ran)."""
+        nonlocal contiguous
+        job = job_by_name[name]
+        if not job.get("_admitted"):
+            return
+        was_contig, was_infl = job.get("_geom", (None, None))
+        is_contig, infl = _gang_geometry(gang_chips_fn(cluster, name))
+        job["_geom"] = (is_contig, infl)
+        if was_contig is not None:
+            contiguous += (1 if is_contig else 0) - (1 if was_contig else 0)
+            inflations[inflations.index(was_infl)] = infl
+
+    def attempt_defrag(job):
+        """The runtime policy ladder for a packing-blocked gang:
+        migration if its blocker is fragmentation, opportunistic backfill
+        if it is quota stranding."""
+        t0 = time.perf_counter()
+        need = job["pods"] * job["chips"]
+        running = defrag.running_groups(job_by_name)
+        if job["priority"] >= 0:
+            qfree = guar_quota_free(job["vc"])
+            if qfree >= need:
+                plan = defrag.planner.plan_migration(
+                    defrag.probe, defrag.spec_of(job), running,
+                    free_chips=qfree)
+                if hasattr(plan, "moves"):
+                    return execute_migration(plan, job, t0)
+                defrag.reject(plan.reason)
+            if defrag.backfill_on and free_chips() >= need:
+                # quota-stranded: ride other VCs' idle guarantees
+                # opportunistically — unless the quota frees sooner than a
+                # promote round-trip would cost
+                eta = quota_eta(job["vc"], need)
+                if eta is not None and eta - clock <= 2 * defrag.DOWNTIME:
+                    defrag.reject("quota-frees-soon")
+                    return False
+                ok, dt, _ = cluster.schedule_gang(
+                    job["vc"], OPPORTUNISTIC, job["name"],
+                    job["pods"], job["chips"])
+                if ok:
+                    is_contig, _ = _gang_geometry(
+                        gang_chips_fn(cluster, job["name"]))
+                    if not is_contig:
+                        # a scattered slice cannot ride ICI: a backfill
+                        # that degrades the placement is worse than the
+                        # wait it saves
+                        cluster.free_gang(job["name"])
+                        defrag.reject("backfill-noncontiguous")
+                        return False
+                    defrag.downgraded[job["name"]] = job["priority"]
+                    defrag.backfills += 1
+                    register_success(job, time.perf_counter() - t0)
+                    return True
+                defrag.reject("backfill-unplaceable")
+            return False
+        plan = defrag.planner.plan_migration(
+            defrag.probe, defrag.spec_of(job), running,
+            free_chips=free_chips())
+        if hasattr(plan, "moves"):
+            return execute_migration(plan, job, t0)
+        defrag.reject(plan.reason)
+        return False
+
+    def rescue_preempted_downgrades():
+        """Work-preserving preemption: every preempted gang (backfilled or
+        natively opportunistic) checkpointed on SIGTERM — it re-queues with
+        its remaining duration plus restore downtime instead of counting
+        its accrued work wasted (the PR 3 bit-exact kill-and-resume
+        contract, which the defrag subsystem turns into policy)."""
+        for name in [n for n in completes_at if n not in cluster.groups]:
+            job = job_by_name[name]
+            defrag.downgraded.pop(name, None)
+            job["gen"] = job.get("gen", 0) + 1
+            remaining = max(0.0, completes_at.pop(name, clock) - clock)
+            job["duration"] = remaining + defrag.DOWNTIME
+            defrag.overhead_chip_time += (
+                defrag.DOWNTIME * job["pods"] * job["chips"])
+            chips_of.pop(name, None)
+            job["block_reason"] = (
+                "capacity" if free_chips() < job["pods"] * job["chips"]
+                else "packing"
+            )
+            waiting.append(job)
+
+    def try_promotions():
+        """Quota freed: promote backfilled gangs (oldest first) back to
+        their guaranteed priority through the work-preserving machinery."""
+        for name in sorted(defrag.downgraded,
+                           key=lambda n: job_by_name[n]["arrival"]):
+            job = job_by_name[name]
+            if guar_quota_free(job["vc"]) < job["pods"] * job["chips"]:
+                continue
+            group = next(g for g in defrag.running_groups(job_by_name)
+                         if g.name == name)
+            plan = defrag.planner.plan_promotion(
+                defrag.probe, group, defrag.downgraded[name])
+            if not hasattr(plan, "moves"):
+                defrag.reject("promotion-" + plan.reason)
+                continue
+            cluster.free_gang(name)
+            ok, _, _ = cluster.schedule_gang(
+                job["vc"], defrag.downgraded[name], name,
+                job["pods"], job["chips"])
+            assert ok, f"promotion of {name} failed after probe said placeable"
+            defrag.downgraded.pop(name)
+            charge_move(name)
+            geom_update(name)
+            defrag.promotions += 1
 
     arrival_i = 0
     while arrival_i < len(jobs) or events:
@@ -586,13 +897,20 @@ def replay_trace(cluster, jobs, gang_chips_fn):
         else:
             advance(next_done)
             clock = next_done
-            _, _, job = heapq.heappop(events)
+            _, entry_seq, job = heapq.heappop(events)
+            if defrag is not None and entry_gen.pop(entry_seq, 0) != job.get(
+                    "gen", 0):
+                continue  # stale completion: the gang migrated or re-queued
             if job["name"] in cluster.groups:
                 cluster.free_gang(job["name"])
             else:
                 # preempted away mid-run: everything it accrued is wasted
                 wasted_chip_time += busy_of.get(job["name"], 0.0)
             chips_of.pop(job["name"], None)
+            if defrag is not None:
+                completes_at.pop(job["name"], None)
+                defrag.downgraded.pop(job["name"], None)
+                try_promotions()
             # retry FIFO waiters
             still = []
             for w in waiting:
@@ -603,16 +921,19 @@ def replay_trace(cluster, jobs, gang_chips_fn):
     p50 = statistics.median(lat_ms) if lat_ms else 0.0
     p99 = lat_ms[max(0, int(len(lat_ms) * 0.99) - 1)] if lat_ms else 0.0
     span = last_t * total_chips
-    offered = sum(j["pods"] * j["chips"] * j["duration"] for j in jobs)
     total_wait = sum(wait_chip_time.values())
-    return {
+    useful_chip_time = busy_chip_time
+    if defrag is not None:
+        # restore windows occupy chips but are not work
+        useful_chip_time -= defrag.overhead_chip_time
+    out = {
         "jobs": len(jobs),
         "scheduled": scheduled,
         "preemption_events": preempt_events,
         "sched_p50_ms": round(p50, 3),
         "sched_p99_ms": round(p99, 3),
         "wait_p50_t": round(statistics.median(waits), 2) if waits else 0.0,
-        "utilization_pct": round(100.0 * busy_chip_time / span, 1)
+        "utilization_pct": round(100.0 * useful_chip_time / span, 1)
         if span else 0.0,
         # -- the decomposition + placement-quality fields ------------------
         "offered_pct": round(100.0 * offered / span, 1) if span else 0.0,
@@ -628,6 +949,17 @@ def replay_trace(cluster, jobs, gang_chips_fn):
         "preempt_wasted_pct": round(100.0 * wasted_chip_time / span, 1)
         if span else 0.0,
     }
+    if defrag is not None:
+        out.update({
+            "migrations": defrag.migrations,
+            "promotions": defrag.promotions,
+            "backfills": defrag.backfills,
+            "migrated_chips": defrag.migrated_chips,
+            "migration_overhead_pct": round(
+                100.0 * defrag.overhead_chip_time / span, 2) if span else 0.0,
+            "planner_rejections": dict(sorted(defrag.rejections.items())),
+        })
+    return out
 
 
 def run_trace(n_jobs: int = 300, seed: int = 11, baseline: bool = False):
@@ -653,7 +985,13 @@ def run_trace(n_jobs: int = 300, seed: int = 11, baseline: bool = False):
     jobs = make_trace_jobs(n_jobs, seed)
     if baseline:
         return replay_trace(NaiveCluster(), jobs, naive_gang_chips)
-    return replay_trace(Cluster(), jobs, hived_gang_chips)
+    from hivedscheduler_tpu.defrag import defrag_enabled
+
+    cluster = Cluster()
+    # HIVED_DEFRAG=0 runs exactly the pre-defrag replay statements — the
+    # kill-switch differential (guard: tests/test_defrag.py)
+    adapter = TraceDefrag(cluster) if defrag_enabled() else None
+    return replay_trace(cluster, jobs, hived_gang_chips, defrag=adapter)
 
 
 def parse_model_bench_output(returncode: int, stdout: str, stderr: str):
@@ -854,6 +1192,11 @@ if __name__ == "__main__":
                           trace_wait_capacity_share=t["wait_capacity_share"],
                           trace_wait_packing_share=t["wait_packing_share"],
                           trace_preempt_wasted_pct=t["preempt_wasted_pct"])
+            # defrag/backfill fields (absent under HIVED_DEFRAG=0)
+            for k in ("migrations", "promotions", "backfills",
+                      "migrated_chips", "migration_overhead_pct"):
+                if k in t:
+                    fields[f"trace_{k}"] = t[k]
         except Exception as e:  # pragma: no cover - defensive
             fields["trace_error"] = f"{type(e).__name__}: {e}"
         try:

@@ -51,6 +51,10 @@ VIRTUAL_CLUSTERS_PATH = CLUSTER_STATUS_PATH + "/virtualclusters/"
 # of the shared obs timeline (doc/design/observability.md)
 TRACES_PATH = INSPECT_PATH + "/traces"
 TRACES_CHROME_PATH = TRACES_PATH + "/chrome"
+# scheduler-visible admission hints (serving block-pool headroom) and the
+# defrag subsystem's reservations/migrations
+ADMISSION_HINTS_PATH = INSPECT_PATH + "/admission-hints"
+DEFRAG_PATH = INSPECT_PATH + "/defrag"
 
 # --- Config (reference: constants.go:65) ------------------------------------
 ENV_CONFIG_FILE = "CONFIG"

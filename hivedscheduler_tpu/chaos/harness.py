@@ -149,7 +149,16 @@ class ChaosHarness:
         plan: Optional[FaultPlan] = None,
         config_factory=default_config,
         restart_every: int = 8,
+        ops_profile: str = "v1",
     ):
+        # "v1" = the original fault mix (pinned seeds replay it forever);
+        # "defrag-v1" adds migration episodes: a deliberately-waiting gang,
+        # defrag_tick planning + eviction, resume_migrations re-binds, and
+        # a kill -9 window (job dies after checkpoint, before re-bind ->
+        # abort_migration). Invariants now always include check_defrag.
+        if ops_profile not in ("v1", "defrag-v1"):
+            raise ValueError(f"unknown ops profile {ops_profile!r}")
+        self.ops_profile = ops_profile
         self.seed = seed
         self.rng = random.Random(seed)
         self.config_factory = config_factory
@@ -171,6 +180,11 @@ class ChaosHarness:
         self.schedules_done = 0
         self.gangs_completed = 0
         self.gid = 0
+        # defrag-episode accounting (non-vacuity: tests assert the soak
+        # actually exercised migrations, not just scheduled around them)
+        self.migrations_planned = 0
+        self.migrations_killed = 0
+        self.migrations_rebound = 0
 
     @property
     def algo(self):
@@ -190,7 +204,8 @@ class ChaosHarness:
         try:
             with self.scheduler.scheduler_lock:
                 invariants.check_all(
-                    self.algo, f"seed {self.seed} {ctx}", full_groups=full
+                    self.algo, f"seed {self.seed} {ctx}", full_groups=full,
+                    scheduler=self.scheduler,
                 )
         except invariants.InvariantViolation as e:
             self.violations.append(str(e))
@@ -355,6 +370,141 @@ class ChaosHarness:
             self.bad_nodes.add(n)
             self.fake.update_node(Node(name=n, conditions=list(_NOT_READY)))
 
+    def op_migrate(self) -> None:
+        """One defrag episode: a gang that cannot place records itself as a
+        waiter; ``defrag_tick`` plans + evicts; then EITHER the job dies in
+        the kill -9 window (after checkpoint, before re-bind —
+        ``abort_migration``) OR ``resume_migrations`` re-binds the movers
+        and the waiter is driven to completion. The harness registry tracks
+        moved gangs across their pod-identity change, so the quiesce
+        gang-atomicity check covers migrated placements too."""
+        rng = self.rng
+        # construct the fragmentation pattern defrag exists for: vc-c's two
+        # v5p 2x2x1 cells get three 2-chip guaranteed gangs (packer pairs
+        # two in one cell), the middle one dies — now both cells are
+        # half-used, 4 quota chips are free, and a 4-chip waiter cannot
+        # place until one survivor moves. The surrounding soak state
+        # perturbs the pattern freely; a degenerate layout just yields an
+        # honest planner rejection.
+        helpers = []
+        for _ in range(3):
+            hname = f"mgh{self.gid}"
+            self.gid += 1
+            hspec = {
+                "virtualCluster": "vc-c", "priority": 5,
+                "leafCellType": "v5p-chip", "leafCellNumber": 2,
+                "affinityGroup": {
+                    "name": hname,
+                    "members": [{"podNumber": 1, "leafCellNumber": 2}],
+                },
+            }
+            self.fake.create_pod(_make_pod(f"{hname}-0", hspec))
+            node = self._filter_member(f"{hname}-0", hspec)
+            stored = (self.fake.get_pod("default", f"{hname}-0")
+                      if node and self._bind(f"{hname}-0", node) else None)
+            if stored is not None and stored.node_name:
+                self.groups[hname] = [stored]
+                helpers.append(hname)
+            else:
+                self._rollback([f"{hname}-0"])
+        if len(helpers) >= 2:
+            self._delete_gang(helpers[1])
+        # the waiter arrives at the SAME priority as the survivors: it
+        # cannot preempt them (strictly-lower only), so fragmentation is
+        # the genuine blocker — the case migration exists for
+        name = f"mg{self.gid}"
+        self.gid += 1
+        pods, chips = 1, 4
+        spec = {
+            "virtualCluster": "vc-c", "priority": 5,
+            "leafCellType": "v5p-chip", "leafCellNumber": chips,
+            "affinityGroup": {
+                "name": name,
+                "members": [{"podNumber": pods, "leafCellNumber": chips}],
+            },
+        }
+        pod_name = f"{name}-0"
+        self.fake.create_pod(_make_pod(pod_name, spec))
+        created = [pod_name]
+        try:
+            self.scheduler.filter_routine(ei.ExtenderArgs(
+                pod=self.fake.get_pod("default", pod_name),
+                node_names=list(self.nodes)))
+        except (api.WebServerError, InjectedApiError):
+            pass  # a wait/transient is exactly the interesting outcome
+        planned = self.scheduler.defrag_tick().get("planned")
+        if planned is not None:
+            self.migrations_planned += 1
+            mid = planned["migrationId"]
+            movers = [m["group"] for m in planned["moves"]]
+            # the evictions are in flight: the moved gangs are mid-flight,
+            # not "complete" — drop them from the registry until (unless)
+            # they re-bind; capture their pods for job-framework teardown
+            mover_pods = {g: list(self.groups.get(g, [])) for g in movers}
+            for g in movers:
+                self.groups.pop(g, None)
+            killed = rng.random() < 0.35
+            if not killed:
+                self.chaos.flush_held()
+                report = {}
+                for _ in range(4):  # re-drive past injected transients
+                    report = self.scheduler.resume_migrations()
+                    state = report.get(mid, {}).get("state")
+                    if state and state != "Evicting":
+                        break
+                if report.get(mid, {}).get("state") == "Evicting":
+                    # evictions kept failing (injected): treat the move as
+                    # dead rather than leave a half-evicted gang behind
+                    killed = True
+                for move in report.get(mid, {}).get("moves", []):
+                    if move["state"] != "Done":
+                        continue
+                    rebound = [self.fake.get_pod("default", nm)
+                               for nm in move["rebound"]]
+                    rebound = [p for p in rebound
+                               if p is not None and p.node_name]
+                    if len(rebound) == len(move["rebound"]):
+                        self.groups[move["group"]] = rebound
+                        self.migrations_rebound += 1
+            if killed:
+                # kill -9 window: the job dies after its checkpoint,
+                # before the re-bind — the executor must release every
+                # hold with nothing half-bound, and the job framework
+                # (played here) tears down whatever pods remain
+                self.scheduler.abort_migration(mid, why="chaos kill -9")
+                self.migrations_killed += 1
+                for g, gpods in mover_pods.items():
+                    if g in self.groups:
+                        continue  # re-bound before the kill landed
+                    for bp in gpods:
+                        self.fake.delete_pod(bp.namespace, bp.name)
+        # drive the waiter gang to completion through the normal ladder
+        # (reservation-steered when the migration landed); gang semantics
+        # on failure
+        ok = True
+        bound: List[Pod] = []
+        for i in range(pods):
+            member = f"{name}-{i}"
+            if member not in created:
+                self.fake.create_pod(_make_pod(member, spec))
+                created.append(member)
+            node = self._filter_member(member, spec)
+            if node is None or not self._bind(member, node):
+                ok = False
+                break
+            stored = self.fake.get_pod("default", member)
+            if stored is None or not stored.node_name:
+                ok = False
+                break
+            bound.append(stored)
+        if ok:
+            self.groups[name] = bound
+            self.gangs_completed += 1
+        else:
+            self._rollback(created)
+        self.schedules_done += 1
+        self._check(f"after migrate op #{self.schedules_done} ({name})")
+
     def op_kill_pod_mid_gang(self) -> None:
         """Delete one member of a bound gang, then (as the gang framework
         would) tear down the rest — never leaves a partial gang behind."""
@@ -416,6 +566,8 @@ class ChaosHarness:
             + [self.op_flip_node] * 2
             + [self.op_kill_pod_mid_gang] * 1
         )
+        if self.ops_profile == "defrag-v1":
+            ops += [self.op_migrate] * 3
         last_restart_at = 0
         while self.schedules_done < n_schedules:
             self.rng.choice(ops)()
@@ -430,5 +582,8 @@ class ChaosHarness:
             "gangs_live": len(self.groups),
             "restarts": self.restarts,
             "injector": dict(self.chaos.stats),
+            "migrations_planned": self.migrations_planned,
+            "migrations_killed": self.migrations_killed,
+            "migrations_rebound": self.migrations_rebound,
             "violations": list(self.violations),
         }

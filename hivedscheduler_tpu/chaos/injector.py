@@ -219,6 +219,20 @@ class ChaosKubeClient(KubeClient):
         self._maybe_fail("list_pods")
         return self.inner.list_pods()
 
+    def create_pod(self, pod: Pod) -> None:
+        """Pod creation (the defrag executor's replacement-pod path):
+        transient injected failure before the write, like the reads — the
+        executor rolls the half-placed move back on failure."""
+        self._maybe_fail("create_pod")
+        self.inner.create_pod(pod)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        """Pod deletion (the defrag executor's SIGTERM-analogue eviction):
+        injected failure leaves the pod in place — evictions are
+        idempotent and re-issued by resume_migrations."""
+        self._maybe_fail("delete_pod")
+        self.inner.delete_pod(namespace, name)
+
     def bind_pod(self, binding: Binding) -> None:
         p = self.plan
         streak = self._consecutive_errors.get("bind_pod", 0)

@@ -307,19 +307,107 @@ def check_cluster_views(algo, ctx: str = "") -> None:
                        f"drifted from topology rebuild")
 
 
+def check_defrag(scheduler, ctx: str = "") -> None:
+    """Structural invariants of the defrag executor's reservation +
+    migration state machine (runtime/scheduler.py; in-memory by design, so
+    a crash-restart must come back with NOTHING — recovery rebuilds
+    allocations from bound pods only):
+
+    - **No orphaned reservation**: every reservation's holder is alive —
+      a waiter reservation's holder is a recorded waiter, an in-flight
+      migration's waiter, or an already-allocated group *momentarily*
+      between placement and release (never observed at a quiescent check);
+      a migration reservation's migration must exist and be active.
+    - **No double hold**: two reservations never hold the same node (a
+      plan that reserved overlapping slices would dead-lock itself).
+    - **No half-released mover**: an Evicting move's group is either still
+      fully allocated (eviction in flight) or completely gone — a group
+      absent from the algorithm with member pods still in
+      ``pod_schedule_statuses`` would be a placement leak.
+    - **Terminal migrations hold nothing**: Done/Failed/Aborted migrations
+      have no reservations left.
+    """
+    reservations = getattr(scheduler, "_reservations", None)
+    migrations = getattr(scheduler, "_migrations", None)
+    if reservations is None or migrations is None:
+        return  # pre-defrag scheduler object: nothing to check
+    algo = scheduler.scheduler_algorithm
+    seen_nodes: Dict[str, str] = {}
+    for res in reservations.values():
+        for n in res.nodes:
+            if n in seen_nodes and seen_nodes[n] != res.holder:
+                _fail(ctx, f"node {n} reserved for both {seen_nodes[n]} "
+                           f"and {res.holder} — double hold")
+            seen_nodes[n] = res.holder
+        mig = migrations.get(res.migration_id) if res.migration_id else None
+        if res.kind == "migration":
+            if mig is None or not mig.active:
+                _fail(ctx, f"migration reservation for {res.holder} has no "
+                           f"active migration ({res.migration_id}) — "
+                           f"orphaned reservation")
+        elif res.kind == "waiter":
+            holder_live = (
+                res.holder in getattr(scheduler, "_defrag_waiters", {})
+                or res.holder in algo.affinity_groups
+                or (mig is not None and mig.active)
+                or any(m.waiter == res.holder and m.active
+                       for m in migrations.values())
+            )
+            if not holder_live:
+                _fail(ctx, f"waiter reservation for {res.holder} has no "
+                           f"live waiter, group, or migration — orphaned "
+                           f"reservation")
+    for mig in migrations.values():
+        held = [r for r in reservations.values()
+                if r.migration_id == mig.id]
+        if not mig.active:
+            if mig.state == "Done":
+                # a completed consolidation legitimately keeps the WAITER
+                # hold until the waiter binds (or TTL); move-target holds
+                # must be gone
+                leftover = [r.holder for r in held if r.kind != "waiter"
+                            or r.holder != mig.waiter]
+            else:
+                leftover = [r.holder for r in held]
+            if leftover:
+                _fail(ctx, f"terminal migration {mig.id} ({mig.state}) "
+                           f"still holds reservations for {leftover}")
+        for move in mig.moves:
+            if not mig.active:
+                continue
+            group_alive = move.group in algo.affinity_groups
+            pods_tracked = [
+                p.uid for p in move.evicted_pods
+                if p.uid in scheduler.pod_schedule_statuses
+            ]
+            if move.state == "Evicting" and not group_alive and pods_tracked:
+                # the informer deletes a pod's status and its allocation in
+                # one locked block, and the group only dies when the last
+                # pod releases — a dead group with tracked member pods is
+                # unreachable unless that atomicity broke
+                _fail(ctx, f"mover {move.group} of {mig.id} is half-released"
+                           f": group gone but pods {pods_tracked} still "
+                           f"tracked — placement leak window")
+
+
 def check_all(
     algo,
     ctx: str = "",
     full_groups: Optional[Iterable[str]] = None,
     allow_partial_placement: bool = False,
+    scheduler=None,
 ) -> None:
-    """Run every algorithm-state invariant (one locked snapshot per check)."""
+    """Run every algorithm-state invariant (one locked snapshot per check).
+    Pass the owning ``HivedScheduler`` as ``scheduler`` to additionally
+    check the defrag reservation/migration state machine."""
     check_vc_safety(algo, ctx)
     check_books(algo, ctx)
     check_cell_ownership(algo, ctx)
     check_cluster_views(algo, ctx)
     check_gang_atomicity(algo, ctx, full_groups=full_groups,
                          allow_partial_placement=allow_partial_placement)
+    if scheduler is not None:
+        check_defrag(scheduler, ctx)
 
 
 # ---------------------------------------------------------------------------

@@ -63,6 +63,16 @@ def main(argv=None) -> int:
         "the server stops; /healthz stays green throughout (0 = stop "
         "immediately)",
     )
+    parser.add_argument(
+        "--defrag-tick-secs",
+        type=float,
+        default=5.0,
+        help="period of the defragmentation watch loop (scheduler."
+        "defrag_tick: sweep expired reservations, advance in-flight "
+        "migrations, plan for the longest-waiting gang; see "
+        "doc/design/defrag.md). 0 disables the loop; HIVED_DEFRAG=0 "
+        "makes every tick a no-op",
+    )
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -116,7 +126,14 @@ def main(argv=None) -> int:
     host, port = server.async_run()
     log.info("tpu-hive ready on %s:%s", host, port)
     stop = common.new_stop_event()
-    stop.wait()
+    if args.defrag_tick_secs > 0:
+        # the defrag watch loop rides the main thread's signal wait: each
+        # tick sweeps expired reservations, advances in-flight migrations
+        # and plans for the longest-waiting gang
+        while not stop.wait(args.defrag_tick_secs):
+            scheduler.defrag_tick()
+    else:
+        stop.wait()
     # graceful termination: readiness flips first (load balancer / probes
     # stop routing new work), in-flight requests get the drain window,
     # liveness stays green — then the listener closes

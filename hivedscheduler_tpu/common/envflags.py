@@ -75,6 +75,30 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
        "`0` disables the multi-chain-relax infeasibility cache (waiting "
        "gangs then re-probe every cycle).",
        "hivedscheduler_tpu/algorithm/hived.py"),
+    # -- defragmentation / backfill (doc/design/defrag.md) ----------------
+    _f("HIVED_DEFRAG", "1",
+       "`0` is the kill switch for work-preserving defragmentation: no "
+       "migration planning, no reservations, no waiter recording — "
+       "decision-identical to the pre-defrag scheduler (differential "
+       "guard).",
+       "hivedscheduler_tpu/defrag/__init__.py"),
+    _f("HIVED_BACKFILL", "1",
+       "`0` disables opportunistic backfill into reserved holes "
+       "(reservations only form when defrag is on, so backfill is inert "
+       "under `HIVED_DEFRAG=0`).",
+       "hivedscheduler_tpu/defrag/__init__.py"),
+    _f("HIVED_DEFRAG_MAX_MOVES", "2",
+       "Largest move-set the migration planner probes per waiter (1 = "
+       "singles only).",
+       "hivedscheduler_tpu/defrag/planner.py"),
+    _f("HIVED_DEFRAG_MAX_PROBES", "24",
+       "What-if probe budget per planning attempt — bounds planning cost "
+       "regardless of cluster size.",
+       "hivedscheduler_tpu/defrag/planner.py"),
+    _f("HIVED_DEFRAG_RESERVE_TTL_S", "300",
+       "Reservation time-to-live: a migration/waiter hold a crashed "
+       "partner never releases is swept after this many seconds.",
+       "hivedscheduler_tpu/runtime/scheduler.py"),
     _f("HIVED_GC_FREEZE", "1",
        "`0` opts out of gc.freeze() after scheduler warmup (the scheduler "
        "then pays the gen-2 collection cost).",

@@ -1,0 +1,101 @@
+"""Work-preserving defragmentation + opportunistic backfill.
+
+HiveD's headline fault-tolerance capability is *work-preserving
+reconfiguration* (PAPER.md §0.5); until this subsystem it was exercised only
+reactively (node-failure recovery, crash-restart replay).  The trace data
+says scheduling *quality* is the cost center now: ~28% of chip-time waits,
+~89% of that wait attributed to packing (``trace_wait_packing_share``,
+BENCH_r05) — free chips exist, but not in the contiguous shape a waiting
+gang needs.  This package turns reconfiguration into a scheduling policy:
+
+- :mod:`~hivedscheduler_tpu.defrag.probe` — transactional what-if placement
+  probes against the live cluster view (mutate under the scheduler lock,
+  roll back bit-exact via the recovery path's ``add_allocated_pod``);
+- :mod:`~hivedscheduler_tpu.defrag.planner` — the migration planner: find a
+  minimal set of running gangs whose relocation frees a contiguous slice
+  for a packing-blocked waiter, scored by chips-moved x checkpoint cost vs
+  the chip-time the waiter burns;
+- :mod:`~hivedscheduler_tpu.defrag.backfill` — the opportunistic backfill
+  policy: admit short/preemptible jobs into holes held for a big waiting
+  gang, bounded so backfill never delays the reservation it rides in;
+- :mod:`~hivedscheduler_tpu.defrag.executor` — the reservation + migration
+  state machine *types*; the executor itself lives in
+  ``runtime/scheduler.py`` (the algorithm-mutation chokepoint, CON003) and
+  drives each move through the existing preemption contract: evict
+  (SIGTERM -> checkpoint-and-exit-0) -> re-place at the tighter target ->
+  resume.
+
+Kill switches: ``HIVED_DEFRAG=0`` / ``HIVED_BACKFILL=0`` reproduce the
+pre-defrag scheduler exactly (differential guards pin this, same pattern
+as ``HIVED_PAGED_KV=0`` / ``HIVED_INCR=0``).  Contract + state machine:
+doc/design/defrag.md.
+"""
+
+from __future__ import annotations
+
+from hivedscheduler_tpu.common import envflags
+
+# Probe/planner entry points that mutate algorithm state (through the
+# transactional probe). hivedlint's CON002 call-graph fixpoint treats a call
+# to any of these attributes inside HivedScheduler as an algorithm-mutating
+# site that must hold the scheduler lock; DFG001 confines the raw mutator
+# calls themselves to defrag/probe.py. Keep in sync with probe.WhatIfProbe
+# and planner.MigrationPlanner method names.
+LOCKED_ENTRY_ATTRS = frozenset({"run_probe", "plan_migration"})
+
+
+def defrag_enabled() -> bool:
+    """``HIVED_DEFRAG=0`` is the kill switch: no planning, no reservations,
+    no waiter recording — today's scheduler, bit for bit."""
+    return envflags.get("HIVED_DEFRAG", "1") != "0"
+
+
+def backfill_enabled() -> bool:
+    """``HIVED_BACKFILL=0`` disables backfill admission into reserved holes
+    (reservations still form when defrag is on)."""
+    return envflags.get("HIVED_BACKFILL", "1") != "0"
+
+
+from hivedscheduler_tpu.defrag.backfill import BackfillDecision, BackfillPolicy  # noqa: E402
+from hivedscheduler_tpu.defrag.executor import (  # noqa: E402
+    MIGRATION_ABORTED,
+    MIGRATION_DONE,
+    MIGRATION_EVICTING,
+    MIGRATION_FAILED,
+    MIGRATION_REBINDING,
+    Migration,
+    Move,
+    Reservation,
+)
+from hivedscheduler_tpu.defrag.planner import (  # noqa: E402
+    MigrationPlan,
+    MigrationPlanner,
+    PlannedMove,
+    PlanRejected,
+    RunningGroup,
+)
+from hivedscheduler_tpu.defrag.probe import GangSpec, ProbeResult, WhatIfProbe  # noqa: E402
+
+__all__ = [
+    "BackfillDecision",
+    "BackfillPolicy",
+    "GangSpec",
+    "LOCKED_ENTRY_ATTRS",
+    "Migration",
+    "MigrationPlan",
+    "MigrationPlanner",
+    "Move",
+    "PlannedMove",
+    "PlanRejected",
+    "ProbeResult",
+    "Reservation",
+    "RunningGroup",
+    "WhatIfProbe",
+    "backfill_enabled",
+    "defrag_enabled",
+    "MIGRATION_ABORTED",
+    "MIGRATION_DONE",
+    "MIGRATION_EVICTING",
+    "MIGRATION_FAILED",
+    "MIGRATION_REBINDING",
+]

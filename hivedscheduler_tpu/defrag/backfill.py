@@ -1,0 +1,69 @@
+"""Opportunistic backfill: who may ride in a hole the packer is holding?
+
+When a big gang waits, the scheduler holds (reserves) the slice it is
+consolidating toward.  Holding chips idle is exactly the utilization gap
+this subsystem exists to close — so short or preemptible work is admitted
+*into* the hold, bounded so backfill never delays the reservation it rides
+in:
+
+- an **opportunistic** job (priority < 0) is always admissible: when the
+  waiter's slice becomes placeable, HiveD's existing preemption evicts
+  opportunistic work — the reservation holder reclaims its hole by
+  contract, so the ride is free;
+- a **guaranteed** job is admissible only when its estimated duration is
+  known and it finishes before the waiter's estimated start
+  (``now + duration * slack <= eta``).  No duration, no ride: an
+  unbounded guaranteed job parked in the hole would push the waiter's
+  start indefinitely (it cannot be preempted by an equal-priority waiter).
+
+The policy is a pure decision function — deterministic, no clock reads, no
+state — so the trace sim and the runtime share it verbatim.  The runtime
+rarely knows durations (pods carry none), so runtime backfill is in
+practice the opportunistic rule; the trace sim exercises both arms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from hivedscheduler_tpu.api.constants import OPPORTUNISTIC_PRIORITY
+
+
+@dataclasses.dataclass(frozen=True)
+class BackfillDecision:
+    admit: bool
+    reason: str  # preemptible | fits-window | would-delay-waiter |
+    #              unknown-duration | no-reservation
+
+
+class BackfillPolicy:
+    """``slack`` > 1 pads the duration estimate (finish-time optimism is the
+    classic backfill failure mode)."""
+
+    def __init__(self, slack: float = 1.25):
+        if slack < 1.0:
+            raise ValueError("backfill slack must be >= 1.0")
+        self.slack = slack
+
+    def admits(
+        self,
+        priority: int,
+        now: float,
+        duration: Optional[float] = None,
+        reservation_eta: Optional[float] = None,
+    ) -> BackfillDecision:
+        """May a candidate gang use chips held for a waiting reservation?
+
+        ``reservation_eta`` is the waiter's estimated start time on the
+        caller's clock (None = unknown — only preemptible work rides then).
+        """
+        if priority <= OPPORTUNISTIC_PRIORITY:
+            return BackfillDecision(True, "preemptible")
+        if duration is None:
+            return BackfillDecision(False, "unknown-duration")
+        if reservation_eta is None:
+            return BackfillDecision(False, "would-delay-waiter")
+        if now + duration * self.slack <= reservation_eta:
+            return BackfillDecision(True, "fits-window")
+        return BackfillDecision(False, "would-delay-waiter")

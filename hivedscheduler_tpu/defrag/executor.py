@@ -1,0 +1,124 @@
+"""Reservation + migration state — the types behind the runtime executor.
+
+The executor itself lives in ``runtime/scheduler.py`` (the one file allowed
+to call algorithm mutators, hivedlint CON003); this module holds the
+passive records it drives, so the state machine is importable by the chaos
+invariant checker and the inspect path without touching the runtime.
+
+Reservation lifecycle (all transitions under the scheduler lock)::
+
+    plan accepted ──> waiter Reservation(kind="waiter") on the slice the
+                      probe found, + one Reservation(kind="migration") per
+                      move's re-placement target
+    mover rebound ──> its migration reservation released
+    waiter bound  ──> waiter reservation released
+    TTL expiry    ──> reservation swept (a crashed/partner-less migration
+                      must never fence cells forever); in-memory only, so a
+                      scheduler crash drops every reservation — recovery
+                      rebuilds allocations from bound pods and nothing else
+                      (the no-orphaned-reservation invariant).
+
+Migration lifecycle::
+
+    Evicting  — movers' pods deleted (SIGTERM -> the supervisor's
+                checkpoint-and-exit-0 contract, parallel/supervisor.py);
+                waiting for the informer to release their cells
+    Rebinding — all movers released; replacement pods are being created,
+                scheduled at the reserved target, and bound (gang-atomic
+                per move: any member failure rolls the whole move back)
+    Done      — every move rebound; the waiter's next filter cycle lands in
+                the freed slice
+    Failed    — a move could not re-place (state drifted since the probe);
+                the move's replacements were rolled back, reservations
+                released.  The job's work survives in its checkpoint; the
+                job framework resubmits it like any preempted gang.
+    Aborted   — the job died mid-migration (e.g. kill -9 after checkpoint,
+                before re-bind) or an operator cancelled; reservations
+                released, nothing half-bound remains.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Set
+
+from hivedscheduler_tpu.defrag.probe import GangSpec
+from hivedscheduler_tpu.k8s.types import Pod
+
+MIGRATION_EVICTING = "Evicting"
+MIGRATION_REBINDING = "Rebinding"
+MIGRATION_DONE = "Done"
+MIGRATION_FAILED = "Failed"
+MIGRATION_ABORTED = "Aborted"
+
+# states with live reservations / pending work
+ACTIVE_MIGRATION_STATES = (MIGRATION_EVICTING, MIGRATION_REBINDING)
+
+
+@dataclasses.dataclass
+class Reservation:
+    """A node-granular hold: while live, no gang other than ``holder`` may
+    be offered these nodes (unless backfill admits it)."""
+
+    holder: str            # affinity-group name the hold serves
+    nodes: Set[str]
+    kind: str              # "waiter" | "migration"
+    created_at: float      # time.monotonic() domain
+    deadline: float        # created_at + TTL; swept when passed
+    migration_id: Optional[str] = None
+
+    def expired(self, now: float) -> bool:
+        return now >= self.deadline
+
+    def to_dict(self) -> dict:
+        return {
+            "holder": self.holder,
+            "kind": self.kind,
+            "nodes": sorted(self.nodes),
+            "migrationId": self.migration_id,
+        }
+
+
+@dataclasses.dataclass
+class Move:
+    """One gang's relocation inside a migration."""
+
+    group: str
+    spec: GangSpec
+    evicted_pods: List[Pod]          # the old bound incarnation
+    target_nodes: List[str]
+    rebound_pods: List[Pod] = dataclasses.field(default_factory=list)
+    state: str = MIGRATION_EVICTING
+
+    def to_dict(self) -> dict:
+        return {
+            "group": self.group,
+            "chips": self.spec.chips,
+            "state": self.state,
+            "targetNodes": list(self.target_nodes),
+            "evicted": [p.name for p in self.evicted_pods],
+            "rebound": [p.name for p in self.rebound_pods],
+        }
+
+
+@dataclasses.dataclass
+class Migration:
+    id: str
+    waiter: str
+    waiter_chips: int
+    moves: List[Move]
+    state: str = MIGRATION_EVICTING
+    generation: int = 1   # replacement-pod uid epoch (uids never recycle)
+
+    @property
+    def active(self) -> bool:
+        return self.state in ACTIVE_MIGRATION_STATES
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "waiter": self.waiter,
+            "waiterChips": self.waiter_chips,
+            "state": self.state,
+            "moves": [m.to_dict() for m in self.moves],
+        }

@@ -1,0 +1,306 @@
+"""The migration planner: which running gangs should move, and is it worth
+it?
+
+Given a waiting gang blocked by *packing* (free chips exist but no
+contiguous slice fits — the wait-attribution signal the trace replay
+computes), the planner searches for a minimal set of running gangs whose
+relocation frees the slice:
+
+- **candidates**: fully-allocated gangs at priority <= the waiter's (a
+  migration is work-preserving, but disturbing higher-priority work for a
+  lower waiter inverts the priority contract) and no bigger than
+  ``max_move_ratio`` x the waiter (moving a whale to seat a minnow never
+  scores);
+- **search**: singles in ascending chip order first, then pairs, each
+  validated by one transactional what-if probe (remove movers -> place
+  waiter -> re-place movers; see :mod:`~hivedscheduler_tpu.defrag.probe`),
+  bounded by a probe budget — planning cost is bounded regardless of
+  cluster size;
+- **scoring**: benefit = waiter chips x the chip-time it would otherwise
+  burn waiting (``waiter_wait_estimate``); cost = chips moved x the
+  checkpoint/restore downtime (``move_downtime``).  When both estimates are
+  known a plan must clear ``score = benefit / cost >= 1`` or it is rejected
+  as not-worth-it; with unknown estimates the chip-ratio bound alone
+  governs (the runtime rarely knows durations; the trace sim always does).
+
+The planner itself never mutates state: every mutation happens inside the
+probe's transaction and is rolled back.  Executing a plan is the runtime
+executor's job (``runtime/scheduler.py``) or the trace sim's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from hivedscheduler_tpu.common import envflags
+from hivedscheduler_tpu.defrag.probe import GangSpec, WhatIfProbe
+from hivedscheduler_tpu.k8s.types import Pod
+
+
+def _int_or(raw, default: int) -> int:
+    try:
+        return int(raw or default)
+    except ValueError:
+        return default
+
+
+def vc_quota_chips(algo, vc: str) -> int:
+    """A VC's guaranteed quota in leaf chips, counted from its static
+    virtual cell trees (read-only; ``vc_free_cell_num`` is the *dynamic*
+    free count, decremented as preassigned cells bind). This is the
+    binding constraint for a guaranteed waiter: migration conserves it, so
+    a waiter needing more than the quota's free remainder can never be
+    helped by moving gangs."""
+    vcs = algo.vc_schedulers.get(vc)
+    if vcs is None:
+        return 0
+    total = 0
+    for ccl in vcs.non_pinned_full_cell_list.values():
+        total += len(ccl[1])
+    for ccl in vcs.pinned_cells.values():
+        total += len(ccl[1])
+    return total
+
+
+@dataclasses.dataclass
+class RunningGroup:
+    """A fully-allocated gang as the planner sees it."""
+
+    name: str
+    spec: GangSpec
+    bound_pods: List[Pod]
+
+    @property
+    def chips(self) -> int:
+        return self.spec.chips
+
+    @property
+    def priority(self) -> int:
+        return self.spec.priority
+
+
+@dataclasses.dataclass
+class PlannedMove:
+    group: RunningGroup
+    # {node -> leaf indices} the probe found for the re-placement; advisory
+    # (the executor re-derives deterministically under the same state, and
+    # re-validates under drifted state)
+    target_placement: Dict[str, List[int]]
+
+    @property
+    def target_nodes(self) -> List[str]:
+        return sorted(self.target_placement)
+
+
+@dataclasses.dataclass
+class MigrationPlan:
+    waiter: GangSpec
+    moves: List[PlannedMove]
+    waiter_placement: Dict[str, List[int]]
+    score: Optional[float]  # None when wait/downtime estimates are unknown
+    probes_spent: int
+
+    @property
+    def waiter_nodes(self) -> List[str]:
+        return sorted(self.waiter_placement)
+
+    @property
+    def moved_chips(self) -> int:
+        return sum(m.group.chips for m in self.moves)
+
+    def to_dict(self) -> dict:
+        return {
+            "waiter": self.waiter.name,
+            "waiterChips": self.waiter.chips,
+            "waiterNodes": self.waiter_nodes,
+            "moves": [
+                {
+                    "group": m.group.name,
+                    "chips": m.group.chips,
+                    "targetNodes": m.target_nodes,
+                }
+                for m in self.moves
+            ],
+            "movedChips": self.moved_chips,
+            "score": self.score,
+            "probesSpent": self.probes_spent,
+        }
+
+
+@dataclasses.dataclass
+class PlanRejected:
+    """Why no plan was produced — feeds the planner-rejection metrics and
+    decision traces."""
+
+    reason: str  # capacity | no-candidates | infeasible | not-worth-it
+    detail: str = ""
+    probes_spent: int = 0
+
+
+class MigrationPlanner:
+    """Bounded greedy search over single- and pair-moves.
+
+    ``max_moves``/``max_probes`` default from the ``HIVED_DEFRAG_MAX_MOVES``
+    / ``HIVED_DEFRAG_MAX_PROBES`` env flags (registered in
+    common/envflags.py) so operators can tune planning effort without code.
+    """
+
+    def __init__(
+        self,
+        max_moves: Optional[int] = None,
+        max_probes: Optional[int] = None,
+        max_move_ratio: float = 4.0,
+        move_downtime: Optional[float] = None,
+    ):
+        self.max_moves = (
+            max_moves if max_moves is not None
+            else _int_or(envflags.get("HIVED_DEFRAG_MAX_MOVES", "2"), 2)
+        )
+        self.max_probes = (
+            max_probes if max_probes is not None
+            else _int_or(envflags.get("HIVED_DEFRAG_MAX_PROBES", "24"), 24)
+        )
+        self.max_move_ratio = max_move_ratio
+        self.move_downtime = move_downtime
+
+    # -- scoring -----------------------------------------------------------
+
+    def _score(
+        self,
+        waiter: GangSpec,
+        moved_chips: int,
+        waiter_wait_estimate: Optional[float],
+    ) -> Optional[float]:
+        if waiter_wait_estimate is None or not self.move_downtime:
+            return None
+        cost = moved_chips * self.move_downtime
+        if cost <= 0:
+            return float("inf")
+        return (waiter.chips * waiter_wait_estimate) / cost
+
+    def _movable_for(self, waiter: GangSpec, g: RunningGroup) -> bool:
+        """Which running gangs can possibly unblock this waiter?
+
+        - never a higher-priority gang (work-preserving or not, disturbing
+          higher-priority work for a lower waiter inverts the contract);
+        - never a whale (``max_move_ratio``);
+        - a *guaranteed* waiter is blocked inside its own VC quota: VC
+          safety guarantees a physical home for every free virtual cell,
+          and opportunistic blockers are lazily preempted — so only
+          same-VC *guaranteed* gangs fragment what it needs;
+        - an *opportunistic* waiter contends on raw physical cells, so any
+          (necessarily opportunistic, by the priority rule) gang may move.
+        """
+        if g.priority > waiter.priority:
+            return False
+        if g.chips > self.max_move_ratio * max(1, waiter.chips):
+            return False
+        if waiter.priority >= 0:
+            return g.priority >= 0 and g.spec.vc == waiter.vc
+        return True
+
+    # -- the search --------------------------------------------------------
+
+    def plan_promotion(self, probe: WhatIfProbe, group: RunningGroup,
+                       to_priority: int):
+        """Can ``group`` (typically running opportunistically beyond quota)
+        be re-placed at ``to_priority`` right now?  One swap probe: remove
+        the running incarnation, place the same gang at the new priority,
+        roll back.  Returns a single-move :class:`MigrationPlan` (the move
+        relocates the group itself) or :class:`PlanRejected`.
+
+        This is how beyond-quota backfill is made work-preserving: the
+        gang rides other VCs' idle guarantees preemptibly, and when its
+        own quota frees the executor promotes it — checkpoint, re-place
+        under the guarantee, resume — instead of leaving it exposed to
+        preemption forever.
+        """
+        promoted = dataclasses.replace(group.spec, priority=to_priority)
+        result = probe.run_swap_probe(group.bound_pods, promoted)
+        if not result.feasible:
+            return PlanRejected("infeasible", result.reason, probes_spent=1)
+        return MigrationPlan(
+            waiter=promoted,
+            moves=[PlannedMove(
+                group=group,
+                target_placement=result.placements[promoted.name],
+            )],
+            waiter_placement=result.placements[promoted.name],
+            score=None,
+            probes_spent=1,
+        )
+
+    def plan_migration(
+        self,
+        probe: WhatIfProbe,
+        waiter: GangSpec,
+        running: Sequence[RunningGroup],
+        free_chips: Optional[int] = None,
+        waiter_wait_estimate: Optional[float] = None,
+    ):
+        """Returns a :class:`MigrationPlan` or a :class:`PlanRejected`.
+
+        ``free_chips`` (when the caller knows it) short-circuits the
+        capacity case: migration conserves free chips, so a waiter needing
+        more than exist can never be helped by moving anything.
+        """
+        if free_chips is not None and free_chips < waiter.chips:
+            return PlanRejected("capacity",
+                                f"{free_chips} free < {waiter.chips} needed")
+        candidates = sorted(
+            (g for g in running if self._movable_for(waiter, g)),
+            key=lambda g: (g.chips, g.name),
+        )
+        if not candidates:
+            return PlanRejected("no-candidates",
+                                "no running gang is movable for this waiter")
+
+        probes = 0
+        combos: List[Tuple[RunningGroup, ...]] = [
+            (g,) for g in candidates
+        ]
+        if self.max_moves >= 2:
+            combos += list(itertools.combinations(candidates, 2))
+        for combo in combos:
+            if probes >= self.max_probes:
+                return PlanRejected(
+                    "infeasible",
+                    f"probe budget exhausted ({self.max_probes})",
+                    probes_spent=probes,
+                )
+            probes += 1
+            result = probe.run_probe(
+                waiter,
+                [(g.name, g.spec, g.bound_pods) for g in combo],
+            )
+            if not result.feasible:
+                continue
+            moved_chips = sum(g.chips for g in combo)
+            score = self._score(waiter, moved_chips, waiter_wait_estimate)
+            if score is not None and score < 1.0:
+                return PlanRejected(
+                    "not-worth-it",
+                    f"score {score:.3f} < 1 (moved {moved_chips} chips)",
+                    probes_spent=probes,
+                )
+            return MigrationPlan(
+                waiter=waiter,
+                moves=[
+                    PlannedMove(
+                        group=g,
+                        target_placement=result.placements[g.name],
+                    )
+                    for g in combo
+                ],
+                waiter_placement=result.placements[waiter.name],
+                score=score,
+                probes_spent=probes,
+            )
+        return PlanRejected(
+            "infeasible",
+            f"no move set within bounds frees a slice "
+            f"(tried {probes} probe(s))",
+            probes_spent=probes,
+        )

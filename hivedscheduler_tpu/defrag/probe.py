@@ -1,0 +1,260 @@
+"""Transactional what-if placement probes on the live cluster view.
+
+A migration plan is only as good as its feasibility proof: "if gangs A and B
+moved, would the waiter fit — and would A and B still fit somewhere else?"
+Rather than cloning the (100k-object) cell trees per question, the probe
+runs the question against the *live* ``HivedAlgorithm`` and rolls every
+mutation back before returning:
+
+- removing a running gang = ``delete_allocated_pod`` per member;
+- restoring it = ``add_allocated_pod`` with the member's original bind
+  annotations — the crash-recovery path, which rebuilds the exact
+  chip-granular placement (the ``check_placement_preserved`` contract);
+- placing a hypothetical gang = ``schedule`` + ``add_allocated_pod`` per
+  member, removed again on exit.
+
+The rollback is therefore bit-exact by the same mechanism recovery is, and
+every chaos soak double-checks it: the from-scratch invariant suite
+(``chaos.invariants.check_all``) runs after schedules that interleave with
+probes, so a probe that failed to restore state cannot hide.
+
+Concurrency: the probe mutates algorithm state, so the caller must hold the
+scheduler lock (in-runtime) or otherwise serialize (the single-threaded
+bench/sim). ``runtime/scheduler.py`` is the only runtime caller; hivedlint
+DFG001 pins this module as the sole home of raw mutator calls inside the
+defrag package, and CON002 requires the runtime entry points that reach
+``run_probe`` to hold the scheduler lock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from hivedscheduler_tpu.api import constants as api_constants
+from hivedscheduler_tpu.common.utils import to_json
+from hivedscheduler_tpu.k8s.types import Container, Pod
+from hivedscheduler_tpu.runtime import utils as internal_utils
+from hivedscheduler_tpu.runtime.types import FILTERING_PHASE
+
+# probe pods live in their own namespace so decision traces and logs
+# attribute them unambiguously; they never reach any ApiServer
+PROBE_NAMESPACE = "defrag-probe"
+
+
+@dataclasses.dataclass(frozen=True)
+class GangSpec:
+    """The scheduling identity of a gang, sufficient to synthesize member
+    pods for a what-if placement (mirrors the pod scheduling-spec
+    annotation)."""
+
+    name: str
+    vc: str
+    priority: int
+    leaf_cell_type: str
+    # (pod_number, leaf_cell_number) per member entry
+    members: Tuple[Tuple[int, int], ...]
+    multi_chain_relax_policy: str = "fewest"
+
+    @property
+    def chips(self) -> int:
+        return sum(n * c for n, c in self.members)
+
+    @property
+    def pod_count(self) -> int:
+        return sum(n for n, _ in self.members)
+
+    @classmethod
+    def from_pod(cls, pod: Pod) -> "GangSpec":
+        """Derive the gang's spec from any member pod's annotation."""
+        s = internal_utils.extract_pod_scheduling_spec(pod)
+        return cls(
+            name=s.affinity_group.name,
+            vc=s.virtual_cluster,
+            priority=s.priority,
+            leaf_cell_type=s.leaf_cell_type,
+            members=tuple(
+                (m.pod_number, m.leaf_cell_number)
+                for m in s.affinity_group.members
+            ),
+            multi_chain_relax_policy=s.multi_chain_relax_policy,
+        )
+
+    def to_annotation(self, leaf_cell_number: int) -> str:
+        """The scheduling-spec annotation for a member pod holding
+        ``leaf_cell_number`` chips (gangs may mix member shapes, so the
+        top-level cell count is per-pod)."""
+        return to_json({
+            "virtualCluster": self.vc,
+            "priority": self.priority,
+            "leafCellType": self.leaf_cell_type,
+            "leafCellNumber": leaf_cell_number,
+            "multiChainRelaxPolicy": self.multi_chain_relax_policy,
+            "affinityGroup": {
+                "name": self.name,
+                "members": [
+                    {"podNumber": n, "leafCellNumber": c}
+                    for n, c in self.members
+                ],
+            },
+        })
+
+
+def gang_pods(spec: GangSpec, uid_prefix: str = "") -> List[Pod]:
+    """Synthesize one unbound pod per gang member; ``uid_prefix``
+    disambiguates replacement incarnations (migration re-binds must carry
+    fresh uids — a deleted pod's uid never comes back)."""
+    pods: List[Pod] = []
+    i = 0
+    for pod_number, chips in spec.members:
+        annotation = spec.to_annotation(chips)
+        for _ in range(pod_number):
+            name = f"{uid_prefix}{spec.name.replace('/', '.')}-{i}"
+            pods.append(Pod(
+                name=name,
+                uid=name,
+                namespace=PROBE_NAMESPACE if not uid_prefix else "default",
+                annotations={
+                    api_constants.ANNOTATION_POD_SCHEDULING_SPEC: annotation
+                },
+                containers=[Container(resource_limits={
+                    api_constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1
+                })],
+            ))
+            i += 1
+    return pods
+
+
+# a member list per gang, in the order schedule() must see them: every pod
+# of one member entry shares leaf_cell_number
+@dataclasses.dataclass
+class ProbeResult:
+    feasible: bool
+    reason: str = ""
+    # group name -> {node -> sorted leaf-cell indices} of the hypothetical
+    # placements found (waiter + each mover's re-placement target)
+    placements: Dict[str, Dict[str, List[int]]] = dataclasses.field(
+        default_factory=dict
+    )
+    probes_spent: int = 1
+
+    @property
+    def waiter_nodes(self) -> List[str]:
+        """Nodes of the first (waiter) placement, if any."""
+        if not self.placements:
+            return []
+        first = next(iter(self.placements.values()))
+        return sorted(first)
+
+    def nodes_of(self, group: str) -> List[str]:
+        return sorted(self.placements.get(group, {}))
+
+
+class WhatIfProbe:
+    """What-if transactions on one algorithm instance.
+
+    All public methods must be called under the caller's serialization (the
+    scheduler lock in the runtime). Every transaction restores the
+    algorithm's state exactly before returning.
+    """
+
+    def __init__(self, algo, nodes: Sequence[str]):
+        self.algo = algo
+        self.nodes = list(nodes)
+
+    # -- internals ---------------------------------------------------------
+
+    def _place_gang(self, spec: GangSpec) -> Optional[List[Pod]]:
+        """Schedule + allocate every member of a hypothetical gang; returns
+        the bound pods, or None (with partial members rolled back). Only a
+        pure bind counts: a preemption nomination means the slice is not
+        actually free."""
+        bound: List[Pod] = []
+        for pod in gang_pods(spec):
+            result = self.algo.schedule(pod, self.nodes, FILTERING_PHASE)
+            if result.pod_bind_info is None:
+                for bp in reversed(bound):
+                    self.algo.delete_allocated_pod(bp)
+                return None
+            bp = internal_utils.new_binding_pod(pod, result.pod_bind_info)
+            self.algo.add_allocated_pod(bp)
+            bound.append(bp)
+        return bound
+
+    def _remove_gang(self, bound_pods: Sequence[Pod]) -> None:
+        for bp in bound_pods:
+            self.algo.delete_allocated_pod(bp)
+
+    def _restore_gang(self, bound_pods: Sequence[Pod]) -> None:
+        # the recovery path: bind annotations rebuild the exact placement
+        for bp in bound_pods:
+            self.algo.add_allocated_pod(bp)
+
+    def _placement_of(self, group: str) -> Dict[str, List[int]]:
+        g = self.algo.get_affinity_group(group)
+        return {
+            n: sorted(ix) for n, ix in g.status.physical_placement.items()
+        }
+
+    # -- the transaction ---------------------------------------------------
+
+    def run_probe(
+        self,
+        waiter: GangSpec,
+        movers: Sequence[Tuple[str, GangSpec, Sequence[Pod]]],
+    ) -> ProbeResult:
+        """One full what-if: remove every mover, place the waiter, re-place
+        every mover elsewhere (the waiter claims its slice first, exactly
+        the order the executor replays), then roll everything back.
+
+        ``movers`` is a sequence of (group name, spec, bound member pods).
+        Feasible only if the waiter AND every mover's re-placement all bind.
+        """
+        removed: List[Sequence[Pod]] = []
+        placed: List[Sequence[Pod]] = []
+        placements: Dict[str, Dict[str, List[int]]] = {}
+        try:
+            for _name, _spec, bound_pods in movers:
+                self._remove_gang(bound_pods)
+                removed.append(bound_pods)
+            waiter_pods = self._place_gang(waiter)
+            if waiter_pods is None:
+                return ProbeResult(False, reason="waiter-unplaceable")
+            placed.append(waiter_pods)
+            placements[waiter.name] = self._placement_of(waiter.name)
+            for name, spec, _bound in movers:
+                mover_pods = self._place_gang(spec)
+                if mover_pods is None:
+                    placements.clear()
+                    return ProbeResult(
+                        False, reason=f"mover-unplaceable:{name}"
+                    )
+                placed.append(mover_pods)
+                placements[name] = self._placement_of(name)
+            return ProbeResult(True, placements=placements)
+        finally:
+            # rollback is unconditional: the probe never leaks state
+            for pods in reversed(placed):
+                self._remove_gang(pods)
+            for pods in reversed(removed):
+                self._restore_gang(pods)
+
+    def run_swap_probe(
+        self, bound_pods: Sequence[Pod], new_spec: GangSpec
+    ) -> ProbeResult:
+        """Can this running gang be re-placed as ``new_spec`` (same group
+        name, typically a different priority — the promotion question)?
+        Remove the running incarnation, try the new one, roll back."""
+        placed: Optional[List[Pod]] = None
+        self._remove_gang(bound_pods)
+        try:
+            placed = self._place_gang(new_spec)
+            if placed is None:
+                return ProbeResult(False, reason="swap-unplaceable")
+            return ProbeResult(True, placements={
+                new_spec.name: self._placement_of(new_spec.name)
+            })
+        finally:
+            if placed is not None:
+                self._remove_gang(placed)
+            self._restore_gang(bound_pods)

@@ -40,6 +40,13 @@ class Registry:
         with self._lock:
             self._gauges[name] = value
 
+    def get_gauge(self, name: str):
+        """Last value set for a gauge, or None if never set — the
+        scheduler-side admission hints read serving-published gauges
+        through this (runtime/scheduler.py get_admission_hints)."""
+        with self._lock:
+            return self._gauges.get(name)
+
     def observe(self, name: str, seconds: float, **labels: str) -> None:
         """Record one histogram sample. ``labels`` mirror ``inc`` (e.g. the
         serving histograms split by priority class); each label set keeps
@@ -180,3 +187,20 @@ REGISTRY.describe("tpu_hive_train_rollbacks_total",
 REGISTRY.describe("tpu_hive_watchdog_stalls_total",
                   "Watchdog step-deadline expiries (hung step; the process "
                   "exits nonzero so the gang restarts)")
+# defragmentation / backfill (defrag/ + runtime/scheduler.py executor)
+REGISTRY.describe("tpu_hive_defrag_migrations_total",
+                  "Work-preserving migrations by outcome (planned, "
+                  "completed, failed, aborted, expired)")
+REGISTRY.describe("tpu_hive_defrag_moved_chips_total",
+                  "Chips relocated by completed migration moves")
+REGISTRY.describe("tpu_hive_defrag_planner_rejections_total",
+                  "Migration planning attempts that produced no plan, by "
+                  "reason (capacity, no-candidates, infeasible, "
+                  "not-worth-it, evict-unsupported)")
+REGISTRY.describe("tpu_hive_defrag_reservations",
+                  "Live defrag reservations (cells held for a waiter or a "
+                  "mid-migration re-placement)")
+REGISTRY.describe("tpu_hive_backfill_admissions_total",
+                  "Gang scheduling decisions that crossed a reservation, "
+                  "by outcome (admitted = preemptible rider allowed into "
+                  "reserved nodes, blocked = reserved nodes withheld)")

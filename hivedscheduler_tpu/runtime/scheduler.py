@@ -20,8 +20,17 @@ from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
 
 from hivedscheduler_tpu.api import config as api_config
 from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.api.constants import OPPORTUNISTIC_PRIORITY
 from hivedscheduler_tpu.algorithm.hived import HivedAlgorithm
-from hivedscheduler_tpu.common import lockcheck
+from hivedscheduler_tpu.common import envflags, lockcheck
+from hivedscheduler_tpu import defrag as defrag_pkg
+from hivedscheduler_tpu.defrag import executor as defrag_exec
+from hivedscheduler_tpu.defrag.planner import (
+    MigrationPlanner,
+    RunningGroup,
+    vc_quota_chips,
+)
+from hivedscheduler_tpu.defrag.probe import GangSpec, WhatIfProbe, gang_pods
 from hivedscheduler_tpu.k8s.client import KubeClient
 from hivedscheduler_tpu.k8s.types import Binding, Node, Pod
 from hivedscheduler_tpu.runtime import extender as ei
@@ -65,6 +74,21 @@ class HivedScheduler:
         # happens under the scheduler lock (asserted when HIVED_LOCKCHECK=1)
         lockcheck.serialize_under(self.scheduler_algorithm, "scheduler_lock")
         self._started = False
+        # -- defrag/backfill executor state (doc/design/defrag.md) ---------
+        # All of it is in-memory only BY DESIGN: a scheduler crash drops
+        # every reservation and migration record; recovery rebuilds
+        # allocations from bound pods and nothing else, so a mid-migration
+        # crash can orphan neither cells nor holds (the chaos invariant).
+        # With HIVED_DEFRAG=0 nothing below is ever populated, so the
+        # filter/preempt paths are bit-identical to the pre-defrag
+        # scheduler (the kill-switch differential).
+        self._reservations: Dict[str, defrag_exec.Reservation] = {}
+        self._migrations: Dict[str, defrag_exec.Migration] = {}
+        self._defrag_waiters: Dict[str, dict] = {}  # group -> {pod, since}
+        self._migration_seq = 0
+        self._all_nodes_cache: Optional[List[str]] = None
+        self.defrag_reserve_ttl_s = float(
+            envflags.get("HIVED_DEFRAG_RESERVE_TTL_S", "300") or 300)
 
         kube_client.on_node_event(self._add_node, self._update_node, self._delete_node)
         kube_client.on_pod_event(self._add_pod, self._update_pod, self._delete_pod)
@@ -167,6 +191,36 @@ class HivedScheduler:
                 else:
                     self.scheduler_algorithm.delete_unallocated_pod(pod_status.pod)
                 del self.pod_schedule_statuses[pod.uid]
+            if self._defrag_waiters or self._reservations:
+                self._on_waiter_pod_deleted(pod)
+
+    def _on_waiter_pod_deleted(self, pod: Pod) -> None:
+        """A cancelled waiting gang must not strand its waiter record or
+        reservation until TTL: when the last pod of a recorded/reserved
+        group is deleted, drop both."""
+        try:
+            group = internal_utils.extract_pod_scheduling_spec(
+                pod).affinity_group.name
+        except Exception:
+            return
+        if (group not in self._defrag_waiters
+                and group not in self._reservations):
+            return
+        for st in self.pod_schedule_statuses.values():
+            if st.pod is None:
+                continue
+            try:
+                other = internal_utils.extract_pod_scheduling_spec(
+                    st.pod).affinity_group.name
+            except Exception:
+                continue
+            if other == group:
+                return  # gang still has live pods
+        self._defrag_waiters.pop(group, None)
+        res = self._reservations.get(group)
+        if res is not None and res.kind == "waiter":
+            del self._reservations[group]
+            self._update_reservation_gauge()
 
     def _add_bound_pod(self, pod: Pod) -> None:
         """Reference: addBoundPod, scheduler.go:306-337."""
@@ -330,14 +384,47 @@ class HivedScheduler:
                     "bind",
                 )
 
-            # pod state is Waiting or Preempting: run a new scheduling
+            # pod state is Waiting or Preempting: run a new scheduling.
+            # Defrag reservations (when any exist) withhold held nodes from
+            # other gangs; with HIVED_DEFRAG=0 the dict is always empty and
+            # this is exactly the pre-defrag call.
+            offered_nodes = suggested_nodes
+            if self._reservations:
+                offered_nodes = self._admissible_nodes(pod, suggested_nodes)
             result = self.scheduler_algorithm.schedule(
-                pod, suggested_nodes, internal.FILTERING_PHASE
+                pod, offered_nodes, internal.FILTERING_PHASE
             )
+            if (result.pod_bind_info is not None and self._reservations
+                    and self._placement_violates_reservation(
+                        pod, result.pod_bind_info)):
+                # the node offer is best-effort for guaranteed gangs (they
+                # ignore k8s suggestions by design), so the hold is
+                # ENFORCED on the decided placement: nothing is committed
+                # yet for a new group, so converting to a wait is safe
+                self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                    pod=pod, pod_state=internal.POD_WAITING,
+                    pod_schedule_result=internal.PodScheduleResult(
+                        pod_wait_info=internal.PodWaitInfo(
+                            reason="placement overlaps cells held by a "
+                                   "defrag reservation")),
+                )
+                wait_reason = ("Pod is waiting for preemptible or free "
+                               "resource to appear: placement overlaps a "
+                               "defrag reservation")
+                log.info("[%s]: %s", internal_utils.key(pod), wait_reason)
+                return (
+                    ei.ExtenderFilterResult(
+                        failed_nodes={_COMPONENT: wait_reason}),
+                    "wait",
+                )
             if result.pod_bind_info is not None:
                 binding_pod = internal_utils.new_binding_pod(pod, result.pod_bind_info)
                 # assume allocated so the next scheduling needn't wait for the bind
                 self.scheduler_algorithm.add_allocated_pod(binding_pod)
+                if self._reservations or self._defrag_waiters:
+                    self._on_group_allocated(
+                        internal_utils.extract_pod_scheduling_spec(
+                            pod).affinity_group.name)
                 self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
                     pod=binding_pod,
                     pod_state=internal.POD_BINDING,
@@ -381,6 +468,14 @@ class HivedScheduler:
             wait_reason = "Pod is waiting for preemptible or free resource to appear"
             if result.pod_wait_info is not None:
                 wait_reason += ": " + result.pod_wait_info.reason
+            if defrag_pkg.defrag_enabled():
+                # record the waiter for defrag_tick: the planner targets the
+                # longest-waiting gang (recording only — no behavior change
+                # until an embedder drives the tick)
+                group = internal_utils.extract_pod_scheduling_spec(
+                    pod).affinity_group.name
+                self._defrag_waiters.setdefault(
+                    group, {"pod": pod, "since": time.monotonic()})
             log.info("[%s]: %s", internal_utils.key(pod), wait_reason)
             return (
                 ei.ExtenderFilterResult(failed_nodes={_COMPONENT: wait_reason}),
@@ -538,6 +633,497 @@ class HivedScheduler:
                 wait_reason += ": " + result.pod_wait_info.reason
             log.info("[%s]: %s", internal_utils.key(pod), wait_reason)
             return ei.ExtenderPreemptionResult()
+
+    # ------------------------------------------------------------------
+    # defragmentation / backfill executor (doc/design/defrag.md)
+    #
+    # The executor lives HERE — runtime/scheduler.py is the one file
+    # allowed to call algorithm mutators (hivedlint CON003), and every
+    # entry point below takes the scheduler lock before reaching the
+    # planner/probe (CON002 traverses plan_migration/run_probe as
+    # mutating calls). The passive state machine types live in
+    # defrag/executor.py.
+    # ------------------------------------------------------------------
+
+    def _all_nodes(self) -> List[str]:
+        if self._all_nodes_cache is None:
+            algo = self.scheduler_algorithm
+            self._all_nodes_cache = sorted({
+                n
+                for ccl in algo.full_cell_list.values()
+                for c in ccl[max(ccl)]
+                for n in c.nodes
+            })
+        return self._all_nodes_cache
+
+    def _reserved_against(self, group: str) -> set:
+        """Nodes held by reservations whose holder is not ``group``."""
+        blocked = set()
+        for res in self._reservations.values():
+            if res.holder != group:
+                blocked |= res.nodes
+        return blocked
+
+    def _admissible_nodes(self, pod: Pod, suggested_nodes: List[str]) -> List[str]:
+        """Reservation-aware node offer for a NEW gang: held nodes are
+        withheld unless the backfill policy admits the candidate
+        (opportunistic = preemptible = the holder reclaims by preemption,
+        so the ride can never delay the reservation). Existing groups keep
+        the full offer — their placement is already committed and must not
+        be perturbed mid-gang."""
+        self._sweep_expired_reservations()
+        if not self._reservations:
+            return suggested_nodes
+        s = internal_utils.extract_pod_scheduling_spec(pod)
+        group = s.affinity_group.name
+        if group in getattr(self.scheduler_algorithm, "affinity_groups", {}):
+            return suggested_nodes
+        blocked = self._reserved_against(group)
+        if not blocked:
+            return suggested_nodes
+        if (defrag_pkg.backfill_enabled()
+                and s.priority <= OPPORTUNISTIC_PRIORITY):
+            return suggested_nodes
+        # advisory prefilter only — guaranteed gangs ignore suggestions,
+        # so _placement_violates_reservation enforces on the decided
+        # placement (and owns the admitted/blocked metrics)
+        return [n for n in suggested_nodes if n not in blocked]
+
+    @staticmethod
+    def _bind_info_nodes(pod_bind_info: api.PodBindInfo) -> set:
+        """Every node the gang's decided placement touches."""
+        return {
+            pp.physical_node
+            for member in pod_bind_info.affinity_group_bind_info
+            for pp in member.pod_placements
+        }
+
+    def _placement_violates_reservation(
+        self, pod: Pod, pod_bind_info: api.PodBindInfo
+    ) -> bool:
+        """Does a NEW gang's decided placement intrude on cells held for
+        someone else? (The enforcement half of reservations — the node
+        offer alone is advisory, guaranteed gangs ignore suggestions.)"""
+        s = internal_utils.extract_pod_scheduling_spec(pod)
+        group = s.affinity_group.name
+        if group in getattr(self.scheduler_algorithm, "affinity_groups", {}):
+            return False  # committed gangs complete unimpeded
+        blocked = self._reserved_against(group)
+        if not blocked or not (self._bind_info_nodes(pod_bind_info)
+                               & blocked):
+            return False
+        if (defrag_pkg.backfill_enabled()
+                and s.priority <= OPPORTUNISTIC_PRIORITY):
+            # preemptible rider INTO the hold: the backfill admission —
+            # the holder reclaims by preemption, so the ride is free
+            metrics.inc("tpu_hive_backfill_admissions_total",
+                        outcome="admitted")
+            return False
+        metrics.inc("tpu_hive_backfill_admissions_total", outcome="blocked")
+        return True
+
+    def _on_group_allocated(self, group: str) -> None:
+        """A gang landed: drop its waiter bookkeeping and release a waiter
+        reservation it held (its cells now hold themselves)."""
+        self._defrag_waiters.pop(group, None)
+        res = self._reservations.get(group)
+        if res is not None and res.kind == "waiter":
+            del self._reservations[group]
+            self._update_reservation_gauge()
+
+    def _update_reservation_gauge(self) -> None:
+        metrics.set_gauge("tpu_hive_defrag_reservations",
+                          len(self._reservations))
+
+    def _sweep_expired_reservations(self) -> None:
+        now = time.monotonic()
+        expired = [k for k, r in self._reservations.items() if r.expired(now)]
+        for k in expired:
+            # _finish_migration below may have already released siblings of
+            # the same migration mid-sweep
+            res = self._reservations.pop(k, None)
+            if res is None:
+                continue
+            log.warning("defrag: reservation for %s (%s) expired after "
+                        "%.0fs — sweeping", res.holder, res.kind,
+                        now - res.created_at)
+            if res.migration_id is not None:
+                mig = self._migrations.get(res.migration_id)
+                if mig is not None and mig.active:
+                    self._finish_migration(mig, defrag_exec.MIGRATION_ABORTED,
+                                           "reservation-expired")
+            else:
+                metrics.inc("tpu_hive_defrag_migrations_total",
+                            outcome="expired")
+        if expired:
+            self._update_reservation_gauge()
+
+    def _finish_migration(self, mig, state: str, why: str) -> None:
+        """Terminal transition: release every reservation the migration
+        still holds (waiter included — a failed consolidation must not
+        fence cells) and record the outcome."""
+        mig.state = state
+        for key in [k for k, r in self._reservations.items()
+                    if r.migration_id == mig.id]:
+            del self._reservations[key]
+        self._update_reservation_gauge()
+        outcome = {defrag_exec.MIGRATION_DONE: "completed",
+                   defrag_exec.MIGRATION_FAILED: "failed",
+                   defrag_exec.MIGRATION_ABORTED: "aborted"}[state]
+        metrics.inc("tpu_hive_defrag_migrations_total", outcome=outcome)
+        log.info("defrag: migration %s for waiter %s -> %s (%s)",
+                 mig.id, mig.waiter, state, why)
+        self._prune_migrations()
+
+    # terminal migration records kept for inspect, bounded so a long-lived
+    # scheduler never grows without limit
+    _MIGRATION_HISTORY = 32
+
+    def _prune_migrations(self) -> None:
+        terminal = [m for m in self._migrations.values() if not m.active]
+        excess = len(terminal) - self._MIGRATION_HISTORY
+        if excess > 0:
+            for m in terminal[:excess]:
+                del self._migrations[m.id]
+
+    def _running_groups(self) -> List[RunningGroup]:
+        """Fully-bound gangs eligible as movers: every member pod
+        allocated, group Allocated, not already migrating or holding a
+        reservation."""
+        algo = self.scheduler_algorithm
+        by_group: Dict[str, List[Pod]] = {}
+        for st in self.pod_schedule_statuses.values():
+            if st.pod is None or not internal.is_allocated(st.pod_state):
+                continue
+            spec = internal_utils.extract_pod_scheduling_spec(st.pod)
+            by_group.setdefault(spec.affinity_group.name, []).append(st.pod)
+        migrating = {
+            m.group for mig in self._migrations.values() if mig.active
+            for m in mig.moves
+        }
+        out: List[RunningGroup] = []
+        for name, pods in by_group.items():
+            if name in migrating or name in self._reservations:
+                continue
+            g = getattr(algo, "affinity_groups", {}).get(name)
+            if g is None or g.state != "Allocated":
+                continue
+            spec = GangSpec.from_pod(pods[0])
+            if len(pods) != spec.pod_count:
+                continue  # mid-bind gang: not a safe mover
+            out.append(RunningGroup(name=name, spec=spec, bound_pods=pods))
+        return out
+
+    def plan_defrag_for(self, pod: Pod) -> Optional[dict]:
+        """Plan (and start executing) a consolidation that unblocks
+        ``pod``'s waiting gang: probe-validated move set, ``migrating``
+        reservations on the waiter slice and every move target, then
+        eviction of the movers (pod deletion = SIGTERM = the supervisor's
+        checkpoint-and-exit-0 contract). Returns the plan dict, or None
+        with the rejection recorded in
+        ``tpu_hive_defrag_planner_rejections_total``."""
+        if not defrag_pkg.defrag_enabled():
+            return None
+        with self.scheduler_lock:
+            with trace.span("defrag_plan", cat="defrag",
+                            pod=internal_utils.key(pod)) as sp:
+                plan = self._plan_defrag_locked(pod, sp)
+                return plan
+
+    def _plan_defrag_locked(self, pod: Pod, sp) -> Optional[dict]:
+        delete_pod = getattr(self.kube_client, "delete_pod", None)
+        if delete_pod is None:
+            self._reject_plan(sp, "evict-unsupported",
+                              "kube client cannot delete pods")
+            return None
+        bad_nodes = getattr(self.scheduler_algorithm, "bad_nodes", None)
+        if bad_nodes:
+            # the what-if probe's remove/restore rollback is only exact on
+            # a healthy view: doomed-bad cell rebinding makes delete+re-add
+            # non-idempotent while nodes are down (found by chaos seed 23's
+            # VC-safety break), and consolidating mid-failure is futile
+            # anyway — the failure handler owns the cluster right now
+            self._reject_plan(sp, "cluster-unhealthy",
+                              f"{len(bad_nodes)} bad node(s)")
+            return None
+        waiter = GangSpec.from_pod(pod)
+        if waiter.name in getattr(
+                self.scheduler_algorithm, "affinity_groups", {}):
+            self._reject_plan(sp, "already-placed", waiter.name)
+            return None
+        if any(m.waiter == waiter.name and m.active
+               for m in self._migrations.values()):
+            self._reject_plan(sp, "already-migrating", waiter.name)
+            return None
+        running = self._running_groups()
+        free_chips = None
+        if waiter.priority >= 0:
+            quota = vc_quota_chips(self.scheduler_algorithm, waiter.vc)
+            used = sum(g.chips for g in running
+                       if g.spec.vc == waiter.vc and g.priority >= 0)
+            free_chips = quota - used
+        planner = MigrationPlanner()
+        probe = WhatIfProbe(self.scheduler_algorithm, self._all_nodes())
+        plan = planner.plan_migration(probe, waiter, running,
+                                      free_chips=free_chips)
+        if not hasattr(plan, "moves"):
+            self._reject_plan(sp, plan.reason, plan.detail)
+            return None
+        # register the migration + reservations, then evict
+        self._migration_seq += 1
+        mid = f"mig-{self._migration_seq}"
+        now = time.monotonic()
+        deadline = now + self.defrag_reserve_ttl_s
+        mig = defrag_exec.Migration(
+            id=mid, waiter=waiter.name, waiter_chips=waiter.chips,
+            moves=[
+                defrag_exec.Move(
+                    group=m.group.name, spec=m.group.spec,
+                    evicted_pods=list(m.group.bound_pods),
+                    target_nodes=m.target_nodes,
+                )
+                for m in plan.moves
+            ],
+        )
+        self._migrations[mid] = mig
+        self._reservations[waiter.name] = defrag_exec.Reservation(
+            holder=waiter.name, nodes=set(plan.waiter_nodes), kind="waiter",
+            created_at=now, deadline=deadline, migration_id=mid)
+        for m in plan.moves:
+            self._reservations[m.group.name] = defrag_exec.Reservation(
+                holder=m.group.name, nodes=set(m.target_nodes),
+                kind="migration", created_at=now, deadline=deadline,
+                migration_id=mid)
+        self._update_reservation_gauge()
+        metrics.inc("tpu_hive_defrag_migrations_total", outcome="planned")
+        sp.add(outcome="planned", moves=len(plan.moves),
+               moved_chips=plan.moved_chips)
+        log.info("defrag: plan %s — move %s to free %d chips for %s",
+                 mid, [m.group.name for m in plan.moves], waiter.chips,
+                 waiter.name)
+        self._evict_moves(mig)
+        plan_dict = plan.to_dict()
+        plan_dict["migrationId"] = mid
+        return plan_dict
+
+    def _reject_plan(self, sp, reason: str, detail: str) -> None:
+        metrics.inc("tpu_hive_defrag_planner_rejections_total",
+                    reason=reason)
+        sp.add(outcome="rejected", reason=reason, detail=detail)
+
+    def _evict_moves(self, mig) -> None:
+        """Issue (or re-issue) the SIGTERM-analogue pod deletions for every
+        still-present mover pod; transient ApiServer failures are left to
+        the next resume_migrations pass (evictions are idempotent)."""
+        delete_pod = getattr(self.kube_client, "delete_pod", None)
+        for move in mig.moves:
+            if move.state != defrag_exec.MIGRATION_EVICTING:
+                continue
+            for p in move.evicted_pods:
+                if p.uid not in self.pod_schedule_statuses:
+                    continue
+                try:
+                    delete_pod(p.namespace, p.name)
+                except Exception as e:
+                    log.warning("defrag: evict of %s failed transiently: %s",
+                                internal_utils.key(p), e)
+
+    def resume_migrations(self) -> dict:
+        """Advance every in-flight migration: re-issue pending evictions,
+        and re-place movers whose cells the informer has fully released
+        (gang-atomic per move; a member failure rolls the whole move back
+        and fails the migration — the evicted job's work stays safe in its
+        checkpoint for resubmission). Call from the embedder's watch loop
+        or after eviction events settle."""
+        if not defrag_pkg.defrag_enabled():
+            return {}
+        report = {}
+        with self.scheduler_lock:
+            self._sweep_expired_reservations()
+            for mig in list(self._migrations.values()):
+                if not mig.active:
+                    continue
+                if mig.state == defrag_exec.MIGRATION_EVICTING:
+                    self._evict_moves(mig)
+                    if self._movers_released(mig):
+                        mig.state = defrag_exec.MIGRATION_REBINDING
+                if mig.state == defrag_exec.MIGRATION_REBINDING:
+                    self._rebind_moves(mig)
+                report[mig.id] = mig.to_dict()
+        return report
+
+    def _movers_released(self, mig) -> bool:
+        algo_groups = getattr(self.scheduler_algorithm, "affinity_groups", {})
+        for move in mig.moves:
+            if move.group in algo_groups:
+                return False
+            if any(p.uid in self.pod_schedule_statuses
+                   for p in move.evicted_pods):
+                return False
+        return True
+
+    def _rebind_moves(self, mig) -> None:
+        create_pod = getattr(self.kube_client, "create_pod", None)
+        if create_pod is None:
+            self._finish_migration(mig, defrag_exec.MIGRATION_FAILED,
+                                   "kube client cannot create pods")
+            return
+        allowed_base = self._all_nodes()
+        for move in mig.moves:
+            if move.state != defrag_exec.MIGRATION_EVICTING:
+                continue
+            blocked = self._reserved_against(move.group)
+            allowed = [n for n in allowed_base if n not in blocked]
+            placed: List[Pod] = []
+            created: List[Pod] = []
+            ok = True
+            for rp in gang_pods(move.spec,
+                                uid_prefix=f"{mig.id}g{mig.generation}-"):
+                try:
+                    create_pod(rp)
+                    created.append(rp)
+                    result = self.scheduler_algorithm.schedule(
+                        rp, allowed, internal.FILTERING_PHASE)
+                    if result.pod_bind_info is None:
+                        raise RuntimeError(
+                            f"replacement for {move.group} found no "
+                            f"placement (state drifted since the probe)")
+                    if self._bind_info_nodes(result.pod_bind_info) & blocked:
+                        # the node offer is advisory: a re-placement that
+                        # grabbed someone else's held slice (e.g. the
+                        # waiter's) must not commit
+                        raise RuntimeError(
+                            f"replacement for {move.group} landed on "
+                            f"reserved cells (state drifted since the "
+                            f"probe)")
+                    bp = internal_utils.new_binding_pod(
+                        rp, result.pod_bind_info)
+                    self.scheduler_algorithm.add_allocated_pod(bp)
+                    self.pod_schedule_statuses[bp.uid] = PodScheduleStatus(
+                        pod=bp, pod_state=internal.POD_BINDING)
+                    self._commit_bind(Binding(
+                        pod_name=bp.name, pod_namespace=bp.namespace,
+                        pod_uid=bp.uid, node=bp.node_name,
+                        annotations=internal_utils
+                        .extract_pod_bind_annotations(bp),
+                    ))
+                    metrics.inc("tpu_hive_binds_total")
+                    self.pod_schedule_statuses[bp.uid] = PodScheduleStatus(
+                        pod=bp, pod_state=internal.POD_BOUND)
+                    placed.append(bp)
+                except Exception as e:
+                    log.warning("defrag: re-bind of %s member failed: %s",
+                                move.group, e)
+                    ok = False
+                    break
+            if not ok:
+                # gang atomicity: unwind the half-placed move entirely —
+                # allocations released, every created replacement pod
+                # (bound or not) deleted from the ApiServer
+                delete_pod = getattr(self.kube_client, "delete_pod", None)
+                for bp in reversed(placed):
+                    if bp.uid in self.pod_schedule_statuses:
+                        self.scheduler_algorithm.delete_allocated_pod(bp)
+                        self.pod_schedule_statuses.pop(bp.uid, None)
+                for rp in reversed(created):
+                    if delete_pod is not None:
+                        try:
+                            delete_pod(rp.namespace, rp.name)
+                        except Exception:
+                            pass
+                self._finish_migration(mig, defrag_exec.MIGRATION_FAILED,
+                                       f"move {move.group} could not re-place")
+                return
+            move.rebound_pods = placed
+            move.state = defrag_exec.MIGRATION_DONE
+            res = self._reservations.get(move.group)
+            if res is not None and res.kind == "migration":
+                del self._reservations[move.group]
+                self._update_reservation_gauge()
+            metrics.inc("tpu_hive_defrag_moved_chips_total",
+                        amount=move.spec.chips)
+        if all(m.state == defrag_exec.MIGRATION_DONE for m in mig.moves):
+            mig.state = defrag_exec.MIGRATION_DONE
+            metrics.inc("tpu_hive_defrag_migrations_total",
+                        outcome="completed")
+            # the waiter reservation stays until the waiter binds (or TTL)
+            log.info("defrag: migration %s complete — %s's slice is free",
+                     mig.id, mig.waiter)
+            self._prune_migrations()
+
+    def abort_migration(self, migration_id: str,
+                        why: str = "job died") -> bool:
+        """The job framework reports a mid-migration death (e.g. kill -9
+        after checkpoint, before re-bind): release every hold, mark the
+        migration aborted. Nothing half-bound survives; the checkpoint
+        keeps the work."""
+        with self.scheduler_lock:
+            mig = self._migrations.get(migration_id)
+            if mig is None or not mig.active:
+                return False
+            self._finish_migration(mig, defrag_exec.MIGRATION_ABORTED, why)
+            return True
+
+    def defrag_tick(self) -> dict:
+        """One defrag scan: sweep expiries, advance in-flight migrations,
+        then plan for the longest-waiting recorded gang. The embedder's
+        watch loop (cli/demo) or the chaos harness drives this; with
+        HIVED_DEFRAG=0 it is a no-op."""
+        if not defrag_pkg.defrag_enabled():
+            return {"enabled": False}
+        with self.scheduler_lock:
+            progressed = self.resume_migrations()
+            planned = None
+            for group, rec in sorted(self._defrag_waiters.items(),
+                                     key=lambda kv: kv[1]["since"]):
+                if group in self._reservations:
+                    continue  # already holding a consolidated slice
+                if any(m.waiter == group and m.active
+                       for m in self._migrations.values()):
+                    continue
+                planned = self.plan_defrag_for(rec["pod"])
+                if planned is not None:
+                    break
+            return {"enabled": True, "planned": planned,
+                    "migrations": progressed}
+
+    def get_defrag_status(self) -> dict:
+        """Inspect view of the reservation/migration state machine."""
+        with self.scheduler_lock:
+            return {
+                "enabled": defrag_pkg.defrag_enabled(),
+                "backfill": defrag_pkg.backfill_enabled(),
+                "reservations": [
+                    r.to_dict() for r in self._reservations.values()
+                ],
+                "migrations": [
+                    m.to_dict() for m in self._migrations.values()
+                ],
+                "waiters": sorted(self._defrag_waiters),
+            }
+
+    def get_admission_hints(self) -> dict:
+        """Scheduler-visible admission hints: the serving tier's block-pool
+        occupancy (published by ServingEngine as the
+        ``tpu_hive_serve_block_pool_occupancy`` gauge) plus the defrag
+        subsystem's current holds — what gang admission should know about
+        headroom it cannot see in the cell trees."""
+        occupancy = metrics.get_gauge("tpu_hive_serve_block_pool_occupancy")
+        with self.scheduler_lock:
+            reserved_nodes = sorted({
+                n for r in self._reservations.values() for n in r.nodes
+            })
+            return {
+                "serveBlockPoolOccupancy": occupancy,
+                "serveBlockPoolHeadroom": (
+                    None if occupancy is None
+                    else round(max(0.0, 1.0 - occupancy), 4)
+                ),
+                "defragReservedNodes": reserved_nodes,
+                "defragMigrationsInFlight": sum(
+                    1 for m in self._migrations.values() if m.active),
+                "waitingGangs": sorted(self._defrag_waiters),
+            }
 
     # ------------------------------------------------------------------
     # inspect delegates (reference: scheduler.go:723-745)

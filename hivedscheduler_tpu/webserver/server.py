@@ -186,7 +186,13 @@ def _make_handler(scheduler: HivedScheduler, webserver: Optional[WebServer] = No
                         C.AFFINITY_GROUPS_PATH, C.CLUSTER_STATUS_PATH,
                         C.PHYSICAL_CLUSTER_PATH, C.VIRTUAL_CLUSTERS_PATH,
                         C.TRACES_PATH, C.TRACES_CHROME_PATH,
+                        C.ADMISSION_HINTS_PATH, C.DEFRAG_PATH,
                     ]})
+                elif path == C.ADMISSION_HINTS_PATH:
+                    # serving headroom + defrag holds, for gang admission
+                    self._reply(200, scheduler.get_admission_hints())
+                elif path == C.DEFRAG_PATH:
+                    self._reply(200, scheduler.get_defrag_status())
                 elif path == C.TRACES_CHROME_PATH:
                     from hivedscheduler_tpu.obs import trace
 

@@ -73,6 +73,47 @@ def test_chaos_soak_long(seed):
     assert report["violations"] == [], report
 
 
+@pytest.mark.parametrize("seed", [0, 4])
+def test_chaos_soak_defrag_quick(seed):
+    """Tier-1 defrag soak (ISSUE 9): the defrag-v1 ops profile constructs
+    fragmentation episodes and drives the full migration protocol (plan ->
+    evict -> re-bind -> waiter completes) under injected faults and
+    crash-restarts, with the reservation/migration invariants
+    (check_defrag) active after every schedule. Non-vacuity is asserted:
+    these seeds really plan AND re-bind a migration."""
+    h = ChaosHarness(seed=seed, plan=SOAK_PLAN, restart_every=3,
+                     ops_profile="defrag-v1")
+    report = h.run(10)
+    assert report["violations"] == [], report
+    assert report["migrations_planned"] >= 1
+    assert report["migrations_rebound"] >= 1
+
+
+@pytest.mark.parametrize("seed", [13, 18])
+def test_chaos_soak_defrag_kill_window(seed):
+    """Tier-1: the kill -9 window — the job dies after its checkpoint,
+    before the re-bind; abort_migration must release every hold with
+    nothing half-bound (these seeds deterministically take the kill
+    branch)."""
+    h = ChaosHarness(seed=seed, plan=SOAK_PLAN, restart_every=3,
+                     ops_profile="defrag-v1")
+    report = h.run(14)
+    assert report["violations"] == [], report
+    assert report["migrations_killed"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 4, 6, 14, 20, 25, 26, 27, 28])
+def test_chaos_soak_defrag_long(seed):
+    """Slow cousin: the wider defrag-v1 seed sweep (every seed here planned
+    at least one migration in the 14-schedule soak when pinned)."""
+    h = ChaosHarness(seed=seed, plan=SOAK_PLAN, restart_every=3,
+                     ops_profile="defrag-v1")
+    report = h.run(14)
+    assert report["violations"] == [], report
+    assert report["migrations_planned"] >= 1
+
+
 def test_crash_restart_mid_gang_recovers_bound_placements():
     """Crash injected mid-gang: some members bound, the rest still pending.
     The restarted scheduler must (a) rebuild the gang from the bound pods'

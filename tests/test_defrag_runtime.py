@@ -1,0 +1,283 @@
+"""Runtime migration executor: plan -> reserve -> evict -> re-bind ->
+waiter lands, with kill switches, reservation steering/backfill, TTL
+sweeps, the kill -9 abort window, and the inspect/admission-hints surface
+(ISSUE 9).
+
+Scenario used throughout (see tests/test_defrag.py.fragmented_state): a
+two-cell VC where g1+g2 fill cell A, g3 half-fills cell B, g2 dies — a
+4-chip waiter has the quota but no contiguous cell until one survivor
+moves.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tests.test_defrag import make_pod, mini_config  # noqa: E402
+
+from hivedscheduler_tpu.chaos import invariants  # noqa: E402
+from hivedscheduler_tpu.k8s.fake import FakeKubeClient  # noqa: E402
+from hivedscheduler_tpu.k8s.types import Node  # noqa: E402
+from hivedscheduler_tpu.runtime import extender as ei  # noqa: E402
+from hivedscheduler_tpu.runtime.metrics import REGISTRY  # noqa: E402
+from hivedscheduler_tpu.runtime.scheduler import HivedScheduler  # noqa: E402
+
+
+def build_scheduler(kube=None):
+    kube = kube or FakeKubeClient()
+    sched = HivedScheduler(mini_config(), kube)
+    nodes = sorted({
+        n for ccl in sched.scheduler_algorithm.full_cell_list.values()
+        for c in ccl[max(ccl)] for n in c.nodes
+    })
+    for n in nodes:
+        kube.create_node(Node(name=n))
+    sched.start()
+    return sched, kube, nodes
+
+
+def drive(sched, kube, nodes, pod):
+    """Play the kube-scheduler: create, filter, bind. Returns the node or
+    None (waiting)."""
+    if kube.get_pod(pod.namespace, pod.name) is None:
+        kube.create_pod(pod)
+    r = sched.filter_routine(ei.ExtenderArgs(pod=pod, node_names=list(nodes)))
+    if not r.node_names:
+        return None
+    sched.bind_routine(ei.ExtenderBindingArgs(
+        pod_name=pod.name, pod_namespace=pod.namespace, pod_uid=pod.uid,
+        node=r.node_names[0]))
+    return r.node_names[0]
+
+
+def fragmented_scheduler():
+    sched, kube, nodes = build_scheduler()
+    assert drive(sched, kube, nodes, make_pod("g1-0", "g1", 2)) is not None
+    assert drive(sched, kube, nodes, make_pod("g2-0", "g2", 2)) is not None
+    assert drive(sched, kube, nodes, make_pod("g3-0", "g3", 2)) is not None
+    kube.delete_pod("default", "g2-0")
+    return sched, kube, nodes
+
+
+def check(sched, ctx):
+    with sched.scheduler_lock:
+        invariants.check_all(sched.scheduler_algorithm, ctx, scheduler=sched)
+
+
+class TestMigrationEndToEnd:
+    def test_full_pipeline(self):
+        sched, kube, nodes = fragmented_scheduler()
+        w = make_pod("w-0", "w", 4)
+        assert drive(sched, kube, nodes, w) is None  # waits: fragmentation
+        tick = sched.defrag_tick()
+        plan = tick["planned"]
+        assert plan is not None and plan["waiter"] == "w"
+        assert len(plan["moves"]) == 1 and plan["movedChips"] == 2
+        check(sched, "post-plan")
+        report = sched.resume_migrations()
+        assert report[plan["migrationId"]]["state"] == "Done"
+        check(sched, "post-rebind")
+        # the mover runs again under a NEW pod identity on its target node
+        move = report[plan["migrationId"]]["moves"][0]
+        rb = kube.get_pod("default", move["rebound"][0])
+        assert rb is not None and rb.node_name in move["targetNodes"]
+        # the waiter lands in the freed (reserved) slice
+        node = drive(sched, kube, nodes, w)
+        assert node in plan["waiterNodes"]
+        st = sched.get_defrag_status()
+        assert st["reservations"] == [] and st["waiters"] == []
+        check(sched, "end")
+
+    def test_waiter_reservation_blocks_equal_gang_until_bound(self):
+        sched, kube, nodes = fragmented_scheduler()
+        w = make_pod("w-0", "w", 4)
+        assert drive(sched, kube, nodes, w) is None
+        plan = sched.defrag_tick()["planned"]
+        assert plan is not None
+        sched.resume_migrations()
+        # a competitor of the same shape arrives while the slice is held:
+        # the reserved node is withheld, so it must wait
+        rival = make_pod("rival-0", "rival", 4)
+        assert drive(sched, kube, nodes, rival) is None
+        blocked = REGISTRY.render()
+        assert 'tpu_hive_backfill_admissions_total{outcome="blocked"}' in blocked
+        # the holder still lands
+        assert drive(sched, kube, nodes, w) in plan["waiterNodes"]
+        check(sched, "end")
+
+    def test_opportunistic_backfill_rides_reservation(self):
+        sched, kube, nodes = fragmented_scheduler()
+        w = make_pod("w-0", "w", 4)
+        assert drive(sched, kube, nodes, w) is None
+        plan = sched.defrag_tick()["planned"]
+        assert plan is not None
+        sched.resume_migrations()
+        # an opportunistic gang may ride INTO the held slice (the holder
+        # reclaims by preemption — the ride cannot delay it)
+        opp = make_pod("opp-0", "opp", 4, prio=-1)
+        node = drive(sched, kube, nodes, opp)
+        assert node in plan["waiterNodes"]
+        check(sched, "end")
+
+    def test_backfill_kill_switch_blocks_the_ride(self, monkeypatch):
+        monkeypatch.setenv("HIVED_BACKFILL", "0")
+        sched, kube, nodes = fragmented_scheduler()
+        w = make_pod("w-0", "w", 4)
+        assert drive(sched, kube, nodes, w) is None
+        plan = sched.defrag_tick()["planned"]
+        assert plan is not None
+        sched.resume_migrations()
+        opp = make_pod("opp-0", "opp", 4, prio=-1)
+        assert drive(sched, kube, nodes, opp) is None  # reserved = withheld
+        check(sched, "end")
+
+
+class TestKillSwitchAndFaults:
+    def test_defrag_off_is_inert(self, monkeypatch):
+        monkeypatch.setenv("HIVED_DEFRAG", "0")
+        sched, kube, nodes = fragmented_scheduler()
+        w = make_pod("w-0", "w", 4)
+        assert drive(sched, kube, nodes, w) is None
+        assert sched.defrag_tick() == {"enabled": False}
+        assert sched.plan_defrag_for(w) is None
+        assert sched.resume_migrations() == {}
+        st = sched.get_defrag_status()
+        assert (st["reservations"] == [] and st["migrations"] == []
+                and st["waiters"] == [])
+        check(sched, "flags-off")
+
+    def test_abort_in_the_kill_window_releases_everything(self):
+        """kill -9 after checkpoint, before re-bind: nothing half-bound,
+        no orphaned reservation, invariants clean."""
+        sched, kube, nodes = fragmented_scheduler()
+        w = make_pod("w-0", "w", 4)
+        assert drive(sched, kube, nodes, w) is None
+        plan = sched.defrag_tick()["planned"]
+        assert plan is not None
+        mover = plan["moves"][0]["group"]
+        assert sched.abort_migration(plan["migrationId"], why="kill -9")
+        st = sched.get_defrag_status()
+        assert st["reservations"] == []
+        assert [m["state"] for m in st["migrations"]] == ["Aborted"]
+        assert mover not in sched.scheduler_algorithm.affinity_groups
+        check(sched, "post-abort")
+        # second abort is a no-op, not an error
+        assert not sched.abort_migration(plan["migrationId"])
+
+    def test_reservation_ttl_expiry_aborts_stuck_migration(self):
+        sched, kube, nodes = fragmented_scheduler()
+        sched.defrag_reserve_ttl_s = 0.0  # everything expires immediately
+        w = make_pod("w-0", "w", 4)
+        assert drive(sched, kube, nodes, w) is None
+        plan = sched.defrag_tick()["planned"]
+        assert plan is not None
+        report = sched.resume_migrations()  # first act: sweep expiries
+        assert report.get(plan["migrationId"], {}).get("state") in (
+            None, "Aborted")
+        st = sched.get_defrag_status()
+        assert st["reservations"] == []
+        assert all(m["state"] != "Evicting" for m in st["migrations"])
+        check(sched, "post-expiry")
+
+    def test_rebind_failure_rolls_the_move_back(self):
+        class NoCreate(FakeKubeClient):
+            def create_pod(self, pod):
+                if pod.name.startswith("mig-"):
+                    raise RuntimeError("ApiServer down for replacements")
+                super().create_pod(pod)
+
+        sched, kube, nodes = build_scheduler(NoCreate())
+        assert drive(sched, kube, nodes, make_pod("g1-0", "g1", 2))
+        assert drive(sched, kube, nodes, make_pod("g2-0", "g2", 2))
+        assert drive(sched, kube, nodes, make_pod("g3-0", "g3", 2))
+        kube.delete_pod("default", "g2-0")
+        w = make_pod("w-0", "w", 4)
+        assert drive(sched, kube, nodes, w) is None
+        plan = sched.defrag_tick()["planned"]
+        assert plan is not None
+        report = sched.resume_migrations()
+        assert report[plan["migrationId"]]["state"] == "Failed"
+        st = sched.get_defrag_status()
+        assert st["reservations"] == []  # a failed consolidation holds nothing
+        check(sched, "post-failed-rebind")
+        # the evicted job's work lives in its checkpoint; the waiter still
+        # fits once the failed migration released the freed cells
+        assert drive(sched, kube, nodes, w) is not None
+        check(sched, "end")
+
+    def test_cancelled_waiter_drops_record_and_reservation(self):
+        sched, kube, nodes = fragmented_scheduler()
+        w = make_pod("w-0", "w", 4)
+        assert drive(sched, kube, nodes, w) is None
+        plan = sched.defrag_tick()["planned"]
+        assert plan is not None
+        sched.resume_migrations()
+        kube.delete_pod("default", "w-0")  # the user gave up
+        st = sched.get_defrag_status()
+        assert st["waiters"] == [] and st["reservations"] == []
+        check(sched, "post-cancel")
+
+    def test_planning_refused_while_nodes_bad(self):
+        from hivedscheduler_tpu.k8s.types import NodeCondition
+
+        sched, kube, nodes = fragmented_scheduler()
+        kube.update_node(Node(name=nodes[1], conditions=[
+            NodeCondition(type="Ready", status="False")]))
+        w = make_pod("w-0", "w", 4)
+        drive(sched, kube, nodes, w)
+        assert sched.defrag_tick()["planned"] is None
+        assert ('tpu_hive_defrag_planner_rejections_total'
+                '{reason="cluster-unhealthy"}') in REGISTRY.render()
+        check(sched, "bad-node-reject")
+
+
+class TestInspectSurface:
+    def test_admission_hints_surface_serving_occupancy(self):
+        sched, _, _ = build_scheduler()
+        REGISTRY.set_gauge("tpu_hive_serve_block_pool_occupancy", 0.75)
+        hints = sched.get_admission_hints()
+        assert hints["serveBlockPoolOccupancy"] == 0.75
+        assert hints["serveBlockPoolHeadroom"] == 0.25
+        assert hints["defragReservedNodes"] == []
+        assert hints["defragMigrationsInFlight"] == 0
+
+    def test_admission_hints_include_live_holds(self):
+        sched, kube, nodes = fragmented_scheduler()
+        w = make_pod("w-0", "w", 4)
+        assert drive(sched, kube, nodes, w) is None
+        plan = sched.defrag_tick()["planned"]
+        assert plan is not None
+        hints = sched.get_admission_hints()
+        assert set(plan["waiterNodes"]) <= set(hints["defragReservedNodes"])
+        assert hints["defragMigrationsInFlight"] == 1
+        assert "w" in hints["waitingGangs"]
+
+    def test_webserver_serves_hints_and_defrag_status(self):
+        from hivedscheduler_tpu.webserver import WebServer
+
+        sched, _, _ = build_scheduler()
+        sched.config.web_server_address = "127.0.0.1:0"
+        server = WebServer(sched)
+        host, port = server.async_run()
+        try:
+            REGISTRY.set_gauge("tpu_hive_serve_block_pool_occupancy", 0.5)
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/v1/inspect/admission-hints") as r:
+                hints = json.loads(r.read())
+            assert hints["serveBlockPoolHeadroom"] == 0.5
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/v1/inspect/defrag") as r:
+                st = json.loads(r.read())
+            assert "reservations" in st and "migrations" in st
+            with urllib.request.urlopen(f"http://{host}:{port}/v1") as r:
+                idx = json.loads(r.read())
+            assert "/v1/inspect/admission-hints" in idx["paths"]
+            assert "/v1/inspect/defrag" in idx["paths"]
+        finally:
+            server.stop()

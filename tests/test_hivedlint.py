@@ -218,6 +218,52 @@ def test_con003_bypass_flagged(tmp_path):
     assert [f.rule for f in got] == ["CON003"]
 
 
+def test_con002_defrag_entry_points_traversed(tmp_path):
+    """The CON002 fixpoint treats the defrag probe/planner entry points
+    (defrag.LOCKED_ENTRY_ATTRS) as algorithm-mutating calls: reaching one
+    without the scheduler lock is flagged, the locked shape passes."""
+    path = _write(tmp_path, "sched.py", """
+        class Sched:
+            def plan_defrag_for(self, pod):
+                self._planner.plan_migration(self._probe, pod, [])  # no lock!
+            def resume(self):
+                with self.scheduler_lock:
+                    self._probe.run_probe(None, [])
+        """)
+    got = concurrency.check_scheduler_lock_paths(
+        path, ["mutate"], class_name="Sched",
+        extra_mutator_attrs={"plan_migration", "run_probe"})
+    assert [f.rule for f in got] == ["CON002"]
+    assert "plan_defrag_for()" in got[0].message
+    # without the extension the same tree sails through — the fixture is
+    # non-vacuous
+    assert concurrency.check_scheduler_lock_paths(
+        path, ["mutate"], class_name="Sched") == []
+
+
+def test_dfg001_mutator_outside_probe_flagged(tmp_path):
+    """DFG001: an algorithm-mutator call in any defrag module other than
+    probe.py is a lock-contract bypass; the probe itself may mutate (its
+    transaction rolls back)."""
+    _write(tmp_path, "pkg/defrag/planner.py", """
+        def sneaky(algo, pod):
+            algo.delete_allocated_pod(pod)   # mutating outside the probe!
+            return algo.get_affinity_group('x')  # reads are fine
+        """)
+    _write(tmp_path, "pkg/defrag/probe.py", """
+        def sanctioned(algo, pod):
+            algo.delete_allocated_pod(pod)
+            algo.add_allocated_pod(pod)
+        """)
+    got = concurrency.check_defrag_mutator_confinement(
+        str(tmp_path / "pkg"),
+        ["delete_allocated_pod", "add_allocated_pod"],
+        defrag_rel="pkg/defrag", probe_rel="pkg/defrag/probe.py")
+    assert [f.rule for f in got] == ["DFG001"]
+    assert "delete_allocated_pod" in got[0].message
+    assert got[0].file.endswith("planner.py")
+
+
 def test_con004_fire_under_store_lock_flagged(tmp_path):
     path = _write(tmp_path, "fake.py", """
         class Fake:

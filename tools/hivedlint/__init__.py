@@ -69,6 +69,10 @@ RULES: Dict[str, tuple] = {
                "method on a scheduler_algorithm attribute", "concurrency"),
     "CON004": ("the fake ApiServer never fires informer handlers while "
                "lexically holding its store leaf lock", "concurrency"),
+    "DFG001": ("defrag-package algorithm mutations are confined to the "
+               "transactional probe (defrag/probe.py); CON002 traverses "
+               "the runtime executor's probe/planner entry points as "
+               "mutating calls", "concurrency"),
     "SHD001": ("fresh arrays (jnp.zeros/ones/full/empty[_like]) flowing "
                "into a shard_map loop carry must pass through "
                "shard_utils.varying(...) — the vma blind spot",

@@ -193,11 +193,18 @@ def check_algorithm_mutators(
 
 class _MethodScan(ast.NodeVisitor):
     """Per-method scan: mutator call sites and intra-class call edges, each
-    tagged with whether the site is lexically under `with self.<lock>`."""
+    tagged with whether the site is lexically under `with self.<lock>`.
 
-    def __init__(self, mutators: Set[str], lock_attr: str):
+    ``extra_mutator_attrs`` names methods that mutate algorithm state
+    through ANY receiver (the defrag probe/planner entry points —
+    ``defrag.LOCKED_ENTRY_ATTRS``): a call to one of them counts as a
+    mutator site for the lock-path fixpoint."""
+
+    def __init__(self, mutators: Set[str], lock_attr: str,
+                 extra_mutator_attrs: Optional[Set[str]] = None):
         self.mutators = mutators
         self.lock_attr = lock_attr
+        self.extra_mutator_attrs = extra_mutator_attrs or set()
         self.depth = 0
         self.mutator_sites: List[Tuple[int, bool]] = []  # (line, guarded)
         self.calls: List[Tuple[str, bool]] = []          # (callee, guarded)
@@ -223,6 +230,8 @@ class _MethodScan(ast.NodeVisitor):
                     and recv.attr == "scheduler_algorithm"
                     and func.attr in self.mutators):
                 self.mutator_sites.append((node.lineno, self.depth > 0))
+            elif func.attr in self.extra_mutator_attrs:
+                self.mutator_sites.append((node.lineno, self.depth > 0))
             elif (isinstance(recv, ast.Name) and recv.id == "self"):
                 self.calls.append((func.attr, self.depth > 0))
             if _is_threading_call(node, {"Thread"}) is not None:
@@ -241,6 +250,7 @@ def check_scheduler_lock_paths(
     class_name: str = "HivedScheduler",
     lock_attr: str = "scheduler_lock",
     rel: str = "hivedscheduler_tpu/runtime/scheduler.py",
+    extra_mutator_attrs: Optional[Set[str]] = None,
 ) -> List[Finding]:
     out: List[Finding] = []
     with open(scheduler_path) as f:
@@ -252,7 +262,8 @@ def check_scheduler_lock_paths(
     scans: Dict[str, _MethodScan] = {}
     handler_regs: Set[str] = set()
     for fn in (n for n in cls.body if isinstance(n, ast.FunctionDef)):
-        scan = _MethodScan(set(mutators), lock_attr)
+        scan = _MethodScan(set(mutators), lock_attr,
+                           extra_mutator_attrs=extra_mutator_attrs)
         for stmt in fn.body:
             scan.visit(stmt)
         scans[fn.name] = scan
@@ -322,6 +333,42 @@ def check_algorithm_bypass(
                     f".scheduler_algorithm.{node.func.attr}() outside the "
                     f"runtime chokepoint ({chokepoint}) bypasses the "
                     f"scheduler lock",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DFG001: defrag cell-state mutation is confined to the probe module
+# ---------------------------------------------------------------------------
+
+def check_defrag_mutator_confinement(
+    package_root: str,
+    mutators: List[str],
+    defrag_rel: str = "hivedscheduler_tpu/defrag",
+    probe_rel: str = "hivedscheduler_tpu/defrag/probe.py",
+) -> List[Finding]:
+    """The defrag subsystem may mutate algorithm state ONLY through the
+    transactional what-if probe (defrag/probe.py), whose every mutation is
+    rolled back before returning; the runtime executor's real mutations
+    live in runtime/scheduler.py under the scheduler lock (CON002
+    traverses its entry points via ``defrag.LOCKED_ENTRY_ATTRS``). An
+    algorithm-mutator call anywhere else in defrag/ is a lock-contract
+    bypass waiting to happen."""
+    out: List[Finding] = []
+    muts = set(mutators)
+    for rel, tree in _walk_py(package_root):
+        if not rel.startswith(defrag_rel + "/") or rel == probe_rel:
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in muts):
+                out.append(Finding(
+                    "DFG001", rel, node.lineno,
+                    f".{node.func.attr}() (a SchedulerAlgorithm mutator) "
+                    f"outside {probe_rel} — defrag mutations must go "
+                    f"through the probe's rollback transaction or the "
+                    f"runtime executor",
                 ))
     return out
 
@@ -397,6 +444,7 @@ def check(root: str) -> List[Finding]:
     sys.path.insert(0, root)
     try:
         from hivedscheduler_tpu.common import lockcheck
+        from hivedscheduler_tpu import defrag as defrag_pkg
     finally:
         sys.path.pop(0)
     pkg = os.path.join(root, "hivedscheduler_tpu")
@@ -409,7 +457,9 @@ def check(root: str) -> List[Finding]:
     out += check_algorithm_mutators(
         os.path.join(pkg, "algorithm", "hived.py"), mutators)
     out += check_scheduler_lock_paths(
-        os.path.join(pkg, "runtime", "scheduler.py"), mutators)
+        os.path.join(pkg, "runtime", "scheduler.py"), mutators,
+        extra_mutator_attrs=set(defrag_pkg.LOCKED_ENTRY_ATTRS))
     out += check_algorithm_bypass(pkg, mutators)
+    out += check_defrag_mutator_confinement(pkg, mutators)
     out += check_store_leaf_fire(os.path.join(pkg, "k8s", "fake.py"))
     return out

@@ -611,6 +611,14 @@ def _env_read_names(tree: ast.AST) -> Tuple[Set[str], Dict[str, str],
                 arg = node.args[0]
             elif node.func.attr == "getenv" and node.args:
                 arg = node.args[0]
+            elif (node.func.attr == "get" and node.args
+                  and isinstance(node.args[0], ast.Constant)
+                  and isinstance(node.args[0].value, str)
+                  and _FLAG_TOKEN.fullmatch(node.args[0].value)):
+                # the registry's own accessor (envflags.get("HIVED_X", ...))
+                # — a KeyError-checked read, the preferred pattern for new
+                # flags
+                arg = node.args[0]
         elif isinstance(node, ast.Subscript):
             v = node.value
             if (isinstance(v, ast.Attribute) and v.attr == "environ") or (
