@@ -421,14 +421,60 @@ class HivedAlgorithm(SchedulerAlgorithm):
     def _try_bind_doomed_bad_cell(self, chain: CellChain, level: CellLevel) -> None:
         """If a VC's free cells exceed the healthy free physical cells, some of
         its cells are doomed bad: bind them so the VC scheduler avoids them
-        (reference: tryBindDoomedBadCell, hived_algorithm.go:604-628)."""
+        (reference: tryBindDoomedBadCell, hived_algorithm.go:604-628).
+
+        Deviation (PARITY.md, chaos defrag-v1 seeds 2/23): outstanding doomed
+        conditions at HIGHER levels are satisfied first. A doomed bind at
+        ``level`` splits free ancestors, so with several nodes bad at once it
+        can consume the only bad free cell able to back a higher level's
+        excess — the higher level's condition then has no cell left to bind
+        and ``total_left < all_vc_free`` materializes. The reference assumes
+        at most one outstanding level at a time; the top-down sweep makes the
+        multi-level case converge (every extra call no-ops when the books are
+        consistent)."""
+        higher = sorted(
+            (lv for lv in self.total_left_cell_num.get(chain, {}) if lv > level),
+            reverse=True,
+        )
+        for lv in higher:
+            self._try_bind_doomed_bad_cell_at(chain, lv)
+        self._try_bind_doomed_bad_cell_at(chain, level)
+
+    def _try_bind_doomed_bad_cell_at(self, chain: CellChain, level: CellLevel) -> None:
+        """The reference per-level bind loop (see _try_bind_doomed_bad_cell
+        for the ordering wrapper)."""
         for vc_name, vc_free in self.vc_free_cell_num.items():
             if chain not in vc_free:
                 continue
             while vc_free[chain].get(level, 0) > (
                 self.total_left_cell_num[chain][level] - len(self.bad_free_cells[chain][level])
             ):
-                pc = self.bad_free_cells[chain][level][0]
+                # the reference binds bad_free[0] unconditionally; under
+                # multi-bad-node layouts the list can hold cells meanwhile
+                # taken into real guaranteed use (the Preempting phase
+                # admits bad nodes), so only a genuinely free candidate is
+                # bindable (deviation, PARITY.md)
+                pc = next(
+                    (
+                        c
+                        for c in self.bad_free_cells[chain][level]
+                        if c.priority < MIN_GUARANTEED_PRIORITY
+                        and in_free_cell_list(c)
+                    ),
+                    None,
+                )
+                if pc is None:
+                    # no bindable bad free cell at this level: the condition
+                    # stays outstanding and is retried as later events
+                    # re-shape the free lists — better than the reference's
+                    # index-out-of-range here
+                    log.warning(
+                        "VC %s has %s free cells at chain %s level %s beyond "
+                        "healthy capacity but no bindable bad free cell is "
+                        "available to doom-bind; deferring",
+                        vc_name, vc_free[chain].get(level, 0), chain, level,
+                    )
+                    break
                 assert isinstance(pc, PhysicalCell)
                 vc = get_unbound_virtual_cell(
                     self.vc_schedulers[vc_name].non_pinned_preassigned_cells[chain][level]
@@ -2050,6 +2096,17 @@ class HivedAlgorithm(SchedulerAlgorithm):
         self.total_left_cell_num[chain][level] -= 1
         split_level_up_to = self._remove_cell_from_free_list(c)
 
+        # pass 1: drop every bad ancestor from the bad free list BEFORE any
+        # doomed rebind below can run — the split above already took them
+        # out of the free list, so a rebind picking one mid-walk would
+        # allocate a cell with no free-list entry (chaos defrag-v1 seed 23)
+        parent = c.parent
+        for l in range(level + 1, split_level_up_to + 1):
+            assert isinstance(parent, PhysicalCell)
+            if not parent.healthy:
+                self.bad_free_cells[chain].remove(parent, l)
+            parent = parent.parent
+
         parent = c.parent
         for l in range(level + 1, split_level_up_to + 1):
             self.total_left_cell_num[chain][l] -= 1
@@ -2062,9 +2119,12 @@ class HivedAlgorithm(SchedulerAlgorithm):
                 )
             assert isinstance(parent, PhysicalCell)
             if not parent.healthy:
-                # parent bad: neither vcFreeCellNum nor healthy-free count
-                # changes; just remove it from bad free cells
-                self.bad_free_cells[chain].remove(parent, l)
+                # parent bad: the healthy-free count is unchanged (total_left
+                # and bad_free_cells both dropped by one), but an OUTSTANDING
+                # doomed condition from an earlier reclaim may still need a
+                # bind here — and this split just consumed one candidate, so
+                # re-check now while others remain (chaos defrag-v1 seed 23)
+                self._try_bind_doomed_bad_cell(chain, l)
             else:
                 # healthy-free count decreased: try binding doomed bad cells
                 self._try_bind_doomed_bad_cell(chain, l)
@@ -2116,11 +2176,13 @@ class HivedAlgorithm(SchedulerAlgorithm):
         merge_level_up_to = self._add_cell_to_free_list(c)
 
         parent = c.parent
+        bad_merge_levels: List[CellLevel] = []
         for l in range(level + 1, merge_level_up_to + 1):
             self.total_left_cell_num[chain][l] += 1
             assert isinstance(parent, PhysicalCell)
             if not parent.healthy:
                 self.bad_free_cells[chain][l].append(parent)
+                bad_merge_levels.append(l)
             else:
                 self._try_unbind_doomed_bad_cell(chain, l)
             parent = parent.parent
@@ -2136,6 +2198,13 @@ class HivedAlgorithm(SchedulerAlgorithm):
             if not doomed_bad:
                 self._try_unbind_doomed_bad_cell(chain, l)
             num_to_add *= len(self.full_cell_list[chain][l][0].children) if l > 1 else 1
+        if bad_merge_levels:
+            # bad free cells (re)appeared along the merge path: a doomed
+            # condition deferred for lack of a bindable candidate can bind
+            # now. Deferred past the merge walk — a rebind firing mid-walk
+            # would allocate through ancestors not yet re-listed in
+            # bad_free_cells (chaos defrag-v1 seed 2).
+            self._try_bind_doomed_bad_cell(chain, bad_merge_levels[0])
 
     def _release_bad_cell(self, c: PhysicalCell) -> None:
         """Reference: releaseBadCell, hived_algorithm.go:1488-1500."""

@@ -29,6 +29,19 @@ ANNOTATION_POD_BIND_INFO = f"{GROUP_NAME}/pod-bind-info"
 # Environment variable the Cloud TPU device plugin / tpu runtime reads.
 ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
 
+# --- Scheduling-spec extension keys (no reference analogue) -----------------
+# Inside the pod-scheduling-spec annotation (api/types.py PodSchedulingSpec):
+# the job's expected run time, consumed by duration-aware guaranteed backfill
+# (defrag/backfill.py: a guaranteed gang may ride a reserved hole only when
+# now + duration*slack <= the hold's expiry), and the elastic shape ladder
+# (doc/design/elastic.md: a gang declaring elasticMinChips accepts any
+# halving-ladder shape down to that floor; elasticFullMembers is written by
+# the scheduler onto a DEGRADED incarnation's pods so the full shape
+# survives crashes and grow-promotion can find its way back).
+SPEC_KEY_DURATION_SECONDS = "durationSeconds"
+SPEC_KEY_ELASTIC_MIN_CHIPS = "elasticMinChips"
+SPEC_KEY_ELASTIC_FULL_MEMBERS = "elasticFullMembers"
+
 # --- Priorities (reference: constants.go:57-62) -----------------------------
 MAX_GUARANTEED_PRIORITY = 1000
 MIN_GUARANTEED_PRIORITY = 0

@@ -341,6 +341,18 @@ class PodSchedulingSpec:
     # collective is balanced instead of straggled by one oversized
     # sub-gang
     multi_chain_relax_policy: str = "fewest"
+    # expected run time in seconds (0 = unknown): duration-aware guaranteed
+    # backfill admits a gang into a reserved hole only when it finishes
+    # before the hold expires (defrag/backfill.py)
+    duration_seconds: float = 0.0
+    # elastic shape ladder floor in TOTAL gang chips (0 = not elastic): the
+    # gang accepts any halving-ladder shape down to this floor when its
+    # full shape is blocked (doc/design/elastic.md)
+    elastic_min_chips: int = 0
+    # scheduler-written onto a DEGRADED incarnation's pods: the original
+    # (full-shape) member list, so the full shape survives crashes and the
+    # grow-promotion path can restore it
+    elastic_full_members: Optional[List[AffinityGroupMemberSpec]] = None
     affinity_group: Optional[AffinityGroupSpec] = None
 
     @staticmethod
@@ -358,6 +370,13 @@ class PodSchedulingSpec:
             ignore_k8s_suggested_nodes=bool(d.get("ignoreK8sSuggestedNodes", True)),
             multi_chain_relax_enable=bool(d.get("multiChainRelaxEnable", True)),
             multi_chain_relax_policy=d.get("multiChainRelaxPolicy", "fewest"),
+            duration_seconds=float(d.get("durationSeconds", 0) or 0),
+            elastic_min_chips=int(d.get("elasticMinChips", 0) or 0),
+            elastic_full_members=(
+                [AffinityGroupMemberSpec.from_dict(m)
+                 for m in d["elasticFullMembers"]]
+                if d.get("elasticFullMembers") else None
+            ),
             affinity_group=(
                 AffinityGroupSpec.from_dict(d["affinityGroup"]) if d.get("affinityGroup") else None
             ),
@@ -376,6 +395,14 @@ class PodSchedulingSpec:
         }
         if self.multi_chain_relax_policy != "fewest":
             out["multiChainRelaxPolicy"] = self.multi_chain_relax_policy
+        if self.duration_seconds:
+            out["durationSeconds"] = self.duration_seconds
+        if self.elastic_min_chips:
+            out["elasticMinChips"] = self.elastic_min_chips
+        if self.elastic_full_members is not None:
+            out["elasticFullMembers"] = [
+                m.to_dict() for m in self.elastic_full_members
+            ]
         if self.pinned_cell_id:
             out["pinnedCellId"] = self.pinned_cell_id
         if self.affinity_group is not None:

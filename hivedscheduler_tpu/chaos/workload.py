@@ -71,13 +71,15 @@ class WorkloadFaultPlan:
                 for _ in range(self.episodes)]
 
 
-def cpu_only_env(**extra: str) -> Dict[str, str]:
+def cpu_only_env(devices: int = 1, **extra: str) -> Dict[str, str]:
     """The CLAUDE.md subprocess recipe: never let a killable child touch
-    the axon TPU backend (single-grant tunnel)."""
+    the axon TPU backend (single-grant tunnel). ``devices`` sizes the
+    virtual CPU mesh — the elastic ladder episodes model the scheduler
+    offering differently-sized slices by varying it per incarnation."""
     env = dict(os.environ)
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
     env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     # never inherit a caller's armed fault hooks
     for k in list(env):
@@ -279,5 +281,189 @@ class WorkloadChaosHarness:
             "episodes": [list(e) for e in self.episodes],
             "steps": self.steps,
             "incarnations": len(self.episodes) + 1,
+            "violations": list(self.violations),
+        }
+
+
+class ElasticWorkloadHarness:
+    """The elastic end-to-end episode: kill -9 mid-step on the full slice →
+    shrink resume on HALF the devices (cross-topology restore) → grow
+    promote back to the full slice, all against one checkpoint directory.
+
+    Models what the scheduler's elastic arm does to a training job
+    (doc/design/elastic.md): the full-shape incarnation dies hard, the next
+    incarnation is offered a degraded slice (``--elastic`` derives a
+    smaller mesh and the checkpoint reshards on load), and once capacity
+    "frees" the grow-promotion evicts (SIGTERM → checkpoint-and-exit-0)
+    and restarts at full shape. Cross-topology resumes change reduction
+    orders, so the merged trajectory is pinned **allclose** against an
+    uninterrupted full-slice reference (LOSS_ATOL) — the same-topology
+    bit-exactness discipline stays with :class:`WorkloadChaosHarness`.
+    The checkpoint metadata is additionally asserted to record each
+    incarnation's mesh (the cross-topology marker trail).
+    """
+
+    FULL_DEVICES = 2
+    SHRUNK_DEVICES = 1
+    # bf16 compute: measured cross-reduction-order drift is ~1e-4 absolute
+    # over 8 steps on the CPU mesh; 0.02 keeps real resume bugs (wrong
+    # step, replayed/skipped data: whole-loss-scale errors) detectable
+    LOSS_ATOL = 0.02
+
+    def __init__(self, seed: int, workdir: str, *, steps: int = 8,
+                 checkpoint_every: int = 2, step_delay_s: float = 0.25,
+                 grace_secs: float = 30.0, run_timeout_s: float = 240.0):
+        self.seed = seed
+        rng = random.Random(seed)
+        self.workdir = workdir
+        self.steps = steps
+        self.checkpoint_every = checkpoint_every
+        self.step_delay_s = step_delay_s
+        self.grace_secs = grace_secs
+        self.run_timeout_s = run_timeout_s
+        # the hard kill lands after the first possible commit; the
+        # cooperative preemption (grow offer) lands strictly later so the
+        # degraded incarnation does real work first
+        self.kill_step = rng.randint(checkpoint_every + 1, steps - 3)
+        self.preempt_step = rng.randint(self.kill_step + 1, steps - 2)
+        self.violations: List[str] = []
+
+    def train_cmd(self, ckpt_dir: str, timeline: str) -> List[str]:
+        return [
+            sys.executable, "-m", "hivedscheduler_tpu.train",
+            "--steps", str(self.steps),
+            "--batch", "2", "--seq-len", "16", "--vocab-size", "64",
+            "--d-model", "16", "--n-layers", "1", "--n-heads", "2",
+            "--d-ff", "32", "--log-every", "100",
+            "--elastic", "--min-chips", "1",
+            "--checkpoint-dir", ckpt_dir,
+            "--checkpoint-every", str(self.checkpoint_every),
+            "--timeline", timeline,
+            "--grace-secs", str(self.grace_secs),
+        ]
+
+    def _spawn(self, ckpt: str, timeline: str, devices: int,
+               paced: bool) -> subprocess.Popen:
+        extra = ({sup_lib.ENV_FAULT_STEP_DELAY: str(self.step_delay_s)}
+                 if paced else {})
+        return subprocess.Popen(
+            self.train_cmd(ckpt, timeline), cwd=_REPO_ROOT,
+            env=cpu_only_env(devices=devices, **extra),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    def _wait_for_step(self, proc, timeline: str, step: int) -> bool:
+        deadline = time.monotonic() + self.run_timeout_s
+        while time.monotonic() < deadline:
+            if read_timeline(timeline).get(step) is not None:
+                return True
+            if proc.poll() is not None:
+                return False
+            time.sleep(0.02)
+        return False
+
+    def _wait(self, proc, what: str) -> Optional[int]:
+        try:
+            proc.wait(timeout=self.run_timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            self.violations.append(f"{what}: incarnation did not exit within "
+                                   f"{self.run_timeout_s}s")
+            return None
+        return proc.returncode
+
+    def _checkpoint_mesh(self, ckpt: str) -> Optional[dict]:
+        from hivedscheduler_tpu.parallel import checkpoint as ckpt_lib
+
+        return ckpt_lib.read_metadata(ckpt).get("mesh")
+
+    def run(self) -> dict:
+        ck = os.path.join(self.workdir, "elastic-ck")
+        timelines: List[str] = []
+
+        # uninterrupted full-slice reference (own checkpoint dir)
+        ref_tl = os.path.join(self.workdir, "elastic-ref.jsonl")
+        proc = self._spawn(os.path.join(self.workdir, "elastic-ref-ck"),
+                           ref_tl, self.FULL_DEVICES, paced=False)
+        if self._wait(proc, "reference") != 0:
+            self.violations.append("reference run failed")
+        reference = read_timeline(ref_tl)
+        if len(reference) != self.steps:
+            self.violations.append(
+                f"reference covered {len(reference)}/{self.steps} steps")
+
+        # 1. full slice, kill -9 mid-step
+        tl = os.path.join(self.workdir, "elastic-full.jsonl")
+        timelines.append(tl)
+        proc = self._spawn(ck, tl, self.FULL_DEVICES, paced=True)
+        if self._wait_for_step(proc, tl, self.kill_step):
+            proc.send_signal(signal.SIGKILL)
+        self._wait(proc, f"full incarnation (sigkill@{self.kill_step})")
+        mesh = self._checkpoint_mesh(ck)
+        if mesh is None:
+            self.violations.append("full incarnation left no committed "
+                                   "checkpoint to shrink-resume from")
+        elif mesh.get("dp") != self.FULL_DEVICES:
+            self.violations.append(
+                f"full incarnation's checkpoint records mesh {mesh}, "
+                f"expected dp={self.FULL_DEVICES}")
+
+        # 2. shrink resume on the degraded slice; SIGTERM = the grow offer
+        #    evicting it (checkpoint-and-exit-0)
+        tl = os.path.join(self.workdir, "elastic-shrunk.jsonl")
+        timelines.append(tl)
+        proc = self._spawn(ck, tl, self.SHRUNK_DEVICES, paced=True)
+        if self._wait_for_step(proc, tl, self.preempt_step):
+            proc.send_signal(signal.SIGTERM)
+        rc = self._wait(proc, f"shrunk incarnation (sigterm@{self.preempt_step})")
+        if rc != 0:
+            self.violations.append(
+                f"shrunk incarnation exited {rc}, expected a clean "
+                f"checkpoint-and-exit (0)")
+        mesh = self._checkpoint_mesh(ck)
+        if mesh is not None and mesh.get("dp") != self.SHRUNK_DEVICES:
+            self.violations.append(
+                f"shrunk incarnation's checkpoint records mesh {mesh}, "
+                f"expected dp={self.SHRUNK_DEVICES} (cross-topology "
+                f"metadata trail broken)")
+
+        # 3. grow promote: back to the full slice, run to completion
+        tl = os.path.join(self.workdir, "elastic-grown.jsonl")
+        timelines.append(tl)
+        proc = self._spawn(ck, tl, self.FULL_DEVICES, paced=False)
+        rc = self._wait(proc, "grown incarnation")
+        if rc != 0:
+            self.violations.append(f"grown incarnation exited {rc}")
+
+        # the merged trajectory stays allclose to the uninterrupted
+        # reference: a resume that replayed/skipped data or restored the
+        # wrong state shows up as a whole-loss-scale divergence
+        covered: set = set()
+        for t in timelines:
+            for step, loss in read_timeline(t).items():
+                covered.add(step)
+                ref = reference.get(step)
+                if ref is None:
+                    self.violations.append(
+                        f"{os.path.basename(t)}: step {step} beyond the "
+                        f"reference run")
+                elif abs(loss - ref) > self.LOSS_ATOL:
+                    self.violations.append(
+                        f"{os.path.basename(t)}: step {step} loss {loss!r} "
+                        f"vs reference {ref!r} exceeds atol "
+                        f"{self.LOSS_ATOL} (elastic resume diverged)")
+        missing = set(range(1, self.steps + 1)) - covered
+        if missing:
+            self.violations.append(
+                f"steps never executed by any incarnation: {sorted(missing)}")
+
+        return {
+            "seed": self.seed,
+            "kind": "elastic",
+            "kill_step": self.kill_step,
+            "preempt_step": self.preempt_step,
+            "steps": self.steps,
+            "incarnations": 3,
             "violations": list(self.violations),
         }

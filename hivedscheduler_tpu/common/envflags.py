@@ -99,6 +99,11 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
        "Reservation time-to-live: a migration/waiter hold a crashed "
        "partner never releases is swept after this many seconds.",
        "hivedscheduler_tpu/runtime/scheduler.py"),
+    _f("HIVED_ELASTIC", "1",
+       "`0` disables elastic offers (shrink a blocked elastic waiter to "
+       "its largest feasible ladder shape, grow-promote degraded gangs "
+       "when capacity frees); inert for gangs without `elasticMinChips`.",
+       "hivedscheduler_tpu/defrag/__init__.py"),
     _f("HIVED_GC_FREEZE", "1",
        "`0` opts out of gc.freeze() after scheduler warmup (the scheduler "
        "then pays the gen-2 collection cost).",

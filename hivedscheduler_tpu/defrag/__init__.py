@@ -41,7 +41,10 @@ from hivedscheduler_tpu.common import envflags
 # site that must hold the scheduler lock; DFG001 confines the raw mutator
 # calls themselves to defrag/probe.py. Keep in sync with probe.WhatIfProbe
 # and planner.MigrationPlanner method names.
-LOCKED_ENTRY_ATTRS = frozenset({"run_probe", "plan_migration"})
+LOCKED_ENTRY_ATTRS = frozenset({
+    "run_probe", "plan_migration", "run_fit_probe", "run_swap_probe",
+    "plan_promotion",
+})
 
 
 def defrag_enabled() -> bool:
@@ -54,6 +57,14 @@ def backfill_enabled() -> bool:
     """``HIVED_BACKFILL=0`` disables backfill admission into reserved holes
     (reservations still form when defrag is on)."""
     return envflags.get("HIVED_BACKFILL", "1") != "0"
+
+
+def elastic_enabled() -> bool:
+    """``HIVED_ELASTIC=0`` disables elastic offers: no shrink offers for
+    blocked elastic waiters, no grow-promotion of degraded gangs. Inert
+    for gangs that declare no ``elasticMinChips`` either way — a cluster
+    with no elastic jobs behaves identically under both settings."""
+    return envflags.get("HIVED_ELASTIC", "1") != "0"
 
 
 from hivedscheduler_tpu.defrag.backfill import BackfillDecision, BackfillPolicy  # noqa: E402
@@ -74,7 +85,12 @@ from hivedscheduler_tpu.defrag.planner import (  # noqa: E402
     PlanRejected,
     RunningGroup,
 )
-from hivedscheduler_tpu.defrag.probe import GangSpec, ProbeResult, WhatIfProbe  # noqa: E402
+from hivedscheduler_tpu.defrag.probe import (  # noqa: E402
+    GangSpec,
+    ProbeResult,
+    WhatIfProbe,
+    shrink_ladder,
+)
 
 __all__ = [
     "BackfillDecision",
@@ -93,6 +109,8 @@ __all__ = [
     "WhatIfProbe",
     "backfill_enabled",
     "defrag_enabled",
+    "elastic_enabled",
+    "shrink_ladder",
     "MIGRATION_ABORTED",
     "MIGRATION_DONE",
     "MIGRATION_EVICTING",

@@ -17,9 +17,13 @@ in:
   start indefinitely (it cannot be preempted by an equal-priority waiter).
 
 The policy is a pure decision function — deterministic, no clock reads, no
-state — so the trace sim and the runtime share it verbatim.  The runtime
-rarely knows durations (pods carry none), so runtime backfill is in
-practice the opportunistic rule; the trace sim exercises both arms.
+state — so the trace sim and the runtime share it verbatim.  Pods declare
+their expected run time via the ``durationSeconds`` scheduling-spec key
+(api/types.py); the runtime's honest ETA for a hold is its reservation TTL
+deadline — the hold cannot outlive it, so a gang that finishes first
+provably never delays the waiter (``HivedScheduler._duration_fits_all_holds``).
+Gangs without a declared duration keep the conservative behavior: only
+preemptible work rides.
 """
 
 from __future__ import annotations

@@ -55,6 +55,13 @@ class GangSpec:
     # (pod_number, leaf_cell_number) per member entry
     members: Tuple[Tuple[int, int], ...]
     multi_chain_relax_policy: str = "fewest"
+    # elastic ladder floor in total gang chips (0 = not elastic)
+    elastic_min_chips: int = 0
+    # set on a DEGRADED incarnation: the original full-shape member list
+    # (written into the pods' annotations so it survives crashes)
+    elastic_full_members: Optional[Tuple[Tuple[int, int], ...]] = None
+    # expected run time (0 = unknown; duration-aware backfill admission)
+    duration_seconds: float = 0.0
 
     @property
     def chips(self) -> int:
@@ -63,6 +70,24 @@ class GangSpec:
     @property
     def pod_count(self) -> int:
         return sum(n for n, _ in self.members)
+
+    @property
+    def elastic(self) -> bool:
+        return self.elastic_min_chips > 0
+
+    @property
+    def degraded(self) -> bool:
+        """Is this a shrunk incarnation of a bigger declared shape?"""
+        return (self.elastic_full_members is not None
+                and self.elastic_full_members != self.members)
+
+    def full_spec(self) -> "GangSpec":
+        """The declared full shape (self when not degraded)."""
+        if not self.degraded:
+            return self
+        return dataclasses.replace(
+            self, members=self.elastic_full_members, elastic_full_members=None
+        )
 
     @classmethod
     def from_pod(cls, pod: Pod) -> "GangSpec":
@@ -78,13 +103,20 @@ class GangSpec:
                 for m in s.affinity_group.members
             ),
             multi_chain_relax_policy=s.multi_chain_relax_policy,
+            elastic_min_chips=s.elastic_min_chips,
+            elastic_full_members=(
+                tuple((m.pod_number, m.leaf_cell_number)
+                      for m in s.elastic_full_members)
+                if s.elastic_full_members is not None else None
+            ),
+            duration_seconds=s.duration_seconds,
         )
 
     def to_annotation(self, leaf_cell_number: int) -> str:
         """The scheduling-spec annotation for a member pod holding
         ``leaf_cell_number`` chips (gangs may mix member shapes, so the
         top-level cell count is per-pod)."""
-        return to_json({
+        d = {
             "virtualCluster": self.vc,
             "priority": self.priority,
             "leafCellType": self.leaf_cell_type,
@@ -97,7 +129,41 @@ class GangSpec:
                     for n, c in self.members
                 ],
             },
-        })
+        }
+        if self.duration_seconds:
+            d[api_constants.SPEC_KEY_DURATION_SECONDS] = self.duration_seconds
+        if self.elastic_min_chips:
+            d[api_constants.SPEC_KEY_ELASTIC_MIN_CHIPS] = self.elastic_min_chips
+        if self.elastic_full_members is not None:
+            d[api_constants.SPEC_KEY_ELASTIC_FULL_MEMBERS] = [
+                {"podNumber": n, "leafCellNumber": c}
+                for n, c in self.elastic_full_members
+            ]
+        return to_json(d)
+
+
+def shrink_ladder(spec: GangSpec) -> List[GangSpec]:
+    """The declared shape ladder of an elastic gang, largest shrink first.
+
+    Each rung halves every member's per-pod chip count (the natural TPU
+    ladder: the workload's per-pod slice halves, ``train --elastic``
+    derives a correspondingly smaller mesh); rungs stop when any member's
+    count turns odd or the total would fall below ``elastic_min_chips``.
+    Every rung records the ORIGINAL full shape in ``elastic_full_members``
+    so a degraded incarnation carries its way back up. Empty for
+    non-elastic specs."""
+    if not spec.elastic:
+        return []
+    full = spec.elastic_full_members or spec.members
+    out: List[GangSpec] = []
+    members = spec.members
+    while all(c % 2 == 0 for _, c in members):
+        members = tuple((n, c // 2) for n, c in members)
+        if sum(n * c for n, c in members) < spec.elastic_min_chips:
+            break
+        out.append(dataclasses.replace(
+            spec, members=members, elastic_full_members=full))
+    return out
 
 
 def gang_pods(spec: GangSpec, uid_prefix: str = "") -> List[Pod]:
@@ -238,6 +304,20 @@ class WhatIfProbe:
                 self._remove_gang(pods)
             for pods in reversed(removed):
                 self._restore_gang(pods)
+
+    def run_fit_probe(self, spec: GangSpec) -> ProbeResult:
+        """Would this gang bind RIGHT NOW, as-is?  Place it, record the
+        placement, roll back.  The elastic shrink offer walks the shape
+        ladder with one fit probe per rung (doc/design/elastic.md)."""
+        placed = self._place_gang(spec)
+        if placed is None:
+            return ProbeResult(False, reason="fit-unplaceable")
+        try:
+            return ProbeResult(True, placements={
+                spec.name: self._placement_of(spec.name)
+            })
+        finally:
+            self._remove_gang(placed)
 
     def run_swap_probe(
         self, bound_pods: Sequence[Pod], new_spec: GangSpec

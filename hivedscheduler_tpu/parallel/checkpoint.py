@@ -33,6 +33,78 @@ log = logging.getLogger(__name__)
 
 _COMPLETE_MARKER = "hived_complete.json"
 
+# TransformerConfig fields that determine the parameter-tree SHAPES. A
+# checkpoint restores onto any (dp, fsdp, pp, ep, tp, sp) mesh — global
+# array shapes are mesh-independent, so orbax redistributes shards to the
+# target templates — but these fields must match exactly or the restore
+# would be loading a different model (doc/design/elastic.md).
+GEOMETRY_FIELDS = (
+    "vocab_size", "d_model", "n_heads", "n_kv_heads", "n_layers", "d_ff",
+    "n_experts", "lora_rank", "lora_mlp",
+)
+
+
+def train_metadata(axes, cfg, *, global_batch: int, seq_len: int,
+                   elastic: Optional[dict] = None) -> dict:
+    """The elastic-resume sidecar persisted inside the commit marker: the
+    SOURCE mesh axes the arrays were sharded over, the model geometry they
+    encode, and the data-stream identity (global batch x seq len — the two
+    numbers that define the loader's sample plan). ``elastic`` carries the
+    job's declared shape ladder (``train --elastic``) so a restarted
+    incarnation — and operators reading the marker — can see which slices
+    are acceptable."""
+    out = {
+        "mesh": {name: size for name, size in zip(axes.names, axes.shape)},
+        "model": {f: getattr(cfg, f) for f in GEOMETRY_FIELDS},
+        "data": {"global_batch": global_batch, "seq_len": seq_len},
+    }
+    if elastic:
+        out["elastic"] = elastic
+    return out
+
+
+def validate_resume_metadata(meta: dict, axes, cfg, *, global_batch: int,
+                             seq_len: int) -> Optional[dict]:
+    """Gate a resume against the checkpoint's recorded identity.
+
+    Returns the SOURCE mesh dict when the checkpoint was written on a
+    different (dp, fsdp, pp, ep, tp, sp) layout (the cross-topology resume
+    path: reshard-on-load, loss-trajectory allclose), ``None`` when the
+    topology matches (the bit-exact path) or the checkpoint predates the
+    metadata (legacy: nothing to validate). Raises ``ValueError`` when the
+    checkpoint encodes a different model geometry, or a different data
+    stream — silently resuming either would double-train or skip samples,
+    or load a differently-shaped model."""
+    model = meta.get("model")
+    if model:
+        mismatched = {
+            f: (model[f], getattr(cfg, f))
+            for f in GEOMETRY_FIELDS
+            if f in model and model[f] != getattr(cfg, f)
+        }
+        if mismatched:
+            raise ValueError(
+                "checkpoint model geometry mismatch: "
+                + ", ".join(f"{k}: saved {s} != current {c}"
+                            for k, (s, c) in sorted(mismatched.items()))
+            )
+    data = meta.get("data")
+    if data:
+        saved = (data.get("global_batch"), data.get("seq_len"))
+        if saved != (global_batch, seq_len):
+            raise ValueError(
+                f"checkpoint data stream mismatch: the loader's sample plan "
+                f"is defined by (global batch, seq len) = {saved}; resuming "
+                f"with {(global_batch, seq_len)} would silently change the "
+                f"training stream"
+            )
+    saved_mesh = meta.get("mesh")
+    if saved_mesh:
+        current = {name: size for name, size in zip(axes.names, axes.shape)}
+        if saved_mesh != current:
+            return saved_mesh
+    return None
+
 
 def _manager(directory: str, max_to_keep: int = 3, create: bool = False):
     import orbax.checkpoint as ocp

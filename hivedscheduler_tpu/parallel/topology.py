@@ -136,3 +136,70 @@ def infer_axes(
             f"{n_devices} devices not divisible by tp*sp*fsdp*pp*ep={rest}"
         )
     return MeshAxes(dp=n_devices // rest, fsdp=fsdp, pp=pp, ep=ep, tp=tp, sp=sp)
+
+
+def _divisors_desc(k: int):
+    return sorted((d for d in range(1, k + 1) if k % d == 0), reverse=True)
+
+
+def elastic_axes(
+    n_devices: int,
+    *,
+    tp: int = 1,
+    sp: int = 1,
+    fsdp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
+    n_heads: int = 0,
+    n_kv_heads: int = 0,
+    global_batch: int = 0,
+    seq_len: int = 0,
+) -> MeshAxes:
+    """Derive a valid mesh for whatever slice the scheduler actually
+    offered (``train --elastic``): the requested degrees are PREFERENCES,
+    shrunk only as far as the offered device count forces.
+
+    Each axis takes the largest divisor of its requested degree that fits;
+    dp absorbs the remainder (so a 2x-bigger offer doubles dp — the grow
+    path — and a halved offer shrinks the most expendable axis first).
+    Sacrifice order when the full product does not fit: fsdp, then sp,
+    then tp, then ep, then pp — tensor/expert/pipeline parallelism encode
+    per-device memory needs, so they are held longest. Model/data
+    constraints are enforced where known: ``n_heads``/``n_kv_heads`` must
+    divide tp, ``global_batch`` must divide dp*fsdp, ``seq_len`` must
+    divide sp. Deterministic: the same inputs always derive the same mesh
+    (a restarted incarnation on an equal slice gets an identical layout).
+    """
+    if n_devices < 1:
+        raise ValueError(f"need at least 1 device, offered {n_devices}")
+
+    def fits(t: int, s: int, f: int, p: int, e: int) -> bool:
+        rest = t * s * f * p * e
+        if rest > n_devices or n_devices % rest:
+            return False
+        dp = n_devices // rest
+        if n_heads and n_heads % t:
+            return False
+        if n_kv_heads and n_kv_heads % t:
+            return False
+        if global_batch and global_batch % (dp * f):
+            return False
+        if seq_len and seq_len % s:
+            return False
+        return True
+
+    for p in _divisors_desc(pp):
+        for e in _divisors_desc(ep):
+            for t in _divisors_desc(tp):
+                for s in _divisors_desc(sp):
+                    for f in _divisors_desc(fsdp):
+                        if fits(t, s, f, p, e):
+                            return MeshAxes(
+                                dp=n_devices // (t * s * f * p * e),
+                                fsdp=f, pp=p, ep=e, tp=t, sp=s,
+                            )
+    raise ValueError(
+        f"no valid mesh for {n_devices} offered device(s) within the "
+        f"requested degrees tp={tp} sp={sp} fsdp={fsdp} pp={pp} ep={ep} "
+        f"(n_heads={n_heads}, global_batch={global_batch}, seq_len={seq_len})"
+    )

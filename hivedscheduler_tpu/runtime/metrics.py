@@ -203,4 +203,22 @@ REGISTRY.describe("tpu_hive_defrag_reservations",
 REGISTRY.describe("tpu_hive_backfill_admissions_total",
                   "Gang scheduling decisions that crossed a reservation, "
                   "by outcome (admitted = preemptible rider allowed into "
-                  "reserved nodes, blocked = reserved nodes withheld)")
+                  "reserved nodes, fits-window = guaranteed rider whose "
+                  "declared duration ends before every intersecting hold "
+                  "expires, blocked = reserved nodes withheld)")
+# elastic offers (doc/design/elastic.md): shrink a blocked elastic waiter
+# onto a degraded slice, grow it back when capacity frees
+REGISTRY.describe("tpu_hive_elastic_offers_total",
+                  "Elastic shrink offers by outcome (offered = degraded "
+                  "incarnation bound, infeasible = no ladder shape fits, "
+                  "failed = degraded bind lost a race with state drift)")
+REGISTRY.describe("tpu_hive_elastic_grows_total",
+                  "Grow-promotions of degraded elastic gangs by outcome "
+                  "(planned, completed, infeasible)")
+REGISTRY.describe("tpu_hive_elastic_degraded_gangs",
+                  "Elastic gangs currently running on a degraded slice "
+                  "(shrink-offered, not yet grown back)")
+REGISTRY.describe("tpu_hive_train_cross_topology_resumes_total",
+                  "Training incarnations that restored a checkpoint saved "
+                  "on a DIFFERENT (dp, fsdp, pp, ep, tp, sp) mesh "
+                  "(reshard-on-load; loss allclose, not bit-exact)")

@@ -30,7 +30,12 @@ from hivedscheduler_tpu.defrag.planner import (
     RunningGroup,
     vc_quota_chips,
 )
-from hivedscheduler_tpu.defrag.probe import GangSpec, WhatIfProbe, gang_pods
+from hivedscheduler_tpu.defrag.probe import (
+    GangSpec,
+    WhatIfProbe,
+    gang_pods,
+    shrink_ladder,
+)
 from hivedscheduler_tpu.k8s.client import KubeClient
 from hivedscheduler_tpu.k8s.types import Binding, Node, Pod
 from hivedscheduler_tpu.runtime import extender as ei
@@ -89,6 +94,17 @@ class HivedScheduler:
         self._all_nodes_cache: Optional[List[str]] = None
         self.defrag_reserve_ttl_s = float(
             envflags.get("HIVED_DEFRAG_RESERVE_TTL_S", "300") or 300)
+        # -- elastic offers (doc/design/elastic.md) ------------------------
+        # group -> {offeredChips, fullChips, since}: bookkeeping for the
+        # inspect surface and gauges. The state of RECORD is the degraded
+        # pods' own annotations (elasticFullMembers), so a scheduler crash
+        # loses nothing: recovery rebuilds the bound degraded gang and the
+        # next defrag_tick re-derives its grow eligibility from the specs.
+        self._elastic_degraded: Dict[str, dict] = {}
+        self._elastic_seq = 0
+        # duration-aware guaranteed backfill (defrag/backfill.py): the
+        # pure policy shared with the trace sim
+        self._backfill_policy = defrag_pkg.BackfillPolicy()
 
         kube_client.on_node_event(self._add_node, self._update_node, self._delete_node)
         kube_client.on_pod_event(self._add_pod, self._update_pod, self._delete_pod)
@@ -191,20 +207,22 @@ class HivedScheduler:
                 else:
                     self.scheduler_algorithm.delete_unallocated_pod(pod_status.pod)
                 del self.pod_schedule_statuses[pod.uid]
-            if self._defrag_waiters or self._reservations:
+            if (self._defrag_waiters or self._reservations
+                    or self._elastic_degraded):
                 self._on_waiter_pod_deleted(pod)
 
     def _on_waiter_pod_deleted(self, pod: Pod) -> None:
-        """A cancelled waiting gang must not strand its waiter record or
-        reservation until TTL: when the last pod of a recorded/reserved
-        group is deleted, drop both."""
+        """A cancelled waiting gang must not strand its waiter record,
+        reservation or elastic-degraded record until TTL: when the last
+        pod of a recorded/reserved group is deleted, drop them."""
         try:
             group = internal_utils.extract_pod_scheduling_spec(
                 pod).affinity_group.name
         except Exception:
             return
         if (group not in self._defrag_waiters
-                and group not in self._reservations):
+                and group not in self._reservations
+                and group not in self._elastic_degraded):
             return
         for st in self.pod_schedule_statuses.values():
             if st.pod is None:
@@ -217,6 +235,16 @@ class HivedScheduler:
             if other == group:
                 return  # gang still has live pods
         self._defrag_waiters.pop(group, None)
+        # a degraded gang completing/cancelled with no live pods is no
+        # longer grow-eligible — unless a migration is mid-flight (its
+        # eviction legitimately empties the gang; the re-bind restores it)
+        mid_migration = any(
+            m.active and any(mv.group == group for mv in m.moves)
+            for m in self._migrations.values()
+        )
+        if (not mid_migration
+                and self._elastic_degraded.pop(group, None) is not None):
+            self._update_elastic_gauge()
         res = self._reservations.get(group)
         if res is not None and res.kind == "waiter":
             del self._reservations[group]
@@ -684,10 +712,39 @@ class HivedScheduler:
         if (defrag_pkg.backfill_enabled()
                 and s.priority <= OPPORTUNISTIC_PRIORITY):
             return suggested_nodes
+        if (defrag_pkg.backfill_enabled() and s.duration_seconds > 0
+                and self._duration_fits_all_holds(s, group)):
+            # duration-aware guaranteed backfill: this gang declares it
+            # finishes before ANY live hold expires, so it cannot delay
+            # the reservations it might ride in
+            return suggested_nodes
         # advisory prefilter only — guaranteed gangs ignore suggestions,
         # so _placement_violates_reservation enforces on the decided
         # placement (and owns the admitted/blocked metrics)
         return [n for n in suggested_nodes if n not in blocked]
+
+    def _duration_fits_all_holds(self, s, group: str,
+                                 nodes: Optional[set] = None) -> bool:
+        """The duration-aware backfill bound (defrag/backfill.py): a
+        guaranteed gang with a declared ``durationSeconds`` may ride a
+        reserved hole when ``now + duration*slack <= eta`` for every hold
+        it would intersect. The runtime's honest ETA for a hold is its TTL
+        deadline — the hold cannot outlive it (the sweep releases it), so
+        finishing first provably never delays the waiter. ``nodes``
+        restricts the check to holds intersecting that placement (the
+        enforcement point); None checks against every foreign hold (the
+        advisory node-offer prefilter)."""
+        now = time.monotonic()
+        etas = [
+            r.deadline for r in self._reservations.values()
+            if r.holder != group and (nodes is None or (r.nodes & nodes))
+        ]
+        if not etas:
+            return True
+        return self._backfill_policy.admits(
+            s.priority, now, duration=s.duration_seconds,
+            reservation_eta=min(etas),
+        ).admit
 
     @staticmethod
     def _bind_info_nodes(pod_bind_info: api.PodBindInfo) -> set:
@@ -718,6 +775,14 @@ class HivedScheduler:
             # the holder reclaims by preemption, so the ride is free
             metrics.inc("tpu_hive_backfill_admissions_total",
                         outcome="admitted")
+            return False
+        if (defrag_pkg.backfill_enabled() and s.duration_seconds > 0
+                and self._duration_fits_all_holds(
+                    s, group, nodes=self._bind_info_nodes(pod_bind_info))):
+            # guaranteed rider that provably finishes before every hold it
+            # intersects expires: the duration-aware backfill window
+            metrics.inc("tpu_hive_backfill_admissions_total",
+                        outcome="fits-window")
             return False
         metrics.inc("tpu_hive_backfill_admissions_total", outcome="blocked")
         return True
@@ -767,6 +832,18 @@ class HivedScheduler:
                     if r.migration_id == mig.id]:
             del self._reservations[key]
         self._update_reservation_gauge()
+        if state != defrag_exec.MIGRATION_DONE and self._elastic_degraded:
+            # a failed/aborted grow leaves the gang fully evicted: its
+            # degraded record has no pods to grow any more — the job
+            # framework resubmits from the checkpoint (full or ladder
+            # shape, its call)
+            groups = {mv.group for mv in mig.moves}
+            live = {self._group_of(st.pod)
+                    for st in self.pod_schedule_statuses.values()
+                    if st.pod is not None}
+            for group in groups & set(self._elastic_degraded) - live:
+                del self._elastic_degraded[group]
+                self._update_elastic_gauge()
         outcome = {defrag_exec.MIGRATION_DONE: "completed",
                    defrag_exec.MIGRATION_FAILED: "failed",
                    defrag_exec.MIGRATION_ABORTED: "aborted"}[state]
@@ -962,80 +1039,105 @@ class HivedScheduler:
                 return False
         return True
 
-    def _rebind_moves(self, mig) -> None:
+    def _bind_gang_atomically(
+        self, group: str, replacement_pods: List[Pod], blocked: set
+    ) -> Optional[List[Pod]]:
+        """Create, schedule and bind a gang's replacement pods as one unit:
+        any member failure unwinds the whole gang (allocations released,
+        every created pod deleted from the ApiServer) and returns None.
+        Shared by migration re-binds and elastic shrink offers; the caller
+        holds the scheduler lock."""
         create_pod = getattr(self.kube_client, "create_pod", None)
         if create_pod is None:
+            return None
+        allowed = [n for n in self._all_nodes() if n not in blocked]
+        placed: List[Pod] = []
+        created: List[Pod] = []
+        ok = True
+        for rp in replacement_pods:
+            try:
+                create_pod(rp)
+                created.append(rp)
+                result = self.scheduler_algorithm.schedule(
+                    rp, allowed, internal.FILTERING_PHASE)
+                if result.pod_bind_info is None:
+                    raise RuntimeError(
+                        f"replacement for {group} found no "
+                        f"placement (state drifted since the probe)")
+                if self._bind_info_nodes(result.pod_bind_info) & blocked:
+                    # the node offer is advisory: a re-placement that
+                    # grabbed someone else's held slice (e.g. the
+                    # waiter's) must not commit
+                    raise RuntimeError(
+                        f"replacement for {group} landed on "
+                        f"reserved cells (state drifted since the "
+                        f"probe)")
+                bp = internal_utils.new_binding_pod(
+                    rp, result.pod_bind_info)
+                self.scheduler_algorithm.add_allocated_pod(bp)
+                self.pod_schedule_statuses[bp.uid] = PodScheduleStatus(
+                    pod=bp, pod_state=internal.POD_BINDING)
+                self._commit_bind(Binding(
+                    pod_name=bp.name, pod_namespace=bp.namespace,
+                    pod_uid=bp.uid, node=bp.node_name,
+                    annotations=internal_utils
+                    .extract_pod_bind_annotations(bp),
+                ))
+                metrics.inc("tpu_hive_binds_total")
+                self.pod_schedule_statuses[bp.uid] = PodScheduleStatus(
+                    pod=bp, pod_state=internal.POD_BOUND)
+                placed.append(bp)
+            except Exception as e:
+                log.warning("defrag: re-bind of %s member failed: %s",
+                            group, e)
+                ok = False
+                break
+        if not ok:
+            # gang atomicity: unwind the half-placed gang entirely
+            delete_pod = getattr(self.kube_client, "delete_pod", None)
+            for bp in reversed(placed):
+                if bp.uid in self.pod_schedule_statuses:
+                    self.scheduler_algorithm.delete_allocated_pod(bp)
+                    self.pod_schedule_statuses.pop(bp.uid, None)
+            for rp in reversed(created):
+                if delete_pod is not None:
+                    try:
+                        delete_pod(rp.namespace, rp.name)
+                    except Exception:
+                        pass
+            return None
+        return placed
+
+    def _rebind_moves(self, mig) -> None:
+        if getattr(self.kube_client, "create_pod", None) is None:
             self._finish_migration(mig, defrag_exec.MIGRATION_FAILED,
                                    "kube client cannot create pods")
             return
-        allowed_base = self._all_nodes()
         for move in mig.moves:
             if move.state != defrag_exec.MIGRATION_EVICTING:
                 continue
-            blocked = self._reserved_against(move.group)
-            allowed = [n for n in allowed_base if n not in blocked]
-            placed: List[Pod] = []
-            created: List[Pod] = []
-            ok = True
-            for rp in gang_pods(move.spec,
-                                uid_prefix=f"{mig.id}g{mig.generation}-"):
-                try:
-                    create_pod(rp)
-                    created.append(rp)
-                    result = self.scheduler_algorithm.schedule(
-                        rp, allowed, internal.FILTERING_PHASE)
-                    if result.pod_bind_info is None:
-                        raise RuntimeError(
-                            f"replacement for {move.group} found no "
-                            f"placement (state drifted since the probe)")
-                    if self._bind_info_nodes(result.pod_bind_info) & blocked:
-                        # the node offer is advisory: a re-placement that
-                        # grabbed someone else's held slice (e.g. the
-                        # waiter's) must not commit
-                        raise RuntimeError(
-                            f"replacement for {move.group} landed on "
-                            f"reserved cells (state drifted since the "
-                            f"probe)")
-                    bp = internal_utils.new_binding_pod(
-                        rp, result.pod_bind_info)
-                    self.scheduler_algorithm.add_allocated_pod(bp)
-                    self.pod_schedule_statuses[bp.uid] = PodScheduleStatus(
-                        pod=bp, pod_state=internal.POD_BINDING)
-                    self._commit_bind(Binding(
-                        pod_name=bp.name, pod_namespace=bp.namespace,
-                        pod_uid=bp.uid, node=bp.node_name,
-                        annotations=internal_utils
-                        .extract_pod_bind_annotations(bp),
-                    ))
-                    metrics.inc("tpu_hive_binds_total")
-                    self.pod_schedule_statuses[bp.uid] = PodScheduleStatus(
-                        pod=bp, pod_state=internal.POD_BOUND)
-                    placed.append(bp)
-                except Exception as e:
-                    log.warning("defrag: re-bind of %s member failed: %s",
-                                move.group, e)
-                    ok = False
-                    break
-            if not ok:
-                # gang atomicity: unwind the half-placed move entirely —
-                # allocations released, every created replacement pod
-                # (bound or not) deleted from the ApiServer
-                delete_pod = getattr(self.kube_client, "delete_pod", None)
-                for bp in reversed(placed):
-                    if bp.uid in self.pod_schedule_statuses:
-                        self.scheduler_algorithm.delete_allocated_pod(bp)
-                        self.pod_schedule_statuses.pop(bp.uid, None)
-                for rp in reversed(created):
-                    if delete_pod is not None:
-                        try:
-                            delete_pod(rp.namespace, rp.name)
-                        except Exception:
-                            pass
+            placed = self._bind_gang_atomically(
+                move.group,
+                gang_pods(move.spec, uid_prefix=f"{mig.id}g{mig.generation}-"),
+                self._reserved_against(move.group),
+            )
+            if placed is None:
                 self._finish_migration(mig, defrag_exec.MIGRATION_FAILED,
                                        f"move {move.group} could not re-place")
                 return
             move.rebound_pods = placed
             move.state = defrag_exec.MIGRATION_DONE
+            if (not move.spec.degraded
+                    and self._elastic_degraded.pop(move.group, None)
+                    is not None):
+                # a grow-promotion landed: the gang runs at full shape
+                # again (an ordinary defrag move of a still-degraded gang
+                # keeps its record — its spec still carries the ladder)
+                self._update_elastic_gauge()
+                metrics.inc("tpu_hive_elastic_grows_total",
+                            outcome="completed")
+                log.info("elastic: %s grew back to full shape (%d chips)",
+                         move.group, move.spec.chips)
             res = self._reservations.get(move.group)
             if res is not None and res.kind == "migration":
                 del self._reservations[move.group]
@@ -1066,14 +1168,18 @@ class HivedScheduler:
 
     def defrag_tick(self) -> dict:
         """One defrag scan: sweep expiries, advance in-flight migrations,
-        then plan for the longest-waiting recorded gang. The embedder's
-        watch loop (cli/demo) or the chaos harness drives this; with
-        HIVED_DEFRAG=0 it is a no-op."""
+        plan for the longest-waiting recorded gang, then the elastic arm —
+        a waiter whose full shape the planner could not unblock is offered
+        the largest feasible shrink from its declared ladder, and degraded
+        running gangs are grow-promoted back to full shape when capacity
+        frees. The embedder's watch loop (cli/demo) or the chaos harness
+        drives this; with HIVED_DEFRAG=0 it is a no-op."""
         if not defrag_pkg.defrag_enabled():
             return {"enabled": False}
         with self.scheduler_lock:
             progressed = self.resume_migrations()
             planned = None
+            offered = None
             for group, rec in sorted(self._defrag_waiters.items(),
                                      key=lambda kv: kv[1]["since"]):
                 if group in self._reservations:
@@ -1084,8 +1190,167 @@ class HivedScheduler:
                 planned = self.plan_defrag_for(rec["pod"])
                 if planned is not None:
                     break
+                # the defrag planner declined this waiter: the elastic arm
+                # may still put it to work on a degraded slice
+                offered = self._offer_elastic_shrink(group, rec["pod"])
+                if offered is not None:
+                    break
+            grown = self._promote_elastic_grows()
             return {"enabled": True, "planned": planned,
-                    "migrations": progressed}
+                    "migrations": progressed, "elasticOffer": offered,
+                    "elasticGrows": grown}
+
+    # ------------------------------------------------------------------
+    # elastic offers: shrink a blocked waiter, grow a degraded gang back
+    # (doc/design/elastic.md)
+    # ------------------------------------------------------------------
+
+    def _update_elastic_gauge(self) -> None:
+        metrics.set_gauge("tpu_hive_elastic_degraded_gangs",
+                          len(self._elastic_degraded))
+
+    def _offer_elastic_shrink(self, group: str, pod: Pod) -> Optional[dict]:
+        """A waiting elastic gang whose full shape is infeasible (and whose
+        wait the defrag planner just declined to fix) is offered the
+        largest feasible shrink from its declared ladder: the waiting
+        full-shape pods are replaced by a degraded incarnation, created
+        and gang-atomically bound in their place. The degraded pods' bind
+        annotations ARE the offer — their slice is what the workload's
+        ``train --elastic`` entry point derives its mesh from — and their
+        scheduling specs carry ``elasticFullMembers`` so the full shape
+        survives crashes and grow-promotion can restore it. Caller holds
+        the scheduler lock."""
+        if not defrag_pkg.elastic_enabled():
+            return None
+        if getattr(self.scheduler_algorithm, "bad_nodes", None):
+            # same rule as plan_defrag_for: probe rollback is only exact
+            # on a healthy view
+            return None
+        try:
+            spec = GangSpec.from_pod(pod)
+        except Exception:
+            return None
+        if not spec.elastic or spec.degraded:
+            return None
+        if spec.name in getattr(self.scheduler_algorithm,
+                                "affinity_groups", {}):
+            return None  # already placed since recorded
+        probe = WhatIfProbe(self.scheduler_algorithm, self._all_nodes())
+        rung = None
+        for candidate in shrink_ladder(spec):
+            if probe.run_fit_probe(candidate).feasible:
+                rung = candidate
+                break
+        if rung is None:
+            metrics.inc("tpu_hive_elastic_offers_total",
+                        outcome="infeasible")
+            return None
+        # replace the waiting full-shape pods with the degraded incarnation
+        # (same group name, fresh uids — a deleted pod's uid never returns)
+        delete_pod = getattr(self.kube_client, "delete_pod", None)
+        waiting = [
+            st.pod for st in list(self.pod_schedule_statuses.values())
+            if st.pod is not None and not internal.is_allocated(st.pod_state)
+            and self._group_of(st.pod) == group
+        ]
+        if delete_pod is not None:
+            for p in waiting:
+                try:
+                    delete_pod(p.namespace, p.name)
+                except Exception as e:
+                    log.warning("elastic: delete of waiting pod %s failed "
+                                "transiently: %s", internal_utils.key(p), e)
+        self._defrag_waiters.pop(group, None)
+        self._elastic_seq += 1
+        placed = self._bind_gang_atomically(
+            group,
+            gang_pods(rung, uid_prefix=f"el{self._elastic_seq}-"),
+            self._reserved_against(group),
+        )
+        if placed is None:
+            # the job framework resubmits the gang like any preempted one
+            # (nothing was running yet — no work is lost)
+            metrics.inc("tpu_hive_elastic_offers_total", outcome="failed")
+            log.warning("elastic: degraded bind of %s failed; the gang "
+                        "must be resubmitted", group)
+            return None
+        self._elastic_degraded[group] = {
+            "offeredChips": rung.chips, "fullChips": spec.chips,
+            "since": time.monotonic(),
+        }
+        self._update_elastic_gauge()
+        metrics.inc("tpu_hive_elastic_offers_total", outcome="offered")
+        log.info("elastic: offered %s a degraded %d-chip slice (full "
+                 "shape %d chips blocked)", group, rung.chips, spec.chips)
+        return {"group": group, "offeredChips": rung.chips,
+                "fullChips": spec.chips,
+                "nodes": sorted({p.node_name for p in placed})}
+
+    @staticmethod
+    def _group_of(pod: Pod) -> Optional[str]:
+        try:
+            return internal_utils.extract_pod_scheduling_spec(
+                pod).affinity_group.name
+        except Exception:
+            return None
+
+    def _promote_elastic_grows(self) -> List[dict]:
+        """Degraded running gangs whose full shape fits again are
+        grow-migrated back through the migration machinery: reserve the
+        target slice, evict (pod deletion = SIGTERM = the supervisor's
+        checkpoint-and-exit-0 contract), re-place at full shape, resume —
+        the workload's cross-topology restore turns the bigger slice back
+        into goodput. Degradedness is read from the running pods' own
+        specs, so this works across scheduler restarts. Caller holds the
+        scheduler lock."""
+        if not defrag_pkg.elastic_enabled():
+            return []
+        if getattr(self.scheduler_algorithm, "bad_nodes", None):
+            return []
+        if getattr(self.kube_client, "delete_pod", None) is None:
+            return []
+        grown: List[dict] = []
+        for g in self._running_groups():
+            if not g.spec.degraded:
+                continue
+            full = g.spec.full_spec()
+            probe = WhatIfProbe(self.scheduler_algorithm, self._all_nodes())
+            result = probe.run_swap_probe(g.bound_pods, full)
+            if not result.feasible:
+                metrics.inc("tpu_hive_elastic_grows_total",
+                            outcome="infeasible")
+                continue
+            self._migration_seq += 1
+            mid = f"mig-{self._migration_seq}"
+            now = time.monotonic()
+            target = set(result.nodes_of(full.name))
+            mig = defrag_exec.Migration(
+                id=mid, waiter=g.name, waiter_chips=full.chips,
+                moves=[defrag_exec.Move(
+                    group=g.name, spec=full,
+                    evicted_pods=list(g.bound_pods),
+                    target_nodes=sorted(target),
+                )],
+            )
+            self._migrations[mid] = mig
+            self._reservations[g.name] = defrag_exec.Reservation(
+                holder=g.name, nodes=target, kind="migration",
+                created_at=now, deadline=now + self.defrag_reserve_ttl_s,
+                migration_id=mid)
+            self._update_reservation_gauge()
+            self._elastic_degraded.setdefault(g.name, {
+                "offeredChips": g.spec.chips, "fullChips": full.chips,
+                "since": now,
+            })
+            metrics.inc("tpu_hive_defrag_migrations_total",
+                        outcome="planned")
+            metrics.inc("tpu_hive_elastic_grows_total", outcome="planned")
+            log.info("elastic: promoting %s from %d back to %d chips "
+                     "(migration %s)", g.name, g.spec.chips, full.chips, mid)
+            self._evict_moves(mig)
+            grown.append({"group": g.name, "migrationId": mid,
+                          "fromChips": g.spec.chips, "toChips": full.chips})
+        return grown
 
     def get_defrag_status(self) -> dict:
         """Inspect view of the reservation/migration state machine."""
@@ -1093,6 +1358,7 @@ class HivedScheduler:
             return {
                 "enabled": defrag_pkg.defrag_enabled(),
                 "backfill": defrag_pkg.backfill_enabled(),
+                "elastic": defrag_pkg.elastic_enabled(),
                 "reservations": [
                     r.to_dict() for r in self._reservations.values()
                 ],
@@ -1100,6 +1366,10 @@ class HivedScheduler:
                     m.to_dict() for m in self._migrations.values()
                 ],
                 "waiters": sorted(self._defrag_waiters),
+                "elasticDegraded": {
+                    group: {k: v for k, v in rec.items() if k != "since"}
+                    for group, rec in sorted(self._elastic_degraded.items())
+                },
             }
 
     def get_admission_hints(self) -> dict:

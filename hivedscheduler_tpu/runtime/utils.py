@@ -352,4 +352,16 @@ def extract_pod_scheduling_spec(pod: Pod) -> api.PodSchedulingSpec:
             is_pod_in_group = True
     if not is_pod_in_group:
         raise api.as_bad_request(err_pfx + "AffinityGroup.Members does not contain current Pod")
+    if spec.duration_seconds < 0:
+        raise api.as_bad_request(err_pfx + "durationSeconds is negative")
+    if spec.elastic_min_chips < 0:
+        raise api.as_bad_request(err_pfx + "elasticMinChips is negative")
+    total_chips = sum(
+        m.pod_number * m.leaf_cell_number for m in spec.affinity_group.members
+    )
+    if spec.elastic_min_chips > total_chips:
+        raise api.as_bad_request(
+            err_pfx + f"elasticMinChips exceeds the gang's total leaf cells "
+            f"({total_chips})"
+        )
     return _memo_put(_sched_spec_memo, raw if pod_independent else memo_key, spec)

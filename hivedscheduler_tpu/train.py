@@ -134,6 +134,21 @@ def main(argv=None) -> int:
                         help="data-loader stream seed (the loader's RNG "
                         "state joins every checkpoint, so a preempted run "
                         "resumes the exact uninterrupted stream)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="elastic mesh mode: treat --tp/--sp/--fsdp/"
+                        "--pp/--ep as PREFERENCES and derive a valid mesh "
+                        "for whatever slice the scheduler actually offered "
+                        "(the device count the bind annotation granted) "
+                        "instead of asserting the requested shape. A "
+                        "checkpoint saved on one mesh restores onto the "
+                        "derived one (cross-topology resume; "
+                        "doc/design/elastic.md)")
+    parser.add_argument("--min-chips", type=int, default=0,
+                        help="with --elastic: the smallest slice this job "
+                        "accepts; an offer below it exits nonzero instead "
+                        "of training degenerately (recorded in the "
+                        "checkpoint metadata as the job's shape ladder "
+                        "floor)")
     parser.add_argument("--grace-secs", type=float, default=30.0,
                         help="preemption grace period: SIGTERM/SIGINT "
                         "request checkpoint-and-exit at the next step "
@@ -171,6 +186,8 @@ def main(argv=None) -> int:
     if args.on_nan == "skip" and args.lora_rank > 0:
         parser.error("--on-nan skip gates the full train step; with "
                      "--lora-rank use rollback or halt")
+    if args.min_chips and not args.elastic:
+        parser.error("--min-chips requires --elastic")
 
     from hivedscheduler_tpu.common import utils as common
 
@@ -192,10 +209,34 @@ def main(argv=None) -> int:
         make_sharded_train_step,
     )
 
-    # 2. mesh over the granted slice
+    # 2. mesh over the granted slice. Elastic mode reads the OFFERED slice
+    #    (the device count the scheduler's bind actually granted) and
+    #    derives a valid mesh for it instead of asserting the requested
+    #    shape — the entry-point half of the elastic-resume contract
+    #    (doc/design/elastic.md).
     n_devices = len(jax.devices())
-    axes = topology.infer_axes(n_devices, tp=args.tp, sp=args.sp,
-                               fsdp=args.fsdp, pp=args.pp, ep=args.ep)
+    if args.elastic:
+        if args.min_chips and n_devices < args.min_chips:
+            raise SystemExit(
+                f"elastic job floor not met: offered {n_devices} chip(s), "
+                f"--min-chips {args.min_chips}"
+            )
+        axes = topology.elastic_axes(
+            n_devices, tp=args.tp, sp=args.sp, fsdp=args.fsdp, pp=args.pp,
+            ep=args.ep, n_heads=args.n_heads,
+            n_kv_heads=args.n_kv_heads or args.n_heads,
+            global_batch=args.batch, seq_len=args.seq_len,
+        )
+        requested = (args.tp, args.sp, args.fsdp, args.pp, args.ep)
+        if (axes.tp, axes.sp, axes.fsdp, axes.pp, axes.ep) != requested:
+            log.warning(
+                "elastic: requested (tp, sp, fsdp, pp, ep)=%s does not fit "
+                "the offered %d-chip slice; derived mesh %s", requested,
+                n_devices, axes,
+            )
+    else:
+        axes = topology.infer_axes(n_devices, tp=args.tp, sp=args.sp,
+                                   fsdp=args.fsdp, pp=args.pp, ep=args.ep)
     mesh = topology.make_mesh(axes)
     log.info("rank %s/%s: %s devices, mesh %s", rank, world, n_devices, axes)
 
@@ -215,7 +256,10 @@ def main(argv=None) -> int:
         moe_zloss_weight=args.moe_zloss,
         expert_capacity_factor=args.expert_capacity_factor,
         rope_theta=args.rope_theta,
-        pipeline_microbatches=args.microbatches if args.pp > 1 else 0,
+        # elastic mode may have shrunk pp away: pipelining follows the
+        # DERIVED mesh, not the request (a 1-stage pipeline is just the
+        # plain layer scan)
+        pipeline_microbatches=args.microbatches if axes.pp > 1 else 0,
         lora_rank=args.lora_rank,
         lora_alpha=args.lora_alpha,
         lora_mlp=args.lora_mlp,
@@ -265,7 +309,11 @@ def main(argv=None) -> int:
 
     def restore_state(params_t, opt_t):
         """Restore the newest committed checkpoint into the given templates;
-        returns (step, params, opt_state, loader_metadata)."""
+        returns (step, params, opt_state, loader_metadata). The templates
+        carry THIS incarnation's shardings, so a checkpoint written on a
+        different (dp, fsdp, pp, ep, tp, sp) mesh reshards on load — the
+        metadata gate below has already verified the model geometry and
+        data stream match."""
         step_no, p, o = ckpt.restore(args.checkpoint_dir, params_t, opt_t)
         meta = ckpt.read_metadata(args.checkpoint_dir, step_no)
         return step_no, p, o, meta
@@ -276,12 +324,27 @@ def main(argv=None) -> int:
     if args.checkpoint_dir:
         last = ckpt.latest_step(args.checkpoint_dir)
         if last is not None:
+            source_mesh = ckpt.validate_resume_metadata(
+                ckpt.read_metadata(args.checkpoint_dir, last), axes, cfg,
+                global_batch=args.batch, seq_len=args.seq_len,
+            )
             start_step, params, opt_state, resume_meta = restore_state(
                 params, opt_state
             )
             if lora_mode:
                 base_params, lora_params = tm.split_lora_params(params)
             metrics.inc("tpu_hive_train_resumes_total")
+            if source_mesh is not None:
+                # cross-topology resume: same arrays, different layout —
+                # bit-exactness is not promised across reduction orders;
+                # the loss trajectory is pinned allclose instead
+                # (tests/test_elastic.py)
+                metrics.inc("tpu_hive_train_cross_topology_resumes_total")
+                log.warning(
+                    "cross-topology resume: checkpoint step %s was saved on "
+                    "mesh %s, restoring onto %s", start_step, source_mesh,
+                    {n: s for n, s in zip(axes.names, axes.shape)},
+                )
             log.info("resumed from checkpoint step %s", start_step)
 
     from hivedscheduler_tpu.parallel import data as data_lib
@@ -354,13 +417,27 @@ def main(argv=None) -> int:
             args.steps - start_step,
         )
 
+    # the commit-marker sidecar: loader state of record + the elastic-resume
+    # identity (source mesh, model geometry, data stream, shape ladder)
+    elastic_meta = None
+    if args.elastic:
+        elastic_meta = {
+            "min_chips": args.min_chips,
+            "requested": {"tp": args.tp, "sp": args.sp, "fsdp": args.fsdp,
+                          "pp": args.pp, "ep": args.ep},
+        }
+    train_meta = ckpt.train_metadata(
+        axes, cfg, global_batch=args.batch, seq_len=args.seq_len,
+        elastic=elastic_meta,
+    )
+
     def save_checkpoint(step_no):
         if not args.checkpoint_dir:
             return
         if ckpt.latest_step(args.checkpoint_dir) == step_no:
             return  # already committed (e.g. preempted right after a save)
         ckpt.save(args.checkpoint_dir, step_no, params, opt_state,
-                  extra={"loader": loader_snap})
+                  extra={"loader": loader_snap, **train_meta})
 
     preempted = False
     diverged = None

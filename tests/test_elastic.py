@@ -1,0 +1,265 @@
+"""Elastic resume matrix (ISSUE 10): a checkpoint saved on one
+(dp, fsdp, pp, ep, tp, sp) mesh restores onto another.
+
+- same-topology resume stays **bit-exact** (the existing discipline,
+  re-asserted through the new commit-marker metadata path);
+- cross-topology resume (shrink, grow, tp<->dp reshape on the 8-device CPU
+  mesh) pins the loss trajectory **allclose** against the uninterrupted
+  run — resharding is exact, only reduction orders change;
+- the loader state of record re-slices to a new host width with no sample
+  double-trained or skipped;
+- `topology.elastic_axes` derives a valid mesh for whatever slice was
+  offered, holding the requested degrees as preferences.
+
+The CLI-level end-to-end cousin (kill -9 -> shrink resume -> grow promote
+through real `train --elastic` subprocesses) is the slow-marked elastic
+chaos episode (tests/test_workload_seeds.py, tools/check_workload_seeds.py).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+pytest.importorskip("orbax.checkpoint")
+import jax.numpy as jnp  # noqa: E402
+
+from hivedscheduler_tpu.models import transformer as tm  # noqa: E402
+from hivedscheduler_tpu.parallel import checkpoint, topology  # noqa: E402
+from hivedscheduler_tpu.parallel import data as data_lib  # noqa: E402
+from hivedscheduler_tpu.parallel.train import make_sharded_train_step  # noqa: E402
+
+CFG = tm.TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+    max_seq_len=32, dtype=jnp.float32,
+)
+BATCH, SEQ = 8, 16
+
+# one compiled step per distinct axes layout for the whole module (the
+# matrix reuses layouts; recompiling per case would double the wall time)
+_SETUPS = {}
+
+
+def setup_for(axes: topology.MeshAxes):
+    if axes not in _SETUPS:
+        mesh = topology.make_mesh(axes, topology.get_devices(axes.size))
+        _SETUPS[axes] = make_sharded_train_step(CFG, mesh)
+    return _SETUPS[axes]
+
+
+def make_loader(state=None, process_index=0, process_count=1):
+    ds = data_lib.synthetic_dataset(CFG.vocab_size, size=1 << 14, seed=7)
+    if state is None:
+        return data_lib.CheckpointableBatches(
+            ds, BATCH, SEQ, seed=5,
+            process_index=process_index, process_count=process_count)
+    return data_lib.CheckpointableBatches.from_dict(
+        state, ds, BATCH, SEQ,
+        process_index=process_index, process_count=process_count)
+
+
+def run_steps(step_fn, tok_sh, params, opt, loader, n):
+    losses = []
+    for _ in range(n):
+        tokens = jax.device_put(next(loader), tok_sh)
+        params, opt, loss = step_fn(params, opt, tokens)
+        losses.append(float(loss))
+    return params, opt, losses
+
+
+class TestResumeMatrix:
+    """Checkpoint at step 2 on the source mesh, then compare steps 3..5 of
+    the uninterrupted source run against a fresh incarnation restoring on
+    the target mesh — through the same metadata path train.py uses."""
+
+    @pytest.mark.parametrize("source,target,exact", [
+        # same topology: bit-exact (the existing kill -9 discipline)
+        (topology.MeshAxes(dp=4), topology.MeshAxes(dp=4), True),
+        # shrink: half the devices
+        (topology.MeshAxes(dp=4), topology.MeshAxes(dp=2), False),
+        # grow: double the devices
+        (topology.MeshAxes(dp=2), topology.MeshAxes(dp=4), False),
+        # dp -> tp reshape at equal size
+        (topology.MeshAxes(dp=4), topology.MeshAxes(dp=2, tp=2), False),
+        # tp -> dp reshape at equal size
+        (topology.MeshAxes(dp=2, tp=2), topology.MeshAxes(dp=4), False),
+    ], ids=["same-dp4", "shrink-dp4-to-dp2", "grow-dp2-to-dp4",
+            "reshape-dp4-to-dp2tp2", "reshape-dp2tp2-to-dp4"])
+    def test_trajectory(self, tmp_path, source, target, exact):
+        step_fn, init_fn, tok_sh = setup_for(source)
+        params, opt = init_fn(jax.random.PRNGKey(0))
+        loader = make_loader()
+        params, opt, _ = run_steps(step_fn, tok_sh, params, opt, loader, 2)
+        meta = checkpoint.train_metadata(
+            source, CFG, global_batch=BATCH, seq_len=SEQ)
+        checkpoint.save(str(tmp_path), 2, params, opt,
+                        extra={"loader": loader.to_dict(), **meta})
+
+        # the uninterrupted reference continues on the source mesh
+        _, _, ref_losses = run_steps(step_fn, tok_sh, params, opt, loader, 3)
+
+        # fresh incarnation on the target mesh: validate + restore + resume
+        step2_fn, init2_fn, tok_sh2 = setup_for(target)
+        params2, opt2 = init2_fn(jax.random.PRNGKey(9))  # overwritten
+        saved = checkpoint.read_metadata(str(tmp_path), 2)
+        source_mesh = checkpoint.validate_resume_metadata(
+            saved, target, CFG, global_batch=BATCH, seq_len=SEQ)
+        if source == target:
+            assert source_mesh is None  # the bit-exact path
+        else:
+            assert source_mesh == {
+                n: s for n, s in zip(source.names, source.shape)}
+        step_no, params2, opt2 = checkpoint.restore(
+            str(tmp_path), params2, opt2)
+        assert step_no == 2
+        loader2 = make_loader(state=saved["loader"])
+        _, _, losses = run_steps(step2_fn, tok_sh2, params2, opt2,
+                                 loader2, 3)
+        if exact:
+            assert losses == ref_losses, (
+                "same-topology resume must stay bit-exact")
+        else:
+            np.testing.assert_allclose(losses, ref_losses,
+                                       rtol=1e-5, atol=1e-5)
+
+
+class TestResumeMetadata:
+    def test_geometry_mismatch_raises(self):
+        meta = checkpoint.train_metadata(
+            topology.MeshAxes(dp=2), CFG, global_batch=BATCH, seq_len=SEQ)
+        import dataclasses
+
+        other = dataclasses.replace(CFG, d_model=64)
+        with pytest.raises(ValueError, match="model geometry mismatch"):
+            checkpoint.validate_resume_metadata(
+                meta, topology.MeshAxes(dp=2), other,
+                global_batch=BATCH, seq_len=SEQ)
+
+    def test_data_stream_mismatch_raises(self):
+        meta = checkpoint.train_metadata(
+            topology.MeshAxes(dp=2), CFG, global_batch=BATCH, seq_len=SEQ)
+        with pytest.raises(ValueError, match="data stream mismatch"):
+            checkpoint.validate_resume_metadata(
+                meta, topology.MeshAxes(dp=2), CFG,
+                global_batch=BATCH * 2, seq_len=SEQ)
+
+    def test_legacy_checkpoint_passes(self):
+        # pre-metadata checkpoints have nothing to validate against
+        assert checkpoint.validate_resume_metadata(
+            {}, topology.MeshAxes(dp=2), CFG,
+            global_batch=BATCH, seq_len=SEQ) is None
+
+    def test_elastic_ladder_recorded(self):
+        meta = checkpoint.train_metadata(
+            topology.MeshAxes(dp=2), CFG, global_batch=BATCH, seq_len=SEQ,
+            elastic={"min_chips": 2, "requested": {"tp": 2}})
+        assert meta["elastic"]["min_chips"] == 2
+        assert meta["mesh"]["dp"] == 2
+        assert meta["model"]["d_model"] == CFG.d_model
+
+
+class TestLoaderReslice:
+    def test_resume_to_new_host_width_preserves_the_stream(self):
+        """A loader checkpointed on 1 host and resumed on 2 hosts yields
+        EXACTLY the uninterrupted stream's rows, split by host — no sample
+        double-trained or skipped across the dp-width change."""
+        ref = make_loader()
+        for _ in range(3):
+            next(ref)
+        state = ref.to_dict()
+        expected = [next(ref) for _ in range(2)]
+
+        halves = [make_loader(state=state, process_index=i, process_count=2)
+                  for i in range(2)]
+        for step in range(2):
+            merged = np.vstack([next(h) for h in halves])
+            np.testing.assert_array_equal(merged, expected[step])
+
+    def test_indivisible_host_width_rejected(self):
+        state = make_loader().to_dict()
+        with pytest.raises(ValueError, match="not divisible"):
+            make_loader(state=state, process_index=0, process_count=3)
+
+
+class TestElasticAxes:
+    def test_preferences_kept_when_they_fit(self):
+        axes = topology.elastic_axes(8, tp=2, sp=2, n_heads=4)
+        assert (axes.dp, axes.tp, axes.sp) == (2, 2, 2)
+
+    def test_shrinks_to_the_offered_slice(self):
+        # tp=4 cannot fit 2 devices: the largest fitting divisor wins
+        axes = topology.elastic_axes(2, tp=4, n_heads=4)
+        assert (axes.dp, axes.tp) == (1, 2)
+
+    def test_grow_fills_dp(self):
+        axes = topology.elastic_axes(8, tp=2, n_heads=4)
+        assert (axes.dp, axes.tp) == (4, 2)
+
+    def test_head_constraint_caps_tp(self):
+        # 2 heads cannot shard over tp=4 even though 4 devices exist
+        axes = topology.elastic_axes(4, tp=4, n_heads=2)
+        assert (axes.dp, axes.tp) == (2, 2)
+
+    def test_batch_constraint_caps_dp_via_fsdp(self):
+        # batch 2 cannot shard over dp*fsdp=4: no valid mesh at 4 devices
+        # without another axis to absorb them
+        with pytest.raises(ValueError, match="no valid mesh"):
+            topology.elastic_axes(4, global_batch=2)
+        axes = topology.elastic_axes(4, tp=2, global_batch=2, n_heads=4)
+        assert (axes.dp, axes.tp) == (2, 2)
+
+    def test_deterministic(self):
+        a = topology.elastic_axes(8, tp=2, sp=2, fsdp=2, n_heads=8)
+        b = topology.elastic_axes(8, tp=2, sp=2, fsdp=2, n_heads=8)
+        assert a == b
+
+    def test_pp_is_sacrificed_last(self):
+        # 4 devices, pp=2 tp=2 sp=2 requested: sp gives way before tp/pp
+        axes = topology.elastic_axes(4, pp=2, tp=2, sp=2, n_heads=4)
+        assert (axes.pp, axes.tp, axes.sp) == (2, 2, 1)
+
+
+class TestElasticCLI:
+    def test_min_chips_requires_elastic(self):
+        from hivedscheduler_tpu import train as train_cli
+
+        with pytest.raises(SystemExit):
+            train_cli.main(["--min-chips", "2"])
+
+    def test_min_chips_floor_enforced(self, tmp_path):
+        from hivedscheduler_tpu import train as train_cli
+
+        with pytest.raises(SystemExit, match="elastic job floor not met"):
+            train_cli.main([
+                "--steps", "1", "--batch", "2", "--seq-len", "16",
+                "--vocab-size", "64", "--d-model", "16", "--n-layers", "1",
+                "--n-heads", "2", "--d-ff", "32",
+                "--elastic", "--min-chips", "1024",
+            ])
+
+    def test_elastic_run_and_cross_topology_metadata(self, tmp_path):
+        """Fast in-process cousin of the slow elastic chaos episode: one
+        tiny `train --elastic` run records its derived mesh in the commit
+        marker; a second run with a different tp preference resumes from
+        it cleanly (the cross-topology metadata path end to end)."""
+        from hivedscheduler_tpu import train as train_cli
+
+        def args(steps, *extra):
+            return [
+                "--steps", str(steps), "--batch", "8", "--seq-len", "16",
+                "--vocab-size", "64", "--d-model", "16", "--n-layers", "1",
+                "--n-heads", "2", "--d-ff", "32", "--log-every", "100",
+                "--checkpoint-dir", str(tmp_path),
+                "--checkpoint-every", "1",
+                "--elastic", "--min-chips", "1", *extra,
+            ]
+
+        assert train_cli.main(args(2)) == 0
+        meta = checkpoint.read_metadata(str(tmp_path))
+        n = len(jax.devices())
+        assert meta["mesh"]["dp"] == n and meta["mesh"]["tp"] == 1
+        assert meta["elastic"]["min_chips"] == 1
+        # resume with a tp preference: derives a different mesh, restores
+        # the dp-mesh checkpoint onto it, trains 1 more step
+        assert train_cli.main(args(3, "--tp", "2")) == 0
+        meta = checkpoint.read_metadata(str(tmp_path))
+        assert meta["mesh"]["tp"] == 2

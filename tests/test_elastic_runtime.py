@@ -1,0 +1,285 @@
+"""Scheduler-side elastic offers (ISSUE 10): a waiting elastic gang whose
+full shape is blocked (and whose wait the defrag planner declined to fix)
+is bound onto the largest feasible shrink from its declared ladder; once
+capacity frees, the degraded gang is grow-migrated back to full shape via
+the PR 9 migration machinery (reserve target -> evict/checkpoint ->
+re-place -> resume). Plus the duration-aware guaranteed backfill arm:
+a gang declaring ``durationSeconds`` may ride a reserved hole when it
+provably finishes before the hold expires.
+
+Scenario fixture mirrors tests/test_defrag_runtime.py: the mini 2-cell
+cluster where one 4-chip cell is taken and an 8-chip elastic gang cannot
+fit at full shape.
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tests.test_defrag import make_pod, mini_config  # noqa: E402,F401
+from tests.test_defrag_runtime import build_scheduler, check, drive  # noqa: E402
+
+from hivedscheduler_tpu.api import constants as C  # noqa: E402
+from hivedscheduler_tpu.chaos import invariants  # noqa: E402,F401
+from hivedscheduler_tpu.common.utils import to_json  # noqa: E402
+from hivedscheduler_tpu.defrag.probe import GangSpec, shrink_ladder  # noqa: E402
+from hivedscheduler_tpu.k8s.types import Container, Pod  # noqa: E402
+from hivedscheduler_tpu.runtime.metrics import REGISTRY  # noqa: E402
+
+
+def make_elastic_pods(group, pods, chips, min_chips, vc="vc-x", prio=5,
+                      duration=0):
+    spec = {
+        "virtualCluster": vc, "priority": prio,
+        "leafCellType": "v5p-chip", "leafCellNumber": chips,
+        "elasticMinChips": min_chips,
+        "affinityGroup": {
+            "name": group,
+            "members": [{"podNumber": pods, "leafCellNumber": chips}],
+        },
+    }
+    if duration:
+        spec["durationSeconds"] = duration
+    return [
+        Pod(name=f"{group}-{i}", uid=f"{group}-{i}",
+            annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: to_json(spec)},
+            containers=[Container(resource_limits={
+                C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})])
+        for i in range(pods)
+    ]
+
+
+def blocked_elastic_scheduler():
+    """g1 holds one of the two 4-chip cells; elastic gang e (2 pods x 4
+    chips = 8, floor 2) cannot fit at full shape."""
+    sched, kube, nodes = build_scheduler()
+    assert drive(sched, kube, nodes, make_pod("g1-0", "g1", 4)) is not None
+    pods = make_elastic_pods("e", 2, 4, 2)
+    for p in pods:
+        assert drive(sched, kube, nodes, p) is None
+    return sched, kube, nodes
+
+
+class TestShrinkLadder:
+    def test_halving_rungs_to_the_floor(self):
+        spec = GangSpec(name="e", vc="v", priority=5, leaf_cell_type="c",
+                        members=((2, 4),), elastic_min_chips=2)
+        rungs = shrink_ladder(spec)
+        assert [r.members for r in rungs] == [((2, 2),), ((2, 1),)]
+        assert all(r.elastic_full_members == ((2, 4),) for r in rungs)
+        assert all(r.degraded for r in rungs)
+        assert rungs[0].full_spec().members == ((2, 4),)
+
+    def test_floor_respected(self):
+        spec = GangSpec(name="e", vc="v", priority=5, leaf_cell_type="c",
+                        members=((2, 4),), elastic_min_chips=5)
+        assert shrink_ladder(spec) == []
+
+    def test_non_elastic_has_no_ladder(self):
+        spec = GangSpec(name="e", vc="v", priority=5, leaf_cell_type="c",
+                        members=((2, 4),))
+        assert shrink_ladder(spec) == []
+
+    def test_odd_shapes_stop_the_ladder(self):
+        spec = GangSpec(name="e", vc="v", priority=5, leaf_cell_type="c",
+                        members=((1, 6),), elastic_min_chips=1)
+        assert [r.members for r in shrink_ladder(spec)] == [((1, 3),)]
+
+
+class TestShrinkOffer:
+    def test_offer_binds_the_largest_feasible_rung(self):
+        sched, kube, nodes = blocked_elastic_scheduler()
+        tick = sched.defrag_tick()
+        assert tick["planned"] is None  # the defrag planner declined
+        offer = tick["elasticOffer"]
+        assert offer is not None
+        assert offer["group"] == "e"
+        assert offer["offeredChips"] == 4 and offer["fullChips"] == 8
+        check(sched, "post-offer")
+        # the degraded incarnation is BOUND and carries the full shape in
+        # its own annotations (crash-safe grow eligibility) plus a 2-chip
+        # isolation handoff — the offered slice the workload reads
+        st = sched.get_defrag_status()
+        assert st["elasticDegraded"] == {
+            "e": {"offeredChips": 4, "fullChips": 8}}
+        g = sched.scheduler_algorithm.get_affinity_group("e")
+        total = sum(len(v) for v in g.status.physical_placement.values())
+        assert total == 4
+        bound = [st_.pod for st_ in sched.pod_schedule_statuses.values()
+                 if st_.pod is not None and st_.pod.name.startswith("el")]
+        assert len(bound) == 2
+        for p in bound:
+            spec = GangSpec.from_pod(p)
+            assert spec.degraded and spec.full_spec().chips == 8
+            iso = p.annotations[C.ANNOTATION_POD_CHIP_ISOLATION]
+            assert len(iso.split(",")) == 2
+        assert ('tpu_hive_elastic_offers_total{outcome="offered"}'
+                in REGISTRY.render())
+
+    def test_floor_blocks_too_deep_shrinks(self):
+        sched, kube, nodes = build_scheduler()
+        assert drive(sched, kube, nodes, make_pod("g1-0", "g1", 4)) is not None
+        # floor 8 == full shape: no rung exists, the gang keeps waiting
+        for p in make_elastic_pods("e8", 2, 4, 8):
+            assert drive(sched, kube, nodes, p) is None
+        tick = sched.defrag_tick()
+        assert tick["elasticOffer"] is None
+        assert "e8" in sched.get_defrag_status()["waiters"]
+
+    def test_non_elastic_waiter_is_untouched(self):
+        sched, kube, nodes = build_scheduler()
+        assert drive(sched, kube, nodes, make_pod("g1-0", "g1", 4)) is not None
+        w = make_pod("w-0", "w", 4, pods=2)
+        assert drive(sched, kube, nodes, w) is None
+        tick = sched.defrag_tick()
+        assert tick["elasticOffer"] is None
+        assert "w" in sched.get_defrag_status()["waiters"]
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("HIVED_ELASTIC", "0")
+        sched, kube, nodes = blocked_elastic_scheduler()
+        tick = sched.defrag_tick()
+        assert tick["elasticOffer"] is None and tick["elasticGrows"] == []
+        assert "e" in sched.get_defrag_status()["waiters"]
+
+    def test_no_offers_while_nodes_bad(self):
+        from hivedscheduler_tpu.k8s.types import Node, NodeCondition
+
+        sched, kube, nodes = blocked_elastic_scheduler()
+        sched._update_node(
+            Node(name=nodes[0]),
+            Node(name=nodes[0],
+                 conditions=[NodeCondition(type="Ready", status="False")]),
+        )
+        tick = sched.defrag_tick()
+        assert tick["elasticOffer"] is None
+
+
+class TestGrowPromote:
+    def grown(self):
+        sched, kube, nodes = blocked_elastic_scheduler()
+        assert sched.defrag_tick()["elasticOffer"] is not None
+        kube.delete_pod("default", "g1-0")  # capacity frees
+        return sched, kube, nodes
+
+    def test_grow_migrates_back_to_full_shape(self):
+        sched, kube, nodes = self.grown()
+        tick = sched.defrag_tick()
+        grows = tick["elasticGrows"]
+        assert grows and grows[0]["group"] == "e"
+        assert grows[0]["fromChips"] == 4 and grows[0]["toChips"] == 8
+        # the grow rides the migration machinery: reservation on the
+        # target, eviction issued; the next pass re-binds at full shape
+        rep = sched.resume_migrations()
+        assert rep[grows[0]["migrationId"]]["state"] == "Done"
+        check(sched, "post-grow")
+        g = sched.scheduler_algorithm.get_affinity_group("e")
+        total = sum(len(v) for v in g.status.physical_placement.values())
+        assert total == 8
+        st = sched.get_defrag_status()
+        assert st["elasticDegraded"] == {} and st["reservations"] == []
+        # the grown pods carry no degraded marker any more
+        for st_ in sched.pod_schedule_statuses.values():
+            spec = GangSpec.from_pod(st_.pod)
+            if spec.name == "e":
+                assert not spec.degraded and spec.elastic_min_chips == 2
+        assert ('tpu_hive_elastic_grows_total{outcome="completed"}'
+                in REGISTRY.render())
+
+    def test_no_grow_while_capacity_is_still_used(self):
+        sched, kube, nodes = blocked_elastic_scheduler()
+        assert sched.defrag_tick()["elasticOffer"] is not None
+        tick = sched.defrag_tick()  # g1 still holds the other cell
+        assert tick["elasticGrows"] == []
+        st = sched.get_defrag_status()
+        assert st["elasticDegraded"] != {}
+
+    def test_degraded_record_cleared_when_gang_deleted(self):
+        sched, kube, nodes = blocked_elastic_scheduler()
+        assert sched.defrag_tick()["elasticOffer"] is not None
+        for st_ in list(sched.pod_schedule_statuses.values()):
+            if GangSpec.from_pod(st_.pod).name == "e":
+                kube.delete_pod(st_.pod.namespace, st_.pod.name)
+        assert sched.get_defrag_status()["elasticDegraded"] == {}
+
+
+class TestDurationAwareBackfill:
+    def reserved(self):
+        """A waiter holds a reservation (via the migration pipeline of
+        tests/test_defrag_runtime.fragmented_scheduler)."""
+        from tests.test_defrag_runtime import fragmented_scheduler
+
+        sched, kube, nodes = fragmented_scheduler()
+        w = make_pod("w-0", "w", 4)
+        assert drive(sched, kube, nodes, w) is None
+        plan = sched.defrag_tick()["planned"]
+        assert plan is not None
+        sched.resume_migrations()
+        return sched, kube, nodes, w, plan
+
+    def test_short_guaranteed_gang_rides_the_hold(self):
+        sched, kube, nodes, w, plan = self.reserved()
+        # declares it finishes in 1s; the hold's TTL is 300s: fits-window
+        rider = make_elastic_pods("rider", 1, 4, 0, duration=1.0)[0]
+        assert drive(sched, kube, nodes, rider) is not None
+        assert ('tpu_hive_backfill_admissions_total{outcome="fits-window"}'
+                in REGISTRY.render())
+        check(sched, "rider-landed")
+
+    def test_long_guaranteed_gang_stays_blocked(self):
+        sched, kube, nodes, w, plan = self.reserved()
+        # a declared duration past the hold's TTL cannot ride
+        rider = make_elastic_pods("slow-rider", 1, 4, 0,
+                                  duration=10_000.0)[0]
+        assert drive(sched, kube, nodes, rider) is None
+        blocked = REGISTRY.render()
+        assert 'tpu_hive_backfill_admissions_total{outcome="blocked"}' in blocked
+        # the holder still lands in its reserved slice
+        assert drive(sched, kube, nodes, w) in plan["waiterNodes"]
+        check(sched, "end")
+
+    def test_unknown_duration_keeps_conservative_behavior(self):
+        sched, kube, nodes, w, plan = self.reserved()
+        rider = make_pod("nodur-0", "nodur", 4)
+        assert drive(sched, kube, nodes, rider) is None
+
+
+class TestSpecValidation:
+    def test_negative_duration_rejected(self):
+        from hivedscheduler_tpu.api.types import WebServerError
+        from hivedscheduler_tpu.runtime import utils as internal_utils
+
+        pod = make_elastic_pods("bad", 1, 4, 0, duration=-1.0)[0]
+        with pytest.raises(WebServerError, match="durationSeconds is negative"):
+            internal_utils.extract_pod_scheduling_spec(pod)
+
+    def test_elastic_min_above_total_rejected(self):
+        from hivedscheduler_tpu.api.types import WebServerError
+        from hivedscheduler_tpu.runtime import utils as internal_utils
+
+        pod = make_elastic_pods("bad2", 1, 4, 99)[0]
+        with pytest.raises(WebServerError,
+                           match="elasticMinChips exceeds the"):
+            internal_utils.extract_pod_scheduling_spec(pod)
+
+    def test_spec_roundtrip_keeps_elastic_fields(self):
+        from hivedscheduler_tpu.api.types import PodSchedulingSpec
+
+        d = {
+            "virtualCluster": "v", "priority": 1, "leafCellType": "c",
+            "leafCellNumber": 4, "durationSeconds": 60.0,
+            "elasticMinChips": 2,
+            "elasticFullMembers": [{"podNumber": 2, "leafCellNumber": 4}],
+            "affinityGroup": {"name": "g", "members": [
+                {"podNumber": 2, "leafCellNumber": 4}]},
+        }
+        spec = PodSchedulingSpec.from_dict(d)
+        out = spec.to_dict()
+        assert out["durationSeconds"] == 60.0
+        assert out["elasticMinChips"] == 2
+        assert out["elasticFullMembers"] == [
+            {"podNumber": 2, "leafCellNumber": 4}]

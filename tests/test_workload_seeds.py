@@ -49,6 +49,17 @@ def test_episode_plans_are_deterministic(tmp_path):
         assert a.plan.min_step <= step <= a.steps - 2
 
 
+def test_elastic_episode_plan_is_deterministic(tmp_path):
+    """Same seed => identical kill/preempt steps for the elastic ladder
+    episode (drawn in __init__; no subprocesses spawned)."""
+    from hivedscheduler_tpu.chaos.workload import ElasticWorkloadHarness
+
+    a = ElasticWorkloadHarness(seed=3, workdir=str(tmp_path))
+    b = ElasticWorkloadHarness(seed=3, workdir=str(tmp_path))
+    assert (a.kill_step, a.preempt_step) == (b.kill_step, b.preempt_step)
+    assert a.checkpoint_every < a.kill_step < a.preempt_step <= a.steps - 2
+
+
 def test_pinned_set_covers_the_full_fault_ladder(tmp_path):
     """The pinned seeds must keep covering every episode kind — a plan
     change that silently drops e.g. the hang rung from the replayed mix

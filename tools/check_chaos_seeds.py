@@ -50,15 +50,18 @@ PINNED_SEEDS = [
     (13, "defrag-v1", 14, "defrag: kill -9 mid-migration (abort path)"),
     (18, "defrag-v1", 14, "defrag: kill -9 under injected evict faults"),
     (28, "defrag-v1", 14, "defrag: two plans in one soak + rebind"),
-    # KNOWN PRE-EXISTING CORNER (not pinned green): seeds 2 and 23 of
-    # defrag-v1 reach a doomed-bad accounting gap with NO defrag machinery
-    # active (empty reservations/migrations; planner rejected) — a
-    # preassigned doomed-bad binding at one level drops
-    # total_left_cell_num at a HIGHER level below all_vc_free without a
-    # doomed bind there, so check_vc_safety trips (seed 23) or
-    # safe_relaxed_buddy_alloc raises VCSafetyBroken at schedule time
-    # (seed 2). Repro: python tools/check_chaos_seeds.py --seed 23
-    # --plan defrag-v1 --schedules 14. See doc/design/fault-model.md.
+    # Doomed-bad accounting under multi-bad-node layouts (the ex-"known
+    # pre-existing corner", fixed in ISSUE 10): a reclaim-then-reallocate
+    # sequence left a VC's free cell unbacked at a level whose only bad
+    # free candidate was later split away by a LOWER-level doomed bind, so
+    # total_left < all_vc_free materialized (seed 23: invariant trip;
+    # seed 2: VCSafetyBroken at schedule time). Fixed by the top-down
+    # doom-bind sweep + rebind re-checks in the bad-parent accounting
+    # branches + the bindable-candidate filter (PARITY.md deviations).
+    (23, "defrag-v1", 14, "doomed-bad: higher-level excess stranded by a "
+                          "lower-level doomed bind (invariant trip)"),
+    (2, "defrag-v1", 14, "doomed-bad: VCSafetyBroken raise at schedule "
+                         "time from the same accounting gap"),
 ]
 
 

@@ -47,6 +47,16 @@ PINNED_SEEDS = [
     (15, 2, "baseline: hard kill + injected hang -> watchdog exit"),
 ]
 
+# (seed, why-it-is-pinned) — the elastic ladder episode
+# (chaos.workload.ElasticWorkloadHarness): kill -9 on the full slice ->
+# shrink resume on half the devices (cross-topology restore) -> grow
+# promote back, merged trajectory allclose vs an uninterrupted full-slice
+# reference. Same pin-the-seed policy as PINNED_SEEDS.
+ELASTIC_PINNED_SEEDS = [
+    (3, "elastic baseline: kill@3 -> shrink resume -> SIGTERM grow "
+        "offer@6 -> full-slice completion"),
+]
+
 
 def replay(seed: int, episodes: int = 2, workdir: str | None = None) -> dict:
     from hivedscheduler_tpu.chaos.workload import (
@@ -65,19 +75,38 @@ def replay(seed: int, episodes: int = 2, workdir: str | None = None) -> dict:
         return _run(d)
 
 
+def replay_elastic(seed: int, workdir: str | None = None) -> dict:
+    from hivedscheduler_tpu.chaos.workload import ElasticWorkloadHarness
+
+    def _run(d: str) -> dict:
+        return ElasticWorkloadHarness(seed=seed, workdir=d).run()
+
+    if workdir is not None:
+        return _run(workdir)
+    with tempfile.TemporaryDirectory(prefix="hived-elastic-chaos-") as d:
+        return _run(d)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--seed", type=int, default=None,
                         help="replay ONE seed (debugging) instead of the "
                              "pinned set")
     parser.add_argument("--episodes", type=int, default=2)
+    parser.add_argument("--elastic", action="store_true",
+                        help="with --seed: replay the ELASTIC ladder "
+                             "episode (kill -> shrink resume -> grow "
+                             "promote) instead of the fault-ladder plan")
     args = parser.parse_args(argv)
     logging.disable(logging.CRITICAL)
 
     if args.seed is not None:
-        targets = [(args.seed, args.episodes, "ad hoc")]
+        targets = [] if args.elastic else [(args.seed, args.episodes,
+                                            "ad hoc")]
+        elastic_targets = [(args.seed, "ad hoc")] if args.elastic else []
     else:
         targets = PINNED_SEEDS
+        elastic_targets = ELASTIC_PINNED_SEEDS
     ok = True
     for seed, episodes, why in targets:
         report = replay(seed, episodes)
@@ -92,8 +121,22 @@ def main(argv=None) -> int:
                   f"episodes {json.dumps(report['episodes'])}, "
                   f"{report['incarnations']} incarnations, "
                   f"{report['steps']} steps bit-exact")
+    for seed, why in elastic_targets:
+        report = replay_elastic(seed)
+        if report["violations"]:
+            ok = False
+            print(f"ELASTIC SEED {seed} ({why}): "
+                  f"{len(report['violations'])} violation(s):")
+            for v in report["violations"]:
+                print(f"  {v}")
+        else:
+            print(f"elastic seed {seed} OK — kill@{report['kill_step']}, "
+                  f"grow offer@{report['preempt_step']}, "
+                  f"{report['incarnations']} incarnations, "
+                  f"{report['steps']} steps allclose")
+    total = len(targets) + len(elastic_targets)
     if ok:
-        print(f"check_workload_seeds: OK ({len(targets)} seed(s) clean)")
+        print(f"check_workload_seeds: OK ({total} seed(s) clean)")
     return 0 if ok else 1
 
 
