@@ -609,8 +609,27 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
       if a guarantee owner preempts it first, its accrued work is NOT
       wasted — it re-queues with only its remaining duration (+ restore
       downtime), the bit-exact kill-and-resume contract.
+
+    Wait accounting is journal-backed (ISSUE 11): the replay drives a
+    virtual-clock :class:`obs.journal.Journal` exactly the way the live
+    runtime does — every block opens/re-attributes a wait interval in the
+    shared bucket taxonomy (``vc_quota`` / ``fragmentation`` /
+    ``capacity``), every admission closes it — and the per-bucket
+    chip-time summed over the journal's closed intervals is ASSERTED equal
+    to the ``advance()``-integrated total wait chip-time. The same buckets
+    the live server serves at ``/v1/inspect/gangs`` become the
+    ``wait_attribution`` shares in the driver artifact.
     """
     import heapq
+
+    from hivedscheduler_tpu.obs import journal as obs_journal
+
+    # virtual-clock journal instance: metrics off (sim durations must not
+    # pollute the process registry), interval cap lifted (the assertion
+    # below must see every closed interval)
+    jr = obs_journal.Journal(capacity=1 << 17, metrics=False,
+                             intervals_per_gang=1 << 16)
+    jr.enabled = True
 
     total_chips = TRACE_TOTAL_CHIPS
     clock = 0.0
@@ -659,8 +678,23 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
             completes_at[job["name"]] = at
         heapq.heappush(events, (at, seq, job))
 
+    def wait_bucket(job):
+        """The journal attribution bucket for a blocked job — the sim-side
+        mirror of obs.journal.classify_wait: global shortfall is pure
+        queueing (`capacity`); a guaranteed gang whose VC quota has no room
+        is `vc_quota` stranding; everything else that has the chips but no
+        placement is `fragmentation`."""
+        if job["block_reason"] == "capacity":
+            return "capacity"
+        if (defrag is not None and job["priority"] >= 0
+                and guar_quota_free(job["vc"])
+                < job["pods"] * job["chips"]):
+            return "vc_quota"
+        return "fragmentation"
+
     def register_success(job, dt):
         nonlocal scheduled, contiguous
+        jr.note_phase(job["name"], "running", "bind", at=clock)
         if not job.get("_admitted"):
             # stats count each job once; a work-preserving re-admission
             # (defrag mode) is a resume, not a new schedule
@@ -700,6 +734,7 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
             if (defrag is not None and job["block_reason"] == "packing"
                     and attempt_defrag(job)):
                 return True
+            jr.note_wait(job["name"], wait_bucket(job), at=clock)
             return False
         register_success(job, dt)
         return True
@@ -856,6 +891,7 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
                 "capacity" if free_chips() < job["pods"] * job["chips"]
                 else "packing"
             )
+            jr.note_wait(name, wait_bucket(job), at=clock)
             waiting.append(job)
 
     def try_promotions():
@@ -906,6 +942,7 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
             else:
                 # preempted away mid-run: everything it accrued is wasted
                 wasted_chip_time += busy_of.get(job["name"], 0.0)
+            jr.note_phase(job["name"], "closed", "released", at=clock)
             chips_of.pop(job["name"], None)
             if defrag is not None:
                 completes_at.pop(job["name"], None)
@@ -922,6 +959,22 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
     p99 = lat_ms[max(0, int(len(lat_ms) * 0.99) - 1)] if lat_ms else 0.0
     span = last_t * total_chips
     total_wait = sum(wait_chip_time.values())
+    # -- journal-backed wait attribution (ISSUE 11) ------------------------
+    # Sum per-bucket chip-time over the journal's closed wait intervals and
+    # ASSERT it equals the advance()-integrated total: the attribution the
+    # live server serves is pinned to the accounting the bench reports.
+    jr.close_all(last_t)
+    journal_wait = {}
+    for gang, bucket, start, end in jr.wait_intervals():
+        j = job_by_name[gang]
+        journal_wait[bucket] = (journal_wait.get(bucket, 0.0)
+                                + (end - start) * j["pods"] * j["chips"])
+    attributed = sum(journal_wait.values())
+    assert abs(attributed - total_wait) <= 1e-6 * max(1.0, total_wait), (
+        f"journal wait-attribution buckets sum to {attributed} chip-time "
+        f"but the replay integrated {total_wait} — an interval was lost or "
+        f"double-opened"
+    )
     useful_chip_time = busy_chip_time
     if defrag is not None:
         # restore windows occupy chips but are not work
@@ -946,6 +999,12 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
             wait_chip_time["capacity"] / total_wait, 3) if total_wait else 0.0,
         "wait_packing_share": round(
             wait_chip_time["packing"] / total_wait, 3) if total_wait else 0.0,
+        # the journal's finer buckets (vc_quota vs fragmentation split of
+        # the old "packing"), shares of total wait chip-time
+        "wait_attribution": {
+            b: round(v / total_wait, 3)
+            for b, v in sorted(journal_wait.items())
+        } if total_wait else {},
         "preempt_wasted_pct": round(100.0 * wasted_chip_time / span, 1)
         if span else 0.0,
     }
@@ -1191,6 +1250,7 @@ if __name__ == "__main__":
                           trace_wait_chip_time_pct=t["wait_chip_time_pct"],
                           trace_wait_capacity_share=t["wait_capacity_share"],
                           trace_wait_packing_share=t["wait_packing_share"],
+                          trace_wait_attribution=t["wait_attribution"],
                           trace_preempt_wasted_pct=t["preempt_wasted_pct"])
             # defrag/backfill fields (absent under HIVED_DEFRAG=0)
             for k in ("migrations", "promotions", "backfills",

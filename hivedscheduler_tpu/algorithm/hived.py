@@ -80,6 +80,7 @@ from hivedscheduler_tpu.algorithm.utils import (
 )
 from hivedscheduler_tpu.k8s.types import Node, Pod
 from hivedscheduler_tpu.obs import decisions as obs_decisions
+from hivedscheduler_tpu.obs import journal as obs_journal
 from hivedscheduler_tpu.runtime import types as internal
 from hivedscheduler_tpu.runtime import utils as internal_utils
 from hivedscheduler_tpu.runtime.types import PodScheduleResult, SchedulerAlgorithm
@@ -549,34 +550,64 @@ class HivedAlgorithm(SchedulerAlgorithm):
         lockcheck.assert_serialized(self)
         with self.algorithm_lock:
             rec = obs_decisions.RECORDER
-            if not rec.enabled:
+            jr = obs_journal.JOURNAL
+            if not rec.enabled and not jr.enabled:
                 return self._schedule_locked(pod, suggested_nodes, phase)
             dec = rec.begin(internal_utils.key(pod), phase)
             self._decision = dec
             try:
                 result = self._schedule_locked(pod, suggested_nodes, phase)
             except Exception as e:
-                dec.finish("error", reason=str(e))
-                rec.commit(dec)
+                if dec is not None:
+                    dec.finish("error", reason=str(e))
+                    rec.commit(dec)
                 raise
             finally:
                 self._decision = None
-            if result.pod_bind_info is not None:
-                dec.finish("bind", node=result.pod_bind_info.node)
-            elif result.pod_preempt_info is not None:
-                dec.finish(
-                    "preempt",
-                    victims=[internal_utils.key(v)
-                             for v in result.pod_preempt_info.victim_pods],
-                )
-            else:
-                dec.finish(
-                    "wait",
-                    reason=(result.pod_wait_info.reason
-                            if result.pod_wait_info is not None else ""),
-                )
-            rec.commit(dec)
+            if dec is not None:
+                if result.pod_bind_info is not None:
+                    dec.finish("bind", node=result.pod_bind_info.node)
+                elif result.pod_preempt_info is not None:
+                    dec.finish(
+                        "preempt",
+                        victims=[internal_utils.key(v)
+                                 for v in result.pod_preempt_info.victim_pods],
+                    )
+                else:
+                    dec.finish(
+                        "wait",
+                        reason=(result.pod_wait_info.reason
+                                if result.pod_wait_info is not None else ""),
+                    )
+                rec.commit(dec)
+            if jr.enabled:
+                self._journal_schedule(pod, result)
             return result
+
+    def _journal_schedule(self, pod: Pod, result: PodScheduleResult) -> None:
+        """Gang-lifecycle journal hook (obs/journal.py): one event per gang
+        *transition* — the first member bind of an incarnation opens its
+        running episode, a preemption or wait opens/re-attributes a wait
+        interval (same bucket = the interval just continues, no event)."""
+        s = internal_utils.extract_pod_scheduling_spec(pod)
+        gang = s.affinity_group.name
+        if result.pod_bind_info is not None:
+            obs_journal.note_phase(
+                gang, "running", "bind", node=result.pod_bind_info.node,
+                vc=s.virtual_cluster, priority=s.priority)
+        elif result.pod_preempt_info is not None:
+            obs_journal.note_wait(
+                gang, "priority", etype="preempt_planned",
+                detail="waiting on victim preemption",
+                victims=[internal_utils.key(v)
+                         for v in result.pod_preempt_info.victim_pods],
+                vc=s.virtual_cluster)
+        else:
+            reason = (result.pod_wait_info.reason
+                      if result.pod_wait_info is not None else "")
+            obs_journal.note_wait(
+                gang, obs_journal.classify_wait(reason), detail=reason,
+                vc=s.virtual_cluster)
 
     def _schedule_locked(
         self, pod: Pod, suggested_nodes: List[str], phase: str
@@ -752,6 +783,14 @@ class HivedAlgorithm(SchedulerAlgorithm):
                 g.pod_index_watermark[s.leaf_cell_number] = pod_index
             if all_pods_released(g.allocated_pods):
                 self._delete_allocated_affinity_group(g, pod)
+                if (obs_journal.JOURNAL.enabled
+                        and s.affinity_group.name
+                        not in self.affinity_groups):
+                    # the gang's allocation is fully gone (complete, evicted
+                    # or preempted — the cause chain says which): close its
+                    # journal episode
+                    obs_journal.note_phase(
+                        s.affinity_group.name, "closed", "released")
 
     # ------------------------------------------------------------------
     # inspect

@@ -68,6 +68,9 @@ TRACES_CHROME_PATH = TRACES_PATH + "/chrome"
 # defrag subsystem's reservations/migrations
 ADMISSION_HINTS_PATH = INSPECT_PATH + "/admission-hints"
 DEFRAG_PATH = INSPECT_PATH + "/defrag"
+# gang-lifecycle flight recorder (obs/journal.py): per-gang summaries and
+# the causal event timeline (GET /v1/inspect/gangs/<id>/timeline)
+GANGS_PATH = INSPECT_PATH + "/gangs"
 
 # --- Config (reference: constants.go:65) ------------------------------------
 ENV_CONFIG_FILE = "CONFIG"

@@ -560,6 +560,23 @@ class ChaosHarness:
     # ------------------------------------------------------------------
 
     def run(self, n_schedules: int) -> dict:
+        # the gang-lifecycle journal rides every soak: check_journal (in
+        # check_all) then covers causal integrity and open->close
+        # lifecycles under the same faults — incl. the kill -9
+        # mid-migration windows — for free. Fresh ring per soak so gang
+        # names reused across seeds cannot alias; restored afterwards so
+        # the process-global singleton never leaks into other tests.
+        from hivedscheduler_tpu.obs import journal as obs_journal
+
+        was_enabled = obs_journal.JOURNAL.enabled
+        obs_journal.enable(capacity=65536)
+        try:
+            return self._run(n_schedules)
+        finally:
+            if not was_enabled:
+                obs_journal.disable()
+
+    def _run(self, n_schedules: int) -> dict:
         ops = (
             [self.op_schedule_gang] * 5
             + [self.op_delete_gang] * 2
@@ -575,6 +592,8 @@ class ChaosHarness:
                 last_restart_at = self.schedules_done
                 self.crash_restart()
         self._check("final quiesce", quiesce=True)
+        from hivedscheduler_tpu.obs import journal as obs_journal
+
         return {
             "seed": self.seed,
             "schedules": self.schedules_done,
@@ -585,5 +604,7 @@ class ChaosHarness:
             "migrations_planned": self.migrations_planned,
             "migrations_killed": self.migrations_killed,
             "migrations_rebound": self.migrations_rebound,
+            # non-vacuity: the soak must actually have journaled
+            "journal_events": len(obs_journal.JOURNAL),
             "violations": list(self.violations),
         }

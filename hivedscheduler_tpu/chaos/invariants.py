@@ -390,6 +390,59 @@ def check_defrag(scheduler, ctx: str = "") -> None:
                            f"tracked — placement leak window")
 
 
+def check_journal(journal=None, ctx: str = "") -> None:
+    """Structural invariants of the gang-lifecycle journal
+    (obs/journal.py). No-op when the journal is disabled, so every
+    existing soak covers it for free once the harness opts in:
+
+    - **Causal integrity**: every event's ``cause`` points BACKWARD to an
+      event id that is retained (the ring evicts oldest-first, so retained
+      ids are contiguous — a cause inside the retained range that is
+      missing, or a cause >= its own event id, is an orphan/cycle).
+    - **Complete lifecycles**: a terminal event (``released`` /
+      ``serve_finish`` / ``serve_shed``) requires an open episode — an
+      opening event for the same gang after its previous terminal. Two
+      terminals with no re-open between them is a duplicate close. The
+      open-before-close direction is only enforced while the ring has
+      never evicted (a wrapped ring may have dropped the opener).
+    """
+    from hivedscheduler_tpu.obs import journal as obs_journal
+
+    j = journal if journal is not None else obs_journal.JOURNAL
+    if not j.enabled:
+        return
+    events = j.snapshot()
+    if not events:
+        return
+    ids = {e.id for e in events}
+    min_id = min(ids)
+    terminal_types = {"released", "serve_finish", "serve_shed"}
+    full_history = j.evicted == 0
+    open_state: Dict[str, Optional[bool]] = {}  # gang -> episode open?
+    for e in events:
+        if e.cause is not None:
+            if e.cause >= e.id:
+                _fail(ctx, f"journal event {e.id} ({e.type}, gang {e.gang}) "
+                           f"names a non-backward cause {e.cause}")
+            if e.cause >= min_id and e.cause not in ids:
+                _fail(ctx, f"journal event {e.id} ({e.type}, gang {e.gang}) "
+                           f"has an orphan cause {e.cause} — the cause id "
+                           f"is inside the retained range but missing")
+        is_open = open_state.get(e.gang)
+        if e.type in terminal_types:
+            if is_open is False:
+                _fail(ctx, f"journal gang {e.gang}: duplicate terminal "
+                           f"event {e.type} (id {e.id}) with no re-open "
+                           f"since the previous close")
+            if is_open is None and full_history:
+                _fail(ctx, f"journal gang {e.gang}: terminal event "
+                           f"{e.type} (id {e.id}) with no opening event — "
+                           f"incomplete open->close lifecycle")
+            open_state[e.gang] = False
+        else:
+            open_state[e.gang] = True
+
+
 def check_all(
     algo,
     ctx: str = "",
@@ -399,7 +452,8 @@ def check_all(
 ) -> None:
     """Run every algorithm-state invariant (one locked snapshot per check).
     Pass the owning ``HivedScheduler`` as ``scheduler`` to additionally
-    check the defrag reservation/migration state machine."""
+    check the defrag reservation/migration state machine. The journal
+    check piggybacks on every call (no-op while the journal is off)."""
     check_vc_safety(algo, ctx)
     check_books(algo, ctx)
     check_cell_ownership(algo, ctx)
@@ -408,6 +462,7 @@ def check_all(
                          allow_partial_placement=allow_partial_placement)
     if scheduler is not None:
         check_defrag(scheduler, ctx)
+    check_journal(ctx=ctx)
 
 
 # ---------------------------------------------------------------------------

@@ -54,6 +54,14 @@ def main(argv=None) -> int:
         "on shutdown (also served live at GET /v1/inspect/traces/chrome)",
     )
     parser.add_argument(
+        "--journal-file",
+        default="",
+        help="append the gang-lifecycle journal (obs/journal.py) to this "
+        "JSONL spool — one causal event per line, flushed per append, so "
+        "a kill -9 loses nothing; the journal itself is always on in the "
+        "server and served at GET /v1/inspect/gangs",
+    )
+    parser.add_argument(
         "--drain-secs",
         type=float,
         default=2.0,
@@ -84,10 +92,15 @@ def main(argv=None) -> int:
     # /v1/inspect/traces/chrome). Library/bench users stay on the
     # zero-overhead disabled path — only this entry point opts in.
     from hivedscheduler_tpu.obs import decisions as obs_decisions
+    from hivedscheduler_tpu.obs import journal as obs_journal
     from hivedscheduler_tpu.obs import trace as obs_trace
 
     obs_decisions.RECORDER.enable()
     obs_trace.enable()
+    # the gang-lifecycle journal (bounded ring) backs /v1/inspect/gangs and
+    # the wait-attribution histograms; --journal-file adds the crash-safe
+    # JSONL spool for post-mortem replay
+    obs_journal.enable(spool_path=args.journal_file or None)
     if args.explain:
         obs_decisions.RECORDER.on_commit = lambda d: log.info("%s", d.explain())
     config = api_config.load_config(args.config)

@@ -124,6 +124,12 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
        "`1` enables the span tracer at import time (ad-hoc runs; "
        "programmatic `trace.enable()` otherwise).",
        "hivedscheduler_tpu/obs/trace.py"),
+    _f("HIVED_JOURNAL", "0",
+       "`1` enables the gang-lifecycle flight recorder at import time "
+       "(programmatic `journal.enable()` / the CLIs' `--journal-file` "
+       "otherwise); backs `/v1/inspect/gangs` and the "
+       "`tpu_hive_gang_wait_seconds` attribution histograms.",
+       "hivedscheduler_tpu/obs/journal.py"),
     # -- chaos fault hooks (one-shot per process; unset = unarmed) --------
     _f("HIVED_FAULT_HANG_AT", "unarmed",
        "Wedge the workload at this step index (watchdog-ladder chaos "

@@ -61,6 +61,10 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # it; the only things legal under it are pure store mutations.
     "store_lock": 50,
     # observability leaves: nothing is ever acquired under these.
+    # (journal_lock sits just below metrics_lock: closing a wait interval
+    # observes the gang-wait histogram while holding it — the one legal
+    # under-journal acquisition.)
+    "journal_lock": 78,
     "metrics_lock": 80,
     "trace_lock": 82,
     "decisions_lock": 84,
@@ -76,6 +80,7 @@ LOCK_SITES: Dict[str, str] = {
     "algorithm_lock": "hivedscheduler_tpu/algorithm/hived.py",
     "watchdog_lock": "hivedscheduler_tpu/parallel/supervisor.py",
     "store_lock": "hivedscheduler_tpu/k8s/fake.py",
+    "journal_lock": "hivedscheduler_tpu/obs/journal.py",
     "metrics_lock": "hivedscheduler_tpu/runtime/metrics.py",
     "trace_lock": "hivedscheduler_tpu/obs/trace.py",
     "decisions_lock": "hivedscheduler_tpu/obs/decisions.py",

@@ -109,6 +109,11 @@ class Migration:
     moves: List[Move]
     state: str = MIGRATION_EVICTING
     generation: int = 1   # replacement-pod uid epoch (uids never recycle)
+    # observability riders (runtime executor writes them; in-memory like
+    # everything else here — a crash drops them with the migration):
+    created_at: float = 0.0   # time.monotonic() at plan time
+    phase_t: float = 0.0      # start of the current phase (evict/rebind)
+    journal_event: int = 0    # the plan's journal event id (causal anchor)
 
     @property
     def active(self) -> bool:

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from hivedscheduler_tpu.api import constants as api_constants
 from hivedscheduler_tpu.common.utils import to_json
 from hivedscheduler_tpu.k8s.types import Container, Pod
+from hivedscheduler_tpu.obs import journal as obs_journal
 from hivedscheduler_tpu.runtime import utils as internal_utils
 from hivedscheduler_tpu.runtime.types import FILTERING_PHASE
 
@@ -279,45 +280,50 @@ class WhatIfProbe:
         removed: List[Sequence[Pod]] = []
         placed: List[Sequence[Pod]] = []
         placements: Dict[str, Dict[str, List[int]]] = {}
-        try:
-            for _name, _spec, bound_pods in movers:
-                self._remove_gang(bound_pods)
-                removed.append(bound_pods)
-            waiter_pods = self._place_gang(waiter)
-            if waiter_pods is None:
-                return ProbeResult(False, reason="waiter-unplaceable")
-            placed.append(waiter_pods)
-            placements[waiter.name] = self._placement_of(waiter.name)
-            for name, spec, _bound in movers:
-                mover_pods = self._place_gang(spec)
-                if mover_pods is None:
-                    placements.clear()
-                    return ProbeResult(
-                        False, reason=f"mover-unplaceable:{name}"
-                    )
-                placed.append(mover_pods)
-                placements[name] = self._placement_of(name)
-            return ProbeResult(True, placements=placements)
-        finally:
-            # rollback is unconditional: the probe never leaks state
-            for pods in reversed(placed):
-                self._remove_gang(pods)
-            for pods in reversed(removed):
-                self._restore_gang(pods)
+        # the probe's schedule/delete churn is rolled back bit-exactly —
+        # it never really happened, so the gang-lifecycle journal must
+        # not see it (thread-local: serving threads keep journaling)
+        with obs_journal.suppress():
+            try:
+                for _name, _spec, bound_pods in movers:
+                    self._remove_gang(bound_pods)
+                    removed.append(bound_pods)
+                waiter_pods = self._place_gang(waiter)
+                if waiter_pods is None:
+                    return ProbeResult(False, reason="waiter-unplaceable")
+                placed.append(waiter_pods)
+                placements[waiter.name] = self._placement_of(waiter.name)
+                for name, spec, _bound in movers:
+                    mover_pods = self._place_gang(spec)
+                    if mover_pods is None:
+                        placements.clear()
+                        return ProbeResult(
+                            False, reason=f"mover-unplaceable:{name}"
+                        )
+                    placed.append(mover_pods)
+                    placements[name] = self._placement_of(name)
+                return ProbeResult(True, placements=placements)
+            finally:
+                # rollback is unconditional: the probe never leaks state
+                for pods in reversed(placed):
+                    self._remove_gang(pods)
+                for pods in reversed(removed):
+                    self._restore_gang(pods)
 
     def run_fit_probe(self, spec: GangSpec) -> ProbeResult:
         """Would this gang bind RIGHT NOW, as-is?  Place it, record the
         placement, roll back.  The elastic shrink offer walks the shape
         ladder with one fit probe per rung (doc/design/elastic.md)."""
-        placed = self._place_gang(spec)
-        if placed is None:
-            return ProbeResult(False, reason="fit-unplaceable")
-        try:
-            return ProbeResult(True, placements={
-                spec.name: self._placement_of(spec.name)
-            })
-        finally:
-            self._remove_gang(placed)
+        with obs_journal.suppress():
+            placed = self._place_gang(spec)
+            if placed is None:
+                return ProbeResult(False, reason="fit-unplaceable")
+            try:
+                return ProbeResult(True, placements={
+                    spec.name: self._placement_of(spec.name)
+                })
+            finally:
+                self._remove_gang(placed)
 
     def run_swap_probe(
         self, bound_pods: Sequence[Pod], new_spec: GangSpec
@@ -325,16 +331,17 @@ class WhatIfProbe:
         """Can this running gang be re-placed as ``new_spec`` (same group
         name, typically a different priority — the promotion question)?
         Remove the running incarnation, try the new one, roll back."""
-        placed: Optional[List[Pod]] = None
-        self._remove_gang(bound_pods)
-        try:
-            placed = self._place_gang(new_spec)
-            if placed is None:
-                return ProbeResult(False, reason="swap-unplaceable")
-            return ProbeResult(True, placements={
-                new_spec.name: self._placement_of(new_spec.name)
-            })
-        finally:
-            if placed is not None:
-                self._remove_gang(placed)
-            self._restore_gang(bound_pods)
+        with obs_journal.suppress():
+            placed: Optional[List[Pod]] = None
+            self._remove_gang(bound_pods)
+            try:
+                placed = self._place_gang(new_spec)
+                if placed is None:
+                    return ProbeResult(False, reason="swap-unplaceable")
+                return ProbeResult(True, placements={
+                    new_spec.name: self._placement_of(new_spec.name)
+                })
+            finally:
+                if placed is not None:
+                    self._remove_gang(placed)
+                self._restore_gang(bound_pods)

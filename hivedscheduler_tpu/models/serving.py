@@ -84,6 +84,7 @@ from hivedscheduler_tpu.models.transformer import (
     _rms_norm,
     load_weight,
 )
+from hivedscheduler_tpu.obs import journal as obs_journal
 from hivedscheduler_tpu.obs import trace as obs_trace
 from hivedscheduler_tpu.ops.attention import NEG_INF, block_coords, gather_block_kv
 from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
@@ -1089,6 +1090,9 @@ class ServingEngine:
         req.done = True
         req.done_at = self._clock()
         req.finish_reason = "preempted"
+        if obs_journal.JOURNAL.enabled:
+            obs_journal.emit("serve_preempt", f"serve/{req.rid}",
+                             slot=victim, priority=req.priority)
         self._observe_request(req)
         metrics.inc("tpu_hive_serve_pool_preempted_total")
         self.pool_preempted += 1
@@ -1248,6 +1252,10 @@ class ServingEngine:
                 at = i
                 break
         self.queue.insert(at, req)
+        if obs_journal.JOURNAL.enabled:
+            obs_journal.emit("serve_submit", f"serve/{req.rid}",
+                             priority=priority,
+                             promptTokens=len(req.prompt))
         return req
 
     def _bucket(self, n: int) -> int:
@@ -1355,6 +1363,11 @@ class ServingEngine:
                 req.finish_reason = "shed"
                 metrics.inc("tpu_hive_serve_shed_total",
                             priority=str(req.priority))
+                if obs_journal.JOURNAL.enabled:
+                    # shed closes the request's episode (it never ran)
+                    obs_journal.note_phase(
+                        f"serve/{req.rid}", "closed", "serve_shed",
+                        priority=req.priority)
             else:
                 kept.append(req)
         self.queue = kept
@@ -1396,6 +1409,9 @@ class ServingEngine:
                 return
             self.queue.pop(at)
             req.admitted_at = self._clock()
+            if obs_journal.JOURNAL.enabled:
+                obs_journal.emit("serve_admit", f"serve/{req.rid}",
+                                 slot=slot, priority=req.priority)
             if hit is not None:
                 payload, plen = hit[1]
                 self.prefix_hits += 1
@@ -1553,6 +1569,11 @@ class ServingEngine:
         safe when engines run on worker threads."""
         prio = str(req.priority)
         metrics.inc("tpu_hive_serve_requests_total", priority=prio)
+        if obs_journal.JOURNAL.enabled:
+            obs_journal.note_phase(
+                f"serve/{req.rid}", "closed", "serve_finish",
+                finishReason=req.finish_reason,
+                tokensOut=len(req.tokens_out))
         if req.queue_wait_s is not None:
             metrics.observe("tpu_hive_serve_queue_wait_seconds",
                             req.queue_wait_s, priority=prio)

@@ -1,0 +1,593 @@
+"""Gang-lifecycle flight recorder: a causal event journal + wait attribution.
+
+The decision traces (``obs.decisions``) explain one ``schedule()`` call;
+the subsystems landed since — defrag migrations, elastic shrink/grow,
+backfill promotions, serving admission — form multi-step causal chains no
+single trace captures. This module records every gang's lifecycle as a
+bounded, crash-safe, causally-linked event journal::
+
+    submit -> queued(wait_reason) -> defrag_planned -> migration_evict ->
+    bind -> elastic_grow_planned -> ... -> released
+
+Each :class:`Event` carries the gang id, a **cause** (the parent event id —
+auto-chained to the gang's previous event unless an explicit cross-gang
+cause is given, e.g. a mover's eviction caused by the waiter's plan), and,
+for waits, a **wait-attribution bucket** from :data:`WAIT_BUCKETS`. Wait
+intervals are closed on bucket transitions and on bind/grow/release, each
+closure observed into the ``tpu_hive_gang_wait_seconds{reason=}``
+histogram — so "why is this gang waiting, since when, and what is in
+flight to unblock it" is a queryable fact, not a bench.py post-hoc guess
+(BENCH_r05's 89.2% "packing" wait turned out to be ~100% VC-quota
+stranding only after manual measurement).
+
+Served three ways:
+
+- ``GET /v1/inspect/gangs`` (per-gang summaries) and
+  ``GET /v1/inspect/gangs/<id>/timeline`` (the causal event list) —
+  copy-on-read snapshots, like the other inspect endpoints;
+- per-gang Perfetto tracks merged into the Chrome-trace export
+  (:func:`Journal.chrome_events`, folded in by ``obs.trace``);
+- an optional ``--journal-file`` JSONL spool (one event per line,
+  flushed per append) for post-mortem replay after a crash.
+
+Contracts (mirroring ``obs.trace`` / ``obs.decisions``, the PR 1 rules):
+
+- **Zero overhead when disabled** (the default): every instrumentation
+  site gates on one attribute load (``JOURNAL.enabled``); ``emit`` and the
+  ``note_*`` helpers return immediately without taking the lock.
+- **Bounded**: the event ring is a ``deque(maxlen=...)``; per-gang records
+  and their closed wait intervals are capped, oldest-closed evicted first.
+- **Thread-safe leaf**: scheduler/algorithm sites append under the
+  scheduler lock; serving appends from worker threads; the webserver
+  reads concurrently. ``journal_lock`` is a leaf in the lock hierarchy —
+  nothing but the metrics leaf is ever acquired under it.
+- **Schema-checked**: every event type must be a :data:`SCHEMA` row and
+  every wait bucket a :data:`WAIT_BUCKETS` row (hivedlint OBS001 checks
+  the call sites statically; the runtime raises on dynamic misuse).
+
+Enable programmatically (``journal.enable()``), via the CLIs'
+``--journal-file``, or ``HIVED_JOURNAL=1`` in the environment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from hivedscheduler_tpu.common import lockcheck
+
+_DEFAULT_CAPACITY = 16384
+_MAX_GANGS = 4096
+_MAX_INTERVALS_PER_GANG = 64
+
+# ---------------------------------------------------------------------------
+# wait-attribution taxonomy. Buckets are monotonic accounting categories:
+# at any instant a waiting gang is in exactly ONE bucket, transitions close
+# the previous interval, and the per-bucket chip-time sums to the gang's
+# total wait — the invariant bench.py's trace replay asserts.
+# ---------------------------------------------------------------------------
+WAIT_BUCKETS: Dict[str, str] = {
+    "vc_quota": "the gang's VC has no free guaranteed cells left (quota "
+                "stranding; backfill/promotion is the unblocking arm)",
+    "fragmentation": "enough capacity exists but no contiguous placement "
+                     "(defrag migration is the unblocking arm)",
+    "capacity": "fewer free chips than the gang needs anywhere: pure "
+                "queueing, no scheduler can help",
+    "bad_hardware": "placement forced onto bad/doomed nodes; waiting on "
+                    "node recovery",
+    "reservation_hold": "blocked by cells held for a defrag waiter or a "
+                        "mid-migration re-placement",
+    "priority": "waiting on preemption of lower-priority victims to "
+                "complete",
+    "elastic_degraded": "running on a degraded elastic slice, waiting for "
+                        "grow-promotion back to full shape",
+    "unknown": "wait reason not classified (classifier fallback — a "
+               "growing share here is a bug)",
+}
+
+# ---------------------------------------------------------------------------
+# event schema registry — the single source of truth for journal event
+# types. hivedlint OBS001 cross-checks every `journal.emit(...)` /
+# `journal.note_*(...)` literal in the package against this table and
+# flags registered types nothing emits.
+# ---------------------------------------------------------------------------
+SCHEMA: Dict[str, str] = {
+    # scheduler core lifecycle (algorithm/hived.py)
+    "queued": "gang is waiting; bucket = wait attribution (re-emitted only "
+              "on bucket transition)",
+    "bind": "gang's placement committed (first member bind of an "
+            "incarnation opens its running episode)",
+    "preempt_planned": "preemption decided for this gang; victims listed "
+                       "(opens/continues a `priority` wait)",
+    "released": "gang's allocation fully released (complete, evicted, or "
+                "preempted — the cause chain says which)",
+    # defrag executor (runtime/scheduler.py, under the scheduler lock)
+    "defrag_planned": "migration plan accepted for this waiting gang; "
+                      "moves + reserved slice in args",
+    "migration_evict": "a mover gang's pods are being evicted (cause = the "
+                       "waiter's defrag_planned / grow plan event)",
+    "migration_rebound": "a mover re-placed on its reserved target "
+                         "(work-preserving: resumed from checkpoint)",
+    "migration_done": "every move rebound; the waiter's slice is free",
+    "migration_failed": "a move could not re-place; holds released, the "
+                        "evicted job resubmits from its checkpoint",
+    "migration_aborted": "the job died mid-migration or an operator "
+                         "cancelled; holds released",
+    "reservation_expired": "a TTL sweep released a hold whose partner "
+                           "never came back",
+    "backfill_admitted": "a gang rode reserved/idle cells (outcome: "
+                         "admitted = preemptible rider, fits-window = "
+                         "duration-bounded guaranteed rider)",
+    # elastic arm (runtime/scheduler.py)
+    "elastic_offer": "a blocked elastic waiter is offered its largest "
+                     "feasible shrink rung",
+    "elastic_grow_planned": "a degraded gang's full shape fits again; "
+                            "grow-migration planned",
+    "elastic_grow_done": "grow-promotion landed: the gang runs at full "
+                         "shape (closes its elastic_degraded wait)",
+    # serving admission/preemption (models/serving.py)
+    "serve_submit": "request entered the serving queue",
+    "serve_admit": "request admitted to a decode slot (queue wait closed)",
+    "serve_shed": "request shed on the queue-wait deadline before it ran",
+    "serve_preempt": "stream truncated to relieve KV block-pool exhaustion",
+    "serve_finish": "request finished (finish_reason in args)",
+    # workload supervisor (train.py / parallel/supervisor.py)
+    "train_resume": "a training incarnation resumed from a committed "
+                    "checkpoint (preemption/crash restart)",
+    "train_rollback": "divergence-guard rollback to the last good "
+                      "checkpoint",
+}
+
+# event types that close a gang's open wait interval when emitted through
+# note_phase (bind ends the queue wait; grow ends the degraded wait;
+# released ends whatever was open)
+_PHASE_CLOSED = "closed"
+
+
+@dataclass
+class Event:
+    """One journal event. ``t`` is the monotonic timestamp used for
+    durations (``perf_counter`` seconds, or the caller's virtual clock in
+    sim contexts); ``ts`` is the wall epoch (0.0 when virtual)."""
+
+    id: int
+    gang: str
+    type: str
+    cause: Optional[int] = None
+    bucket: str = ""
+    detail: str = ""
+    t: float = 0.0
+    ts: float = 0.0
+    args: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "gang": self.gang,
+            "type": self.type,
+            "cause": self.cause,
+            "bucket": self.bucket,
+            "detail": self.detail,
+            "t": round(self.t, 6),
+            "ts": self.ts,
+            "args": self.args,
+        }
+
+
+class Journal:
+    """Bounded ring of lifecycle events + per-gang wait accounting.
+
+    Instantiable for tests and for the bench's virtual-clock replay; the
+    module singleton :data:`JOURNAL` is what the stack shares. ``metrics``
+    gates the ``tpu_hive_gang_wait_seconds`` observation so a sim-time
+    instance never pollutes the process registry with virtual durations.
+    """
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY,
+                 max_gangs: int = _MAX_GANGS, metrics: bool = True,
+                 intervals_per_gang: int = _MAX_INTERVALS_PER_GANG):
+        self._lock = lockcheck.make_lock("journal_lock", late=True)
+        self._ring: deque = deque(maxlen=capacity)
+        # gang -> record; insertion-ordered so eviction drops the oldest
+        # closed gang first
+        self._gangs: Dict[str, Dict[str, Any]] = {}
+        self._max_gangs = max_gangs
+        self._intervals_per_gang = intervals_per_gang
+        self._seq = 0
+        self._next_tid = 1000  # stable Perfetto lane per gang
+        self.enabled = False
+        self.metrics = metrics
+        self.evicted = 0  # events displaced by the ring bound
+        self._spool = None
+        self._spool_path = ""
+
+    # -- internal (caller holds self._lock) -----------------------------
+    def _record(self, gang: str, at: float) -> Dict[str, Any]:
+        rec = self._gangs.get(gang)
+        if rec is None:
+            if len(self._gangs) >= self._max_gangs:
+                # evict the oldest CLOSED gang; live gangs are never dropped
+                for name, r in list(self._gangs.items()):
+                    if r["phase"] == _PHASE_CLOSED:
+                        del self._gangs[name]
+                        break
+            self._next_tid += 1
+            rec = {
+                "tid": self._next_tid,
+                "phase": "new",
+                "wait": None,  # (bucket, start_t) while a wait is open
+                "waits": {},  # bucket -> closed seconds
+                "intervals": [],  # (bucket, start, end), capped
+                "last": None,  # last event id (the auto-chain cause)
+                "last_type": "",
+                "first_t": at,
+                "last_t": at,
+                "events": 0,
+            }
+            self._gangs[gang] = rec
+        return rec
+
+    def _append(self, etype: str, gang: str, cause: Optional[int],
+                bucket: str, detail: str, at: Optional[float],
+                args: Dict[str, Any]) -> int:
+        if etype not in SCHEMA:
+            raise ValueError(
+                f"{etype!r} is not a registered journal event type — add it "
+                f"to obs/journal.py SCHEMA (OBS001)")
+        virtual = at is not None
+        t = time.perf_counter() if at is None else at
+        with self._lock:
+            rec = self._record(gang, t)
+            self._seq += 1
+            if cause is None:
+                cause = rec["last"]
+            ev = Event(id=self._seq, gang=gang, type=etype, cause=cause,
+                       bucket=bucket, detail=detail, t=t,
+                       ts=0.0 if virtual else time.time(), args=args)
+            if len(self._ring) == self._ring.maxlen:
+                self.evicted += 1
+            self._ring.append(ev)
+            rec["last"] = ev.id
+            rec["last_type"] = etype
+            rec["last_t"] = t
+            rec["events"] += 1
+            spool = self._spool
+            if spool is not None:
+                try:
+                    spool.write(json.dumps(ev.to_dict()) + "\n")
+                    spool.flush()  # crash-safe: every line survives kill -9
+                except OSError:
+                    self._spool = None  # a dead spool must not fail emit
+            return ev.id
+
+    def _close_wait(self, rec: Dict[str, Any], at: float) -> None:
+        open_wait = rec["wait"]
+        if open_wait is None:
+            return
+        bucket, start = open_wait
+        rec["wait"] = None
+        dur = max(0.0, at - start)
+        rec["waits"][bucket] = rec["waits"].get(bucket, 0.0) + dur
+        if len(rec["intervals"]) < self._intervals_per_gang:
+            rec["intervals"].append((bucket, start, at))
+        if self.metrics:
+            from hivedscheduler_tpu.runtime.metrics import REGISTRY
+            REGISTRY.observe("tpu_hive_gang_wait_seconds", dur,
+                             reason=bucket)
+
+    # -- emit API --------------------------------------------------------
+    def emit(self, etype: str, gang: str, cause: Optional[int] = None,
+             bucket: str = "", detail: str = "", at: Optional[float] = None,
+             **args: Any) -> Optional[int]:
+        """Append one event (no phase bookkeeping). Returns the event id,
+        or None when disabled — the single-check contract — or while this
+        thread is inside a suppressed (probe) transaction."""
+        if not self.enabled or suppressed():
+            return None
+        return self._append(etype, gang, cause, bucket, detail, at, args)
+
+    def note_wait(self, gang: str, bucket: str, detail: str = "",
+                  cause: Optional[int] = None, at: Optional[float] = None,
+                  etype: str = "queued", **args: Any) -> Optional[int]:
+        """Open (or re-attribute) a gang's wait. Same bucket: no event, the
+        interval continues. Bucket change: the previous interval closes
+        (accumulated + observed) and a new one opens at ``at``."""
+        if not self.enabled or suppressed():
+            return None
+        if bucket not in WAIT_BUCKETS:
+            raise ValueError(
+                f"{bucket!r} is not a registered wait-attribution bucket — "
+                f"add it to obs/journal.py WAIT_BUCKETS (OBS001)")
+        t = time.perf_counter() if at is None else at
+        with self._lock:
+            rec = self._record(gang, t)
+            open_wait = rec["wait"]
+            if open_wait is not None and open_wait[0] == bucket:
+                return rec["last"]
+            self._close_wait(rec, t)
+            rec["wait"] = (bucket, t)
+            if rec["phase"] != "running":
+                rec["phase"] = "waiting"
+        return self._append(etype, gang, cause, bucket, detail, at, args)
+
+    def note_phase(self, gang: str, phase: str, etype: str,
+                   cause: Optional[int] = None, at: Optional[float] = None,
+                   **args: Any) -> Optional[int]:
+        """Transition a gang's lifecycle phase (``running`` / ``closed``),
+        closing any open wait interval. Idempotent: a repeat transition to
+        the current phase emits nothing (so every member pod of a gang can
+        report the bind and only the first opens the episode)."""
+        if not self.enabled or suppressed():
+            return None
+        t = time.perf_counter() if at is None else at
+        with self._lock:
+            rec = self._gangs.get(gang)
+            if rec is None:
+                if phase == _PHASE_CLOSED:
+                    # release of a gang the journal never saw open (e.g.
+                    # enabled mid-flight): nothing to close, keep the
+                    # open->close invariant vacuously true
+                    return None
+                rec = self._record(gang, t)
+            if rec["phase"] == phase and rec["wait"] is None:
+                # idempotent repeat (e.g. every member pod reporting the
+                # gang bind) — but a same-phase transition that closes an
+                # open wait (elastic_grow_done while running-degraded)
+                # still emits
+                return rec["last"]
+            self._close_wait(rec, t)
+            rec["phase"] = phase
+        return self._append(etype, gang, cause, "", "", at, args)
+
+    def last_id(self, gang: str) -> Optional[int]:
+        """The gang's most recent event id (for explicit cross-gang
+        causes), or None."""
+        with self._lock:
+            rec = self._gangs.get(gang)
+            return None if rec is None else rec["last"]
+
+    def close_all(self, at: float) -> None:
+        """Close every open wait interval at ``at`` (sim end-of-replay)."""
+        with self._lock:
+            for rec in self._gangs.values():
+                self._close_wait(rec, at)
+
+    # -- read API (copy-on-read snapshots) -------------------------------
+    def snapshot(self) -> List[Event]:
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def gangs(self) -> List[Dict[str, Any]]:
+        """Per-gang summaries, most recently active first."""
+        with self._lock:
+            out = []
+            for gang, rec in self._gangs.items():
+                open_wait = rec["wait"]
+                out.append({
+                    "gang": gang,
+                    "phase": rec["phase"],
+                    "events": rec["events"],
+                    "lastType": rec["last_type"],
+                    "firstT": round(rec["first_t"], 6),
+                    "lastT": round(rec["last_t"], 6),
+                    "waits": {b: round(s, 6)
+                              for b, s in sorted(rec["waits"].items())},
+                    "openWait": None if open_wait is None else {
+                        "bucket": open_wait[0],
+                        "since": round(open_wait[1], 6),
+                    },
+                })
+        out.sort(key=lambda r: r["lastT"], reverse=True)
+        return out
+
+    def timeline(self, gang: str) -> Dict[str, Any]:
+        """The gang's retained events in causal (id) order, plus its wait
+        summary. Events older than the ring bound are gone — ``evicted``
+        says whether the ring ever wrapped."""
+        with self._lock:
+            events = [e.to_dict() for e in self._ring if e.gang == gang]
+            rec = self._gangs.get(gang)
+            summary = None
+            if rec is not None:
+                open_wait = rec["wait"]
+                summary = {
+                    "phase": rec["phase"],
+                    "waits": {b: round(s, 6)
+                              for b, s in sorted(rec["waits"].items())},
+                    "openWait": None if open_wait is None else {
+                        "bucket": open_wait[0],
+                        "since": round(open_wait[1], 6),
+                    },
+                }
+        return {"gang": gang, "events": events, "summary": summary,
+                "ringEvicted": self.evicted}
+
+    def wait_intervals(self) -> List[Tuple[str, str, float, float]]:
+        """Every CLOSED wait interval: (gang, bucket, start, end) — the
+        bench replay's attribution source."""
+        with self._lock:
+            return [
+                (gang, bucket, start, end)
+                for gang, rec in self._gangs.items()
+                for bucket, start, end in rec["intervals"]
+            ]
+
+    def wait_totals(self) -> Dict[str, float]:
+        """Closed wait seconds per bucket, summed over all gangs."""
+        totals: Dict[str, float] = {}
+        for _gang, bucket, start, end in self.wait_intervals():
+            totals[bucket] = totals.get(bucket, 0.0) + (end - start)
+        return totals
+
+    def chrome_events(self, t0: float) -> List[Dict[str, Any]]:
+        """Per-gang Perfetto tracks: one named thread lane per gang, an
+        instant per journal event and an X span per closed wait interval.
+        ``t0`` is the tracer's perf_counter anchor so the lanes align with
+        the span tracer's timeline."""
+        with self._lock:
+            lanes = {gang: rec["tid"] for gang, rec in self._gangs.items()}
+            intervals = [
+                (rec["tid"], bucket, start, end)
+                for rec in self._gangs.values()
+                for bucket, start, end in rec["intervals"]
+            ]
+            events = list(self._ring)
+        out: List[Dict[str, Any]] = []
+        for gang, tid in lanes.items():
+            out.append({"name": "thread_name", "ph": "M", "pid": 1,
+                        "tid": tid, "ts": 0,
+                        "args": {"name": f"gang {gang}"}})
+        for ev in events:
+            tid = lanes.get(ev.gang)
+            if tid is None:
+                continue  # gang record evicted; no lane to draw on
+            args = dict(ev.args)
+            args.update(id=ev.id, cause=ev.cause)
+            if ev.bucket:
+                args["bucket"] = ev.bucket
+            out.append({"name": ev.type, "ph": "i", "s": "t",
+                        "cat": "journal", "ts": (ev.t - t0) * 1e6,
+                        "pid": 1, "tid": tid, "args": args})
+        for tid, bucket, start, end in intervals:
+            out.append({"name": f"wait:{bucket}", "ph": "X",
+                        "cat": "journal", "ts": (start - t0) * 1e6,
+                        "dur": max(0.0, (end - start) * 1e6),
+                        "pid": 1, "tid": tid, "args": {"bucket": bucket}})
+        return out
+
+    # -- lifecycle -------------------------------------------------------
+    def open_spool(self, path: str) -> None:
+        self._spool = open(path, "a", encoding="utf-8")
+        self._spool_path = path
+
+    def close_spool(self) -> None:
+        if self._spool is not None:
+            try:
+                self._spool.close()
+            except OSError:
+                pass
+            self._spool = None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._gangs.clear()
+            self._seq = 0
+            self.evicted = 0
+
+
+JOURNAL = Journal()
+
+# -- thread-local suppression ------------------------------------------------
+# The defrag what-if probes (defrag/probe.py) run real schedule/delete
+# transactions on the live cell trees and roll them back bit-exactly; their
+# churn never really happened, so it must not enter the journal. Suppression
+# is PER-THREAD: the probe always runs under the scheduler lock on one
+# thread, while serving engines keep journaling from theirs.
+
+_tls = threading.local()
+
+
+class _Suppress:
+    __slots__ = ()
+
+    def __enter__(self) -> "_Suppress":
+        _tls.depth = getattr(_tls, "depth", 0) + 1
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        _tls.depth -= 1
+        return False
+
+
+_SUPPRESS = _Suppress()
+
+
+def suppress() -> _Suppress:
+    """``with journal.suppress(): ...`` — mute this thread's emissions
+    (what-if probe transactions)."""
+    return _SUPPRESS
+
+
+def suppressed() -> bool:
+    return getattr(_tls, "depth", 0) > 0
+
+
+def enabled() -> bool:
+    return JOURNAL.enabled
+
+
+def enable(capacity: Optional[int] = None,
+           spool_path: Optional[str] = None) -> None:
+    """Turn the journal on (optionally resizing — which resets — the ring,
+    and/or opening a JSONL spool)."""
+    global JOURNAL
+    if capacity is not None:
+        JOURNAL.close_spool()
+        JOURNAL = Journal(capacity)
+    if spool_path:
+        JOURNAL.open_spool(spool_path)
+    JOURNAL.enabled = True
+
+
+def disable() -> None:
+    JOURNAL.enabled = False
+    JOURNAL.close_spool()
+
+
+def emit(etype: str, gang: str, cause: Optional[int] = None,
+         bucket: str = "", detail: str = "", at: Optional[float] = None,
+         **args: Any) -> Optional[int]:
+    return JOURNAL.emit(etype, gang, cause=cause, bucket=bucket,
+                        detail=detail, at=at, **args)
+
+
+def note_wait(gang: str, bucket: str, detail: str = "",
+              cause: Optional[int] = None, at: Optional[float] = None,
+              etype: str = "queued", **args: Any) -> Optional[int]:
+    return JOURNAL.note_wait(gang, bucket, detail=detail, cause=cause,
+                             at=at, etype=etype, **args)
+
+
+def note_phase(gang: str, phase: str, etype: str,
+               cause: Optional[int] = None, at: Optional[float] = None,
+               **args: Any) -> Optional[int]:
+    return JOURNAL.note_phase(gang, phase, etype, cause=cause, at=at,
+                              **args)
+
+
+# ---------------------------------------------------------------------------
+# wait-reason classifier: the algorithm ladder's human reason strings ->
+# attribution buckets. Substring-keyed on the stable fragments of the
+# ladder's messages (the same fragments GRD001 pins for the error guards);
+# anything unmatched lands in `unknown` so drift is visible, never silent.
+# ---------------------------------------------------------------------------
+
+def classify_wait(reason: str) -> str:
+    r = (reason or "").lower()
+    if "reservation" in r:
+        return "reservation_hold"
+    if "bad node" in r or "doomed" in r or "bad or non-suggested" in r:
+        return "bad_hardware"
+    if "insufficient free cell in the vc" in r or "insufficient quota" in r:
+        return "vc_quota"
+    if "non-suggested" in r:
+        return "reservation_hold"
+    if "insufficient capacity" in r:
+        return "fragmentation"
+    if "preempt" in r:
+        return "priority"
+    return "unknown"
+
+
+if os.environ.get("HIVED_JOURNAL") == "1":  # ad-hoc opt-in, like HIVED_TRACE
+    enable()

@@ -253,11 +253,24 @@ def complete(name: str, start: float, end: float, cat: str = "",
 
 
 def to_chrome_trace() -> Dict[str, Any]:
-    return TRACER.to_chrome_trace()
+    """The shared timeline as Chrome-trace JSON. When the gang-lifecycle
+    journal is enabled, its per-gang tracks (one named lane per gang:
+    lifecycle instants + wait-interval spans) are merged in — every
+    exporter (webserver, --trace-file, --metrics-dump) gets them free."""
+    out = TRACER.to_chrome_trace()
+    from hivedscheduler_tpu.obs import journal as _journal
+
+    if _journal.JOURNAL.enabled:
+        out["traceEvents"] = (
+            list(out["traceEvents"])
+            + _journal.JOURNAL.chrome_events(TRACER._t0)
+        )
+    return out
 
 
 def write_chrome_trace(path: str) -> None:
-    TRACER.write_chrome_trace(path)
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(), f)
 
 
 if os.environ.get("HIVED_TRACE") == "1":  # ad-hoc opt-in without code changes

@@ -433,5 +433,9 @@ class Supervisor:
         exhausted (the caller must halt)."""
         self.rollbacks += 1
         metrics.inc("tpu_hive_train_rollbacks_total")
+        from hivedscheduler_tpu.obs import journal as obs_journal
+        if obs_journal.JOURNAL.enabled:
+            obs_journal.emit("train_rollback", "train",
+                             rollbacks=self.rollbacks)
         self.guard.reset()
         return self.rollbacks <= self.max_rollbacks

@@ -218,6 +218,24 @@ REGISTRY.describe("tpu_hive_elastic_grows_total",
 REGISTRY.describe("tpu_hive_elastic_degraded_gangs",
                   "Elastic gangs currently running on a degraded slice "
                   "(shrink-offered, not yet grown back)")
+# gang-lifecycle flight recorder (obs/journal.py + runtime/scheduler.py):
+# wait attribution and phase timers derived from the causal event journal
+REGISTRY.describe("tpu_hive_gang_wait_seconds",
+                  "Closed gang wait intervals by attribution bucket "
+                  "(reason label: vc_quota, fragmentation, capacity, "
+                  "bad_hardware, reservation_hold, priority, "
+                  "elastic_degraded, unknown — obs/journal.py "
+                  "WAIT_BUCKETS)")
+REGISTRY.describe("tpu_hive_migration_phase_seconds",
+                  "Work-preserving migration phase durations (phase: "
+                  "evict = plan to movers released, rebind = re-placement "
+                  "to done, total = plan to terminal)")
+REGISTRY.describe("tpu_hive_sched_loop_phase_seconds",
+                  "Scheduler-loop phase durations per cycle (phase: "
+                  "schedule = one filter routine, migrations = advancing "
+                  "in-flight migrations, plan = defrag planning + elastic "
+                  "shrink offers for waiters, elastic = grow-promotion "
+                  "scan)")
 REGISTRY.describe("tpu_hive_train_cross_topology_resumes_total",
                   "Training incarnations that restored a checkpoint saved "
                   "on a DIFFERENT (dp, fsdp, pp, ep, tp, sp) mesh "

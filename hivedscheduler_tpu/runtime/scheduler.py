@@ -15,6 +15,7 @@ import time
 from typing import Dict, List, Optional
 
 from hivedscheduler_tpu.api.constants import COMPONENT_NAME as _COMPONENT
+from hivedscheduler_tpu.obs import journal as obs_journal
 from hivedscheduler_tpu.obs import trace
 from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
 
@@ -384,8 +385,10 @@ class HivedScheduler:
                             routine="filter", outcome="error")
                 raise
             finally:
-                metrics.observe("tpu_hive_filter_latency_seconds",
-                                time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                metrics.observe("tpu_hive_filter_latency_seconds", dt)
+                metrics.observe("tpu_hive_sched_loop_phase_seconds", dt,
+                                phase="schedule")
 
     def _filter_routine(self, args: ei.ExtenderArgs):
         """Returns (result, metric outcome); each return site knows its own
@@ -439,6 +442,13 @@ class HivedScheduler:
                 wait_reason = ("Pod is waiting for preemptible or free "
                                "resource to appear: placement overlaps a "
                                "defrag reservation")
+                if obs_journal.JOURNAL.enabled:
+                    # the algorithm hook just recorded a bind; the runtime
+                    # vetoed it — re-attribute the gang to the hold
+                    obs_journal.note_wait(
+                        internal_utils.extract_pod_scheduling_spec(
+                            pod).affinity_group.name,
+                        "reservation_hold", detail=wait_reason)
                 log.info("[%s]: %s", internal_utils.key(pod), wait_reason)
                 return (
                     ei.ExtenderFilterResult(
@@ -775,6 +785,9 @@ class HivedScheduler:
             # the holder reclaims by preemption, so the ride is free
             metrics.inc("tpu_hive_backfill_admissions_total",
                         outcome="admitted")
+            if obs_journal.JOURNAL.enabled:
+                obs_journal.emit("backfill_admitted", group,
+                                 outcome="admitted")
             return False
         if (defrag_pkg.backfill_enabled() and s.duration_seconds > 0
                 and self._duration_fits_all_holds(
@@ -783,6 +796,9 @@ class HivedScheduler:
             # intersects expires: the duration-aware backfill window
             metrics.inc("tpu_hive_backfill_admissions_total",
                         outcome="fits-window")
+            if obs_journal.JOURNAL.enabled:
+                obs_journal.emit("backfill_admitted", group,
+                                 outcome="fits-window")
             return False
         metrics.inc("tpu_hive_backfill_admissions_total", outcome="blocked")
         return True
@@ -812,6 +828,10 @@ class HivedScheduler:
             log.warning("defrag: reservation for %s (%s) expired after "
                         "%.0fs — sweeping", res.holder, res.kind,
                         now - res.created_at)
+            if obs_journal.JOURNAL.enabled:
+                obs_journal.emit("reservation_expired", res.holder,
+                                 kind=res.kind, heldSecs=round(
+                                     now - res.created_at, 3))
             if res.migration_id is not None:
                 mig = self._migrations.get(res.migration_id)
                 if mig is not None and mig.active:
@@ -848,6 +868,19 @@ class HivedScheduler:
                    defrag_exec.MIGRATION_FAILED: "failed",
                    defrag_exec.MIGRATION_ABORTED: "aborted"}[state]
         metrics.inc("tpu_hive_defrag_migrations_total", outcome=outcome)
+        if mig.created_at:
+            metrics.observe("tpu_hive_migration_phase_seconds",
+                            time.monotonic() - mig.created_at,
+                            phase="total")
+        if obs_journal.JOURNAL.enabled:
+            if state == defrag_exec.MIGRATION_FAILED:
+                obs_journal.emit("migration_failed", mig.waiter,
+                                 cause=mig.journal_event or None,
+                                 migration=mig.id, why=why)
+            elif state == defrag_exec.MIGRATION_ABORTED:
+                obs_journal.emit("migration_aborted", mig.waiter,
+                                 cause=mig.journal_event or None,
+                                 migration=mig.id, why=why)
         log.info("defrag: migration %s for waiter %s -> %s (%s)",
                  mig.id, mig.waiter, state, why)
         self._prune_migrations()
@@ -961,8 +994,23 @@ class HivedScheduler:
                 )
                 for m in plan.moves
             ],
+            created_at=now, phase_t=now,
         )
         self._migrations[mid] = mig
+        if obs_journal.JOURNAL.enabled:
+            # the plan event chains off the waiter's open queued event; the
+            # movers' evictions chain off the plan — the causal spine the
+            # /v1/inspect/gangs timelines reconstruct a migration from
+            pid = obs_journal.emit(
+                "defrag_planned", waiter.name, migration=mid,
+                moves=[m.group.name for m in plan.moves],
+                waiterNodes=sorted(plan.waiter_nodes),
+                movedChips=plan.moved_chips)
+            mig.journal_event = pid or 0
+            for m in plan.moves:
+                obs_journal.emit(
+                    "migration_evict", m.group.name, cause=pid,
+                    migration=mid, targetNodes=sorted(m.target_nodes))
         self._reservations[waiter.name] = defrag_exec.Reservation(
             holder=waiter.name, nodes=set(plan.waiter_nodes), kind="waiter",
             created_at=now, deadline=deadline, migration_id=mid)
@@ -1024,6 +1072,10 @@ class HivedScheduler:
                     self._evict_moves(mig)
                     if self._movers_released(mig):
                         mig.state = defrag_exec.MIGRATION_REBINDING
+                        mono = time.monotonic()
+                        metrics.observe("tpu_hive_migration_phase_seconds",
+                                        mono - mig.phase_t, phase="evict")
+                        mig.phase_t = mono
                 if mig.state == defrag_exec.MIGRATION_REBINDING:
                     self._rebind_moves(mig)
                 report[mig.id] = mig.to_dict()
@@ -1127,6 +1179,11 @@ class HivedScheduler:
                 return
             move.rebound_pods = placed
             move.state = defrag_exec.MIGRATION_DONE
+            if obs_journal.JOURNAL.enabled:
+                obs_journal.emit(
+                    "migration_rebound", move.group,
+                    cause=mig.journal_event or None, migration=mig.id,
+                    nodes=sorted({p.node_name for p in placed}))
             if (not move.spec.degraded
                     and self._elastic_degraded.pop(move.group, None)
                     is not None):
@@ -1136,6 +1193,11 @@ class HivedScheduler:
                 self._update_elastic_gauge()
                 metrics.inc("tpu_hive_elastic_grows_total",
                             outcome="completed")
+                if obs_journal.JOURNAL.enabled:
+                    obs_journal.emit(
+                        "elastic_grow_done", move.group,
+                        cause=mig.journal_event or None,
+                        chips=move.spec.chips)
                 log.info("elastic: %s grew back to full shape (%d chips)",
                          move.group, move.spec.chips)
             res = self._reservations.get(move.group)
@@ -1148,6 +1210,15 @@ class HivedScheduler:
             mig.state = defrag_exec.MIGRATION_DONE
             metrics.inc("tpu_hive_defrag_migrations_total",
                         outcome="completed")
+            mono = time.monotonic()
+            metrics.observe("tpu_hive_migration_phase_seconds",
+                            mono - mig.phase_t, phase="rebind")
+            metrics.observe("tpu_hive_migration_phase_seconds",
+                            mono - mig.created_at, phase="total")
+            if obs_journal.JOURNAL.enabled:
+                obs_journal.emit("migration_done", mig.waiter,
+                                 cause=mig.journal_event or None,
+                                 migration=mig.id)
             # the waiter reservation stays until the waiter binds (or TTL)
             log.info("defrag: migration %s complete — %s's slice is free",
                      mig.id, mig.waiter)
@@ -1177,7 +1248,11 @@ class HivedScheduler:
         if not defrag_pkg.defrag_enabled():
             return {"enabled": False}
         with self.scheduler_lock:
+            t0 = time.perf_counter()
             progressed = self.resume_migrations()
+            t1 = time.perf_counter()
+            metrics.observe("tpu_hive_sched_loop_phase_seconds", t1 - t0,
+                            phase="migrations")
             planned = None
             offered = None
             for group, rec in sorted(self._defrag_waiters.items(),
@@ -1195,7 +1270,12 @@ class HivedScheduler:
                 offered = self._offer_elastic_shrink(group, rec["pod"])
                 if offered is not None:
                     break
+            t2 = time.perf_counter()
+            metrics.observe("tpu_hive_sched_loop_phase_seconds", t2 - t1,
+                            phase="plan")
             grown = self._promote_elastic_grows()
+            metrics.observe("tpu_hive_sched_loop_phase_seconds",
+                            time.perf_counter() - t2, phase="elastic")
             return {"enabled": True, "planned": planned,
                     "migrations": progressed, "elasticOffer": offered,
                     "elasticGrows": grown}
@@ -1262,6 +1342,11 @@ class HivedScheduler:
                                 "transiently: %s", internal_utils.key(p), e)
         self._defrag_waiters.pop(group, None)
         self._elastic_seq += 1
+        offer_event = None
+        if obs_journal.JOURNAL.enabled:
+            offer_event = obs_journal.emit(
+                "elastic_offer", group, offeredChips=rung.chips,
+                fullChips=spec.chips)
         placed = self._bind_gang_atomically(
             group,
             gang_pods(rung, uid_prefix=f"el{self._elastic_seq}-"),
@@ -1279,6 +1364,12 @@ class HivedScheduler:
             "since": time.monotonic(),
         }
         self._update_elastic_gauge()
+        if obs_journal.JOURNAL.enabled:
+            # the gang now runs degraded: its time on the small slice is a
+            # wait on grow-promotion, attributed as elastic_degraded
+            obs_journal.note_wait(
+                group, "elastic_degraded", cause=offer_event,
+                detail=f"running {rung.chips}/{spec.chips} chips")
         metrics.inc("tpu_hive_elastic_offers_total", outcome="offered")
         log.info("elastic: offered %s a degraded %d-chip slice (full "
                  "shape %d chips blocked)", group, rung.chips, spec.chips)
@@ -1331,8 +1422,17 @@ class HivedScheduler:
                     evicted_pods=list(g.bound_pods),
                     target_nodes=sorted(target),
                 )],
+                created_at=now, phase_t=now,
             )
             self._migrations[mid] = mig
+            if obs_journal.JOURNAL.enabled:
+                pid = obs_journal.emit(
+                    "elastic_grow_planned", g.name, migration=mid,
+                    fromChips=g.spec.chips, toChips=full.chips)
+                mig.journal_event = pid or 0
+                obs_journal.emit("migration_evict", g.name, cause=pid,
+                                 migration=mid,
+                                 targetNodes=sorted(target))
             self._reservations[g.name] = defrag_exec.Reservation(
                 holder=g.name, nodes=target, kind="migration",
                 created_at=now, deadline=now + self.defrag_reserve_ttl_s,

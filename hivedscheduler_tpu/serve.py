@@ -152,6 +152,11 @@ def main(argv=None) -> int:
                         "text (per-priority TTFT/TPOT/queue-wait histograms) "
                         "to this path and a Chrome-trace/Perfetto JSON of "
                         "request lifecycles to <path>.trace.json")
+    parser.add_argument("--journal-file", default="",
+                        help="enable the gang-lifecycle journal "
+                        "(obs/journal.py) and append its request "
+                        "admission/shed/preemption events to this JSONL "
+                        "spool (one line per event, flushed per append)")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
     if args.prefix_cache > 0:
@@ -171,6 +176,10 @@ def main(argv=None) -> int:
         from hivedscheduler_tpu.obs import trace as obs_trace
 
         obs_trace.enable()
+    if args.journal_file:
+        from hivedscheduler_tpu.obs import journal as obs_journal
+
+        obs_journal.enable(spool_path=args.journal_file)
     import jax
     import jax.numpy as jnp
 

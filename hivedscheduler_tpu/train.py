@@ -121,6 +121,10 @@ def main(argv=None) -> int:
                         help="capture a jax.profiler trace (TensorBoard/"
                         "Perfetto format) of steps 2..4 into this directory "
                         "— step 1 is compile and would drown the trace")
+    parser.add_argument("--journal-file", default="",
+                        help="enable the gang-lifecycle journal "
+                        "(obs/journal.py) and append this incarnation's "
+                        "resume/rollback events to this JSONL spool")
     parser.add_argument("--timeline", default="",
                         help="write a per-step JSONL timeline (step, wall_s, "
                         "tokens_per_sec, loss, compile flag) to this path — "
@@ -192,6 +196,10 @@ def main(argv=None) -> int:
     from hivedscheduler_tpu.common import utils as common
 
     common.init_all(logging.DEBUG if args.verbose else logging.INFO)
+    if args.journal_file:
+        from hivedscheduler_tpu.obs import journal as obs_journal
+
+        obs_journal.enable(spool_path=args.journal_file)
 
     # 1. multi-host wiring from the scheduler's gang handoff (no-op when
     #    single-host / not scheduled)
@@ -334,6 +342,11 @@ def main(argv=None) -> int:
             if lora_mode:
                 base_params, lora_params = tm.split_lora_params(params)
             metrics.inc("tpu_hive_train_resumes_total")
+            from hivedscheduler_tpu.obs import journal as obs_journal
+            if obs_journal.JOURNAL.enabled:
+                obs_journal.emit("train_resume", "train",
+                                 step=start_step,
+                                 crossTopology=source_mesh is not None)
             if source_mesh is not None:
                 # cross-topology resume: same arrays, different layout —
                 # bit-exactness is not promised across reduction orders;

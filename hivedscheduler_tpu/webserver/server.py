@@ -187,12 +187,32 @@ def _make_handler(scheduler: HivedScheduler, webserver: Optional[WebServer] = No
                         C.PHYSICAL_CLUSTER_PATH, C.VIRTUAL_CLUSTERS_PATH,
                         C.TRACES_PATH, C.TRACES_CHROME_PATH,
                         C.ADMISSION_HINTS_PATH, C.DEFRAG_PATH,
+                        C.GANGS_PATH,
                     ]})
                 elif path == C.ADMISSION_HINTS_PATH:
                     # serving headroom + defrag holds, for gang admission
                     self._reply(200, scheduler.get_admission_hints())
                 elif path == C.DEFRAG_PATH:
                     self._reply(200, scheduler.get_defrag_status())
+                elif path == C.GANGS_PATH:
+                    # gang-lifecycle flight recorder: per-gang summaries
+                    # (copy-on-read snapshot; empty when the journal is off)
+                    from hivedscheduler_tpu.obs import journal as obs_journal
+
+                    self._reply(200, {
+                        "enabled": obs_journal.JOURNAL.enabled,
+                        "items": obs_journal.JOURNAL.gangs(),
+                    })
+                elif (full.startswith(C.GANGS_PATH + "/")
+                        and path.endswith("/timeline")):
+                    # /v1/inspect/gangs/<id>/timeline — <id> may contain
+                    # slashes (namespace-qualified group names)
+                    from hivedscheduler_tpu.obs import journal as obs_journal
+
+                    gang = path[len(C.GANGS_PATH) + 1:-len("/timeline")]
+                    if not gang:
+                        raise WebServerError(400, "gang id is empty")
+                    self._reply(200, obs_journal.JOURNAL.timeline(gang))
                 elif path == C.TRACES_CHROME_PATH:
                     from hivedscheduler_tpu.obs import trace
 

@@ -448,6 +448,67 @@ def test_met001_fixture_flagged(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# OBS001: journal event-type / wait-bucket schema registry (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+_OBS_SCHEMA = {"bind": "doc", "queued": "doc", "never_emitted": "doc"}
+_OBS_BUCKETS = {"vc_quota": "doc"}
+
+
+def test_obs001_unregistered_event_type_flagged(tmp_path):
+    _write(tmp_path, "pkg/mod.py", """
+        journal.emit("rogue_event", "g")
+        obs_journal.note_phase("g", "running", "bind")
+        journal.note_wait("g", "vc_quota")
+        """)
+    got = blindspots.check_journal_schema(
+        REPO, package_root=str(tmp_path / "pkg"),
+        schema=dict(_OBS_SCHEMA), buckets=dict(_OBS_BUCKETS))
+    assert [f.rule for f in got] == ["OBS001", "OBS001"]
+    msgs = sorted(f.message for f in got)
+    assert any("'rogue_event'" in m and "not registered" in m for m in msgs)
+    # vice-versa: the registered-but-never-emitted row is flagged too
+    assert any("'never_emitted'" in m and "never emitted" in m
+               for m in msgs)
+
+
+def test_obs001_unregistered_bucket_and_dynamic_type_flagged(tmp_path):
+    _write(tmp_path, "pkg/mod.py", """
+        journal.note_wait("g", "rogue_bucket", etype="queued")
+        name = "bind"
+        journal.emit(name, "g")
+        obs_journal.emit("bind", "g")
+        """)
+    got = blindspots.check_journal_schema(
+        REPO, package_root=str(tmp_path / "pkg"),
+        schema={"bind": "d", "queued": "d"},
+        buckets=dict(_OBS_BUCKETS))
+    msgs = sorted(f.message for f in got)
+    assert len(got) == 2 and all(f.rule == "OBS001" for f in got)
+    assert any("'rogue_bucket'" in m for m in msgs)
+    assert any("non-literal" in m for m in msgs)
+
+
+def test_obs001_clean_fixture_passes(tmp_path):
+    _write(tmp_path, "pkg/mod.py", """
+        journal.emit("bind", "g")
+        journal.note_wait("g", "vc_quota")
+        """)
+    got = blindspots.check_journal_schema(
+        REPO, package_root=str(tmp_path / "pkg"),
+        schema={"bind": "d", "queued": "d"},
+        buckets=dict(_OBS_BUCKETS))
+    assert got == []
+
+
+def test_obs001_real_tree_schema_is_exact():
+    """Clean on the real package (also covered by the tier-1 full-suite
+    run, but pinned here so a schema drift names the rule directly)."""
+    got = blindspots.check_journal_schema(REPO)
+    assert got == []
+
+
+# ---------------------------------------------------------------------------
 # HIVED_LOCKCHECK runtime sanitizer
 # ---------------------------------------------------------------------------
 

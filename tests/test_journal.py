@@ -1,0 +1,421 @@
+"""Gang-lifecycle flight recorder (ISSUE 11): journal core semantics
+(causal chaining, wait-attribution intervals, bounded ring, crash-safe
+spool), the schedule-ladder / defrag / elastic emitters, the
+/v1/inspect/gangs endpoints causally reconstructing a complete defrag
+migration and an elastic shrink->grow episode, the Perfetto merge, the
+chaos invariant, and the overhead gate (disabled path = one bool check;
+enabled cost bounded).
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from helpers import validate_chrome_trace  # noqa: E402
+
+from tests.test_defrag import make_pod, mini_config  # noqa: E402,F401
+from tests.test_defrag_runtime import (  # noqa: E402
+    build_scheduler,
+    drive,
+    fragmented_scheduler,
+)
+from tests.test_elastic_runtime import (  # noqa: E402
+    blocked_elastic_scheduler,
+)
+
+from hivedscheduler_tpu.api import constants as C  # noqa: E402
+from hivedscheduler_tpu.chaos import invariants  # noqa: E402
+from hivedscheduler_tpu.obs import journal  # noqa: E402
+from hivedscheduler_tpu.obs import trace as obs_trace  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _journal_isolation():
+    """Every test starts with the journal off and empty; the global
+    singleton never leaks across tests."""
+    journal.disable()
+    journal.JOURNAL.clear()
+    obs_trace.disable()
+    obs_trace.TRACER.clear()
+    yield
+    journal.disable()
+    journal.JOURNAL.clear()
+    obs_trace.disable()
+    obs_trace.TRACER.clear()
+
+
+# ----------------------------------------------------------------- core
+
+
+class TestJournalCore:
+    def test_disabled_is_noop(self):
+        assert journal.emit("bind", "g") is None
+        assert journal.note_wait("g", "vc_quota") is None
+        assert journal.note_phase("g", "running", "bind") is None
+        assert len(journal.JOURNAL) == 0 and journal.JOURNAL.gangs() == []
+
+    def test_unregistered_event_type_rejected(self):
+        journal.enable()
+        with pytest.raises(ValueError,
+                           match="not a registered journal event type"):
+            journal.emit("made_up_event", "g")
+
+    def test_unregistered_bucket_rejected(self):
+        journal.enable()
+        with pytest.raises(
+                ValueError,
+                match="not a registered wait-attribution bucket"):
+            journal.note_wait("g", "made_up_bucket")
+
+    def test_causal_auto_chain_and_explicit_cross_gang_cause(self):
+        journal.enable()
+        a = journal.note_wait("w", "fragmentation")
+        b = journal.emit("defrag_planned", "w")  # auto-chains to a
+        c = journal.emit("migration_evict", "mover", cause=b)  # cross-gang
+        events = {e.id: e for e in journal.JOURNAL.snapshot()}
+        assert events[b].cause == a
+        assert events[c].cause == b and events[c].gang == "mover"
+
+    def test_wait_transition_closes_interval_and_observes(self):
+        from hivedscheduler_tpu.runtime.metrics import REGISTRY
+
+        journal.enable()
+        journal.note_wait("g", "vc_quota", at=10.0)
+        # same bucket: no new event, the interval continues
+        assert journal.note_wait("g", "vc_quota", at=11.0) is not None
+        assert len(journal.JOURNAL) == 1
+        journal.note_wait("g", "fragmentation", at=13.0)
+        journal.note_phase("g", "running", "bind", at=17.0)
+        totals = journal.JOURNAL.wait_totals()
+        assert totals == {"vc_quota": 3.0, "fragmentation": 4.0}
+        ivs = sorted(journal.JOURNAL.wait_intervals())
+        assert ivs == [("g", "fragmentation", 13.0, 17.0),
+                       ("g", "vc_quota", 10.0, 13.0)]
+        text = REGISTRY.render()
+        assert 'tpu_hive_gang_wait_seconds_bucket{reason="vc_quota"' in text
+
+    def test_note_phase_idempotent_per_incarnation(self):
+        journal.enable()
+        journal.note_phase("g", "running", "bind")
+        journal.note_phase("g", "running", "bind")  # second member pod
+        assert [e.type for e in journal.JOURNAL.snapshot()] == ["bind"]
+        journal.note_phase("g", "closed", "released")
+        # release of a gang the journal never opened: no orphan close
+        journal.note_phase("ghost", "closed", "released")
+        assert [e.type for e in journal.JOURNAL.snapshot()] == [
+            "bind", "released"]
+
+    def test_ring_bounded(self):
+        j = journal.Journal(capacity=8, metrics=False)
+        j.enabled = True
+        for i in range(20):
+            j.emit("bind", f"g{i}")
+        assert len(j) == 8 and j.evicted == 12
+
+    def test_spool_is_replayable_jsonl(self, tmp_path):
+        spool = str(tmp_path / "journal.jsonl")
+        journal.enable(spool_path=spool)
+        journal.note_wait("g", "vc_quota")
+        journal.note_phase("g", "running", "bind")
+        journal.disable()
+        lines = [json.loads(ln) for ln in open(spool)]
+        assert [ln["type"] for ln in lines] == ["queued", "bind"]
+        assert lines[0]["bucket"] == "vc_quota"
+        assert lines[1]["cause"] == lines[0]["id"]
+
+    def test_schema_and_buckets_documented(self):
+        assert all(doc for doc in journal.SCHEMA.values())
+        for bucket in ("vc_quota", "fragmentation", "bad_hardware",
+                       "reservation_hold", "priority", "elastic_degraded"):
+            assert bucket in journal.WAIT_BUCKETS
+
+    def test_classifier_maps_ladder_reasons(self):
+        cw = journal.classify_wait
+        assert cw("insufficient capacity when scheduling in VC x") == \
+            "fragmentation"
+        assert cw("insufficient free cell in the VC at the preassigned "
+                  "level (2) when scheduling in VC x") == "vc_quota"
+        assert cw("have to use at least one bad node n1") == "bad_hardware"
+        assert cw("placement overlaps cells held by a defrag "
+                  "reservation") == "reservation_hold"
+        assert cw("") == "unknown" and cw("whatever else") == "unknown"
+
+
+# -------------------------------------------------- chrome-trace merge
+
+
+class TestPerfettoMerge:
+    def test_journal_lanes_merge_into_chrome_export(self):
+        obs_trace.enable()
+        journal.enable()
+        journal.note_wait("w", "vc_quota")
+        journal.note_phase("w", "running", "bind")
+        trace_obj = obs_trace.to_chrome_trace()
+        events = validate_chrome_trace(trace_obj)
+        names = [e["name"] for e in events]
+        assert "queued" in names and "bind" in names
+        assert "wait:vc_quota" in names  # the closed interval as an X span
+        lanes = [e for e in events if e["ph"] == "M"
+                 and e["args"].get("name") == "gang w"]
+        assert lanes, "each gang must get a named Perfetto lane"
+
+    def test_disabled_journal_leaves_export_unchanged(self):
+        obs_trace.enable()
+        before = obs_trace.to_chrome_trace()["traceEvents"]
+        journal.JOURNAL.clear()
+        after = obs_trace.to_chrome_trace()["traceEvents"]
+        assert [e["name"] for e in before] == [e["name"] for e in after]
+
+
+# ------------------------------------------- schedule-ladder emitters
+
+
+class TestScheduleLadderJournal:
+    def test_bind_wait_release_lifecycle(self):
+        journal.enable()
+        sched, kube, nodes = build_scheduler()
+        assert drive(sched, kube, nodes, make_pod("g1-0", "g1", 4)) is not None
+        tl = journal.JOURNAL.timeline("g1")
+        assert [e["type"] for e in tl["events"]] == ["bind"]
+        # an 8-chip gang cannot fit beside g1: queued with a classified
+        # bucket
+        assert drive(sched, kube, nodes,
+                     make_pod("g2-0", "g2", 4, pods=2)) is None
+        tl2 = journal.JOURNAL.timeline("g2")
+        assert [e["type"] for e in tl2["events"]] == ["queued"]
+        assert tl2["events"][0]["bucket"] in journal.WAIT_BUCKETS
+        assert tl2["summary"]["openWait"] is not None
+        # completion releases
+        kube.delete_pod("default", "g1-0")
+        tl = journal.JOURNAL.timeline("g1")
+        assert [e["type"] for e in tl["events"]] == ["bind", "released"]
+        assert tl["summary"]["phase"] == "closed"
+
+    def test_gangs_summary_served(self):
+        journal.enable()
+        sched, kube, nodes = build_scheduler()
+        drive(sched, kube, nodes, make_pod("g1-0", "g1", 4))
+        items = journal.JOURNAL.gangs()
+        assert [g["gang"] for g in items] == ["g1"]
+        assert items[0]["phase"] == "running"
+
+
+# --------------------------------- causal reconstruction over HTTP
+
+
+def _serve(sched):
+    from hivedscheduler_tpu.webserver import WebServer
+
+    server = WebServer(sched, address="127.0.0.1:0")
+    host, port = server.async_run()
+    return server, f"http://{host}:{port}"
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestTimelineReconstruction:
+    def test_defrag_migration_is_causally_complete(self):
+        """/v1/inspect/gangs/<id>/timeline reconstructs the whole
+        migration: queued -> defrag_planned(cause=queued) -> the mover's
+        evict/rebind chained to the plan -> migration_done -> bind."""
+        journal.enable()
+        sched, kube, nodes = fragmented_scheduler()
+        w = make_pod("w-0", "w", 4)
+        assert drive(sched, kube, nodes, w) is None
+        plan = sched.defrag_tick()["planned"]
+        assert plan is not None
+        sched.resume_migrations()
+        assert drive(sched, kube, nodes, w) is not None
+        server, base = _serve(sched)
+        try:
+            status, gangs = _get(base, C.GANGS_PATH)
+            assert status == 200 and gangs["enabled"]
+            assert {g["gang"] for g in gangs["items"]} >= {"w"}
+            status, tl = _get(base, C.GANGS_PATH + "/w/timeline")
+        finally:
+            server.stop()
+        ev = {e["type"]: e for e in tl["events"]}
+        assert ["queued", "defrag_planned", "migration_done", "bind"] == [
+            e["type"] for e in tl["events"]]
+        assert ev["defrag_planned"]["cause"] == ev["queued"]["id"]
+        assert ev["migration_done"]["cause"] == ev["defrag_planned"]["id"]
+        assert ev["bind"]["cause"] == ev["migration_done"]["id"]
+        # the mover's eviction/rebind chain off the waiter's plan event
+        mover = plan["moves"][0]["group"]
+        mtl = journal.JOURNAL.timeline(mover)
+        mtypes = [e["type"] for e in mtl["events"]]
+        assert mtypes == ["bind", "migration_evict", "released", "bind",
+                          "migration_rebound"]
+        mev = {e["type"]: e for e in mtl["events"]}
+        assert mev["migration_evict"]["cause"] == ev["defrag_planned"]["id"]
+        assert mev["migration_rebound"]["cause"] == \
+            ev["defrag_planned"]["id"]
+        # the waiter's queue wait is closed and attributed
+        assert tl["summary"]["openWait"] is None
+        assert set(tl["summary"]["waits"]) <= set(journal.WAIT_BUCKETS)
+        invariants.check_journal(ctx="post-migration")
+
+    def test_elastic_shrink_grow_episode_is_causally_complete(self):
+        """The shrink offer, degraded bind, elastic_degraded wait, grow
+        plan and grow completion form one causal chain on gang e."""
+        journal.enable()
+        sched, kube, nodes = blocked_elastic_scheduler()
+        assert sched.defrag_tick()["elasticOffer"] is not None
+        kube.delete_pod("default", "g1-0")  # capacity frees
+        grows = sched.defrag_tick()["elasticGrows"]
+        assert grows and grows[0]["group"] == "e"
+        rep = sched.resume_migrations()
+        assert rep[grows[0]["migrationId"]]["state"] == "Done"
+        tl = journal.JOURNAL.timeline("e")
+        types = [e["type"] for e in tl["events"]]
+        assert types == ["queued", "elastic_offer", "bind", "queued",
+                         "elastic_grow_planned", "migration_evict",
+                         "released", "bind", "migration_rebound",
+                         "elastic_grow_done", "migration_done"]
+        ev = {}
+        for e in tl["events"]:
+            ev.setdefault(e["type"], e)
+        # the degraded wait is attributed to elastic_degraded and caused
+        # by the shrink offer
+        degraded_queued = tl["events"][3]
+        assert degraded_queued["bucket"] == "elastic_degraded"
+        assert degraded_queued["cause"] == ev["elastic_offer"]["id"]
+        assert ev["migration_evict"]["cause"] == \
+            ev["elastic_grow_planned"]["id"]
+        assert ev["elastic_grow_done"]["cause"] == \
+            ev["elastic_grow_planned"]["id"]
+        # wait accounting: both the full-shape block and the degraded
+        # window are closed intervals now
+        waits = tl["summary"]["waits"]
+        assert "elastic_degraded" in waits
+        invariants.check_journal(ctx="post-grow")
+
+
+# ------------------------------------------------------ chaos invariant
+
+
+class TestCheckJournal:
+    def test_noop_when_disabled(self):
+        invariants.check_journal()  # must not raise
+
+    def test_terminal_without_open_flagged(self):
+        j = journal.Journal(metrics=False)
+        j.enabled = True
+        j.emit("released", "g")
+        with pytest.raises(invariants.InvariantViolation,
+                           match="no opening event"):
+            invariants.check_journal(journal=j)
+
+    def test_duplicate_terminal_flagged(self):
+        j = journal.Journal(metrics=False)
+        j.enabled = True
+        j.emit("bind", "g")
+        j.emit("released", "g")
+        j.emit("released", "g")
+        with pytest.raises(invariants.InvariantViolation,
+                           match="duplicate terminal"):
+            invariants.check_journal(journal=j)
+
+    def test_non_backward_cause_flagged(self):
+        j = journal.Journal(metrics=False)
+        j.enabled = True
+        j.emit("bind", "g", cause=99)
+        with pytest.raises(invariants.InvariantViolation,
+                           match="non-backward cause"):
+            invariants.check_journal(journal=j)
+
+    def test_orphan_cause_flagged(self):
+        # a gap inside the retained id range (corrupted/hand-edited spool
+        # replay): cause 2 is >= min retained id but missing
+        j = journal.Journal(metrics=False)
+        j.enabled = True
+        j.emit("bind", "g")
+        with j._lock:
+            j._ring.append(journal.Event(id=3, gang="g", type="released",
+                                         cause=2))
+        with pytest.raises(invariants.InvariantViolation,
+                           match="orphan cause"):
+            invariants.check_journal(journal=j)
+
+    def test_clean_episode_passes_and_reopen_is_legal(self):
+        j = journal.Journal(metrics=False)
+        j.enabled = True
+        j.emit("queued", "g", bucket="fragmentation")
+        j.emit("bind", "g")
+        j.emit("released", "g")
+        j.emit("bind", "g")  # migration re-incarnation
+        j.emit("released", "g")
+        invariants.check_journal(journal=j)
+
+
+# -------------------------------------------------------- overhead gate
+
+
+class TestOverheadGate:
+    def test_disabled_path_takes_no_lock_and_allocates_nothing(self):
+        """The PR 1 contract: disabled emit is ONE attribute check — it
+        must return before ever touching the lock or the ring."""
+        j = journal.JOURNAL
+        saved = j._lock
+        j._lock = None  # any lock acquisition would raise AttributeError
+        try:
+            for _ in range(1000):
+                assert journal.emit("bind", "g") is None
+                assert journal.note_wait("g", "vc_quota") is None
+                assert journal.note_phase("g", "running", "bind") is None
+        finally:
+            j._lock = saved
+        assert len(j) == 0
+
+    def test_schedule_hot_path_emits_nothing_while_disabled(self):
+        sched, kube, nodes = build_scheduler()
+        drive(sched, kube, nodes, make_pod("g1-0", "g1", 4))
+        assert len(journal.JOURNAL) == 0
+
+    def test_enabled_bounded_ring_cost(self):
+        """The enabled path is a dict update + deque append: pin a very
+        generous absolute budget so a regression to O(gangs) or an
+        unbounded structure fails loudly without being box-noise flaky."""
+        j = journal.Journal(capacity=4096, metrics=False)
+        j.enabled = True
+        n = 20000
+        t0 = time.perf_counter()
+        for i in range(n):
+            j.note_wait(f"g{i % 64}", "vc_quota" if i % 2 else
+                        "fragmentation", at=float(i))
+        dt = time.perf_counter() - t0
+        assert len(j) <= 4096  # the ring stayed bounded
+        assert dt < 5.0, f"{n} enabled emits took {dt:.2f}s"
+
+
+# ------------------------------------------------------ CLI parse smoke
+
+
+class TestCliFlags:
+    def test_scheduler_cli_parses_journal_file(self):
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "hivedscheduler_tpu.cli", "--help"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0 and "--journal-file" in proc.stdout
+
+    def test_serve_and_train_parse_journal_file(self, capsys):
+        from hivedscheduler_tpu import serve, train
+
+        for mod in (serve, train):
+            with pytest.raises(SystemExit) as exc:
+                mod.main(["--help"])
+            assert exc.value.code == 0
+            assert "--journal-file" in capsys.readouterr().out

@@ -476,5 +476,5 @@ def test_rule_registry_matches_implementations():
         "LCK001", "LCK002", "CON001", "CON002", "CON003", "CON004",
         "DFG001",
         "SHD001", "SHD002", "SHD003", "SHD004", "ENV001", "ENV002",
-        "CLI001", "CLI002", "GRD001", "SER001", "MET001",
+        "CLI001", "CLI002", "GRD001", "SER001", "MET001", "OBS001",
     }

@@ -107,6 +107,10 @@ RULES: Dict[str, tuple] = {
                "blindspots"),
     "MET001": ("every emitted metric is described, no dead describes, no "
                "dynamic metric names", "blindspots"),
+    "OBS001": ("every journal event type emitted in the package is a "
+               "registered obs/journal.py SCHEMA row and vice versa; "
+               "literal wait buckets must be WAIT_BUCKETS rows; no "
+               "dynamic event types", "blindspots"),
 }
 
 

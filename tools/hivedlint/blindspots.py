@@ -424,6 +424,125 @@ def check_metrics_catalogue(root: str,
 
 
 # ---------------------------------------------------------------------------
+# OBS001: journal event-type / wait-bucket schema registry (the
+# check_metrics pattern applied to the gang-lifecycle flight recorder)
+#
+# Every `journal.emit("<type>", ...)` / `journal.note_phase(_, _, "<type>")`
+# / `journal.note_wait(_, "<bucket>", ..., etype="<type>")` literal in the
+# package must be a registered obs/journal.py SCHEMA (resp. WAIT_BUCKETS)
+# row, every SCHEMA row must be emitted somewhere, and emit sites must use
+# literals (a dynamic type name would dodge both directions). note_wait
+# itself counts as an emitter of its default `queued` type; non-literal
+# *buckets* are legal (the classify_wait() path) — the runtime validates
+# those.
+# ---------------------------------------------------------------------------
+
+_JOURNAL_RECEIVERS = {"journal", "obs_journal"}
+_JOURNAL_METHODS = {"emit", "note_wait", "note_phase"}
+
+
+def check_journal_schema(
+    root: str,
+    package_root: Optional[str] = None,
+    schema: Optional[Dict[str, str]] = None,
+    buckets: Optional[Dict[str, str]] = None,
+) -> List[Finding]:
+    if schema is None or buckets is None:
+        import sys
+
+        sys.path.insert(0, root)
+        try:
+            from hivedscheduler_tpu.obs.journal import SCHEMA, WAIT_BUCKETS
+        finally:
+            sys.path.pop(0)
+        schema = SCHEMA if schema is None else schema
+        buckets = WAIT_BUCKETS if buckets is None else buckets
+    pkg = package_root or os.path.join(root, "hivedscheduler_tpu")
+    base = package_root and os.path.dirname(package_root) or root
+
+    def _lit(expr) -> Optional[str]:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        return None
+
+    def _kw(node: ast.Call, name: str):
+        return next((kw.value for kw in node.keywords if kw.arg == name),
+                    None)
+
+    emitted: Set[str] = set()
+    out: List[Finding] = []
+    for path in _iter_py(pkg):
+        rel = os.path.relpath(path, base).replace(os.sep, "/")
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            recv = node.func.value
+            recv_ok = (
+                (isinstance(recv, ast.Name)
+                 and recv.id in _JOURNAL_RECEIVERS)
+                or (isinstance(recv, ast.Attribute)
+                    and recv.attr == "JOURNAL")
+            )
+            if not recv_ok or attr not in _JOURNAL_METHODS:
+                continue
+            etype_expr = None
+            if attr == "emit":
+                etype_expr = node.args[0] if node.args else _kw(node, "etype")
+            elif attr == "note_phase":
+                etype_expr = (node.args[2] if len(node.args) > 2
+                              else _kw(node, "etype"))
+            else:  # note_wait
+                emitted.add("queued")  # the default etype
+                etype_expr = _kw(node, "etype")
+                bucket_expr = (node.args[1] if len(node.args) > 1
+                               else _kw(node, "bucket"))
+                b = _lit(bucket_expr) if bucket_expr is not None else None
+                if bucket_expr is not None and b is not None \
+                        and b not in buckets:
+                    out.append(Finding(
+                        "OBS001", rel, node.lineno,
+                        f"wait bucket {b!r} is not registered in "
+                        f"obs/journal.py WAIT_BUCKETS",
+                    ))
+                if etype_expr is None:
+                    continue
+            if etype_expr is None:
+                out.append(Finding(
+                    "OBS001", rel, node.lineno,
+                    f"journal {attr}() call without an event type — pass a "
+                    f"string literal so the schema registry stays "
+                    f"machine-checkable",
+                ))
+                continue
+            name = _lit(etype_expr)
+            if name is None:
+                out.append(Finding(
+                    "OBS001", rel, node.lineno,
+                    "journal emit with a non-literal event type — use a "
+                    "string literal",
+                ))
+            elif name not in schema:
+                out.append(Finding(
+                    "OBS001", rel, node.lineno,
+                    f"journal event type {name!r} emitted but not "
+                    f"registered in obs/journal.py SCHEMA",
+                ))
+            else:
+                emitted.add(name)
+    for name in sorted(set(schema) - emitted):
+        out.append(Finding(
+            "OBS001", "hivedscheduler_tpu/obs/journal.py", 1,
+            f"journal event type {name!r} registered in SCHEMA but never "
+            f"emitted in the package — drop the row or wire the emitter",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # entry
 # ---------------------------------------------------------------------------
 
@@ -447,4 +566,5 @@ def check(root: str) -> List[Finding]:
         os.path.join(root, "tests"))
     out += check_serializer_drift(root)
     out += check_metrics_catalogue(root)
+    out += check_journal_schema(root)
     return out
