@@ -471,6 +471,261 @@ def bench_serving_paged_ab(cfg, params, n_requests: int, max_len: int,
     return out
 
 
+def bench_serving_fleet(cfg, params, peak_replicas: int, duration_s: float,
+                        budget: int, max_len: int, page_size: int,
+                        max_batch: int = 2, ttft_ceiling_mult: float = 10.0,
+                        peak_util: float = 0.5, curve_power: int = 6,
+                        rate_scale: float = 1.0):
+    """Fleet stage (ROADMAP item 2's headline): goodput at a p99 TTFT
+    ceiling under a DIURNAL open-loop load curve, autoscaled vs a static
+    fleet at EQUAL PEAK chip budget.
+
+    Arrivals are scheduled in WALL TIME over one diurnal cycle —
+    ``rate(t) = peak_rate * sin(pi*t/T)**curve_power`` (trough -> peak ->
+    trough; the power sharpens the peak so the trough really dominates
+    the cycle, as diurnal traffic does) — and land open-loop: they do
+    not wait for service. ``peak_rate`` is CALIBRATED as ``peak_util`` x
+    the measured single-replica service rate x ``peak_replicas``, so the
+    static fleet is provisioned for the peak by construction. The static
+    arm keeps all ``peak_replicas`` live the whole run; the autoscaled
+    arm starts at 1 replica and lets ``FleetAutoscaler`` track the curve
+    (scale-down drain-based, as always).
+
+    HONEST REPORTING (the PR 6 precedent): at equal peak budget the
+    static arm's ABSOLUTE goodput is an upper bound by construction —
+    fewer replicas never serve faster. The autoscaler's win is the chips
+    it hands back in the trough, so the headline bar is goodput per
+    REPLICA-SECOND (replica-seconds accrue only while a replica is LIVE
+    in the router); absolute goodput, p99 TTFT and replica-seconds are
+    all reported for both arms. Both arms reuse the SAME pre-warmed
+    engines (warm-standby model: the A/B isolates routing/scaling
+    policy, not JIT compiles) with the prefix cache OFF, and the
+    autoscaled arm runs a fresh prompt set of the same length
+    distribution, so neither arm inherits the other's cache state.
+    """
+    import math as _math
+
+    import jax
+
+    from hivedscheduler_tpu.fleet import (
+        AutoscalePolicy,
+        FleetAutoscaler,
+        FleetRouter,
+    )
+    from hivedscheduler_tpu.models import serving
+
+    def build_engine():
+        # a small prefix cache rides along so the exactness check below
+        # can REUSE these engines for the disaggregated KV handoff (the
+        # A/B itself is cache-neutral: each arm runs a fresh random
+        # prompt set, so accidental prefix hits are equally rare in both)
+        return serving.ServingEngine(
+            params, cfg, max_batch=max_batch, max_len=max_len,
+            page_size=page_size, prefix_cache_size=8)
+
+    rng = jax.random.PRNGKey(21)
+
+    def make_prompts(n, key):
+        out = []
+        for i in range(n):
+            k1, k2 = jax.random.split(jax.random.fold_in(key, i))
+            plen = int(jax.random.randint(k1, (), 4, 9))
+            out.append([int(t) for t in jax.random.randint(
+                k2, (plen,), 0, cfg.vocab_size)])
+        return out
+
+    warm_lens = (4, 8)
+
+    def warm(eng):
+        ws = [eng.submit([1] * n, 2) for n in warm_lens]
+        eng.run_until_drained()
+        assert all(w.done for w in ws)
+        return eng
+
+    engines = [warm(build_engine()) for _ in range(peak_replicas)]
+
+    # calibration on one warmed replica: unloaded TTFT (-> the goodput
+    # ceiling) and the saturated service rate (-> the peak arrival rate)
+    rng, kc = jax.random.split(rng)
+    cal_prompts = make_prompts(2 * max_batch, kc)
+    cal = engines[0].submit(list(cal_prompts[0]), 2)
+    engines[0].run_until_drained()
+    ceiling = ttft_ceiling_mult * max(cal.ttft_s, 1e-6)
+    t0 = time.perf_counter()
+    cal_reqs = [engines[0].submit(list(p), budget) for p in cal_prompts]
+    engines[0].run_until_drained()
+    rps1 = len(cal_reqs) / (time.perf_counter() - t0)
+    # ``rate_scale``: how much the peak arrival rate scales past ONE
+    # replica's measured service rate. On real parallel hardware pass
+    # ``peak_replicas`` (capacity scales with replicas); on the CPU A/B
+    # every replica shares one core, so capacity does NOT scale — keep it
+    # near 1 or the calibration saturates BOTH arms and the goodput
+    # comparison degenerates (honest-calibration note in the artifact)
+    peak_rate = peak_util * rps1 * rate_scale
+
+    # arrival schedule: one diurnal cycle, integrated on a fine grid
+    times = []
+    acc, t, grid = 0.0, 0.0, 1e-3
+    while t < duration_s:
+        acc += peak_rate * _math.sin(_math.pi * t / duration_s) \
+            ** curve_power * grid
+        while acc >= 1.0:
+            times.append(t)
+            acc -= 1.0
+        t += grid
+
+    class _StandbyBackend:
+        """Warm-standby pool: grow pops a pre-warmed engine, shrink
+        re-arms the drained engine (ServingEngine.end_drain) and returns
+        it — a scale-down/regrow cycle never pays a JIT rebuild, which
+        is how a real fleet keeps standbys. Only past the pool does a
+        grow build fresh, inside the measured wall."""
+
+        def __init__(self, pool):
+            self.pool = pool
+            self.seq = 0
+
+        def grow(self, role):
+            self.seq += 1
+            eng = self.pool.pop(0) if self.pool else warm(build_engine())
+            return f"auto{self.seq}", eng, ""
+
+        def shrink(self, role, replica):
+            replica.engine.end_drain()
+            self.pool.append(replica.engine)
+
+    def run(autoscale: bool, prompts):
+        router = FleetRouter()
+        auto = None
+        pool = list(engines)
+        if autoscale:
+            router.add_replica("r0", pool.pop(0))
+            auto = FleetAutoscaler(
+                router, _StandbyBackend(pool),
+                AutoscalePolicy(
+                    min_replicas=1, max_replicas=peak_replicas,
+                    occ_high=0.6, occ_low=0.1, queue_high=0.75,
+                    ttft_ceiling_s=0.5 * ceiling,
+                    up_stable_ticks=1, down_stable_ticks=5,
+                    cooldown_s=duration_s / 30.0))
+        else:
+            for i, eng in enumerate(pool):
+                router.add_replica(f"r{i}", eng)
+        reqs = []
+        nxt = 0
+        last_tick = -1.0
+        tick_dt = duration_s / 100.0
+        start = time.perf_counter()
+        if auto is not None:
+            auto.tick()  # anchor the replica-seconds integral
+        while True:
+            now = time.perf_counter() - start
+            while nxt < len(times) and times[nxt] <= now:
+                reqs.append(router.submit(list(prompts[nxt]), budget))
+                nxt += 1
+            if auto is not None and now - last_tick >= tick_dt:
+                auto.tick()
+                last_tick = now
+            work = router.step()
+            if nxt >= len(times) and not work:
+                break
+            if not work:
+                time.sleep(min(0.005, max(0.0, times[nxt] - now)
+                               if nxt < len(times) else 0.005))
+        if auto is not None:
+            auto.tick()
+        dt = time.perf_counter() - start
+        replica_secs = (auto.replica_seconds if auto is not None
+                        else peak_replicas * dt)
+        ups = downs = 0
+        if auto is not None:
+            ups = sum(1 for a in auto.actions if a["phase"] == "added")
+            downs = sum(1 for a in auto.actions
+                        if a["phase"] == "removed")
+        return reqs, dt, replica_secs, ups, downs
+
+    out = {"peak_replicas": peak_replicas,
+           "duration_s": round(duration_s, 2),
+           "n_requests": len(times), "budget": budget,
+           "calibrated_peak_rps": round(peak_rate, 3),
+           "single_replica_rps": round(rps1, 3),
+           "ttft_ceiling_s": round(ceiling, 4)}
+    rng, ka, kb = jax.random.split(rng, 3)
+    for label, autoscale, key in (("static", False, ka),
+                                  ("autoscaled", True, kb)):
+        reqs, dt, rs, ups, downs = run(autoscale, make_prompts(
+            len(times), key))
+        ttfts = sorted(r.ttft_s for r in reqs if r.ttft_s is not None)
+        p99 = ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))] \
+            if ttfts else None
+        good = sum(1 for r in reqs
+                   if r.ttft_s is not None and r.ttft_s <= ceiling)
+        out[f"{label}_goodput_rps"] = round(good / dt, 3)
+        out[f"{label}_good_requests"] = good
+        out[f"{label}_p99_ttft_s"] = round(p99, 4) if p99 else None
+        out[f"{label}_replica_secs"] = round(rs, 3)
+        out[f"{label}_goodput_per_replica_sec"] = round(
+            good / max(rs, 1e-9), 4)
+        if autoscale:
+            out["autoscaled_scale_ups"] = ups
+            out["autoscaled_scale_downs"] = downs
+    out["goodput_ratio"] = round(
+        out["autoscaled_goodput_rps"]
+        / max(1e-9, out["static_goodput_rps"]), 3)
+    out["replica_secs_ratio"] = round(
+        out["autoscaled_replica_secs"]
+        / max(1e-9, out["static_replica_secs"]), 3)
+    out["efficiency_ratio"] = round(
+        out["autoscaled_goodput_per_replica_sec"]
+        / max(1e-9, out["static_goodput_per_replica_sec"]), 3)
+    return out, engines
+
+
+def bench_fleet_disagg_exact(cfg, params, max_len: int, page_size: int,
+                             engines=None):
+    """Disaggregated serving must be token-exact vs single-replica for
+    BOTH KV-handoff modes — asserted in the bench artifact itself, not
+    just the tests (the acceptance criterion names it). ``engines``:
+    reuse the fleet stage's warmed engines (greedy exactness is a pure
+    function of (params, prompt) — carried cache state cannot change the
+    streams, and skipping the rebuilds keeps the smoke bench inside the
+    tier-1 wall-time budget; the fresh-pool import path is pinned by
+    tests/test_fleet_router.py)."""
+    from hivedscheduler_tpu.fleet import FleetRouter
+    from hivedscheduler_tpu.models import serving
+
+    if engines is None or len(engines) < 2:
+        engines = [
+            serving.ServingEngine(params, cfg, max_batch=2,
+                                  max_len=max_len, page_size=page_size,
+                                  prefix_cache_size=8)
+            for _ in range(2)
+        ]
+    p0, d0 = engines[0], engines[1]
+    for eng in (p0, d0):
+        if eng.draining:  # a replica mid-teardown at the A/B's end
+            eng.end_drain()
+    # one prompt past a block boundary (its leading block ships) and one
+    # inside the first block (the miss/re-prefill path)
+    prompts = [list(range(1, page_size + 5)),
+               list(range(5, page_size + 2))]
+    refs = []
+    for p in prompts:
+        req = d0.submit(list(p), 4)
+        d0.run_until_drained()
+        refs.append(list(req.tokens_out))
+    out = {}
+    for mode, ship in (("ship", True), ("reprefill", False)):
+        router = FleetRouter(disaggregate=True, kv_ship=ship)
+        router.add_replica("p0", p0, role="prefill")
+        router.add_replica("d0", d0, role="decode")
+        reqs = [router.submit(list(p), 4) for p in prompts]
+        router.run_until_drained()
+        out[f"{mode}_token_exact"] = all(
+            f.tokens_out == ref for f, ref in zip(reqs, refs))
+    return out
+
+
 BREAKDOWN_KEYS = ("embed_ms", "attn_ms", "mlp_ms", "collective_ms",
                   "sampling_ms")
 
@@ -595,6 +850,12 @@ def main(argv=None) -> int:
     parser.add_argument("--skip-decode", action="store_true")
     parser.add_argument("--skip-serve", action="store_true",
                         help="skip the continuous-batching throughput bench")
+    parser.add_argument("--fleet-duration", type=float, default=0.0,
+                        help="diurnal-cycle wall seconds for the fleet "
+                             "autoscale A/B (0 = the default: 30 on TPU, "
+                             "6 on CPU; the tier-1 smoke test passes a "
+                             "smaller value to stay inside the wall-time "
+                             "budget — the driver's run keeps the default)")
     parser.add_argument(
         "--acquire-timeout", type=float,
         default=float(os.environ.get("HIVED_TPU_ACQUIRE_TIMEOUT_S", "240")),
@@ -723,6 +984,7 @@ def main(argv=None) -> int:
                 stage_errors["serve_error"] = note
                 stage_errors["serve_prefix_error"] = note
                 stage_errors["serve_kv_int8_error"] = note
+                stage_errors["serve_fleet_error"] = note
     if params is not None and not args.skip_decode:
         try:
             dec_s = bench_decode(cfg, params, dec_batch, dec_prompt, dec_new,
@@ -739,6 +1001,7 @@ def main(argv=None) -> int:
             stage_errors["decode_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     serve_prefix_speedup = serve_prefix_ttft_speedup = None
     serve_paged_ab = None
+    serve_fleet = None
     if params is not None and not args.skip_serve:
         try:
             # dense-vs-paged A/B at equal KV HBM under a mixed-length trace
@@ -780,6 +1043,33 @@ def main(argv=None) -> int:
         except Exception as e:
             serve_kv_int8_speedup = None
             stage_errors["serve_kv_int8_error"] = (
+                f"{type(e).__name__}: {str(e)[:200]}"
+            )
+        try:
+            # fleet stage: autoscaled vs static at equal PEAK chip budget
+            # under a diurnal open-loop curve (doc/design/fleet.md) + the
+            # disaggregated token-exactness assertion, both handoff modes
+            serve_fleet, fleet_engines = bench_serving_fleet(
+                cfg, params,
+                peak_replicas=4 if real else 2,
+                duration_s=args.fleet_duration or (30.0 if real else 6.0),
+                budget=12 if real else 4,
+                max_len=256 if real else 64,
+                page_size=16 if real else 8,
+                max_batch=4 if real else 2,
+                # real TPUs serve in parallel (capacity scales with
+                # replicas); the CPU A/B shares one core across replicas
+                rate_scale=4.0 if real else 1.6,
+            )
+            serve_fleet.update(bench_fleet_disagg_exact(
+                cfg, params,
+                max_len=256 if real else 64,
+                page_size=16 if real else 8,
+                engines=fleet_engines,
+            ))
+        except Exception as e:
+            serve_fleet = None
+            stage_errors["serve_fleet_error"] = (
                 f"{type(e).__name__}: {str(e)[:200]}"
             )
         try:
@@ -879,6 +1169,24 @@ def main(argv=None) -> int:
             serve_paged_ab["streams_ratio"] >= 1.5
             if serve_paged_ab is not None else None),
         "serve_paged_goodput_ratio": (serve_paged_ab or {}).get("goodput_ratio"),
+        # fleet stage (doc/design/fleet.md): autoscaled vs static at equal
+        # PEAK chip budget under a diurnal open-loop curve. The bar is on
+        # goodput per REPLICA-SECOND (the autoscaler's win is the chips it
+        # hands back in the trough; static-at-peak bounds absolute goodput
+        # by construction — both numbers reported, honestly labelled), and
+        # disaggregated serving must be token-exact in BOTH KV-handoff
+        # modes (structural, so the bar holds on every backend)
+        "serve_fleet": serve_fleet,
+        "fleet_efficiency_ratio": (serve_fleet or {}).get("efficiency_ratio"),
+        "fleet_efficiency_bar": 1.3,
+        "fleet_efficiency_pass": (
+            serve_fleet["efficiency_ratio"] >= 1.3
+            if serve_fleet is not None else None),
+        "fleet_goodput_ratio": (serve_fleet or {}).get("goodput_ratio"),
+        "fleet_disagg_token_exact": (
+            bool(serve_fleet.get("ship_token_exact")
+                 and serve_fleet.get("reprefill_token_exact"))
+            if serve_fleet is not None else None),
         # null (not vacuously true) when no training ran
         "loss_finite": math.isfinite(loss) if not args.skip_train else None,
         "model": {

@@ -71,6 +71,9 @@ DEFRAG_PATH = INSPECT_PATH + "/defrag"
 # gang-lifecycle flight recorder (obs/journal.py): per-gang summaries and
 # the causal event timeline (GET /v1/inspect/gangs/<id>/timeline)
 GANGS_PATH = INSPECT_PATH + "/gangs"
+# serving fleet tier (fleet/router.py): the published router's
+# copy-on-read snapshot (replicas, handoffs, retries, autoscale state)
+FLEET_PATH = INSPECT_PATH + "/fleet"
 
 # --- Config (reference: constants.go:65) ------------------------------------
 ENV_CONFIG_FILE = "CONFIG"

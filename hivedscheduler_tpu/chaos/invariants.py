@@ -449,11 +449,14 @@ def check_all(
     full_groups: Optional[Iterable[str]] = None,
     allow_partial_placement: bool = False,
     scheduler=None,
+    router=None,
 ) -> None:
     """Run every algorithm-state invariant (one locked snapshot per check).
     Pass the owning ``HivedScheduler`` as ``scheduler`` to additionally
-    check the defrag reservation/migration state machine. The journal
-    check piggybacks on every call (no-op while the journal is off)."""
+    check the defrag reservation/migration state machine, and a
+    ``fleet.FleetRouter`` as ``router`` for the serving-fleet invariants.
+    The journal check piggybacks on every call (no-op while the journal
+    is off)."""
     check_vc_safety(algo, ctx)
     check_books(algo, ctx)
     check_cell_ownership(algo, ctx)
@@ -462,7 +465,84 @@ def check_all(
                          allow_partial_placement=allow_partial_placement)
     if scheduler is not None:
         check_defrag(scheduler, ctx)
+    if router is not None:
+        check_fleet(router, ctx)
     check_journal(ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# serving fleet tier (fleet/router.py)
+# ---------------------------------------------------------------------------
+
+def check_fleet(router, ctx: str = "") -> None:
+    """Structural invariants of the serving-fleet router
+    (doc/design/fleet.md), re-derived from its bookkeeping:
+
+    - **No request lost between shed and retry**: every non-done
+      FleetRequest has exactly one live leg — an in-flight handoff on a
+      live replica, or a last decode attempt whose replica is live (a
+      dead replica's streams must be retried or finished, never
+      forgotten).
+    - **No double-routed stream**: at most one undone engine Request
+      across a fleet request's attempts (the last one); earlier attempts
+      were all finished (shed/preempted/truncated) before the retry.
+    - **Drain-before-teardown**: every removed replica left in state
+      ``drained`` or ``dead`` — an active/draining replica was never
+      torn down (work-preserving scale-down), and a drained replica's
+      engine really holds no work.
+    - **Handoff never leaves orphaned blocks**: every live replica's
+      paged block pool passes :func:`check_block_pool` (imported handoff
+      blocks are refcounted prefix-cache entries, so a leak shows as a
+      refcount/recount mismatch).
+    - **Prefix-index hygiene**: every index entry names a live replica.
+
+    Call at quiescent points (between ``step()`` calls — the same
+    contract as the scheduler checks)."""
+    for freq in router.requests:
+        live_handoff = 0
+        if freq.handoff is not None:
+            rep = router.replicas.get(freq.handoff["replica"])
+            if rep is not None and rep.state != "dead":
+                live_handoff = 1
+        undone = [(name, r) for name, r in freq.attempts if not r.done]
+        live_attempts = [
+            (name, r) for name, r in undone
+            if name in router.replicas
+            and router.replicas[name].state != "dead"
+        ]
+        if len(undone) > 1 or (undone and undone[-1][1]
+                               is not freq.attempts[-1][1]):
+            _fail(ctx, f"fleet request {freq.fid} is double-routed: "
+                       f"undone attempts on {[n for n, _ in undone]} "
+                       f"(only the LAST attempt may be live)")
+        if freq.done:
+            continue
+        legs = live_handoff + len(live_attempts)
+        if freq.handoff is None and not freq.attempts:
+            _fail(ctx, f"fleet request {freq.fid} has neither a handoff "
+                       f"nor any attempt — never dispatched")
+        if legs == 0:
+            _fail(ctx, f"fleet request {freq.fid} lost: not done, no live "
+                       f"handoff, no live attempt (last attempt on "
+                       f"{freq.attempts[-1][0] if freq.attempts else None})")
+        if legs > 1:
+            _fail(ctx, f"fleet request {freq.fid} double-routed: "
+                       f"{legs} live legs at once")
+    for rep in router.removed:
+        if rep.state not in ("drained", "dead"):
+            _fail(ctx, f"replica {rep.name} was removed in state "
+                       f"{rep.state!r} — scale-down must drain before "
+                       f"teardown")
+        if rep.state == "drained" and rep.has_work():
+            _fail(ctx, f"replica {rep.name} was removed as drained but "
+                       f"its engine still holds work")
+    for rep in router.replicas.values():
+        if rep.state != "dead":
+            check_block_pool(rep.engine, f"{ctx}:fleet/{rep.name}")
+    for h, name in router._prefix_index.items():
+        if name not in router.replicas:
+            _fail(ctx, f"prefix-index entry names removed replica "
+                       f"{name!r} — index not scrubbed at teardown")
 
 
 # ---------------------------------------------------------------------------

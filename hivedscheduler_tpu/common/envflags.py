@@ -108,6 +108,18 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
        "`0` opts out of gc.freeze() after scheduler warmup (the scheduler "
        "then pays the gen-2 collection cost).",
        "hivedscheduler_tpu/runtime/utils.py"),
+    # -- serving fleet tier (doc/design/fleet.md) -------------------------
+    _f("HIVED_FLEET_KV_SHIP", "1",
+       "Disaggregated prefill->decode KV handoff mode: unset/`1` ships "
+       "the prefix-cache payload host-side (block table + block "
+       "contents); `0` re-prefills on the decode replica through its own "
+       "prefix cache (re-prefill-on-miss). Both modes are token-exact vs "
+       "single-replica serving.",
+       "hivedscheduler_tpu/fleet/router.py"),
+    _f("HIVED_FLEET_AUTOSCALE_COOLDOWN_S", "30",
+       "Fleet autoscaler cooldown: at most one scale action per role per "
+       "this many seconds (AutoscalePolicy.cooldown_s < 0 reads it).",
+       "hivedscheduler_tpu/fleet/autoscaler.py"),
     # -- sanitizers (opt-in, each wired into tier-1 by its own tests) -----
     _f("HIVED_LOCKCHECK", "0",
        "`1` swaps registry locks to CheckedLock: per-thread lock-order "

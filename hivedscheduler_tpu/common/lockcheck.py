@@ -60,6 +60,11 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # informer handlers (which take the scheduler lock) must never run under
     # it; the only things legal under it are pure store mutations.
     "store_lock": 50,
+    # fleet/router.py — the serving-fleet router's bookkeeping. A leaf
+    # above only the observability leaves: routing/harvest emit journal
+    # events and metrics under it, and NOTHING below it (in particular the
+    # scheduler lock — scale backends run outside the router lock).
+    "fleet_router_lock": 70,
     # observability leaves: nothing is ever acquired under these.
     # (journal_lock sits just below metrics_lock: closing a wait interval
     # observes the gang-wait histogram while holding it — the one legal
@@ -80,6 +85,7 @@ LOCK_SITES: Dict[str, str] = {
     "algorithm_lock": "hivedscheduler_tpu/algorithm/hived.py",
     "watchdog_lock": "hivedscheduler_tpu/parallel/supervisor.py",
     "store_lock": "hivedscheduler_tpu/k8s/fake.py",
+    "fleet_router_lock": "hivedscheduler_tpu/fleet/router.py",
     "journal_lock": "hivedscheduler_tpu/obs/journal.py",
     "metrics_lock": "hivedscheduler_tpu/runtime/metrics.py",
     "trace_lock": "hivedscheduler_tpu/obs/trace.py",

@@ -1,0 +1,103 @@
+"""Serving fleet tier: the cross-replica layer between user traffic and
+per-replica :class:`~hivedscheduler_tpu.models.serving.ServingEngine`\\ s.
+
+The pieces composed here all predate this package — serving exports
+block-pool occupancy as admission hints (``/v1/inspect/admission-hints``),
+the scheduler shrinks/grow-promotes elastic gangs, and ``ServingEngine``
+drains work-preservingly — but they did not talk. This package closes the
+serving<->scheduler loop (ROADMAP item 2):
+
+- :mod:`~hivedscheduler_tpu.fleet.router` — :class:`FleetRouter` owns N
+  replica handles (engine + gang id + role), routes each request by a
+  pluggable policy (least-outstanding-blocks default; prefix-affinity via
+  a content-hash prefix index), retries shed/preempted/lost requests on
+  another replica, and in disaggregated mode splits each request into a
+  prefill leg and a decode leg with a KV handoff
+  (``HIVED_FLEET_KV_SHIP=1`` ships block contents host-side;
+  ``0`` re-prefills through the decode replica's prefix cache).
+- :mod:`~hivedscheduler_tpu.fleet.autoscaler` — :class:`FleetAutoscaler`
+  reads the engines' existing gauges (pool occupancy, queue depth, TTFT)
+  and decides a target replica count per role with hysteresis + cooldown;
+  scale-down is always drain-based, scale-up is effected through a scale
+  backend — in-process for the bench, or through a live
+  :class:`~hivedscheduler_tpu.runtime.scheduler.HivedScheduler` where
+  each replica is a gang member pod competing under VC quotas.
+
+Design doc: doc/design/fleet.md. Chaos invariants:
+``chaos.invariants.check_fleet`` (rides ``check_all`` via ``router=``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from hivedscheduler_tpu.fleet.router import (  # noqa: F401
+    FleetRequest,
+    FleetRouter,
+    Replica,
+    kv_ship_enabled,
+    publish,
+    published,
+)
+from hivedscheduler_tpu.fleet.autoscaler import (  # noqa: F401
+    AutoscalePolicy,
+    FleetAutoscaler,
+    LocalScaleBackend,
+    SchedulerScaleBackend,
+)
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """The ``fleet:`` section of a config YAML
+    (example/config/design/fleet.yaml): router + disaggregation +
+    autoscaler knobs, consumable by ``serve --fleet-config``. Unknown keys
+    raise — a typo'd knob must not silently fall back to a default."""
+
+    replicas: int = 2
+    prefill_replicas: int = 1
+    disaggregate: bool = False
+    policy: str = "least_blocks"
+    autoscale: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    occ_high: float = 0.75
+    occ_low: float = 0.25
+    queue_high: float = 4.0
+    cooldown_s: float = -1.0
+    up_stable_ticks: int = 2
+    down_stable_ticks: int = 4
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "FleetConfig":
+        fields = {f.name for f in dataclasses.fields(FleetConfig)}
+        unknown = sorted(set(d) - fields)
+        if unknown:
+            raise ValueError(f"unknown fleet config keys: {unknown} "
+                             f"(known: {sorted(fields)})")
+        return FleetConfig(**d)
+
+    @staticmethod
+    def from_yaml(path: str) -> Optional["FleetConfig"]:
+        """The ``fleet:`` section of ``path`` (None when absent). The rest
+        of the file is an ordinary scheduler config — one YAML serves both
+        the scheduler boot and the serving-fleet CLI."""
+        import yaml
+
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+        section = raw.get("fleet")
+        if section is None:
+            return None
+        return FleetConfig.from_dict(section)
+
+    def autoscale_policy(self) -> AutoscalePolicy:
+        return AutoscalePolicy(
+            min_replicas=self.min_replicas,
+            max_replicas=self.max_replicas,
+            occ_high=self.occ_high, occ_low=self.occ_low,
+            queue_high=self.queue_high, cooldown_s=self.cooldown_s,
+            up_stable_ticks=self.up_stable_ticks,
+            down_stable_ticks=self.down_stable_ticks,
+        )

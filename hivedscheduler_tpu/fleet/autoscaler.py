@@ -1,0 +1,345 @@
+"""Scheduler-driven fleet autoscaler: gauges in, elastic gangs out.
+
+``FleetAutoscaler`` closes the serving->scheduler loop: it reads the
+signals the serving tier already exports — block-pool occupancy (the
+``tpu_hive_serve_block_pool_occupancy`` gauge's source fields, read
+per-engine), queue depth, and recent TTFT — and decides a target replica
+count per role. Decisions are deliberately boring control theory:
+
+- **hysteresis**: scale up only after ``up_stable_ticks`` consecutive
+  ticks of up-pressure (occupancy above ``occ_high``, queue depth above
+  ``queue_high`` per replica, or p95 TTFT above ``ttft_ceiling_s``);
+  scale down only after ``down_stable_ticks`` ticks of idle signal
+  (occupancy below ``occ_low`` AND empty queues) — a diurnal shoulder
+  must not flap the fleet;
+- **cooldown**: at most one scale action per role per ``cooldown_s``
+  (default from ``HIVED_FLEET_AUTOSCALE_COOLDOWN_S``), so a replica's
+  warm-up transient cannot trigger a second action before its effect is
+  visible;
+- **drain-based scale-down, always**: the victim (least outstanding
+  work) gets ``router.begin_drain``; teardown happens only after the
+  router observes it drained — work-preserving by construction, enforced
+  by ``remove_replica`` and check_fleet.
+
+Scale-UP is effected through a pluggable backend, because capacity is
+the scheduler's to grant, not the autoscaler's to assume:
+
+- :class:`LocalScaleBackend` builds replicas in-process (the CPU bench's
+  A/B and most tests);
+- :class:`SchedulerScaleBackend` drives a live ``HivedScheduler``: each
+  replica is a gang member pod (with ``elasticMinChips`` so the
+  scheduler's elastic arm can degrade it under pressure, exactly like
+  any PR 10 gang) submitted through filter/bind — when the VC has no
+  quota the grow stays PENDING and is retried each tick, i.e. scale-up
+  competes under VC quotas like any gang instead of conjuring capacity.
+
+Every decision is journaled (``fleet_scale``) and counted
+(``tpu_hive_fleet_scale_events_total``); the current target is the
+``tpu_hive_fleet_target_replicas`` gauge. Design doc:
+doc/design/fleet.md (state machine + hysteresis table).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from hivedscheduler_tpu.common import envflags
+from hivedscheduler_tpu.fleet.router import FleetRouter, Replica
+from hivedscheduler_tpu.obs import journal as obs_journal
+from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class AutoscalePolicy:
+    """Per-role autoscaler knobs. ``cooldown_s < 0`` reads the
+    ``HIVED_FLEET_AUTOSCALE_COOLDOWN_S`` flag (default 30)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    occ_high: float = 0.75
+    occ_low: float = 0.25
+    queue_high: float = 4.0      # queued requests per active replica
+    ttft_ceiling_s: float = 0.0  # 0 = TTFT exerts no up-pressure
+    up_stable_ticks: int = 2
+    down_stable_ticks: int = 4
+    cooldown_s: float = -1.0
+
+    def resolved_cooldown(self) -> float:
+        if self.cooldown_s >= 0:
+            return self.cooldown_s
+        return float(envflags.get("HIVED_FLEET_AUTOSCALE_COOLDOWN_S", "30"))
+
+
+class LocalScaleBackend:
+    """In-process replica factory: ``factory(role) -> (name, engine)`` or
+    ``(name, engine, gang)``. grow() never fails for capacity — the CPU
+    bench's static-vs-autoscaled A/B wants the autoscaler's decisions,
+    not the scheduler's admission, to be the variable."""
+
+    def __init__(self, factory: Callable[[str], tuple]):
+        self._factory = factory
+
+    def grow(self, role: str) -> Optional[tuple]:
+        out = self._factory(role)
+        if out is not None and len(out) == 2:
+            out = (out[0], out[1], "")
+        return out
+
+    def shrink(self, role: str, replica: Replica) -> None:
+        pass
+
+
+class SchedulerScaleBackend:
+    """Replica capacity through a live scheduler (see module docstring).
+
+    ``factory(role, name) -> engine`` builds the engine once the pod is
+    bound. One single-member gang per replica, all in ``vc`` — the VC's
+    quota IS the fleet's chip budget, so a grow beyond quota stays
+    pending until capacity frees (and the scheduler's backfill/elastic
+    arms may be what frees it). NOTE: call only from outside the router
+    lock — filter/bind take the scheduler lock, which sits below
+    ``fleet_router_lock`` in the hierarchy."""
+
+    def __init__(self, scheduler, kube, nodes: List[str],
+                 factory: Callable[[str, str], Any], vc: str,
+                 leaf_cell_type: str, chips_per_replica: int = 1,
+                 priority: int = 5, elastic_min_chips: int = 0,
+                 namespace: str = "default"):
+        self.scheduler = scheduler
+        self.kube = kube
+        self.nodes = list(nodes)
+        self.factory = factory
+        self.vc = vc
+        self.leaf_cell_type = leaf_cell_type
+        self.chips = chips_per_replica
+        self.priority = priority
+        self.elastic_min_chips = elastic_min_chips
+        self.namespace = namespace
+        self._seq = 0
+        self._pending: Dict[str, Any] = {}  # role -> waiting Pod
+
+    def _make_pod(self, role: str):
+        from hivedscheduler_tpu.api import constants as C
+        from hivedscheduler_tpu.common.utils import to_json
+        from hivedscheduler_tpu.k8s.types import Container, Pod
+
+        self._seq += 1
+        name = f"fleet-{role}-{self._seq}"
+        spec = {
+            "virtualCluster": self.vc, "priority": self.priority,
+            "leafCellType": self.leaf_cell_type,
+            "leafCellNumber": self.chips,
+            "affinityGroup": {
+                "name": name,
+                "members": [{"podNumber": 1,
+                             "leafCellNumber": self.chips}],
+            },
+        }
+        if self.elastic_min_chips:
+            spec["elasticMinChips"] = self.elastic_min_chips
+        return Pod(
+            name=name, uid=name, namespace=self.namespace,
+            annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: to_json(spec)},
+            containers=[Container(resource_limits={
+                C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})],
+        )
+
+    def grow(self, role: str) -> Optional[tuple]:
+        from hivedscheduler_tpu.runtime import extender as ei
+
+        pod = self._pending.get(role)
+        if pod is None:
+            pod = self._make_pod(role)
+            self.kube.create_pod(pod)
+        r = self.scheduler.filter_routine(
+            ei.ExtenderArgs(pod=pod, node_names=list(self.nodes)))
+        if not r.node_names:
+            # no quota/capacity for the gang right now: the pod stays
+            # submitted and the grow is retried next tick — scale-up
+            # competes under the VC quota like any gang
+            self._pending[role] = pod
+            return None
+        self.scheduler.bind_routine(ei.ExtenderBindingArgs(
+            pod_name=pod.name, pod_namespace=pod.namespace,
+            pod_uid=pod.uid, node=r.node_names[0]))
+        self._pending.pop(role, None)
+        return pod.name, self.factory(role, pod.name), pod.name
+
+    def shrink(self, role: str, replica: Replica) -> None:
+        self.kube.delete_pod(self.namespace, replica.gang)
+
+
+class FleetAutoscaler:
+    """The control loop. Call ``tick()`` periodically (the serve CLI
+    ticks once per arrival batch; the bench per engine step). ``clock``
+    is injectable so hysteresis/cooldown are deterministically
+    testable."""
+
+    def __init__(self, router: FleetRouter, backend,
+                 policy: Optional[AutoscalePolicy] = None,
+                 roles: Optional[Tuple[str, ...]] = None,
+                 clock=time.perf_counter):
+        self.router = router
+        self.backend = backend
+        self.policy = policy or AutoscalePolicy()
+        self._cooldown = self.policy.resolved_cooldown()
+        if roles is None:
+            roles = (("prefill", "decode")
+                     if router.disaggregate and router.kv_ship
+                     else ("serve",))
+        self.roles = roles
+        self._clock = clock
+        self._up: Dict[str, int] = {r: 0 for r in roles}
+        self._down: Dict[str, int] = {r: 0 for r in roles}
+        self._last_action: Dict[str, float] = {r: float("-inf")
+                                               for r in roles}
+        self._pending_down: Dict[str, str] = {}  # role -> draining name
+        self.target: Dict[str, int] = {}
+        self.actions: List[Dict[str, Any]] = []
+        self.replica_seconds = 0.0  # integral of live replicas (bench cost)
+        self._last_tick: Optional[float] = None
+
+    # -- signals -----------------------------------------------------------
+    def _role_replicas(self, role: str) -> List[Replica]:
+        role_blind = len(self.roles) == 1
+        return [r for r in self.router.replicas.values()
+                if r.state == "active"
+                and (role_blind or r.role in (role, "serve"))]
+
+    def signals(self, role: str) -> Dict[str, Any]:
+        reps = self._role_replicas(role)
+        occs = []
+        qdepth = 0
+        for rep in reps:
+            eng = rep.engine
+            if getattr(eng, "paged", False):
+                occs.append(eng.blocks_in_use / max(1, eng.num_blocks - 1))
+            else:
+                occs.append(
+                    sum(s is not None for s in eng.slots) / eng.max_batch)
+            qdepth += len(eng.queue)
+        ttfts = sorted(t for _at, t in self.router.recent_ttfts)
+        p95 = ttfts[int(0.95 * (len(ttfts) - 1))] if ttfts else 0.0
+        return {
+            "replicas": len(reps),
+            "occupancy": sum(occs) / len(occs) if occs else 0.0,
+            "queueDepth": qdepth,
+            "ttftP95": p95,
+        }
+
+    # -- the loop ----------------------------------------------------------
+    def tick(self) -> List[Dict[str, Any]]:
+        now = self._clock()
+        if self._last_tick is not None:
+            live = sum(1 for r in self.router.replicas.values()
+                       if r.state in ("active", "draining"))
+            self.replica_seconds += live * max(0.0, now - self._last_tick)
+        self._last_tick = now
+        done: List[Dict[str, Any]] = []
+        for role in self.roles:
+            done.extend(self._tick_role(role, now))
+        self._complete_drains(now, done)
+        metrics.set_gauge("tpu_hive_fleet_target_replicas",
+                          sum(self.target.values()) if self.target else
+                          sum(1 for r in self.router.replicas.values()
+                              if r.state == "active"))
+        self.actions.extend(done)
+        return done
+
+    def _tick_role(self, role: str, now: float) -> List[Dict[str, Any]]:
+        p = self.policy
+        sig = self.signals(role)
+        n = sig["replicas"]
+        self.target.setdefault(role, max(p.min_replicas, n))
+        up_pressure = (
+            sig["occupancy"] > p.occ_high
+            or sig["queueDepth"] > p.queue_high * max(1, n)
+            or (p.ttft_ceiling_s > 0 and sig["ttftP95"] > p.ttft_ceiling_s)
+        )
+        down_pressure = (
+            sig["occupancy"] < p.occ_low and sig["queueDepth"] == 0
+        )
+        self._up[role] = self._up[role] + 1 if up_pressure else 0
+        self._down[role] = self._down[role] + 1 if down_pressure else 0
+        out: List[Dict[str, Any]] = []
+        if now - self._last_action[role] < self._cooldown:
+            return out
+        if (self._up[role] >= p.up_stable_ticks and n < p.max_replicas
+                and role not in self._pending_down):
+            reason = ("occupancy" if sig["occupancy"] > p.occ_high else
+                      "queue" if sig["queueDepth"] > p.queue_high * max(1, n)
+                      else "ttft")
+            handle = self.backend.grow(role)
+            if handle is None:
+                # competing under the VC quota: the grow stays pending at
+                # the scheduler and is retried next tick
+                out.append({"role": role, "direction": "up",
+                            "phase": "pending", "reason": reason})
+                if obs_journal.JOURNAL.enabled:
+                    obs_journal.emit("fleet_scale", f"fleetrole/{role}",
+                                     direction="up", phase="pending",
+                                     reason=reason)
+                return out
+            name, engine, gang = handle
+            self.router.add_replica(name, engine, role=role, gang=gang)
+            self.target[role] = n + 1
+            self._last_action[role] = now
+            self._up[role] = 0
+            metrics.inc("tpu_hive_fleet_scale_events_total", direction="up")
+            if obs_journal.JOURNAL.enabled:
+                obs_journal.emit("fleet_scale", f"fleetrole/{role}",
+                                 direction="up", phase="added",
+                                 replica=name, reason=reason)
+            log.info("fleet autoscaler: %s scaled up to %d (%s; occ %.2f, "
+                     "queue %d)", role, n + 1, reason, sig["occupancy"],
+                     sig["queueDepth"])
+            out.append({"role": role, "direction": "up", "phase": "added",
+                        "replica": name, "reason": reason})
+        elif (self._down[role] >= p.down_stable_ticks
+                and n > p.min_replicas and role not in self._pending_down):
+            reps = self._role_replicas(role)
+            victim = min(reps, key=lambda r: (r.outstanding_blocks(),
+                                              r.name))
+            self.router.begin_drain(victim.name)
+            self._pending_down[role] = victim.name
+            self.target[role] = n - 1
+            self._last_action[role] = now
+            self._down[role] = 0
+            metrics.inc("tpu_hive_fleet_scale_events_total",
+                        direction="down")
+            if obs_journal.JOURNAL.enabled:
+                obs_journal.emit("fleet_scale", f"fleetrole/{role}",
+                                 direction="down", phase="draining",
+                                 replica=victim.name, reason="idle")
+            log.info("fleet autoscaler: %s draining %s toward %d replicas",
+                     role, victim.name, n - 1)
+            out.append({"role": role, "direction": "down",
+                        "phase": "draining", "replica": victim.name,
+                        "reason": "idle"})
+        return out
+
+    def _complete_drains(self, now: float,
+                         out: List[Dict[str, Any]]) -> None:
+        for role, name in list(self._pending_down.items()):
+            rep = self.router.replicas.get(name)
+            if rep is None:
+                del self._pending_down[role]
+                continue
+            if rep.state != "drained":
+                continue
+            self.backend.shrink(role, rep)
+            self.router.remove_replica(name)
+            del self._pending_down[role]
+            if obs_journal.JOURNAL.enabled:
+                obs_journal.emit("fleet_scale", f"fleetrole/{role}",
+                                 direction="down", phase="removed",
+                                 replica=name)
+            log.info("fleet autoscaler: %s removed drained replica %s",
+                     role, name)
+            out.append({"role": role, "direction": "down",
+                        "phase": "removed", "replica": name})

@@ -936,6 +936,23 @@ class ServingEngine:
                     upd["v_scale"] = cp(pool.v_scale)
                 return pool._replace(**upd)
 
+            def import_block(pool, dst, *parts):
+                """Fleet KV handoff: write one shipped block's contents
+                (values + scales for int8) into freshly-allocated block
+                ``dst``. Block-shaped, so ONE program serves any prefix
+                length — the per-block loop in import_prefix never
+                recompiles."""
+                kc, vc = parts[0], parts[1]
+                upd = dict(k=pool.k.at[:, dst].set(kc.astype(pool.k.dtype)),
+                           v=pool.v.at[:, dst].set(vc.astype(pool.v.dtype)))
+                if quant_pool:
+                    upd["k_scale"] = pool.k_scale.at[:, dst].set(parts[2])
+                    upd["v_scale"] = pool.v_scale.at[:, dst].set(parts[3])
+                return pool._replace(**upd)
+
+            self._import_block = compileguard.jit(
+                import_block, guard_label="serve.import_block",
+                donate_argnums=(0,))
             self._paged_decode = compileguard.jit(
                 paged_decode, guard_label="serve.paged_decode",
                 donate_argnums=(1,))
@@ -1346,6 +1363,91 @@ class ServingEngine:
             _, (payload, _plen) = self._prefix_cache.popitem(last=False)
             self._drop_entry(payload)  # paged: drop the block references
 
+    # -- cross-replica prefix shipping (the fleet tier's KV handoff;
+    # doc/design/fleet.md) -------------------------------------------------
+    def export_prefix(self, prompt: List[int]):
+        """Host-side copy of the longest cached STRICT prefix of
+        ``prompt`` — the ship leg of the fleet KV handoff. Returns None on
+        a cache miss, else ``(key, plen, data)`` where ``data`` is an
+        opaque host payload consumable by :meth:`import_prefix` on a
+        config-identical engine (same TransformerConfig, page_size and
+        kv_dtype). Exactness: the shipped bytes are bit-identical copies
+        of this engine's cached KV, which is itself bit-identical to what
+        the importing replica would compute for the same prompt prefix
+        (same params, deterministic prefill) — so a decode leg resumed
+        from an imported prefix is token-exact vs local serving (guard:
+        tests/test_fleet_router.py)."""
+        hit = self._match_prefix(list(prompt))
+        if hit is None:
+            return None
+        key, (payload, plen) = hit
+        if self.paged:
+            idx = jnp.asarray(list(self._entry_bids(payload)), jnp.int32)
+            data = {"k": np.asarray(self.pool.k[:, idx]),
+                    "v": np.asarray(self.pool.v[:, idx])}
+            if self.kv_dtype == "int8":
+                data["k_scale"] = np.asarray(self.pool.k_scale[:, idx])
+                data["v_scale"] = np.asarray(self.pool.v_scale[:, idx])
+        else:
+            data = tuple(np.asarray(a) for a in payload)
+        return key, plen, data
+
+    def import_prefix(self, key, plen: int, data) -> bool:
+        """Install a shipped prefix payload (from :meth:`export_prefix` on
+        a config-identical engine) into this engine's prefix cache: the
+        receive leg of the fleet KV handoff. The next ``submit()`` of a
+        prompt extending ``key`` restores the imported KV and prefills
+        only the tail — the ordinary prefix-hit path, so every exactness
+        and accounting argument of the local cache carries over (paged:
+        the imported blocks are allocated from this pool and refcounted
+        exactly like locally-stored entries; check_block_pool covers
+        them). Returns False when the key is already cached (LRU-touched,
+        nothing written). May reclaim LRU cache entries or preempt
+        streams under pool pressure, like any allocation."""
+        if self.prefix_cache_size <= 0:
+            raise ValueError(
+                "import_prefix needs prefix_cache_size > 0 — the imported "
+                "payload lives in the prefix cache"
+            )
+        key = tuple(key)
+        if len(key) != plen or plen <= 0:
+            raise ValueError(f"prefix key length {len(key)} != plen {plen}")
+        if key in self._prefix_cache:
+            self._prefix_cache.move_to_end(key)
+            return False
+        if self.paged:
+            nb = -(-plen // self.page_size)
+            if data["k"].shape[1] != nb or data["k"].shape[2] != self.page_size:
+                raise ValueError(
+                    f"shipped payload shape {data['k'].shape} does not "
+                    f"cover {plen} tokens at page_size {self.page_size} — "
+                    f"handoff requires config-identical engines"
+                )
+            bids: List[int] = []
+            try:
+                for j in range(nb):
+                    bid = self._alloc_block()
+                    bids.append(bid)
+                    parts = [jnp.asarray(data["k"][:, j]),
+                             jnp.asarray(data["v"][:, j])]
+                    if self.kv_dtype == "int8":
+                        parts += [jnp.asarray(data["k_scale"][:, j]),
+                                  jnp.asarray(data["v_scale"][:, j])]
+                    self.pool = self._import_block(
+                        self.pool, jnp.int32(bid), *parts)
+            except RuntimeError:
+                for bid in bids:
+                    self._decref(bid)
+                raise
+            payload = tuple(bids)
+        else:
+            payload = tuple(jnp.asarray(a) for a in data)
+        self._prefix_cache[key] = (payload, plen)
+        while len(self._prefix_cache) > self.prefix_cache_size:
+            _k, (pl, _n) = self._prefix_cache.popitem(last=False)
+            self._drop_entry(pl)
+        return True
+
     def _shed_expired(self) -> None:
         """Queue-wait deadline: finish expired waiters with
         ``finish_reason="shed"`` before admission. Under strict priority the
@@ -1748,6 +1850,19 @@ class ServingEngine:
         if not self.draining:
             self.draining = True
 
+    def end_drain(self) -> None:
+        """Re-arm admission after a COMPLETED drain — a drained replica
+        returning to a warm standby pool (the fleet autoscaler's
+        scale-down/regrow cycle must not pay a fresh engine build). Only
+        legal once idle: re-arming with work still in flight would turn
+        the drain's 503 contract into silent re-admission."""
+        if self.queue or any(s is not None for s in self.slots):
+            raise RuntimeError(
+                "end_drain with work still in flight — finish the drain "
+                "(step until idle) before re-arming admission"
+            )
+        self.draining = False
+
     def drain(self, deadline_s: Optional[float] = None,
               max_steps: int = 100_000) -> bool:
         """Finish all in-flight work, bounded by ``deadline_s``.
@@ -2102,6 +2217,20 @@ class SpeculativeServingEngine(ServingEngine):
 
     def _entry_bids(self, payload):
         return payload[0]
+
+    def export_prefix(self, prompt):
+        raise RuntimeError(
+            "KV shipping across replicas does not support the speculative "
+            "engine (its prefix payloads bundle a draft-cache copy); run "
+            "the fleet with HIVED_FLEET_KV_SHIP=0 (re-prefill-on-miss)"
+        )
+
+    def import_prefix(self, key, plen: int, data) -> bool:
+        raise RuntimeError(
+            "KV shipping across replicas does not support the speculative "
+            "engine (its prefix payloads bundle a draft-cache copy); run "
+            "the fleet with HIVED_FLEET_KV_SHIP=0 (re-prefill-on-miss)"
+        )
 
     def submit(self, prompt, max_new_tokens: int,
                priority: int = 0) -> Request:

@@ -136,6 +136,17 @@ SCHEMA: Dict[str, str] = {
     "serve_shed": "request shed on the queue-wait deadline before it ran",
     "serve_preempt": "stream truncated to relieve KV block-pool exhaustion",
     "serve_finish": "request finished (finish_reason in args)",
+    # serving fleet tier (fleet/router.py + fleet/autoscaler.py)
+    "fleet_route": "a fleet request leg was routed to a replica (leg = "
+                   "prefill/decode; policy in args)",
+    "fleet_handoff": "disaggregated prefill->decode KV handoff (mode = "
+                     "ship/miss/reprefill; cause = the prefill leg's last "
+                     "serve event)",
+    "fleet_retry": "a shed/preempted/lost leg was re-routed to another "
+                   "replica (the stream restarts from scratch, "
+                   "token-exactly for greedy)",
+    "fleet_scale": "autoscaler decision (direction, phase = "
+                   "pending/added/draining/removed, replica, reason)",
     # workload supervisor (train.py / parallel/supervisor.py)
     "train_resume": "a training incarnation resumed from a committed "
                     "checkpoint (preemption/crash restart)",

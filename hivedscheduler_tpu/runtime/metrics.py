@@ -176,6 +176,30 @@ REGISTRY.describe("tpu_hive_serve_pool_preempted_total",
 REGISTRY.describe("tpu_hive_serve_spec_acceptance_ratio",
                   "Per-verify-round speculative acceptance fraction "
                   "(accepted draft tokens / gamma) as a histogram")
+# serving fleet tier (fleet/router.py + fleet/autoscaler.py): the
+# cross-replica router and the scheduler-driven autoscaler
+REGISTRY.describe("tpu_hive_fleet_requests_total",
+                  "Fleet requests finished by outcome (eos/length/shed/"
+                  "preempted/no_replica — shed/preempted here means "
+                  "retries were exhausted)")
+REGISTRY.describe("tpu_hive_fleet_retries_total",
+                  "Shed/preempted/lost legs re-routed to another replica "
+                  "by leg (prefill/decode)")
+REGISTRY.describe("tpu_hive_fleet_handoffs_total",
+                  "Disaggregated prefill->decode handoffs by mode (ship = "
+                  "KV crossed host-side, miss = no exportable prefix, "
+                  "reprefill = HIVED_FLEET_KV_SHIP=0 path)")
+REGISTRY.describe("tpu_hive_fleet_prefix_affinity_hits_total",
+                  "Requests routed by a content-hash prefix-index hit "
+                  "(the caching replica serves the prompt's leading "
+                  "blocks from its prefix cache)")
+REGISTRY.describe("tpu_hive_fleet_replicas",
+                  "Live fleet replicas (active + draining)")
+REGISTRY.describe("tpu_hive_fleet_target_replicas",
+                  "Fleet autoscaler target replica count (sum over roles)")
+REGISTRY.describe("tpu_hive_fleet_scale_events_total",
+                  "Autoscaler scale actions by direction (up = replica "
+                  "added, down = drain-based removal started)")
 # workload supervisor (parallel/supervisor.py + the train CLI): the
 # preemption-tolerance surface of the training loop
 REGISTRY.describe("tpu_hive_train_resumes_total",

@@ -21,6 +21,81 @@ from hivedscheduler_tpu.common import utils as common
 log = logging.getLogger(__name__)
 
 
+def _run_fleet(args, router, autoscaler, pending, prio_of) -> int:
+    """Drive the synthetic load through the FleetRouter (the --fleet
+    path): staggered arrivals, per-step autoscaler ticks, and a fleet
+    summary mirroring the single-engine report."""
+    from hivedscheduler_tpu import fleet as fleet_pkg
+
+    reqs = []
+    steps = 0
+    t0 = time.perf_counter()
+    try:
+        if args.arrival_every == 0:  # all up front
+            while pending:
+                prompt, budget = pending.pop(0)
+                reqs.append(router.submit(prompt, budget,
+                                          priority=prio_of(len(reqs))))
+        while pending or (reqs and not all(f.done for f in reqs)):
+            if pending and steps % args.arrival_every == 0:
+                prompt, budget = pending.pop(0)
+                reqs.append(router.submit(prompt, budget,
+                                          priority=prio_of(len(reqs))))
+            if autoscaler is not None:
+                autoscaler.tick()
+            router.step()
+            steps += 1
+    finally:
+        fleet_pkg.publish(None)
+    dt = time.perf_counter() - t0
+
+    total_tokens = sum(len(f.tokens_out) for f in reqs)
+    for f in reqs:
+        print(f"[{f.fid}] " + " ".join(str(t) for t in f.tokens_out))
+    ttfts = sorted(f.ttft_s for f in reqs if f.ttft_s is not None)
+    if ttfts:
+        log.info("fleet time-to-first-token: p50 %.0f ms, max %.0f ms",
+                 1e3 * ttfts[len(ttfts) // 2], 1e3 * ttfts[-1])
+    snap = router.snapshot()
+    log.info(
+        "fleet: %s requests, %s tokens in %.2fs (%.1f tok/s) over %s "
+        "replicas (policy %s%s)",
+        len(reqs), total_tokens, dt, total_tokens / dt,
+        len(snap["replicas"]), router.policy,
+        ", disaggregated" if router.disaggregate else "",
+    )
+    if router.disaggregate:
+        log.info("fleet handoffs: %s shipped, %s missed, %s re-prefilled "
+                 "(HIVED_FLEET_KV_SHIP=%s)", router.handoffs["ship"],
+                 router.handoffs["miss"], router.handoffs["reprefill"],
+                 "1" if router.kv_ship else "0")
+    if router.retried:
+        log.info("fleet retries: %s shed/preempted/lost legs re-routed",
+                 router.retried)
+    if router.policy == "prefix_affinity":
+        log.info("fleet prefix-affinity hits: %s", router.affinity_hits)
+    if autoscaler is not None:
+        ups = sum(1 for a in autoscaler.actions
+                  if a["direction"] == "up" and a["phase"] == "added")
+        downs = sum(1 for a in autoscaler.actions
+                    if a["phase"] == "removed")
+        log.info("fleet autoscaler: %s scale-ups, %s drain-based "
+                 "removals, %s live replicas at exit", ups, downs,
+                 sum(1 for r in snap["replicas"]
+                     if r["state"] in ("active", "draining")))
+    if args.metrics_dump:
+        from hivedscheduler_tpu.obs import trace as obs_trace
+        from hivedscheduler_tpu.runtime.metrics import REGISTRY
+
+        with open(args.metrics_dump, "w") as f:
+            f.write(REGISTRY.render())
+        trace_path = args.metrics_dump + ".trace.json"
+        obs_trace.write_chrome_trace(trace_path)
+        log.info("metrics exposition -> %s; Chrome trace -> %s",
+                 args.metrics_dump, trace_path)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="tpu-hive-serve")
     parser.add_argument("--requests", type=int, default=8)
@@ -157,8 +232,77 @@ def main(argv=None) -> int:
                         "(obs/journal.py) and append its request "
                         "admission/shed/preemption events to this JSONL "
                         "spool (one line per event, flushed per append)")
+    parser.add_argument("--fleet", type=int, default=0,
+                        help="serve through a FleetRouter over this many "
+                        "replicas (0 = single engine). Each replica is a "
+                        "fresh engine over the same weights; requests are "
+                        "routed by --route-policy, shed/preempted streams "
+                        "retry on another replica (doc/design/fleet.md)")
+    parser.add_argument("--disaggregate", action="store_true",
+                        help="fleet mode: split prefill from decode — the "
+                        "first --prefill-replicas replicas take prefill "
+                        "legs, the rest decode legs, with the KV handoff "
+                        "selected by HIVED_FLEET_KV_SHIP (1 = ship block "
+                        "contents host-side, 0 = re-prefill through the "
+                        "decode replica's prefix cache). Token-exact vs "
+                        "single-replica either way")
+    parser.add_argument("--prefill-replicas", type=int, default=1,
+                        help="with --disaggregate: replicas dedicated to "
+                        "prefill legs (the rest decode)")
+    parser.add_argument("--route-policy", default="least_blocks",
+                        choices=["least_blocks", "prefix_affinity"],
+                        help="fleet routing policy: least outstanding KV "
+                        "blocks, or prefix-affinity (route to the replica "
+                        "whose prefix cache holds the prompt's leading "
+                        "blocks, falling back to least-blocks)")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="fleet mode: run the FleetAutoscaler over the "
+                        "replica set (hysteresis + cooldown; scale-down is "
+                        "always drain-based) between --fleet-min and "
+                        "--fleet-max replicas; --fleet sizes the starting "
+                        "set")
+    parser.add_argument("--fleet-min", type=int, default=1,
+                        help="autoscaler floor (replicas)")
+    parser.add_argument("--fleet-max", type=int, default=0,
+                        help="autoscaler ceiling (0 = the --fleet value)")
+    parser.add_argument("--fleet-config", default="",
+                        help="YAML with a `fleet:` section (see example/"
+                        "config/design/fleet.yaml) providing fleet/"
+                        "disaggregation/autoscaler knobs; explicit fleet "
+                        "flags override it")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
+    fleet_cfg = None
+    if args.fleet_config:
+        from hivedscheduler_tpu.fleet import FleetConfig
+
+        fleet_cfg = FleetConfig.from_yaml(args.fleet_config)
+        if fleet_cfg is None:
+            parser.error(f"{args.fleet_config} has no `fleet:` section")
+        if args.fleet == 0:
+            args.fleet = fleet_cfg.replicas
+        if not args.disaggregate:
+            args.disaggregate = fleet_cfg.disaggregate
+        if args.prefill_replicas == 1:
+            args.prefill_replicas = fleet_cfg.prefill_replicas
+        if args.route_policy == "least_blocks":
+            args.route_policy = fleet_cfg.policy
+        if not args.autoscale:
+            args.autoscale = fleet_cfg.autoscale
+        if args.fleet_min == 1:
+            args.fleet_min = fleet_cfg.min_replicas
+        if args.fleet_max == 0 and fleet_cfg.autoscale:
+            args.fleet_max = fleet_cfg.max_replicas
+    if args.fleet > 0:
+        if args.disaggregate and not 0 < args.prefill_replicas < args.fleet:
+            parser.error(
+                f"--disaggregate needs 0 < --prefill-replicas "
+                f"{args.prefill_replicas} < --fleet {args.fleet} (at least "
+                f"one prefill and one decode replica)"
+            )
+        if args.tp > 1 or args.dp > 1:
+            parser.error("--fleet does not compose with --tp/--dp (each "
+                         "replica is a single-host engine in this CLI)")
     if args.prefix_cache > 0:
         # synthetic prompts are system + up to 16 tokens; fail fast instead
         # of letting a mid-run submit() raise past the engine guard
@@ -229,48 +373,82 @@ def main(argv=None) -> int:
 
         axes = topology.MeshAxes(dp=args.dp, tp=args.tp)
         mesh = topology.make_mesh(axes, topology.get_devices(axes.size))
-    try:
-        kw = dict(
-            max_batch=args.max_batch, max_len=args.max_len,
-            temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-            eos_id=None if args.eos_id < 0 else args.eos_id, seed=args.seed,
-            mesh=mesh, prefix_cache_size=args.prefix_cache,
-            prefill_chunk=args.prefill_chunk,
-            kv_dtype=None if args.kv_quantize == "none" else args.kv_quantize,
-            queue_timeout_s=args.queue_timeout if args.queue_timeout > 0 else None,
-            age_boost_secs=args.age_boost_secs if args.age_boost_secs > 0 else None,
-            decode_steps=args.decode_steps,
-            page_size=args.page_size, num_blocks=args.num_blocks,
+    kw = dict(
+        max_batch=args.max_batch, max_len=args.max_len,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        eos_id=None if args.eos_id < 0 else args.eos_id, seed=args.seed,
+        mesh=mesh, prefix_cache_size=args.prefix_cache,
+        prefill_chunk=args.prefill_chunk,
+        kv_dtype=None if args.kv_quantize == "none" else args.kv_quantize,
+        queue_timeout_s=args.queue_timeout if args.queue_timeout > 0 else None,
+        age_boost_secs=args.age_boost_secs if args.age_boost_secs > 0 else None,
+        decode_steps=args.decode_steps,
+        page_size=args.page_size, num_blocks=args.num_blocks,
+    )
+    speculative = args.spec_decode or args.draft_layers > 0
+    if speculative and args.decode_steps > 1:
+        log.warning("--decode-steps is ignored by the speculative "
+                    "engine (a verify round already amortizes the "
+                    "host round-trip)")
+    spec_cfg = None
+    if speculative:
+        from hivedscheduler_tpu.models.speculative import (
+            SpecDecodeConfig,
+            derive_draft_config,
         )
-        speculative = args.spec_decode or args.draft_layers > 0
-        if speculative and args.decode_steps > 1:
-            log.warning("--decode-steps is ignored by the speculative "
-                        "engine (a verify round already amortizes the "
-                        "host round-trip)")
-        if speculative:
-            from hivedscheduler_tpu.models.speculative import (
-                SpecDecodeConfig,
-                derive_draft_config,
-            )
 
-            dft_cfg = derive_draft_config(cfg, args.draft_layers or 2,
-                                          args.draft_d_model)
-            dft_params = tm.cast_params(
-                tm.init_params(dft_cfg, jax.random.PRNGKey(args.seed + 3)),
-                dft_cfg.dtype,
-            )
-            # the first-class construction path: one constructor, every
-            # composition (paging, chunked prefill, prefix cache)
-            eng = serving.ServingEngine(
-                params, cfg,
-                spec_decode=SpecDecodeConfig(
-                    draft_params=dft_params, draft_cfg=dft_cfg,
-                    gamma=args.gamma,
-                ),
-                **kw,
-            )
+        dft_cfg = derive_draft_config(cfg, args.draft_layers or 2,
+                                      args.draft_d_model)
+        dft_params = tm.cast_params(
+            tm.init_params(dft_cfg, jax.random.PRNGKey(args.seed + 3)),
+            dft_cfg.dtype,
+        )
+        # the first-class construction path: one constructor, every
+        # composition (paging, chunked prefill, prefix cache)
+        spec_cfg = SpecDecodeConfig(draft_params=dft_params,
+                                    draft_cfg=dft_cfg, gamma=args.gamma)
+
+    def build_engine():
+        return serving.ServingEngine(params, cfg, spec_decode=spec_cfg,
+                                     **kw)
+
+    router = autoscaler = None
+    try:
+        if args.fleet > 0:
+            from hivedscheduler_tpu import fleet as fleet_pkg
+
+            router = fleet_pkg.FleetRouter(policy=args.route_policy,
+                                           disaggregate=args.disaggregate)
+            if (args.disaggregate and router.kv_ship
+                    and kw["prefix_cache_size"] == 0):
+                # the handoff payload travels through the prefix cache
+                kw["prefix_cache_size"] = 8
+                log.info("fleet: --disaggregate with KV shipping needs a "
+                         "prefix cache; defaulting to 8 entries/replica")
+            for i in range(args.fleet):
+                role = "serve"
+                if args.disaggregate:
+                    role = ("prefill" if i < args.prefill_replicas
+                            else "decode")
+                router.add_replica(f"r{i}-{role}", build_engine(),
+                                   role=role)
+            fleet_pkg.publish(router)
+            if args.autoscale:
+                fleet_max = args.fleet_max or args.fleet
+                seq = [0]
+
+                def factory(role):
+                    seq[0] += 1
+                    return f"auto{seq[0]}-{role}", build_engine()
+
+                autoscaler = fleet_pkg.FleetAutoscaler(
+                    router, fleet_pkg.LocalScaleBackend(factory),
+                    fleet_pkg.AutoscalePolicy(
+                        min_replicas=args.fleet_min,
+                        max_replicas=fleet_max),
+                )
         else:
-            eng = serving.ServingEngine(params, cfg, **kw)
+            eng = build_engine()
     except ValueError as e:
         log.error("%s", e)
         return 1
@@ -292,6 +470,9 @@ def main(argv=None) -> int:
     def prio_of(i: int) -> int:
         hp = args.high_priority_every
         return 10 if hp > 0 and (i + 1) % hp == 0 else 0
+
+    if router is not None:
+        return _run_fleet(args, router, autoscaler, pending, prio_of)
 
     from hivedscheduler_tpu.parallel import supervisor as sup_lib
 

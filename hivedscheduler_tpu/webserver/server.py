@@ -187,8 +187,19 @@ def _make_handler(scheduler: HivedScheduler, webserver: Optional[WebServer] = No
                         C.PHYSICAL_CLUSTER_PATH, C.VIRTUAL_CLUSTERS_PATH,
                         C.TRACES_PATH, C.TRACES_CHROME_PATH,
                         C.ADMISSION_HINTS_PATH, C.DEFRAG_PATH,
-                        C.GANGS_PATH,
+                        C.GANGS_PATH, C.FLEET_PATH,
                     ]})
+                elif path == C.FLEET_PATH:
+                    # serving-fleet router snapshot (copy-on-read under
+                    # the router's leaf lock; empty when no fleet runs in
+                    # this process)
+                    from hivedscheduler_tpu.fleet import router as fleet_router
+
+                    r = fleet_router.published()
+                    payload = {"enabled": r is not None}
+                    if r is not None:
+                        payload.update(r.snapshot())
+                    self._reply(200, payload)
                 elif path == C.ADMISSION_HINTS_PATH:
                     # serving headroom + defrag holds, for gang admission
                     self._reply(200, scheduler.get_admission_hints())

@@ -14,8 +14,11 @@ def test_bench_model_smoke(capsys):
     import bench_model
 
     # one invocation covers the stage metrics AND the --breakdown schema
-    # (a separate breakdown run would repeat the whole smoke bench)
-    rc = bench_model.main(["--smoke", "--iters", "1", "--breakdown"])
+    # (a separate breakdown run would repeat the whole smoke bench); the
+    # short --fleet-duration keeps the diurnal fleet A/B inside the
+    # tier-1 wall-time budget (the driver's run keeps the default cycle)
+    rc = bench_model.main(["--smoke", "--iters", "1", "--breakdown",
+                           "--fleet-duration", "1.0"])
     assert rc == 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
     m = json.loads(line)
@@ -37,6 +40,13 @@ def test_bench_model_smoke(capsys):
     for key, val in m["breakdown"].items():
         assert isinstance(val, (int, float)) and val >= 0.0, (key, val)
     assert m["model"]["decode_steps"] == 1
+    # fleet stage (ISSUE 12): the A/B ran and disaggregated serving is
+    # token-exact in BOTH KV-handoff modes, even at smoke sizes
+    assert "serve_fleet_error" not in m, m.get("serve_fleet_error")
+    assert m["fleet_disagg_token_exact"] is True
+    sf = m["serve_fleet"]
+    assert sf["static_good_requests"] > 0
+    assert sf["autoscaled_good_requests"] > 0
 
 
 @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 7): fault-ladder

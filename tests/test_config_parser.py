@@ -275,6 +275,19 @@ class TestExampleConfigsValid:
         assert set(h.full_cell_list) == {
             "v5p-8x4x2", "v5e-16f", "g-pool", "ct-node", "3-mx-node"}
 
+    def test_fleet_fixture(self):
+        """fleet.yaml boots BOTH ways: the scheduler side constructs the
+        algorithm, the serving side parses the `fleet:` section."""
+        from hivedscheduler_tpu.algorithm import HivedAlgorithm
+        from hivedscheduler_tpu.fleet import FleetConfig
+
+        path = os.path.join(os.path.dirname(FIXTURE), "fleet.yaml")
+        h = HivedAlgorithm(load_config(path))
+        assert "v5e-16f" in h.full_cell_list
+        fc = FleetConfig.from_yaml(path)
+        assert fc is not None and fc.disaggregate
+        assert fc.autoscale_policy().max_replicas == fc.max_replicas
+
     def test_deploy_manifest_embedded_config(self):
         import yaml
 

@@ -1,0 +1,175 @@
+"""Fleet chaos (ISSUE 12 satellite): check_fleet invariants under
+injected replica loss — including a decode replica killed MID-HANDOFF —
+and a seeded random soak over submit/kill/drain/add/remove ops.
+
+The invariants re-derived each check (chaos.invariants.check_fleet):
+no request lost between shed and retry, no double-routed stream,
+drain-before-teardown on every scale-down, and no orphaned blocks after
+any handoff (check_block_pool over every live replica's pool)."""
+
+import os
+import random
+import sys
+
+import pytest
+
+pytest.importorskip("jax")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from hivedscheduler_tpu.chaos import invariants  # noqa: E402
+from hivedscheduler_tpu.fleet import FleetRouter  # noqa: E402
+from hivedscheduler_tpu.models import serving, transformer as tm  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tm.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_kv_heads=2, n_layers=1,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32)
+    params = tm.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def make_engine(setup):
+    cfg, params = setup
+    return serving.ServingEngine(params, cfg, max_batch=2, max_len=64,
+                                 page_size=8, prefix_cache_size=8)
+
+
+_REF = {}
+
+
+def reference(setup, prompt, budget):
+    """Single-replica reference stream. ONE shared engine serves every
+    reference serially — greedy streams depend only on (params, prompt),
+    so cache state between references cannot change them."""
+    key = (tuple(prompt), budget)
+    if key not in _REF:
+        if "_eng" not in _REF:
+            _REF["_eng"] = make_engine(setup)
+        eng = _REF["_eng"]
+        req = eng.submit(list(prompt), budget)
+        eng.run_until_drained()
+        _REF[key] = list(req.tokens_out)
+    return _REF[key]
+
+
+def test_kill_decode_replica_mid_handoff(setup):
+    """The satellite's named episode: the decode replica dies AFTER the
+    prefill leg shipped its KV and the decode leg started — the stream
+    must retry on the surviving decode replica, token-exactly, with no
+    orphaned blocks anywhere."""
+    r = FleetRouter(disaggregate=True, kv_ship=True)
+    r.add_replica("p0", make_engine(setup), role="prefill")
+    r.add_replica("d0", make_engine(setup), role="decode")
+    r.add_replica("d1", make_engine(setup), role="decode")
+    prompt = list(range(1, 20))
+    f = r.submit(prompt, 8)
+    # drive until the handoff completed and the decode leg is in flight
+    for _ in range(200):
+        r.step()
+        if f.handoff is None and f.attempts and not f.done:
+            break
+    assert f.handoff is None and f.replica in ("d0", "d1")
+    victim = f.replica
+    r.kill(victim)
+    r.step()
+    invariants.check_fleet(r, "post-kill")  # retried, nothing lost
+    assert f.retries == 1 and f.replica != victim
+    r.run_until_drained()
+    assert f.finish_reason == "length"
+    assert f.tokens_out == reference(setup, prompt, 8)
+    invariants.check_fleet(r, "post-drain")
+    # the dead replica's blocks are NOT checked (its pool died with it);
+    # every surviving pool must balance
+    for name, rep in r.replicas.items():
+        if rep.state != "dead":
+            invariants.check_block_pool(rep.engine, name)
+
+
+@pytest.mark.slow  # tier-1 wall-time budget: the decode-kill episode above is the tier-1 cousin (same retry machinery, the handoff's other end)
+def test_kill_prefill_replica_mid_handoff(setup):
+    """Losing the PREFILL replica while its leg is in flight: the
+    request restarts its dispatch on the surviving prefill replica."""
+    r = FleetRouter(disaggregate=True, kv_ship=True)
+    r.add_replica("p0", make_engine(setup), role="prefill")
+    r.add_replica("p1", make_engine(setup), role="prefill")
+    r.add_replica("d0", make_engine(setup), role="decode")
+    prompt = list(range(1, 20))
+    f = r.submit(prompt, 6)
+    assert f.handoff is not None
+    first_pre = f.handoff["replica"]
+    r.kill(first_pre)
+    r.step()
+    invariants.check_fleet(r, "post-kill")
+    assert f.retries == 1
+    assert f.handoff is None or f.handoff["replica"] != first_pre
+    r.run_until_drained()
+    assert f.tokens_out == reference(setup, prompt, 6)
+    invariants.check_fleet(r, "post-drain")
+
+
+def _soak(setup, seed: int, ops: int) -> None:
+    rng = random.Random(seed)
+    r = FleetRouter(policy="prefix_affinity", disaggregate=True,
+                    kv_ship=True)
+    r.add_replica("p0", make_engine(setup), role="prefill")
+    r.add_replica("d0", make_engine(setup), role="decode")
+    r.add_replica("d1", make_engine(setup), role="decode")
+    system = list(range(1, 9))
+    reqs = []
+    added = 0
+    for i in range(ops):
+        op = rng.random()
+        if op < 0.45:
+            tail = [rng.randrange(1, 60)
+                    for _ in range(rng.randrange(2, 8))]
+            reqs.append((r.submit(system + tail, rng.randrange(2, 5)),
+                         system + tail))
+        elif op < 0.55 and added < 3:
+            # scale-up: a fresh decode replica joins mid-traffic
+            added += 1
+            r.add_replica(f"dx{added}", make_engine(setup), role="decode")
+        elif op < 0.65:
+            # abrupt loss of a random non-last decode replica
+            decs = [n for n, rep in r.replicas.items()
+                    if rep.role == "decode" and rep.state == "active"]
+            if len(decs) > 1:
+                r.kill(rng.choice(decs))
+        elif op < 0.75:
+            # drain-based scale-down of a random decode replica
+            decs = [n for n, rep in r.replicas.items()
+                    if rep.role == "decode" and rep.state == "active"]
+            if len(decs) > 1:
+                r.begin_drain(rng.choice(decs))
+        else:
+            r.step()
+        r.step()
+        invariants.check_fleet(r, f"soak seed={seed} op={i}")
+        # drained replicas are removed as the autoscaler would
+        for name, rep in list(r.replicas.items()):
+            if rep.state == "drained":
+                r.remove_replica(name)
+    r.run_until_drained()
+    invariants.check_fleet(r, f"soak seed={seed} end")
+    for freq, prompt in reqs:
+        assert freq.done
+        if freq.finish_reason == "length":
+            assert freq.tokens_out == reference(setup, prompt,
+                                                freq.max_new_tokens)
+
+
+def test_fleet_soak_fast(setup):
+    """Tier-1 cousin of the slow soak: one pinned seed, bounded ops."""
+    _soak(setup, seed=7, ops=12)
+
+
+@pytest.mark.slow  # tier-1 wall-time budget (ROADMAP maintenance): heavy variant; the fast cousin stays tier-1
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_fleet_soak(setup, seed):
+    _soak(setup, seed=seed, ops=80)

@@ -1,0 +1,266 @@
+"""Serving-fleet router (ISSUE 12 tentpole): routing policies,
+shed-retry and disaggregated prefill/decode token-exactness under both
+KV-handoff modes, and the steady-state recompile pin.
+
+Token-exactness argument under test: greedy streams are a pure function
+of (params, prompt) — so a retried stream equals an unshed run, and a
+decode leg resumed from an imported prefix equals single-replica serving
+(the prefix-cache exactness guarantee crossing a replica boundary)."""
+
+import os
+import sys
+
+import pytest
+
+pytest.importorskip("jax")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from hivedscheduler_tpu.chaos import invariants  # noqa: E402
+from hivedscheduler_tpu.common import compileguard  # noqa: E402
+from hivedscheduler_tpu.fleet import FleetRouter  # noqa: E402
+from hivedscheduler_tpu.models import serving, transformer as tm  # noqa: E402
+
+
+def cfg_of():
+    return tm.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_kv_heads=2, n_layers=1,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = cfg_of()
+    params = tm.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def make_engine(setup, paged=True, prefix_cache=8, **kw):
+    cfg, params = setup
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 64)
+    if paged:
+        kw.setdefault("page_size", 8)
+    return serving.ServingEngine(params, cfg, prefix_cache_size=prefix_cache,
+                                 **kw)
+
+
+_REF_CACHE = {}
+
+
+def reference_stream(setup, prompt, budget, paged=True):
+    """Single-replica reference. ONE shared engine per backend serves
+    every reference serially — greedy streams depend only on (params,
+    prompt), so carried cache state cannot change them (and the shared
+    engine keeps the per-test JIT cost down, the tier-1 budget rule)."""
+    key = (tuple(prompt), budget, paged)
+    if key not in _REF_CACHE:
+        ekey = ("eng", paged)
+        if ekey not in _REF_CACHE:
+            _REF_CACHE[ekey] = make_engine(setup, paged=paged)
+        eng = _REF_CACHE[ekey]
+        req = eng.submit(list(prompt), budget)
+        eng.run_until_drained()
+        _REF_CACHE[key] = list(req.tokens_out)
+    return _REF_CACHE[key]
+
+
+PROMPTS = [list(range(1, 12)), [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9]]
+
+
+# ---------------------------------------------------------------------------
+# routing policies
+# ---------------------------------------------------------------------------
+
+class TestRoutingPolicies:
+    def test_least_blocks_spread_snapshot_publish(self, setup):
+        """One router exercise covers the least-blocks spread, the
+        /v1/inspect/fleet snapshot shape, and publish/unpublish (merged
+        — the tier-1 wall-time budget rule)."""
+        from hivedscheduler_tpu import fleet as fleet_pkg
+
+        r = FleetRouter()
+        r.add_replica("a", make_engine(setup))
+        r.add_replica("b", make_engine(setup))
+        f1 = r.submit(PROMPTS[0], 4)
+        f2 = r.submit(PROMPTS[1], 4)
+        # the first request's queued footprint makes `a` heavier
+        assert {f1.replica, f2.replica} == {"a", "b"}
+        r.run_until_drained()
+        assert all(f.finish_reason == "length" for f in (f1, f2))
+        invariants.check_fleet(r, "least-blocks")
+        fleet_pkg.publish(r)
+        try:
+            snap = fleet_pkg.published().snapshot()
+        finally:
+            fleet_pkg.publish(None)
+        assert fleet_pkg.published() is None
+        assert snap["requests"]["done"] == 2
+        assert {rep["name"] for rep in snap["replicas"]} == {"a", "b"}
+        assert snap["policy"] == "least_blocks"
+
+    def test_prefix_affinity_routes_to_caching_replica(self, setup):
+        r = FleetRouter(policy="prefix_affinity")
+        r.add_replica("a", make_engine(setup))
+        r.add_replica("b", make_engine(setup))
+        system = list(range(1, 17))  # two full blocks: indexable boundaries
+        f1 = r.submit(system + [40, 41], 3)
+        r.run_until_drained()
+        first = f1.replica
+        # keep the OTHER replica idle: least-blocks would pick it, so a
+        # route back to `first` can only be the affinity index
+        f2 = r.submit(system + [50, 51, 52], 3)
+        assert f2.replica == first
+        assert r.affinity_hits >= 1
+        r.run_until_drained()
+        # the hit really lands in the caching replica's prefix cache
+        eng = r.replicas[first].engine
+        assert eng.prefix_hits >= 1
+        invariants.check_fleet(r, "affinity")
+
+# ---------------------------------------------------------------------------
+# shed retry
+# ---------------------------------------------------------------------------
+
+class TestShedRetry:
+    def test_shed_retry_token_exact_then_exhausted(self, setup):
+        """Two scenarios through ONE pair of engines (tier-1 budget):
+        (1) a shed waiter retries on another replica, token-exact vs an
+        un-shed run; (2) with no alternative left, retries exhaust and
+        the request FINISHES with the shed reason — never a silent loss.
+        Replica `a` sheds queued waiters on a VIRTUAL deadline (the
+        engine's injectable clock — deterministic on a loaded box);
+        max_batch=1 so a second submit queues behind the first."""
+        clk = [0.0]
+        r = FleetRouter()
+        r.add_replica("a", make_engine(setup, max_batch=1,
+                                       queue_timeout_s=0.5,
+                                       clock=lambda: clk[0]))
+        f1 = r.submit(PROMPTS[0], 8)
+        f2 = r.submit(PROMPTS[1], 8)
+        assert f2.replica == "a"  # queued behind f1
+        r.step()  # f1 admitted, f2 waiting
+        r.add_replica("b", make_engine(setup, max_batch=1))
+        clk[0] = 1.0  # f2's queue wait blows the deadline
+        r.run_until_drained()
+        assert f2.retries == 1 and f2.replica == "b"
+        assert f2.finish_reason == "length"
+        assert f2.tokens_out == reference_stream(setup, PROMPTS[1], 8)
+        assert f1.tokens_out == reference_stream(setup, PROMPTS[0], 8)
+        invariants.check_fleet(r, "shed-retry")
+        # scenario 2: kill `b`; the only survivor sheds and no
+        # alternative exists
+        r.kill("b")
+        f3 = r.submit(PROMPTS[0], 8)
+        f4 = r.submit(PROMPTS[1], 8)
+        r.step()  # f3 admitted on a, f4 waiting
+        clk[0] = 2.0
+        r.run_until_drained()
+        assert f3.finish_reason == "length"
+        assert f4.finish_reason == "shed" and f4.tokens_out == []
+        invariants.check_fleet(r, "shed-exhausted")
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode: token-exact under both handoff modes
+# ---------------------------------------------------------------------------
+
+class TestDisaggregated:
+    def _token_exact(self, setup, kv_ship, paged, monkeypatch):
+        monkeypatch.setenv("HIVED_FLEET_KV_SHIP", "1" if kv_ship else "0")
+        r = FleetRouter(disaggregate=True)
+        assert r.kv_ship is kv_ship  # the env flag selects the mode
+        r.add_replica("p0", make_engine(setup, paged=paged), role="prefill")
+        r.add_replica("d0", make_engine(setup, paged=paged), role="decode")
+        reqs = [r.submit(p, 6) for p in PROMPTS]
+        r.run_until_drained()
+        for freq, prompt in zip(reqs, PROMPTS):
+            assert freq.tokens_out == reference_stream(
+                setup, prompt, 6, paged=paged), (freq.fid, r.kv_ship)
+        if kv_ship:
+            assert r.handoffs["ship"] == len(PROMPTS)  # both prompts
+            # shipped blocks really SKIP the decode-side prefill: each
+            # decode leg restores the imported leading block instead of
+            # recomputing it (the point of shipping, not just exactness)
+            dec = r.replicas["d0"].engine
+            assert dec.prefix_hits == len(PROMPTS)
+            # both prompts (11 and 13 tokens) ship an 8-token leading
+            # chunk under either boundary rule (block 8 / pow2 8)
+            assert dec.prefix_tokens_reused == 8 * len(PROMPTS)
+        else:
+            assert r.handoffs["reprefill"] == len(PROMPTS)
+        invariants.check_fleet(r, f"disagg ship={kv_ship}")
+
+    # tier-1 covers BOTH handoff modes on the paged backend (the
+    # production config); the dense variants ride the slow tier —
+    # the ROADMAP wall-time budget move
+    @pytest.mark.parametrize("kv_ship", [True, False])
+    def test_token_exact_vs_single_replica(self, setup, kv_ship,
+                                           monkeypatch):
+        self._token_exact(setup, kv_ship, True, monkeypatch)
+
+    @pytest.mark.slow  # tier-1 wall-time budget: dense cousins of the paged tier-1 pair
+    @pytest.mark.parametrize("kv_ship", [True, False])
+    def test_token_exact_dense(self, setup, kv_ship, monkeypatch):
+        self._token_exact(setup, kv_ship, False, monkeypatch)
+
+    def test_speculative_engine_rejected_in_ship_mode(self, setup):
+        cfg, params = setup
+        from hivedscheduler_tpu.models.speculative import (
+            SpecDecodeConfig,
+            derive_draft_config,
+        )
+
+        dft_cfg = derive_draft_config(cfg, 1, 0)
+        dft_params = tm.init_params(dft_cfg, jax.random.PRNGKey(7))
+        eng = serving.ServingEngine(
+            params, cfg, max_batch=2, max_len=64, prefix_cache_size=8,
+            spec_decode=SpecDecodeConfig(draft_params=dft_params,
+                                         draft_cfg=dft_cfg, gamma=2))
+        r = FleetRouter(disaggregate=True, kv_ship=True)
+        with pytest.raises(ValueError, match="HIVED_FLEET_KV_SHIP=0"):
+            r.add_replica("p0", eng, role="prefill")
+
+    def test_ship_mode_requires_prefix_cache(self, setup):
+        r = FleetRouter(disaggregate=True, kv_ship=True)
+        with pytest.raises(ValueError, match="prefix_cache_size > 0"):
+            r.add_replica("p0", make_engine(setup, prefix_cache=0),
+                          role="prefill")
+
+
+# ---------------------------------------------------------------------------
+# steady-state recompiles (HIVED_COMPILE_GUARD pin, per replica)
+# ---------------------------------------------------------------------------
+
+class TestCompileGuard:
+    def test_disagg_fleet_steady_state_zero_recompiles(self, setup,
+                                                       monkeypatch):
+        monkeypatch.setenv("HIVED_COMPILE_GUARD", "1")
+        compileguard.reset()
+        r = FleetRouter(disaggregate=True, kv_ship=True)
+        r.add_replica("p0", make_engine(setup), role="prefill")
+        r.add_replica("d0", make_engine(setup), role="decode")
+        # warm: fresh prompts covering the workload's shapes (full-prompt
+        # prefill bucket, the import path's block writes, the tail
+        # prefill bucket, decode)
+        warm = [r.submit(list(range(1, 12)), 4),
+                r.submit(list(range(30, 41)), 4)]
+        r.run_until_drained()
+        assert all(w.done for w in warm)
+        # steady state: DIFFERENT prompts of the same shape — every
+        # program is already compiled, per replica
+        with compileguard.budget(0):
+            reqs = [r.submit([int(t) % 60 + 1 for t in range(i, i + 11)], 4)
+                    for i in (5, 17)]
+            r.run_until_drained()
+        assert all(f.finish_reason == "length" for f in reqs)
+        compileguard.reset()
+
+
+# /v1/inspect/fleet: the published-router snapshot over HTTP is covered
+# by test_inspect_endpoints' prefix discovery; publish/unpublish rides
+# TestRoutingPolicies.test_least_blocks_spread_snapshot_publish above.
