@@ -74,6 +74,13 @@ GANGS_PATH = INSPECT_PATH + "/gangs"
 # serving fleet tier (fleet/router.py): the published router's
 # copy-on-read snapshot (replicas, handoffs, retries, autoscale state)
 FLEET_PATH = INSPECT_PATH + "/fleet"
+# request flight recorder + SLO layer (obs/journal.py REQUEST_LEGS +
+# obs/slo.py): per-request TTFT leg summaries
+# (GET /v1/inspect/requests/<id>/timeline for one flight's causal events
+# + leg decomposition) and the declared objectives' windowed quantiles /
+# burn rates / violation attribution
+REQUESTS_PATH = INSPECT_PATH + "/requests"
+SLO_PATH = INSPECT_PATH + "/slo"
 
 # --- Config (reference: constants.go:65) ------------------------------------
 ENV_CONFIG_FILE = "CONFIG"

@@ -543,6 +543,80 @@ def check_fleet(router, ctx: str = "") -> None:
         if name not in router.replicas:
             _fail(ctx, f"prefix-index entry names removed replica "
                        f"{name!r} — index not scrubbed at teardown")
+    check_requests(router, ctx)
+
+
+def check_requests(router, ctx: str = "") -> None:
+    """Structural invariants of the request flight recorder
+    (obs/journal.py REQUEST_LEGS), re-derived against the router's own
+    request bookkeeping. No-op while the journal is disabled, so every
+    fleet soak (``check_fleet`` calls this, and ``check_all(router=)``
+    calls ``check_fleet``) attacks the recorder for free once it opts in:
+
+    - **Exactly one terminal leg**: a done request's flight has one
+      ``note_request_done`` terminal — never zero (a finish the recorder
+      missed) nor two (a double close); a live request has none.
+    - **Legs are exclusive, non-overlapping and contiguous** (each leg
+      starts where the previous ended), and their sum never exceeds the
+      request's wall time.
+    - **TTFT legs sum to the measured ttft_s** (the ``ttft_gap`` the
+      journal computed at terminal is ~0): an uninstrumented segment on
+      the request path shows up here, not in a dashboard.
+    - **Retries re-attribute**: every counted retry left a ``retry``
+      leg — no time is lost between shed and retry.
+    """
+    from hivedscheduler_tpu.obs import journal as obs_journal
+
+    j = obs_journal.JOURNAL
+    if not j.enabled:
+        return
+    flights = j.flights()
+    for freq in router.requests:
+        key = f"fleet/{freq.fid}"
+        fl = flights.get(key)
+        if fl is None or not fl["opened"]:
+            # journal enabled mid-flight (or another router's incarnation
+            # overwrote the key): no complete record to check
+            continue
+        legs = fl["legs"]
+        for (l1, s1, e1), (l2, s2, e2) in zip(legs, legs[1:]):
+            if s2 < e1 - 1e-9:
+                _fail(ctx, f"request {key}: legs {l1!r} [{s1}, {e1}] and "
+                           f"{l2!r} [{s2}, {e2}] overlap")
+            if s2 > e2 + 1e-9:
+                _fail(ctx, f"request {key}: leg {l2!r} is negative")
+        if any(s2 > e1 + 1e-9
+               for (_l1, _s1, e1), (_l2, s2, _e2) in zip(legs, legs[1:])):
+            _fail(ctx, f"request {key}: legs are not contiguous — an "
+                       f"interval on the request path went unattributed")
+        if freq.done:
+            if fl["terminals"] == 0:
+                _fail(ctx, f"request {key} is done "
+                           f"({freq.finish_reason}) but its flight never "
+                           f"reached a terminal leg")
+            if fl["terminals"] > 1:
+                _fail(ctx, f"request {key} reached {fl['terminals']} "
+                           f"terminal legs — exactly one is the contract")
+            wall = (freq.done_at or 0.0) - freq.submitted_at
+            total = sum(e - s for _l, s, e in legs)
+            if total > wall + 1e-6:
+                _fail(ctx, f"request {key}: leg sum {total:.6f}s exceeds "
+                           f"wall time {wall:.6f}s")
+            gap = fl["ttft_gap"]
+            if freq.ttft_s is not None and gap is not None \
+                    and abs(gap) > 1e-6:
+                _fail(ctx, f"request {key}: TTFT legs sum differs from "
+                           f"measured ttft_s by {gap:+.9f}s — an "
+                           f"uninstrumented (or double-counted) segment "
+                           f"on the request path")
+            retry_legs = sum(1 for leg, _s, _e in legs if leg == "retry")
+            if retry_legs < freq.retries:
+                _fail(ctx, f"request {key}: {freq.retries} retries but "
+                           f"only {retry_legs} `retry` legs — a leg was "
+                           f"lost between shed and retry")
+        elif fl["terminals"]:
+            _fail(ctx, f"request {key} is live but its flight already "
+                       f"reached a terminal leg")
 
 
 # ---------------------------------------------------------------------------

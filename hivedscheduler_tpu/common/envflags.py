@@ -136,6 +136,12 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
        "`1` enables the span tracer at import time (ad-hoc runs; "
        "programmatic `trace.enable()` otherwise).",
        "hivedscheduler_tpu/obs/trace.py"),
+    _f("HIVED_SLO_WINDOW_S", "60",
+       "Default sliding window (seconds) for the SLO tracker's windowed "
+       "quantiles and error-budget burn rates (obs/slo.py); `0` disables "
+       "time-windowing (pure last-N ring semantics). Overridden by "
+       "`serve --slo-window-s` / the fleet config `slo_window_s` key.",
+       "hivedscheduler_tpu/obs/slo.py"),
     _f("HIVED_JOURNAL", "0",
        "`1` enables the gang-lifecycle flight recorder at import time "
        "(programmatic `journal.enable()` / the CLIs' `--journal-file` "

@@ -66,10 +66,13 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # scheduler lock — scale backends run outside the router lock).
     "fleet_router_lock": 70,
     # observability leaves: nothing is ever acquired under these.
-    # (journal_lock sits just below metrics_lock: closing a wait interval
-    # observes the gang-wait histogram while holding it — the one legal
-    # under-journal acquisition.)
+    # (journal_lock and slo_lock sit just below metrics_lock: closing a
+    # wait interval / observing an SLO datapoint observes histograms and
+    # gauges while holding them — the one legal under-leaf acquisition.)
     "journal_lock": 78,
+    # obs/slo.py — SLO tracker observations/quantiles. Acquired under the
+    # fleet router lock (harvest observes TTFTs) and by webserver reads.
+    "slo_lock": 79,
     "metrics_lock": 80,
     "trace_lock": 82,
     "decisions_lock": 84,
@@ -87,6 +90,7 @@ LOCK_SITES: Dict[str, str] = {
     "store_lock": "hivedscheduler_tpu/k8s/fake.py",
     "fleet_router_lock": "hivedscheduler_tpu/fleet/router.py",
     "journal_lock": "hivedscheduler_tpu/obs/journal.py",
+    "slo_lock": "hivedscheduler_tpu/obs/slo.py",
     "metrics_lock": "hivedscheduler_tpu/runtime/metrics.py",
     "trace_lock": "hivedscheduler_tpu/obs/trace.py",
     "decisions_lock": "hivedscheduler_tpu/obs/decisions.py",

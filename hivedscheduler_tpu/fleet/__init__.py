@@ -68,6 +68,14 @@ class FleetConfig:
     cooldown_s: float = -1.0
     up_stable_ticks: int = 2
     down_stable_ticks: int = 4
+    # declared SLOs (obs/slo.py): 0 = the objective is not declared.
+    # slo_window_s < 0 reads HIVED_SLO_WINDOW_S (0 = no time window);
+    # slo_ttft_p99_by_priority maps priority class -> ceiling seconds
+    slo_ttft_p99_s: float = 0.0
+    slo_tpot_p95_s: float = 0.0
+    slo_window_s: float = -1.0
+    slo_ttft_p99_by_priority: Dict[int, float] = dataclasses.field(
+        default_factory=dict)
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "FleetConfig":
@@ -76,6 +84,11 @@ class FleetConfig:
         if unknown:
             raise ValueError(f"unknown fleet config keys: {unknown} "
                              f"(known: {sorted(fields)})")
+        d = dict(d)
+        if "slo_ttft_p99_by_priority" in d:
+            d["slo_ttft_p99_by_priority"] = {
+                int(k): float(v)
+                for k, v in (d["slo_ttft_p99_by_priority"] or {}).items()}
         return FleetConfig(**d)
 
     @staticmethod
@@ -100,4 +113,21 @@ class FleetConfig:
             queue_high=self.queue_high, cooldown_s=self.cooldown_s,
             up_stable_ticks=self.up_stable_ticks,
             down_stable_ticks=self.down_stable_ticks,
+        )
+
+    def slo_tracker(self, clock=None, metrics: bool = True):
+        """Build the router's :class:`obs.slo.SLOTracker` from the
+        declared ``slo_*`` knobs (objectives may be empty — the tracker
+        still feeds the autoscaler's quantile signal)."""
+        import time as _time
+
+        from hivedscheduler_tpu.obs import slo as obs_slo
+
+        return obs_slo.SLOTracker(
+            objectives=obs_slo.objectives_from_knobs(
+                ttft_p99_s=self.slo_ttft_p99_s,
+                tpot_p95_s=self.slo_tpot_p95_s,
+                per_priority_ttft_p99=self.slo_ttft_p99_by_priority),
+            window_s=None if self.slo_window_s < 0 else self.slo_window_s,
+            clock=clock or _time.perf_counter, metrics=metrics,
         )

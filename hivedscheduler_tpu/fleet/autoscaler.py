@@ -3,8 +3,10 @@
 ``FleetAutoscaler`` closes the serving->scheduler loop: it reads the
 signals the serving tier already exports — block-pool occupancy (the
 ``tpu_hive_serve_block_pool_occupancy`` gauge's source fields, read
-per-engine), queue depth, and recent TTFT — and decides a target replica
-count per role. Decisions are deliberately boring control theory:
+per-engine), queue depth, and the router's SLO tracker's windowed TTFT
+quantile (``obs/slo.py`` — the scaling signal and the reported SLO are
+one number) — and decides a target replica count per role. Decisions are
+deliberately boring control theory:
 
 - **hysteresis**: scale up only after ``up_stable_ticks`` consecutive
   ticks of up-pressure (occupancy above ``occ_high``, queue depth above
@@ -223,13 +225,17 @@ class FleetAutoscaler:
                 occs.append(
                     sum(s is not None for s in eng.slots) / eng.max_batch)
             qdepth += len(eng.queue)
-        ttfts = sorted(t for _at, t in self.router.recent_ttfts)
-        p95 = ttfts[int(0.95 * (len(ttfts) - 1))] if ttfts else 0.0
+        # the SLO tracker's windowed quantile (obs/slo.py) — the SAME
+        # computation /v1/inspect/slo reports, replacing the pre-ISSUE-13
+        # hand-sorted recent_ttfts ring (decision-identical: pinned by
+        # tests/test_request_flights.py)
+        slo = self.router.slo
         return {
             "replicas": len(reps),
             "occupancy": sum(occs) / len(occs) if occs else 0.0,
             "queueDepth": qdepth,
-            "ttftP95": p95,
+            "ttftP95": slo.quantile(0.95, "ttft"),
+            "ttftP99": slo.quantile(0.99, "ttft"),
         }
 
     # -- the loop ----------------------------------------------------------

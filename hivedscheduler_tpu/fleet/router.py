@@ -41,13 +41,23 @@ holding it; the autoscaler's scale backends run outside it.
 Chaos invariants (``chaos.invariants.check_fleet``): no request is lost
 between shed and retry, no stream is double-routed, scale-down always
 drains before teardown, and a handoff never leaves orphaned blocks.
+
+Observability (ISSUE 13): with the journal enabled every fleet request
+is a recorded **flight** (``fleet/<fid>``) — the router marks the
+``route``/``router_queue``/``retry``/``handoff_ship``/``handoff_import``
+legs, the engines mark ``admission_wait`` and ``prefill``/
+``first_decode``, and the legs up to the first token sum to the measured
+``ttft_s`` (``chaos.invariants.check_requests``). Every finished request
+is observed into ``self.slo`` (:class:`obs.slo.SLOTracker`) with its
+dominant-leg attribution; the autoscaler's TTFT up-pressure reads the
+same tracker.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
-from collections import OrderedDict, deque
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from hivedscheduler_tpu.common import envflags, lockcheck
@@ -58,6 +68,7 @@ from hivedscheduler_tpu.models.serving import (
     SpeculativeServingEngine,
 )
 from hivedscheduler_tpu.obs import journal as obs_journal
+from hivedscheduler_tpu.obs import slo as obs_slo
 from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
 
 _POLICIES = ("least_blocks", "prefix_affinity")
@@ -154,6 +165,18 @@ class FleetRequest:
             return None
         return self.first_token_at - self.submitted_at
 
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Mean seconds per output token after the first (None until done
+        or when only one token was emitted) — the fleet twin of
+        ``Request.tpot_s``, observed into the SLO tracker."""
+        if self.done_at is None or self.first_token_at is None:
+            return None
+        n = len(self.tokens_out) - 1
+        if n <= 0:
+            return None
+        return (self.done_at - self.first_token_at) / n
+
 
 class FleetRouter:
     """See the module docstring. Engines must be config-identical
@@ -166,6 +189,7 @@ class FleetRouter:
                  kv_ship: Optional[bool] = None,
                  max_retries: int = 2,
                  affinity_index_cap: int = 4096,
+                 slo: Optional[obs_slo.SLOTracker] = None,
                  clock=time.perf_counter):
         if policy not in _POLICIES:
             raise ValueError(f"unknown routing policy {policy!r} "
@@ -189,7 +213,12 @@ class FleetRouter:
         self.handoffs = {"ship": 0, "miss": 0, "reprefill": 0}
         self.retried = 0
         self.affinity_hits = 0
-        self.recent_ttfts: deque = deque(maxlen=256)  # (done_at, ttft_s)
+        # windowed TTFT/TPOT observations + declared-objective accounting
+        # (obs/slo.py): the autoscaler's up-pressure signal, the
+        # /v1/inspect/slo payload, and the tpu_hive_slo_* exposition are
+        # all THIS tracker — one computation, one number
+        self.slo = slo if slo is not None else obs_slo.SLOTracker(
+            clock=clock)
 
     # -- replica lifecycle -------------------------------------------------
     def add_replica(self, name: str, engine: ServingEngine,
@@ -328,6 +357,10 @@ class FleetRouter:
                                 submitted_at=self._clock())
             self._next_fid += 1
             self.requests.append(freq)
+            if obs_journal.JOURNAL.enabled:
+                obs_journal.note_request_submit(
+                    f"fleet/{freq.fid}", at=freq.submitted_at,
+                    priority=priority, promptTokens=len(freq.prompt))
             self._dispatch_locked(freq)
         return freq
 
@@ -345,6 +378,10 @@ class FleetRouter:
                 pre.routed += 1
                 freq.handoff = {"replica": pre.name, "req": req}
                 if obs_journal.JOURNAL.enabled:
+                    req.flight = f"fleet/{freq.fid}"
+                    obs_journal.note_leg(f"fleet/{freq.fid}", "route",
+                                         at=req.submitted_at,
+                                         replica=pre.name)
                     obs_journal.emit("fleet_route", f"fleet/{freq.fid}",
                                      leg="prefill", replica=pre.name,
                                      policy=self.policy)
@@ -362,7 +399,8 @@ class FleetRouter:
 
     def _dispatch_decode_locked(self, freq: FleetRequest, exclude=(),
                                 cause: Optional[int] = None,
-                                prefer: Optional[Replica] = None) -> None:
+                                prefer: Optional[Replica] = None,
+                                imported: bool = False) -> None:
         dec = prefer
         if dec is None or dec.state != "active" or dec.name in exclude:
             dec = self._pick_locked(freq.prompt, "decode", exclude)
@@ -372,6 +410,7 @@ class FleetRouter:
             freq.done_at = self._clock()
             metrics.inc("tpu_hive_fleet_requests_total",
                         outcome="no_replica")
+            self._finish_flight_locked(freq)
             return
         try:
             req = dec.engine.submit(list(freq.prompt), freq.max_new_tokens,
@@ -380,13 +419,22 @@ class FleetRouter:
             dec.state = "draining"
             self._dispatch_decode_locked(freq,
                                          tuple(exclude) + (dec.name,),
-                                         cause=cause)
+                                         cause=cause, imported=imported)
             return
         dec.routed += 1
         freq.attempts.append((dec.name, req))
         freq.replica = dec.name
         self._register_affinity_locked(freq.prompt, dec)
         if obs_journal.JOURNAL.enabled:
+            # the leg's engine marks (admission_wait + prefill/
+            # first_decode) attribute into this fleet flight; ``imported``
+            # legs resume from a shipped prefix, so their first token is
+            # the `first_decode` leg, not a full `prefill`
+            req.flight = f"fleet/{freq.fid}"
+            req.flight_decode = imported
+            obs_journal.note_leg(f"fleet/{freq.fid}", "route",
+                                 at=req.submitted_at, cause=cause,
+                                 replica=dec.name)
             obs_journal.emit("fleet_route", f"fleet/{freq.fid}",
                              cause=cause, leg="decode", replica=dec.name,
                              policy=self.policy)
@@ -432,6 +480,12 @@ class FleetRouter:
                 self.retried += 1
                 metrics.inc("tpu_hive_fleet_retries_total", leg="prefill")
                 if obs_journal.JOURNAL.enabled:
+                    # re-attribution: the lost leg's whole interval lands
+                    # in `retry` — nothing between shed and retry is lost
+                    obs_journal.note_leg(f"fleet/{freq.fid}", "retry",
+                                         at=self._clock(),
+                                         fromReplica=h["replica"],
+                                         reason="replica_lost")
                     obs_journal.emit("fleet_retry", f"fleet/{freq.fid}",
                                      leg="prefill",
                                      fromReplica=h["replica"],
@@ -442,29 +496,53 @@ class FleetRouter:
                 continue
             cause = self._leg_cause_locked(req)
             freq.handoff = None
+            journaled = obs_journal.JOURNAL.enabled
             if req.finish_reason in _RETRYABLE:
                 # the prefill leg itself was shed/preempted: re-prefill on
                 # the decode side (counted as a miss — no KV crossed)
+                if journaled:
+                    obs_journal.note_leg(f"fleet/{freq.fid}", "retry",
+                                         at=self._clock(), cause=cause,
+                                         fromReplica=rep.name,
+                                         reason=req.finish_reason)
                 mode, prefer = "miss", None
             else:
                 if freq.first_token_at is None:
                     freq.first_token_at = req.first_token_at
+                if journaled:
+                    # the gap between the prefill leg finishing and THIS
+                    # router step picking the handoff up
+                    obs_journal.note_leg(f"fleet/{freq.fid}",
+                                         "router_queue",
+                                         at=self._clock(), cause=cause)
                 prefer = self._pick_locked(freq.prompt, "decode")
                 exp = rep.engine.export_prefix(freq.prompt)
+                if journaled:
+                    obs_journal.note_leg(f"fleet/{freq.fid}",
+                                         "handoff_ship", at=self._clock(),
+                                         fromReplica=rep.name,
+                                         hit=exp is not None)
                 if exp is not None and prefer is not None:
-                    key, plen, data = exp
-                    prefer.engine.import_prefix(key, plen, data)
-                    self._register_affinity_locked(list(key), prefer)
+                    pkey, plen, data = exp
+                    prefer.engine.import_prefix(pkey, plen, data)
+                    self._register_affinity_locked(list(pkey), prefer)
+                    if journaled:
+                        obs_journal.note_leg(f"fleet/{freq.fid}",
+                                             "handoff_import",
+                                             at=self._clock(),
+                                             toReplica=prefer.name,
+                                             prefixTokens=plen)
                     mode = "ship"
                 else:
                     mode = "miss"
             self.handoffs[mode] += 1
             metrics.inc("tpu_hive_fleet_handoffs_total", mode=mode)
-            if obs_journal.JOURNAL.enabled:
+            if journaled:
                 obs_journal.emit("fleet_handoff", f"fleet/{freq.fid}",
                                  cause=cause, mode=mode,
                                  fromReplica=rep.name)
-            self._dispatch_decode_locked(freq, cause=cause, prefer=prefer)
+            self._dispatch_decode_locked(freq, cause=cause, prefer=prefer,
+                                         imported=mode == "ship")
 
     def _harvest_locked(self) -> None:
         for freq in self.requests:
@@ -498,6 +576,10 @@ class FleetRouter:
                     metrics.inc("tpu_hive_fleet_retries_total", leg="decode")
                     cause = self._leg_cause_locked(req)
                     if obs_journal.JOURNAL.enabled:
+                        obs_journal.note_leg(f"fleet/{freq.fid}", "retry",
+                                             at=self._clock(), cause=cause,
+                                             fromReplica=rep_name,
+                                             reason=reason)
                         obs_journal.emit("fleet_retry", f"fleet/{freq.fid}",
                                          cause=cause, leg="decode",
                                          fromReplica=rep_name,
@@ -515,9 +597,29 @@ class FleetRouter:
                 firsts = [r.first_token_at for _n, r in freq.attempts
                           if r.first_token_at is not None]
                 freq.first_token_at = min(firsts) if firsts else None
-            if freq.ttft_s is not None:
-                self.recent_ttfts.append((freq.done_at, freq.ttft_s))
+            self._finish_flight_locked(freq)
             metrics.inc("tpu_hive_fleet_requests_total", outcome=reason)
+
+    def _finish_flight_locked(self, freq: FleetRequest) -> None:
+        """ONE home for a fleet request's terminal: close the journal
+        flight (the sum-to-ttft accounting happens there) and observe the
+        request into the SLO tracker with its dominant-leg attribution —
+        the autoscaler's signal and the /v1/inspect/slo payload both read
+        that tracker."""
+        dom = ""
+        if obs_journal.JOURNAL.enabled:
+            key = f"fleet/{freq.fid}"
+            obs_journal.note_request_done(
+                key, freq.finish_reason,
+                first_token_at=freq.first_token_at, at=freq.done_at,
+                retries=freq.retries, tokensOut=len(freq.tokens_out))
+            dom = obs_journal.JOURNAL.request_dominant_leg(key)
+        if freq.ttft_s is not None:
+            self.slo.observe("ttft", freq.ttft_s, priority=freq.priority,
+                             leg=dom, at=freq.done_at)
+        if freq.tpot_s is not None:
+            self.slo.observe("tpot", freq.tpot_s, priority=freq.priority,
+                             leg=dom, at=freq.done_at)
 
     def _advance_drains_locked(self) -> None:
         for rep in self.replicas.values():

@@ -514,6 +514,16 @@ class Request:
     admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
     done_at: Optional[float] = None
+    # request-flight recording (obs/journal.py REQUEST_LEGS): the journal
+    # key this request's admission/first-token marks attribute into.
+    # ``flight_decode`` picks the first-token leg name (``first_decode``
+    # for a post-handoff decode leg, ``prefill`` otherwise);
+    # ``flight_local`` means THIS engine owns the terminal (self-installed
+    # via ``record_flights`` — fleet-installed flights are terminated by
+    # the router, which outlives any one leg).
+    flight: Optional[str] = None
+    flight_decode: bool = False
+    flight_local: bool = False
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -556,6 +566,13 @@ class ServingEngine:
     continuous batching, chunked prefill, the prefix cache and the paged
     KV cache, not a separate side engine.
     """
+
+    # opt-in request-flight recording for SINGLE-engine serving: submit()
+    # then opens a serve/<rid> flight in the journal and the engine owns
+    # its terminal (serve.py flips this with --journal-file/HIVED_JOURNAL;
+    # fleet-routed engines leave it False — the router installs fleet/<fid>
+    # flights on the legs it dispatches)
+    record_flights = False
 
     def __new__(cls, *args, **kw):
         # first-class speculative mode: spec_decode= routes construction to
@@ -1261,6 +1278,16 @@ class ServingEngine:
         req = Request(self._next_rid, list(prompt), max_new_tokens,
                       priority=priority, submitted_at=self._clock())
         self._next_rid += 1
+        if self.record_flights and obs_journal.JOURNAL.enabled:
+            # single-engine flight (serve CLI): this engine owns the whole
+            # request path, terminal included. Fleet legs instead carry
+            # the router-installed fleet/<fid> flight (req.flight set by
+            # FleetRouter after this submit returns).
+            req.flight = f"serve/{req.rid}"
+            req.flight_local = True
+            obs_journal.note_request_submit(
+                req.flight, at=req.submitted_at, priority=priority,
+                promptTokens=len(req.prompt))
         # stable insertion keeps FIFO within a priority level: insert
         # before the first strictly-lower-priority waiter
         at = len(self.queue)
@@ -1466,6 +1493,10 @@ class ServingEngine:
                 metrics.inc("tpu_hive_serve_shed_total",
                             priority=str(req.priority))
                 if obs_journal.JOURNAL.enabled:
+                    if req.flight_local:
+                        obs_journal.note_request_done(
+                            req.flight, "shed", at=now,
+                            priority=req.priority)
                     # shed closes the request's episode (it never ran)
                     obs_journal.note_phase(
                         f"serve/{req.rid}", "closed", "serve_shed",
@@ -1514,6 +1545,9 @@ class ServingEngine:
             if obs_journal.JOURNAL.enabled:
                 obs_journal.emit("serve_admit", f"serve/{req.rid}",
                                  slot=slot, priority=req.priority)
+                if req.flight is not None:
+                    obs_journal.note_leg(req.flight, "admission_wait",
+                                         at=req.admitted_at, slot=slot)
             if hit is not None:
                 payload, plen = hit[1]
                 self.prefix_hits += 1
@@ -1655,6 +1689,16 @@ class ServingEngine:
     def _emit(self, req: Request, slot: int, tok: int) -> None:
         if req.first_token_at is None:
             req.first_token_at = self._clock()
+            if req.flight is not None and obs_journal.JOURNAL.enabled:
+                # the first-token mark closes the flight's TTFT window —
+                # new request-path code between admission and here must
+                # emit its own leg or the sum-to-ttft assertion trips
+                if req.flight_decode:
+                    obs_journal.note_leg(req.flight, "first_decode",
+                                         at=req.first_token_at)
+                else:
+                    obs_journal.note_leg(req.flight, "prefill",
+                                         at=req.first_token_at)
         req.tokens_out.append(tok)
         self._last_host[slot] = tok
         if len(req.tokens_out) >= req.max_new_tokens or tok == self.eos_id:
@@ -1672,6 +1716,11 @@ class ServingEngine:
         prio = str(req.priority)
         metrics.inc("tpu_hive_serve_requests_total", priority=prio)
         if obs_journal.JOURNAL.enabled:
+            if req.flight_local:
+                obs_journal.note_request_done(
+                    req.flight, req.finish_reason,
+                    first_token_at=req.first_token_at, at=req.done_at,
+                    tokensOut=len(req.tokens_out))
             obs_journal.note_phase(
                 f"serve/{req.rid}", "closed", "serve_finish",
                 finishReason=req.finish_reason,
@@ -1890,6 +1939,10 @@ class ServingEngine:
                     req.done = True
                     req.done_at = now
                     req.finish_reason = "preempted"
+                    if req.flight_local and obs_journal.JOURNAL.enabled:
+                        obs_journal.note_request_done(
+                            req.flight, "preempted",
+                            first_token_at=req.first_token_at, at=now)
                 self.queue.clear()
                 for slot in range(self.max_batch):
                     if self.slots[slot] is not None:

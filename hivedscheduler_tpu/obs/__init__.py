@@ -5,9 +5,15 @@ the Prometheus registry (``runtime/metrics.py``).
   Chrome-trace/Perfetto JSON export, module-level no-op fast path).
 - ``obs.decisions`` — structured scheduler decision traces ("why did this
   gang land on these cells?"), served at ``GET /v1/inspect/traces``.
+- ``obs.journal`` — gang-lifecycle flight recorder + request flights
+  (TTFT leg attribution), served at ``GET /v1/inspect/gangs`` and
+  ``GET /v1/inspect/requests``.
+- ``obs.slo`` — declared serving objectives: windowed quantiles,
+  error-budget burn rate, violation attribution by dominant leg, served
+  at ``GET /v1/inspect/slo``.
 
 See ``doc/design/observability.md`` for the full catalogue of metric
-names, trace event schemas, and the Perfetto workflow.
+names, trace event schemas, leg taxonomy, and the Perfetto workflow.
 """
 
 from hivedscheduler_tpu.obs import decisions, trace  # noqa: F401

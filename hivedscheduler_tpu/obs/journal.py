@@ -20,11 +20,26 @@ flight to unblock it" is a queryable fact, not a bench.py post-hoc guess
 (BENCH_r05's 89.2% "packing" wait turned out to be ~100% VC-quota
 stranding only after manual measurement).
 
+The same recorder also carries **request flights** (ISSUE 13): every
+serving request — fleet-routed (``fleet/<fid>``) or single-engine
+(``serve/<rid>``) — is a cause-chained sequence of exclusive,
+non-overlapping **legs** (:data:`REQUEST_LEGS`:
+route/router_queue/retry/admission_wait/prefill/handoff_ship/
+handoff_import/first_decode) opened by ``note_request_submit``, advanced
+by ``note_leg`` and closed by ``note_request_done``. The legs ending at
+or before the first-token mark sum to the measured ``ttft_s`` (the
+stored ``ttft_gap`` is asserted ~0 by ``chaos.invariants.check_requests``
+and the bench fleet stage), each closed leg is observed into
+``tpu_hive_request_leg_seconds{leg=}``, and ``obs/slo.py`` attributes
+SLO violations to the dominant leg.
+
 Served three ways:
 
 - ``GET /v1/inspect/gangs`` (per-gang summaries) and
   ``GET /v1/inspect/gangs/<id>/timeline`` (the causal event list) —
-  copy-on-read snapshots, like the other inspect endpoints;
+  copy-on-read snapshots, like the other inspect endpoints — plus the
+  request-flight twins ``GET /v1/inspect/requests`` and
+  ``GET /v1/inspect/requests/<id>/timeline``;
 - per-gang Perfetto tracks merged into the Chrome-trace export
   (:func:`Journal.chrome_events`, folded in by ``obs.trace``);
 - an optional ``--journal-file`` JSONL spool (one event per line,
@@ -64,6 +79,7 @@ from hivedscheduler_tpu.common import lockcheck
 _DEFAULT_CAPACITY = 16384
 _MAX_GANGS = 4096
 _MAX_INTERVALS_PER_GANG = 64
+_MAX_LEGS_PER_REQUEST = 64
 
 # ---------------------------------------------------------------------------
 # wait-attribution taxonomy. Buckets are monotonic accounting categories:
@@ -88,6 +104,38 @@ WAIT_BUCKETS: Dict[str, str] = {
                         "grow-promotion back to full shape",
     "unknown": "wait reason not classified (classifier fallback — a "
                "growing share here is a bug)",
+}
+
+# ---------------------------------------------------------------------------
+# request-leg taxonomy: the serving tier's analogue of WAIT_BUCKETS. A
+# request flight is a contiguous sequence of exclusive, non-overlapping
+# legs — each ``note_leg(req, leg, at=t)`` attributes the interval from
+# the flight's previous mark to ``t`` to exactly one leg, so the legs up
+# to the first-token mark SUM to the measured TTFT (asserted by
+# ``note_request_done``'s gap accounting, ``chaos.invariants
+# .check_requests``, and the bench fleet stage — not plotted and hoped).
+# hivedlint OBS001 cross-checks every ``note_leg`` literal against this
+# table, both directions.
+# ---------------------------------------------------------------------------
+REQUEST_LEGS: Dict[str, str] = {
+    "route": "router dispatch: fleet submit (or retry re-dispatch) to the "
+             "chosen replica engine's own submit timestamp",
+    "router_queue": "a completed prefill leg waiting for the router step "
+                    "that advances its KV handoff",
+    "retry": "a shed/preempted/lost leg's whole wasted attempt, up to the "
+             "moment the router abandons it (re-attribution: no time is "
+             "lost between shed and retry)",
+    "admission_wait": "engine queue wait: engine submit to slot admission "
+                      "(the strict-priority / block-availability gate)",
+    "prefill": "slot admission to the leg's first emitted token on a "
+               "prefill-role or unified replica (prompt prefill)",
+    "handoff_ship": "host-side export of the prefill replica's prefix-"
+                    "cache payload (HIVED_FLEET_KV_SHIP=1)",
+    "handoff_import": "importing the shipped payload into the decode "
+                      "replica's block pool as refcounted prefix blocks",
+    "first_decode": "decode-leg admission to its first token after a KV "
+                    "handoff (imported-prefix restore + tail prefill + "
+                    "the first decode window)",
 }
 
 # ---------------------------------------------------------------------------
@@ -147,6 +195,16 @@ SCHEMA: Dict[str, str] = {
                    "token-exactly for greedy)",
     "fleet_scale": "autoscaler decision (direction, phase = "
                    "pending/added/draining/removed, replica, reason)",
+    # request flight recorder (fleet/router.py + models/serving.py):
+    # request-scoped, cause-chained TTFT decomposition — note_request_*
+    # and note_leg emit these (OBS001 treats each method as the emitter
+    # of its implied type)
+    "request_submit": "a request flight opened (the TTFT clock's zero "
+                      "mark; fleet/<fid> or serve/<rid>)",
+    "request_leg": "one closed flight leg (bucket = the REQUEST_LEGS "
+                   "name; legs tile the flight, TTFT legs sum to ttft_s)",
+    "request_done": "the flight's single terminal: finish reason, "
+                    "measured TTFT and the leg-sum gap in args",
     # workload supervisor (train.py / parallel/supervisor.py)
     "train_resume": "a training incarnation resumed from a committed "
                     "checkpoint (preemption/crash restart)",
@@ -239,6 +297,7 @@ class Journal:
                 "first_t": at,
                 "last_t": at,
                 "events": 0,
+                "flight": None,  # request-flight record (see _flight)
             }
             self._gangs[gang] = rec
         return rec
@@ -355,6 +414,197 @@ class Journal:
             rec["phase"] = phase
         return self._append(etype, gang, cause, "", "", at, args)
 
+    # -- request flight recorder (TTFT leg attribution) ------------------
+    def _flight(self, rec: Dict[str, Any], at: float,
+                opened: bool) -> Dict[str, Any]:
+        fl = rec["flight"]
+        if fl is None:
+            fl = rec["flight"] = {
+                "t0": at,       # flight zero mark (= submit time when opened)
+                "mark": at,     # end of the last attributed leg
+                "legs": [],     # (leg, start, end), contiguous, capped
+                "dropped_legs": 0,
+                "terminals": 0,
+                "terminal": None,       # finish reason once terminal
+                "first_token_t": None,
+                "done_t": None,
+                "ttft_gap": None,       # ttft-leg sum minus measured ttft
+                # False when the recorder was enabled mid-flight (first
+                # contact was a leg, not the submit): the TTFT gap is then
+                # unknowable and note_request_done skips the accounting
+                "opened": opened,
+            }
+        return fl
+
+    def note_request_submit(self, req: str, at: Optional[float] = None,
+                            cause: Optional[int] = None,
+                            **args: Any) -> Optional[int]:
+        """Open (or re-open — a fresh incarnation resets the record) a
+        request flight at ``at``: the zero mark every later leg and the
+        measured TTFT are anchored to."""
+        if not self.enabled or suppressed():
+            return None
+        t = time.perf_counter() if at is None else at
+        with self._lock:
+            rec = self._record(req, t)
+            rec["flight"] = None  # re-submission = a fresh incarnation
+            self._flight(rec, t, opened=True)
+        return self._append("request_submit", req, cause, "", "", at, args)
+
+    def note_leg(self, req: str, leg: str, at: Optional[float] = None,
+                 cause: Optional[int] = None, **args: Any) -> Optional[int]:
+        """Attribute the interval from the flight's previous mark to
+        ``at`` to ``leg`` (one of :data:`REQUEST_LEGS`) and advance the
+        mark — legs are exclusive and non-overlapping by construction, so
+        instrument *coverage* is what the sum-to-TTFT assertion checks."""
+        if not self.enabled or suppressed():
+            return None
+        if leg not in REQUEST_LEGS:
+            raise ValueError(
+                f"{leg!r} is not a registered request leg — add it to "
+                f"obs/journal.py REQUEST_LEGS (OBS001)")
+        t = time.perf_counter() if at is None else at
+        with self._lock:
+            rec = self._record(req, t)
+            fl = self._flight(rec, t, opened=False)
+            start = fl["mark"]
+            if t < start:  # defensive: a late-arriving mark never
+                t = start  # produces an overlapping/negative leg
+            if len(fl["legs"]) < _MAX_LEGS_PER_REQUEST:
+                fl["legs"].append((leg, start, t))
+            else:
+                fl["dropped_legs"] += 1
+            fl["mark"] = t
+            if self.metrics:
+                from hivedscheduler_tpu.runtime.metrics import REGISTRY
+                REGISTRY.observe("tpu_hive_request_leg_seconds",
+                                 max(0.0, t - start), leg=leg)
+        return self._append("request_leg", req, cause, leg, "", at,
+                            dict(args, durS=round(t - start, 6)))
+
+    def note_request_done(self, req: str, reason: str,
+                          first_token_at: Optional[float] = None,
+                          at: Optional[float] = None,
+                          cause: Optional[int] = None,
+                          **args: Any) -> Optional[int]:
+        """The flight's single terminal. ``first_token_at`` (the same
+        clock value the caller's ``ttft_s`` derives from) closes the TTFT
+        accounting: the legs ending at or before it must sum to
+        ``first_token_at - t0`` — the stored ``ttft_gap`` is the deficit,
+        and a non-zero gap means an uninstrumented segment on the request
+        path (check_requests and the bench assert it is ~0)."""
+        if not self.enabled or suppressed():
+            return None
+        t = time.perf_counter() if at is None else at
+        with self._lock:
+            rec = self._record(req, t)
+            fl = self._flight(rec, t, opened=False)
+            fl["terminals"] += 1
+            fl["terminal"] = reason
+            fl["done_t"] = t
+            gap = None
+            if first_token_at is not None:
+                fl["first_token_t"] = first_token_at
+                if fl["opened"]:
+                    ttft = first_token_at - fl["t0"]
+                    sum_legs = sum(
+                        e - s for _l, s, e in fl["legs"]
+                        if e <= first_token_at + 1e-9)
+                    gap = fl["ttft_gap"] = sum_legs - ttft
+            rec["phase"] = _PHASE_CLOSED  # eviction-eligible, no extra event
+        extra = dict(args, finishReason=reason)
+        if gap is not None:
+            extra["ttftGapS"] = round(gap, 9)
+        return self._append("request_done", req, cause, "", "", at, extra)
+
+    @staticmethod
+    def _dominant_leg_of(fl: Dict[str, Any]) -> str:
+        """The leg holding the most TTFT time (all legs when the flight
+        never emitted a token) — the SLO violation-attribution key."""
+        ft = fl["first_token_t"]
+        totals: Dict[str, float] = {}
+        for leg, s, e in fl["legs"]:
+            if ft is None or e <= ft + 1e-9:
+                totals[leg] = totals.get(leg, 0.0) + (e - s)
+        if not totals:
+            return ""
+        return max(totals.items(), key=lambda kv: (kv[1], kv[0]))[0]
+
+    def request_dominant_leg(self, req: str) -> str:
+        with self._lock:
+            rec = self._gangs.get(req)
+            if rec is None or rec["flight"] is None:
+                return ""
+            return self._dominant_leg_of(rec["flight"])
+
+    def flights(self) -> Dict[str, Dict[str, Any]]:
+        """Copy-on-read raw flight records — the invariant checks' and
+        the bench's attribution source."""
+        with self._lock:
+            out = {}
+            for gang, rec in self._gangs.items():
+                fl = rec["flight"]
+                if fl is None:
+                    continue
+                out[gang] = dict(fl, legs=list(fl["legs"]))
+            return out
+
+    def requests(self) -> List[Dict[str, Any]]:
+        """Per-request flight summaries, most recently active first (the
+        ``/v1/inspect/requests`` payload)."""
+        with self._lock:
+            out = []
+            for gang, rec in self._gangs.items():
+                fl = rec["flight"]
+                if fl is None:
+                    continue
+                legs: Dict[str, float] = {}
+                for leg, s, e in fl["legs"]:
+                    legs[leg] = legs.get(leg, 0.0) + (e - s)
+                ft = fl["first_token_t"]
+                out.append({
+                    "request": gang,
+                    "terminal": fl["terminal"],
+                    "legs": {k: round(v, 6)
+                             for k, v in sorted(legs.items())},
+                    "dominantLeg": self._dominant_leg_of(fl),
+                    "ttftS": (None if ft is None or not fl["opened"]
+                              else round(ft - fl["t0"], 6)),
+                    "ttftGapS": (None if fl["ttft_gap"] is None
+                                 else round(fl["ttft_gap"], 9)),
+                    "wallS": (None if fl["done_t"] is None
+                              else round(fl["done_t"] - fl["t0"], 6)),
+                    "lastT": rec["last_t"],
+                })
+        out.sort(key=lambda r: r.pop("lastT"), reverse=True)
+        return out
+
+    def request_timeline(self, req: str) -> Dict[str, Any]:
+        """One request's retained events in causal order plus its leg
+        decomposition (the ``/v1/inspect/requests/<id>/timeline``
+        payload)."""
+        with self._lock:
+            events = [e.to_dict() for e in self._ring if e.gang == req]
+            rec = self._gangs.get(req)
+            fl = rec["flight"] if rec is not None else None
+            legs = summary = None
+            if fl is not None:
+                legs = [{"leg": leg, "start": round(s, 6),
+                         "end": round(e, 6), "durS": round(e - s, 6)}
+                        for leg, s, e in fl["legs"]]
+                ft = fl["first_token_t"]
+                summary = {
+                    "terminal": fl["terminal"],
+                    "dominantLeg": self._dominant_leg_of(fl),
+                    "ttftS": (None if ft is None or not fl["opened"]
+                              else round(ft - fl["t0"], 6)),
+                    "ttftGapS": (None if fl["ttft_gap"] is None
+                                 else round(fl["ttft_gap"], 9)),
+                    "droppedLegs": fl["dropped_legs"],
+                }
+        return {"request": req, "events": events, "legs": legs,
+                "summary": summary, "ringEvicted": self.evicted}
+
     def last_id(self, gang: str) -> Optional[int]:
         """The gang's most recent event id (for explicit cross-gang
         causes), or None."""
@@ -446,17 +696,26 @@ class Journal:
         the span tracer's timeline."""
         with self._lock:
             lanes = {gang: rec["tid"] for gang, rec in self._gangs.items()}
+            requests = {gang for gang, rec in self._gangs.items()
+                        if rec["flight"] is not None}
             intervals = [
                 (rec["tid"], bucket, start, end)
                 for rec in self._gangs.values()
                 for bucket, start, end in rec["intervals"]
             ]
+            legs = [
+                (rec["tid"], leg, start, end)
+                for rec in self._gangs.values()
+                if rec["flight"] is not None
+                for leg, start, end in rec["flight"]["legs"]
+            ]
             events = list(self._ring)
         out: List[Dict[str, Any]] = []
         for gang, tid in lanes.items():
+            kind = "request" if gang in requests else "gang"
             out.append({"name": "thread_name", "ph": "M", "pid": 1,
                         "tid": tid, "ts": 0,
-                        "args": {"name": f"gang {gang}"}})
+                        "args": {"name": f"{kind} {gang}"}})
         for ev in events:
             tid = lanes.get(ev.gang)
             if tid is None:
@@ -473,6 +732,11 @@ class Journal:
                         "cat": "journal", "ts": (start - t0) * 1e6,
                         "dur": max(0.0, (end - start) * 1e6),
                         "pid": 1, "tid": tid, "args": {"bucket": bucket}})
+        for tid, leg, start, end in legs:
+            out.append({"name": f"leg:{leg}", "ph": "X",
+                        "cat": "journal", "ts": (start - t0) * 1e6,
+                        "dur": max(0.0, (end - start) * 1e6),
+                        "pid": 1, "tid": tid, "args": {"leg": leg}})
         return out
 
     # -- lifecycle -------------------------------------------------------
@@ -574,6 +838,27 @@ def note_phase(gang: str, phase: str, etype: str,
                **args: Any) -> Optional[int]:
     return JOURNAL.note_phase(gang, phase, etype, cause=cause, at=at,
                               **args)
+
+
+def note_request_submit(req: str, at: Optional[float] = None,
+                        cause: Optional[int] = None,
+                        **args: Any) -> Optional[int]:
+    return JOURNAL.note_request_submit(req, at=at, cause=cause, **args)
+
+
+def note_leg(req: str, leg: str, at: Optional[float] = None,
+             cause: Optional[int] = None, **args: Any) -> Optional[int]:
+    return JOURNAL.note_leg(req, leg, at=at, cause=cause, **args)
+
+
+def note_request_done(req: str, reason: str,
+                      first_token_at: Optional[float] = None,
+                      at: Optional[float] = None,
+                      cause: Optional[int] = None,
+                      **args: Any) -> Optional[int]:
+    return JOURNAL.note_request_done(req, reason,
+                                     first_token_at=first_token_at,
+                                     at=at, cause=cause, **args)
 
 
 # ---------------------------------------------------------------------------

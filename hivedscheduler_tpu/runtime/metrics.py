@@ -260,6 +260,26 @@ REGISTRY.describe("tpu_hive_sched_loop_phase_seconds",
                   "in-flight migrations, plan = defrag planning + elastic "
                   "shrink offers for waiters, elastic = grow-promotion "
                   "scan)")
+# request flight recorder + SLO layer (obs/journal.py + obs/slo.py):
+# per-request TTFT leg decomposition and declared-objective accounting
+REGISTRY.describe("tpu_hive_request_leg_seconds",
+                  "Closed request-flight legs by leg name (leg label: "
+                  "route, router_queue, retry, admission_wait, prefill, "
+                  "handoff_ship, handoff_import, first_decode — "
+                  "obs/journal.py REQUEST_LEGS; TTFT legs sum to the "
+                  "measured ttft_s)")
+REGISTRY.describe("tpu_hive_slo_violations_total",
+                  "Observations exceeding a declared SLO ceiling, by "
+                  "objective and the request's dominant leg "
+                  "(leg=unattributed when the flight recorder is off)")
+REGISTRY.describe("tpu_hive_slo_ttft_p99_seconds",
+                  "Windowed p99 TTFT over the SLO tracker's window — the "
+                  "same number the autoscaler reads as up-pressure and "
+                  "/v1/inspect/slo serves")
+REGISTRY.describe("tpu_hive_slo_burn_rate",
+                  "Worst error-budget burn rate across declared "
+                  "objectives (violating fraction / (1 - quantile) over "
+                  "the window; 1.0 = burning exactly at budget)")
 REGISTRY.describe("tpu_hive_train_cross_topology_resumes_total",
                   "Training incarnations that restored a checkpoint saved "
                   "on a DIFFERENT (dp, fsdp, pp, ep, tp, sp) mesh "

@@ -21,6 +21,28 @@ from hivedscheduler_tpu.common import utils as common
 log = logging.getLogger(__name__)
 
 
+def _log_slo(tracker) -> None:
+    """One SLO summary block at exit: windowed quantiles plus, per
+    declared objective, compliance / burn rate / dominant-leg violation
+    attribution (the /v1/inspect/slo payload, logged)."""
+    snap = tracker.snapshot()
+    s = snap["series"]["ttft"]
+    if s["count"]:
+        log.info("slo: ttft p50 %.0f ms, p95 %.0f ms, p99 %.0f ms over "
+                 "%s requests (window %ss)", 1e3 * s["p50"],
+                 1e3 * s["p95"], 1e3 * s["p99"], s["count"],
+                 snap["windowS"])
+    for o in snap["objectives"]:
+        log.info(
+            "slo objective %s: ceiling %.0f ms, observed %.0f ms, "
+            "compliance %s, burn rate %s, violation attribution %s",
+            o["name"], 1e3 * o["ceilingS"], 1e3 * o["value"],
+            "n/a" if o["compliance"] is None else f"{o['compliance']:.4f}",
+            "n/a" if o["burnRate"] is None else f"{o['burnRate']:.2f}",
+            o["attribution"] or "{}",
+        )
+
+
 def _run_fleet(args, router, autoscaler, pending, prio_of) -> int:
     """Drive the synthetic load through the FleetRouter (the --fleet
     path): staggered arrivals, per-step autoscaler ticks, and a fleet
@@ -74,6 +96,7 @@ def _run_fleet(args, router, autoscaler, pending, prio_of) -> int:
                  router.retried)
     if router.policy == "prefix_affinity":
         log.info("fleet prefix-affinity hits: %s", router.affinity_hits)
+    _log_slo(router.slo)
     if autoscaler is not None:
         ups = sum(1 for a in autoscaler.actions
                   if a["direction"] == "up" and a["phase"] == "added")
@@ -230,8 +253,21 @@ def main(argv=None) -> int:
     parser.add_argument("--journal-file", default="",
                         help="enable the gang-lifecycle journal "
                         "(obs/journal.py) and append its request "
-                        "admission/shed/preemption events to this JSONL "
-                        "spool (one line per event, flushed per append)")
+                        "admission/shed/preemption events — plus the "
+                        "per-request flight legs (REQUEST_LEGS) — to this "
+                        "JSONL spool (one line per event, flushed per "
+                        "append)")
+    parser.add_argument("--slo-ttft-p99", type=float, default=0.0,
+                        help="declare a p99 TTFT objective (seconds): the "
+                        "SLO tracker (obs/slo.py) then reports windowed "
+                        "compliance, error-budget burn rate and "
+                        "violation attribution by dominant request leg "
+                        "(0 = no objective; quantiles are tracked either "
+                        "way and feed the fleet autoscaler)")
+    parser.add_argument("--slo-window-s", type=float, default=-1.0,
+                        help="SLO tracker sliding window in seconds "
+                        "(-1 = the HIVED_SLO_WINDOW_S default, 0 = no "
+                        "time window — pure last-N ring)")
     parser.add_argument("--fleet", type=int, default=0,
                         help="serve through a FleetRouter over this many "
                         "replicas (0 = single engine). Each replica is a "
@@ -293,6 +329,10 @@ def main(argv=None) -> int:
             args.fleet_min = fleet_cfg.min_replicas
         if args.fleet_max == 0 and fleet_cfg.autoscale:
             args.fleet_max = fleet_cfg.max_replicas
+        if args.slo_ttft_p99 == 0.0:
+            args.slo_ttft_p99 = fleet_cfg.slo_ttft_p99_s
+        if args.slo_window_s < 0:
+            args.slo_window_s = fleet_cfg.slo_window_s
     if args.fleet > 0:
         if args.disaggregate and not 0 < args.prefill_replicas < args.fleet:
             parser.error(
@@ -412,13 +452,24 @@ def main(argv=None) -> int:
         return serving.ServingEngine(params, cfg, spec_decode=spec_cfg,
                                      **kw)
 
+    from hivedscheduler_tpu.obs import slo as obs_slo
+
+    slo_tracker = obs_slo.SLOTracker(
+        objectives=obs_slo.objectives_from_knobs(
+            ttft_p99_s=args.slo_ttft_p99,
+            tpot_p95_s=fleet_cfg.slo_tpot_p95_s if fleet_cfg else 0.0,
+            per_priority_ttft_p99=(fleet_cfg.slo_ttft_p99_by_priority
+                                   if fleet_cfg else None)),
+        window_s=None if args.slo_window_s < 0 else args.slo_window_s,
+    )
     router = autoscaler = None
     try:
         if args.fleet > 0:
             from hivedscheduler_tpu import fleet as fleet_pkg
 
             router = fleet_pkg.FleetRouter(policy=args.route_policy,
-                                           disaggregate=args.disaggregate)
+                                           disaggregate=args.disaggregate,
+                                           slo=slo_tracker)
             if (args.disaggregate and router.kv_ship
                     and kw["prefix_cache_size"] == 0):
                 # the handoff payload travels through the prefix cache
@@ -449,6 +500,13 @@ def main(argv=None) -> int:
                 )
         else:
             eng = build_engine()
+            from hivedscheduler_tpu.obs import journal as obs_journal
+
+            if args.journal_file or obs_journal.JOURNAL.enabled:
+                # single-engine flights: serve/<rid> legs + terminal in
+                # the journal/spool (the fleet path's router installs
+                # fleet/<fid> flights instead)
+                eng.record_flights = True
     except ValueError as e:
         log.error("%s", e)
         return 1
@@ -525,6 +583,20 @@ def main(argv=None) -> int:
     total_tokens = sum(len(r.tokens_out) for r in reqs)
     for r in reqs:
         print(f"[{r.rid}] " + " ".join(str(t) for t in r.tokens_out))
+    from hivedscheduler_tpu.obs import journal as obs_journal
+
+    for r in reqs:
+        if not r.done:
+            continue
+        dom = (obs_journal.JOURNAL.request_dominant_leg(f"serve/{r.rid}")
+               if obs_journal.JOURNAL.enabled else "")
+        if r.ttft_s is not None:
+            slo_tracker.observe("ttft", r.ttft_s, priority=r.priority,
+                                leg=dom, at=r.done_at)
+        if r.tpot_s is not None:
+            slo_tracker.observe("tpot", r.tpot_s, priority=r.priority,
+                                leg=dom, at=r.done_at)
+    _log_slo(slo_tracker)
     ttfts = sorted(r.ttft_s for r in reqs if r.ttft_s is not None)
     if ttfts:
         log.info("time-to-first-token: p50 %.0f ms, max %.0f ms",

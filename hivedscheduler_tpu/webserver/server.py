@@ -188,6 +188,7 @@ def _make_handler(scheduler: HivedScheduler, webserver: Optional[WebServer] = No
                         C.TRACES_PATH, C.TRACES_CHROME_PATH,
                         C.ADMISSION_HINTS_PATH, C.DEFRAG_PATH,
                         C.GANGS_PATH, C.FLEET_PATH,
+                        C.REQUESTS_PATH, C.SLO_PATH,
                     ]})
                 elif path == C.FLEET_PATH:
                     # serving-fleet router snapshot (copy-on-read under
@@ -200,6 +201,39 @@ def _make_handler(scheduler: HivedScheduler, webserver: Optional[WebServer] = No
                     if r is not None:
                         payload.update(r.snapshot())
                     self._reply(200, payload)
+                elif path == C.SLO_PATH:
+                    # declared SLOs: windowed quantiles, burn rates and
+                    # violation attribution from the published fleet's
+                    # tracker (copy-on-read; empty when no fleet runs in
+                    # this process)
+                    from hivedscheduler_tpu.fleet import router as fleet_router
+
+                    r = fleet_router.published()
+                    payload = {"enabled": r is not None, "objectives": []}
+                    if r is not None:
+                        payload.update(r.slo.snapshot())
+                    self._reply(200, payload)
+                elif path == C.REQUESTS_PATH:
+                    # request flight recorder: per-request TTFT leg
+                    # summaries (copy-on-read; empty when the journal is
+                    # off)
+                    from hivedscheduler_tpu.obs import journal as obs_journal
+
+                    self._reply(200, {
+                        "enabled": obs_journal.JOURNAL.enabled,
+                        "items": obs_journal.JOURNAL.requests(),
+                    })
+                elif (full.startswith(C.REQUESTS_PATH + "/")
+                        and path.endswith("/timeline")):
+                    # /v1/inspect/requests/<id>/timeline — <id> may
+                    # contain slashes (fleet/<fid>, serve/<rid>)
+                    from hivedscheduler_tpu.obs import journal as obs_journal
+
+                    rid = path[len(C.REQUESTS_PATH) + 1:-len("/timeline")]
+                    if not rid:
+                        raise WebServerError(400, "request id is empty")
+                    self._reply(
+                        200, obs_journal.JOURNAL.request_timeline(rid))
                 elif path == C.ADMISSION_HINTS_PATH:
                     # serving headroom + defrag holds, for gang admission
                     self._reply(200, scheduler.get_admission_hints())

@@ -47,6 +47,19 @@ def test_bench_model_smoke(capsys):
     sf = m["serve_fleet"]
     assert sf["static_good_requests"] > 0
     assert sf["autoscaled_good_requests"] > 0
+    # request flight recorder + SLO layer (ISSUE 13): leg attribution
+    # summed to the measured TTFT for every completed request in both
+    # KV-handoff modes, the burn/attribution tables rode along, and the
+    # disabled path stayed one attribute check
+    assert m["fleet_legs_sum_to_ttft"] is True
+    from hivedscheduler_tpu.obs.journal import REQUEST_LEGS
+
+    for arm in ("static_slo", "autoscaled_slo"):
+        blk = sf[arm]
+        assert blk["attribution_checked_requests"] > 0
+        assert set(blk["ttft_leg_seconds"]) <= set(REQUEST_LEGS)
+        assert blk["burn_rate"] is None or blk["burn_rate"] >= 0.0
+    assert sf["slo_disabled_leg_overhead_ns"] < 20_000
 
 
 @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 7): fault-ladder

@@ -28,7 +28,11 @@ def setup(cfg, b=2, t=12, seed=0):
 
 
 class TestKVCacheDecode:
-    @pytest.mark.parametrize("n_kv", [0, 2, 1])
+    # n_kv=1 (MQA) is slow-marked: tier-1 wall-time budget (ISSUE 13) —
+    # n_kv=0 (MHA) and n_kv=2 (GQA) are the tier-1 cousins through the
+    # same grouped-attention read path
+    @pytest.mark.parametrize(
+        "n_kv", [0, 2, pytest.param(1, marks=pytest.mark.slow)])
     def test_incremental_matches_full_forward(self, n_kv):
         """Prefill 6 tokens then decode the rest one at a time: every
         incremental logit row must equal the full forward's row."""

@@ -76,10 +76,17 @@ class TestResumeMatrix:
         (topology.MeshAxes(dp=4), topology.MeshAxes(dp=4), True),
         # shrink: half the devices
         (topology.MeshAxes(dp=4), topology.MeshAxes(dp=2), False),
-        # grow: double the devices
-        (topology.MeshAxes(dp=2), topology.MeshAxes(dp=4), False),
-        # dp -> tp reshape at equal size
-        (topology.MeshAxes(dp=4), topology.MeshAxes(dp=2, tp=2), False),
+        # grow: double the devices (slow: tier-1 wall-time budget,
+        # ISSUE 13 — the shrink trajectory above is the tier-1 cousin
+        # through the same reshard-on-load path)
+        pytest.param(topology.MeshAxes(dp=2), topology.MeshAxes(dp=4),
+                     False, marks=pytest.mark.slow),
+        # dp -> tp reshape at equal size (slow: tier-1 wall-time budget,
+        # ISSUE 13 — the reverse reshape below is the tier-1 cousin
+        # through the same reshard-on-load path)
+        pytest.param(topology.MeshAxes(dp=4),
+                     topology.MeshAxes(dp=2, tp=2), False,
+                     marks=pytest.mark.slow),
         # tp -> dp reshape at equal size
         (topology.MeshAxes(dp=2, tp=2), topology.MeshAxes(dp=4), False),
     ], ids=["same-dp4", "shrink-dp4-to-dp2", "grow-dp2-to-dp4",

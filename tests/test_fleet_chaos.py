@@ -24,6 +24,21 @@ sys.path.insert(0, REPO)
 from hivedscheduler_tpu.chaos import invariants  # noqa: E402
 from hivedscheduler_tpu.fleet import FleetRouter  # noqa: E402
 from hivedscheduler_tpu.models import serving, transformer as tm  # noqa: E402
+from hivedscheduler_tpu.obs import journal as obs_journal  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _journal_on():
+    """ISSUE 13: run every fleet chaos episode with the request flight
+    recorder ON, so check_fleet's check_requests leg (terminals, leg
+    contiguity, sum-to-ttft, retry re-attribution) is attacked by the
+    same kills/drains — not vacuously skipped. Per-test isolation: the
+    singleton never leaks state (each router restarts fleet fids at 0)."""
+    obs_journal.JOURNAL.clear()
+    obs_journal.enable()
+    yield
+    obs_journal.disable()
+    obs_journal.JOURNAL.clear()
 
 
 @pytest.fixture(scope="module")

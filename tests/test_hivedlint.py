@@ -508,6 +508,63 @@ def test_obs001_real_tree_schema_is_exact():
     assert got == []
 
 
+# the request-leg extension (ISSUE 13): seeded fixtures prove each
+# direction is non-vacuous
+_LEG_SCHEMA = {"request_submit": "d", "request_leg": "d",
+               "request_done": "d"}
+_LEG_REGISTRY = {"route": "d", "never_emitted_leg": "d"}
+
+
+def test_obs001_unregistered_leg_flagged(tmp_path):
+    _write(tmp_path, "pkg/mod.py", """
+        journal.note_request_submit("fleet/0")
+        journal.note_leg("fleet/0", "rogue_leg")
+        obs_journal.note_leg("fleet/0", "route")
+        journal.note_request_done("fleet/0", "length")
+        """)
+    got = blindspots.check_journal_schema(
+        REPO, package_root=str(tmp_path / "pkg"),
+        schema=dict(_LEG_SCHEMA), buckets={"vc_quota": "d"},
+        legs=dict(_LEG_REGISTRY))
+    msgs = sorted(f.message for f in got)
+    assert all(f.rule == "OBS001" for f in got)
+    assert any("'rogue_leg'" in m and "not registered" in m for m in msgs)
+    # vice versa: the registered-but-never-emitted leg is flagged too
+    assert any("'never_emitted_leg'" in m and "never emitted" in m
+               for m in msgs)
+    assert len(got) == 2
+
+
+def test_obs001_non_literal_leg_and_unregistered_implied_event(tmp_path):
+    _write(tmp_path, "pkg/mod.py", """
+        leg = "route"
+        journal.note_leg("fleet/0", leg)
+        journal.note_request_done("fleet/0", "length")
+        """)
+    # note_request_done implies request_done, which this schema lacks
+    got = blindspots.check_journal_schema(
+        REPO, package_root=str(tmp_path / "pkg"),
+        schema={"request_leg": "d"}, buckets={"vc_quota": "d"},
+        legs={"route": "d"})
+    msgs = sorted(f.message for f in got)
+    assert any("non-literal leg" in m for m in msgs)
+    assert any("'request_done'" in m and "not registered" in m
+               for m in msgs)
+
+
+def test_obs001_clean_leg_fixture_passes(tmp_path):
+    _write(tmp_path, "pkg/mod.py", """
+        journal.note_request_submit("fleet/0")
+        journal.note_leg("fleet/0", "route")
+        journal.note_request_done("fleet/0", "length")
+        """)
+    got = blindspots.check_journal_schema(
+        REPO, package_root=str(tmp_path / "pkg"),
+        schema=dict(_LEG_SCHEMA), buckets={"vc_quota": "d"},
+        legs={"route": "d"})
+    assert got == []
+
+
 # ---------------------------------------------------------------------------
 # HIVED_LOCKCHECK runtime sanitizer
 # ---------------------------------------------------------------------------

@@ -545,6 +545,10 @@ class TestTrainStep:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]  # memorizing a fixed batch
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 13): remat-policy
+    # parity variant; tier-1 cousins: test_sharded_train_step_decreases_
+    # loss + test_grad_accum_matches_full_batch (same train-step machinery
+    # at the default remat)
     def test_remat_policies_match(self):
         """cfg.remat trades HBM for recompute FLOPs — it must never change
         the computed loss or gradients (f32 model: exact up to reduction

@@ -176,6 +176,10 @@ class TestPipelineSequenceParallel:
         out = jax.jit(lambda p, t: tm.forward(p, t, cfg_pp, mesh=mesh))(params, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 13): dp x pp x
+    # tp x sp composition variant; tier-1 cousins: TestPipelineFSDP::
+    # test_pipelined_fsdp_train_step (pp x dp) + the ring-attention
+    # train-step guards (test_parallel.py TestGQA[ring])
     def test_pipelined_ring_tp_train_step(self):
         """The full composition: dp x pp x tp x sp in one jitted train step."""
         from hivedscheduler_tpu.parallel.train import make_sharded_train_step
@@ -204,6 +208,10 @@ class TestPipelineSequenceParallel:
 
 
 class TestTop2MoE:
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 13): top-2
+    # routing variant of the MoE train step; tier-1 cousins: TestMoE::
+    # test_moe_train_step_ep_sharded (top-1 train) + the serving-side
+    # top-2 routing guards (test_serving_moe.py, moe_top_k=2)
     def test_top2_forward_and_train(self):
         from hivedscheduler_tpu.parallel.train import make_sharded_train_step
 
@@ -394,6 +402,10 @@ class TestMoEInPipeline:
         out = jax.jit(lambda p, t: tm.forward(p, t, cfg_pp, mesh=mesh))(params, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 13): triple-
+    # composition variant; tier-1 cousins: TestMoE::
+    # test_moe_train_step_ep_sharded (moe x ep) + TestPipelineFSDP::
+    # test_pipelined_fsdp_train_step (pp composition)
     def test_moe_sp_ep_pipeline_train_step(self):
         """Full composition including experts: pp x sp x ep in one jitted
         train step, loss finite and decreasing."""

@@ -80,6 +80,10 @@ def test_int8_vs_float_logits_bounded(setup):
     np.testing.assert_allclose(np.asarray(d8), np.asarray(df), atol=0.25)
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ISSUE 13): int8 x chunked
+# composition variant; tier-1 cousins: the float chunked parity
+# (test_serving_chunked.py::test_chunked_matches_monolithic[4]) + the
+# int8-vs-float base guards above
 def test_int8_chunked_matches_int8_monolithic(setup):
     """Chunking is still a pure scheduling change inside the int8 world:
     the chunks quantize the same values in the same positions."""

@@ -102,6 +102,11 @@ class TestFusedDecodeExactness:
             tested += 1
         assert tested, "every probe position degenerate — new model seed?"
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 13): budget-clamp
+    # variant of the fused-window differential; tier-1 cousins:
+    # test_greedy_streams_match_k1 + test_greedy_streams_match_k1_nonpow2
+    # above (same window machinery, the clamp path unit-covered by
+    # _fused_window tests)
     def test_budget_not_multiple_of_window(self, setup):
         """Budgets 6/4/9/5 against a window of 8: the window clamps to the
         minimum remaining budget (power-of-two bucketed), so no request
@@ -113,6 +118,12 @@ class TestFusedDecodeExactness:
         for (toks, reason), budget in zip(out, BUDGETS):
             assert len(toks) == budget and reason == "length"
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 13): multistep x
+    # chunked composition variant; tier-1 cousins:
+    # test_greedy_streams_match_k1 above + the chunked parity
+    # (test_serving_chunked.py::test_chunked_matches_monolithic[4]); the
+    # collapse-to-1-during-chunking rule is unit-covered in
+    # test_serving_paged.py::test_fused_window_collapses_during_chunked_prefill
     def test_composes_with_chunked_prefill(self, setup):
         cfg, params = setup
         long_prompts = [list(range(2, 26)), [17, 3], [7] * 19, [1, 2, 3]]

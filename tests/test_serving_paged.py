@@ -106,6 +106,10 @@ class TestPagedDifferential:
         assert eng.blocks_cow >= 1, "mid-block divergence did not COW"
         check_block_pool(eng, "after prefix/COW load")
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 13): chunked x
+    # paged composition variant; tier-1 cousins: the greedy paged
+    # differential above + the dense chunked parity
+    # (test_serving_chunked.py::test_chunked_matches_monolithic[4])
     def test_chunked_prefill_composition(self, setup):
         cfg, params = setup
         prompts = [SYSTEM + [100, 101], [17, 3, 88, 41, 7, 6, 2, 91, 55, 44],
@@ -117,6 +121,11 @@ class TestPagedDifferential:
             assert toks == vanilla(params, cfg, p, n)
         assert eng.prefill_chunks_done > 0
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 13): paged twin
+    # of the dense EOS-at-boundary sweep; tier-1 cousins: the dense
+    # sweep (test_serving_multistep.py::TestFusedDecodeExactness) + the
+    # paged fused-window collapse unit test
+    # (test_fused_window_collapses_during_chunked_prefill below)
     def test_fused_window_eos_at_boundary(self, setup):
         """decode_steps=4 with the EOS probed inside the window, exactly AT
         the window boundary, and on the first post-window step (the
@@ -134,10 +143,18 @@ class TestPagedDifferential:
             tested += 1
         assert tested, "every probe position degenerate — new model seed?"
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 13): int8
+    # variant of the paged differential; tier-1 cousins: the greedy
+    # paged differential above + the dense int8 guards
+    # (test_serving_int8kv.py)
     def test_int8_kv_paged_matches_int8_dense(self, setup):
         cfg, params = setup
         run_both(params, cfg, kv_dtype="int8", prefix_cache_size=8)
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 13): sampled
+    # variant of the paged differential; tier-1 cousins: the greedy
+    # paged differential above + the dense sampled-reproducibility guard
+    # (test_serving.py::test_sampled_streams_reproducible_under_interleaving)
     def test_sampled_paged_matches_sampled_dense(self, setup):
         """Counter-based keys make sampled streams a pure function of
         (seed, rid, prompt) — the cache layout must not leak into them."""
@@ -275,6 +292,10 @@ class TestSpecDecodeFirstClass:
         assert isinstance(eng, serving.SpeculativeServingEngine)
         assert eng.gamma == draft.gamma
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 13): spec x paged
+    # x prefix triple-composition variant; tier-1 cousins: the greedy
+    # paged differential (TestPagedDifferential) + the dense speculative
+    # greedy exactness guards (test_serving_speculative.py)
     def test_spec_paged_greedy_exact_with_prefix(self, setup, draft):
         """First-class speculative serving on the paged cache: greedy
         streams bit-match vanilla, target prefix blocks are shared by

@@ -121,6 +121,10 @@ def test_near_arena_end_clamp_candidates_skipped(setup):
     assert eng.prefix_tokens_reused == 64
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ISSUE 13): steady-state
+# traffic soak variant; tier-1 cousins: test_prefix_hits_are_exact +
+# test_longest_prefix_wins (same restore/tail-prefill machinery under
+# deterministic interleavings)
 def test_staggered_mixed_traffic_exact(setup):
     """Prefix hits interleaved with decode steps of other rows (the
     continuous-batching steady state) stay exact."""

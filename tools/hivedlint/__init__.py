@@ -109,8 +109,9 @@ RULES: Dict[str, tuple] = {
                "dynamic metric names", "blindspots"),
     "OBS001": ("every journal event type emitted in the package is a "
                "registered obs/journal.py SCHEMA row and vice versa; "
-               "literal wait buckets must be WAIT_BUCKETS rows; no "
-               "dynamic event types", "blindspots"),
+               "literal wait buckets must be WAIT_BUCKETS rows; every "
+               "note_leg() request leg is a REQUEST_LEGS row and vice "
+               "versa; no dynamic event types or legs", "blindspots"),
 }
 
 

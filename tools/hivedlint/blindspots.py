@@ -424,8 +424,9 @@ def check_metrics_catalogue(root: str,
 
 
 # ---------------------------------------------------------------------------
-# OBS001: journal event-type / wait-bucket schema registry (the
-# check_metrics pattern applied to the gang-lifecycle flight recorder)
+# OBS001: journal event-type / wait-bucket / request-leg schema registry
+# (the check_metrics pattern applied to the gang-lifecycle flight recorder
+# and — ISSUE 13 — the request flight recorder)
 #
 # Every `journal.emit("<type>", ...)` / `journal.note_phase(_, _, "<type>")`
 # / `journal.note_wait(_, "<bucket>", ..., etype="<type>")` literal in the
@@ -435,10 +436,24 @@ def check_metrics_catalogue(root: str,
 # itself counts as an emitter of its default `queued` type; non-literal
 # *buckets* are legal (the classify_wait() path) — the runtime validates
 # those.
+#
+# The request flight recorder extends the same contract: every
+# `journal.note_leg(_, "<leg>")` literal must be a REQUEST_LEGS row, legs
+# must be literals (unlike wait buckets there is no classifier path), and
+# every REQUEST_LEGS row must be emitted somewhere. The flight methods
+# imply their event types (note_request_submit -> request_submit,
+# note_leg -> request_leg, note_request_done -> request_done), exactly
+# like note_wait implies `queued`.
 # ---------------------------------------------------------------------------
 
 _JOURNAL_RECEIVERS = {"journal", "obs_journal"}
-_JOURNAL_METHODS = {"emit", "note_wait", "note_phase"}
+_JOURNAL_METHODS = {"emit", "note_wait", "note_phase", "note_leg",
+                    "note_request_submit", "note_request_done"}
+# flight methods emit their event type internally; seeing a call site
+# marks the implied SCHEMA row as emitted
+_IMPLIED_EVENTS = {"note_leg": "request_leg",
+                   "note_request_submit": "request_submit",
+                   "note_request_done": "request_done"}
 
 
 def check_journal_schema(
@@ -446,17 +461,29 @@ def check_journal_schema(
     package_root: Optional[str] = None,
     schema: Optional[Dict[str, str]] = None,
     buckets: Optional[Dict[str, str]] = None,
+    legs: Optional[Dict[str, str]] = None,
 ) -> List[Finding]:
-    if schema is None or buckets is None:
+    if schema is None or buckets is None or legs is None:
         import sys
 
         sys.path.insert(0, root)
         try:
-            from hivedscheduler_tpu.obs.journal import SCHEMA, WAIT_BUCKETS
+            from hivedscheduler_tpu.obs.journal import (
+                REQUEST_LEGS,
+                SCHEMA,
+                WAIT_BUCKETS,
+            )
         finally:
             sys.path.pop(0)
         schema = SCHEMA if schema is None else schema
         buckets = WAIT_BUCKETS if buckets is None else buckets
+        # fixture scans (package_root given, legs not passed) skip the
+        # legs-never-emitted direction — the pre-ISSUE-13 fixtures are
+        # not leg emitters
+        check_leg_coverage = legs is not None or package_root is None
+        legs = REQUEST_LEGS if legs is None else legs
+    else:
+        check_leg_coverage = True
     pkg = package_root or os.path.join(root, "hivedscheduler_tpu")
     base = package_root and os.path.dirname(package_root) or root
 
@@ -470,6 +497,7 @@ def check_journal_schema(
                     None)
 
     emitted: Set[str] = set()
+    emitted_legs: Set[str] = set()
     out: List[Finding] = []
     for path in _iter_py(pkg):
         rel = os.path.relpath(path, base).replace(os.sep, "/")
@@ -488,6 +516,44 @@ def check_journal_schema(
                     and recv.attr == "JOURNAL")
             )
             if not recv_ok or attr not in _JOURNAL_METHODS:
+                continue
+            if attr in _IMPLIED_EVENTS:
+                implied = _IMPLIED_EVENTS[attr]
+                if implied not in schema:
+                    out.append(Finding(
+                        "OBS001", rel, node.lineno,
+                        f"journal {attr}() implies event type {implied!r} "
+                        f"which is not registered in obs/journal.py SCHEMA",
+                    ))
+                else:
+                    emitted.add(implied)
+                if attr == "note_leg":
+                    leg_expr = (node.args[1] if len(node.args) > 1
+                                else _kw(node, "leg"))
+                    if leg_expr is None:
+                        out.append(Finding(
+                            "OBS001", rel, node.lineno,
+                            "journal note_leg() call without a leg — pass "
+                            "a string literal so the REQUEST_LEGS registry "
+                            "stays machine-checkable",
+                        ))
+                        continue
+                    leg_name = _lit(leg_expr)
+                    if leg_name is None:
+                        out.append(Finding(
+                            "OBS001", rel, node.lineno,
+                            "journal note_leg() with a non-literal leg — "
+                            "use a string literal (there is no classifier "
+                            "path for request legs)",
+                        ))
+                    elif leg_name not in legs:
+                        out.append(Finding(
+                            "OBS001", rel, node.lineno,
+                            f"request leg {leg_name!r} is not registered "
+                            f"in obs/journal.py REQUEST_LEGS",
+                        ))
+                    else:
+                        emitted_legs.add(leg_name)
                 continue
             etype_expr = None
             if attr == "emit":
@@ -539,6 +605,14 @@ def check_journal_schema(
             f"journal event type {name!r} registered in SCHEMA but never "
             f"emitted in the package — drop the row or wire the emitter",
         ))
+    if check_leg_coverage:
+        for name in sorted(set(legs) - emitted_legs):
+            out.append(Finding(
+                "OBS001", "hivedscheduler_tpu/obs/journal.py", 1,
+                f"request leg {name!r} registered in REQUEST_LEGS but "
+                f"never emitted in the package — drop the row or wire "
+                f"the emitter",
+            ))
     return out
 
 
