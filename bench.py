@@ -619,10 +619,33 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
     to the ``advance()``-integrated total wait chip-time. The same buckets
     the live server serves at ``/v1/inspect/gangs`` become the
     ``wait_attribution`` shares in the driver artifact.
+
+    Capacity accounting is ledger-backed (ISSUE 14): unless
+    ``HIVED_LEDGER=0``, the replay also drives a virtual-clock
+    :class:`obs.ledger.CapacityLedger` through the SAME chip-state
+    taxonomy the live scheduler serves at ``/v1/inspect/capacity`` — every
+    admission turns the gang's real chip coordinates busy
+    (guaranteed/opportunistic/backfill), every move reattributes the
+    checkpoint downtime into ``migration_downtime``, idle chips carry the
+    oldest waiter's diagnosis — and the ledger-derived
+    ``utilization_pct`` / wasted / overhead numbers are ASSERTED equal to
+    the legacy hand-rolled ``busy_of``/``wasted_chip_time``/
+    ``overhead_chip_time`` counters, which stay as the differential
+    reference (the ``HIVED_LEDGER=0`` path reports them directly, one
+    release behind, mirroring ``HIVED_INCR=0``). The conservation
+    invariant (per-state chip-seconds sum to chips x elapsed) is asserted
+    via ``chaos.invariants.check_ledger``, and every gang's first wait
+    gets a finite wait-ETA forecast (``obs/eta.py``) recorded alongside
+    its realized wait.
     """
     import heapq
+    import math
 
+    from hivedscheduler_tpu.chaos import invariants as chaos_invariants
+    from hivedscheduler_tpu.common import envflags
+    from hivedscheduler_tpu.obs import eta as obs_eta
     from hivedscheduler_tpu.obs import journal as obs_journal
+    from hivedscheduler_tpu.obs import ledger as obs_ledger
 
     # virtual-clock journal instance: metrics off (sim durations must not
     # pollute the process registry), interval cap lifted (the assertion
@@ -630,6 +653,33 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
     jr = obs_journal.Journal(capacity=1 << 17, metrics=False,
                              intervals_per_gang=1 << 16)
     jr.enabled = True
+
+    # -- virtual-clock capacity ledger (HIVED_LEDGER=0 = legacy-counters
+    # reference path). Chips are the cluster's REAL coordinates, grouped
+    # by host so the live per-node lane semantics carry over.
+    lg = None
+    if envflags.get("HIVED_LEDGER") != "0":
+        lg = obs_ledger.CapacityLedger(metrics=False)
+        lg.enabled = True
+        _hosts = [
+            (x, y, z)
+            for x in range(0, TRACE_TOPOLOGY[0], TRACE_HOST_SHAPE[0])
+            for y in range(0, TRACE_TOPOLOGY[1], TRACE_HOST_SHAPE[1])
+            for z in range(0, TRACE_TOPOLOGY[2], TRACE_HOST_SHAPE[2])
+        ]
+        chip_index = {}
+        for origin in _hosts:
+            key = "%d-%d-%d" % origin
+            lg.register_node(key, len(_host_chip_coords(origin)),
+                             chain="sim", at=0.0)
+            for i, coord in enumerate(_host_chip_coords(origin)):
+                chip_index[coord] = (key, i)
+    led_chips = {}   # gang -> {node -> [chip idx]}
+    led_dirty = set()
+    wasted_led = 0.0
+    eta_pending = {}  # gang -> (forecast time, eta_s)
+    eta_pairs = []    # (forecast eta_s, realized wait)
+    _ETA_RUN_T = 140.0  # expected run in TRACE time units (mean ~140)
 
     total_chips = TRACE_TOTAL_CHIPS
     clock = 0.0
@@ -657,8 +707,64 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
     entry_gen = {}  # heap seq -> job generation at push (stale-entry filter)
     completes_at = {}  # live group name -> its current completion time
 
+    def led_flavor(name):
+        """The ledger busy state a gang's chips carry right now (the sim
+        mirror of the runtime's hint_flavor/busy_state)."""
+        if defrag is not None and name in defrag.downgraded:
+            return "busy_backfill"
+        return ("busy_guaranteed" if job_by_name[name]["priority"] >= 0
+                else "busy_opportunistic")
+
+    def ledger_sync(at):
+        """Reconcile the virtual ledger with the cluster at ``at`` (the
+        time the changes actually happened — the previous event's clock):
+        release dead gangs' chips, (re)place dirty gangs' chips at their
+        current flavor, refresh the idle diagnosis from the oldest
+        waiter. Diff-based per node so an unchanged chip's interval just
+        continues."""
+        if lg is None:
+            return
+        # two phases: ALL releases first, then all claims — within one
+        # event a mover's vacated chips are often the waiter's new slice,
+        # and a stale release after the claim would clobber the new owner
+        claims = []
+        for name in [n for n in led_chips if n not in cluster.groups]:
+            for node, idxs in led_chips.pop(name).items():
+                lg.release(node, idxs, at=at)
+        for name in led_dirty:
+            if name not in cluster.groups:
+                continue
+            new_map = {}
+            for coord in gang_chips_fn(cluster, name):
+                node, i = chip_index[coord]
+                new_map.setdefault(node, []).append(i)
+            old_map = led_chips.get(name, {})
+            for node, idxs in old_map.items():
+                keep = set(new_map.get(node, ()))
+                gone = [i for i in idxs if i not in keep]
+                if gone:
+                    lg.release(node, gone, at=at)
+            claims.append((name, new_map))
+        for name, new_map in claims:
+            flavor = led_flavor(name)
+            vc = job_by_name[name]["vc"]
+            for node, idxs in new_map.items():
+                lg.transition(node, idxs, flavor, vc=vc, gang=name, at=at)
+            led_chips[name] = new_map
+        led_dirty.clear()
+        if waiting:
+            from hivedscheduler_tpu.obs.ledger import IDLE_STATE_FOR_BUCKET
+            diag = IDLE_STATE_FOR_BUCKET.get(wait_bucket(waiting[0]),
+                                             "idle_free")
+        else:
+            diag = "idle_free"
+        lg.set_idle_diagnosis(diag, at=at)
+
     def advance(to):
         nonlocal busy_chip_time, last_t
+        # ledger first: everything that changed since the previous event
+        # happened AT that event's clock (== last_t)
+        ledger_sync(last_t)
         # busy = currently allocated gangs only (a preempted gang stops
         # counting the moment its cells are freed)
         dt = to - last_t
@@ -695,6 +801,11 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
     def register_success(job, dt):
         nonlocal scheduled, contiguous
         jr.note_phase(job["name"], "running", "bind", at=clock)
+        led_dirty.add(job["name"])
+        if job["name"] in eta_pending and not job.get("_admitted"):
+            # score the wait-ETA forecast against the realized wait
+            t_fc, eta_s = eta_pending.pop(job["name"])
+            eta_pairs.append((eta_s, clock - t_fc))
         if not job.get("_admitted"):
             # stats count each job once; a work-preserving re-admission
             # (defrag mode) is a resume, not a new schedule
@@ -735,6 +846,20 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
                     and attempt_defrag(job)):
                 return True
             jr.note_wait(job["name"], wait_bucket(job), at=clock)
+            if lg is not None and job["name"] not in eta_pending \
+                    and not job.get("_admitted"):
+                # first wait of this job: forecast capacity-without-a-move
+                # from the ledger's running-gang ages (finite by contract)
+                f = obs_eta.estimate(
+                    job["name"], job["pods"] * job["chips"],
+                    idle_chips=free_chips(),
+                    running=lg.running_gangs(at=clock),
+                    completed_durations=lg.completed_durations(),
+                    default_run_s=_ETA_RUN_T)
+                assert math.isfinite(f.eta_s), (
+                    f"wait-ETA forecast for {job['name']} is not finite")
+                obs_eta.record(f, jr=jr, at=clock)
+                eta_pending[job["name"]] = (clock, f.eta_s)
             return False
         register_success(job, dt)
         return True
@@ -778,6 +903,19 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
         defrag.overhead_chip_time += (
             defrag.DOWNTIME * job["pods"] * job["chips"])
         defrag.migrated_chips += job["pods"] * job["chips"]
+        led_charge_downtime(name)
+        led_dirty.add(name)
+
+    def led_charge_downtime(name):
+        """Ledger mirror of the downtime charge: move DOWNTIME x chips
+        out of the gang's busy bucket into migration_downtime (total
+        conserved; paid by the gang's extended occupancy)."""
+        if lg is None:
+            return
+        job = job_by_name[name]
+        lg.reattribute(defrag.DOWNTIME * job["pods"] * job["chips"],
+                       (led_flavor(name), job["vc"], "sim"),
+                       ("migration_downtime", job["vc"], "sim"))
 
     def execute_migration(plan, waiter_job, t0):
         """Replay the probe-validated sequence for real: evict movers,
@@ -880,6 +1018,7 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
         contract, which the defrag subsystem turns into policy)."""
         for name in [n for n in completes_at if n not in cluster.groups]:
             job = job_by_name[name]
+            led_charge_downtime(name)  # flavor read before the downgrade pop
             defrag.downgraded.pop(name, None)
             job["gen"] = job.get("gen", 0) + 1
             remaining = max(0.0, completes_at.pop(name, clock) - clock)
@@ -914,8 +1053,11 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
                 job["vc"], defrag.downgraded[name], name,
                 job["pods"], job["chips"])
             assert ok, f"promotion of {name} failed after probe said placeable"
-            defrag.downgraded.pop(name)
+            # downtime charged BEFORE the downgrade record drops so the
+            # ledger reattributes out of busy_backfill (where the gang's
+            # past accrual sits), then the flavor flips to guaranteed
             charge_move(name)
+            defrag.downgraded.pop(name)
             geom_update(name)
             defrag.promotions += 1
 
@@ -942,6 +1084,9 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
             else:
                 # preempted away mid-run: everything it accrued is wasted
                 wasted_chip_time += busy_of.get(job["name"], 0.0)
+                if lg is not None:
+                    wasted_led += sum(
+                        lg.gang_seconds(job["name"]).values())
             jr.note_phase(job["name"], "closed", "released", at=clock)
             chips_of.pop(job["name"], None)
             if defrag is not None:
@@ -979,6 +1124,61 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
     if defrag is not None:
         # restore windows occupy chips but are not work
         useful_chip_time -= defrag.overhead_chip_time
+    # -- ledger-backed capacity attribution (ISSUE 14) ---------------------
+    # Close the virtual ledger, assert the conservation invariant, and PIN
+    # the ledger-derived busy/wasted/overhead numbers to the legacy
+    # hand-rolled counters — the differential that lets the ledger's
+    # numbers be the reported ones while the old counters stay one
+    # release behind as the HIVED_LEDGER=0 reference path.
+    capacity_attribution = None
+    ledger_gap = None
+    eta_fields = None
+    if lg is not None:
+        ledger_sync(last_t)
+        lg.settle(last_t)
+        chaos_invariants.check_ledger(ledger=lg, ctx="bench replay",
+                                      at=last_t)
+        led_totals = lg.totals(last_t)
+        by_state = {}
+        for (state, _vc, _chain), secs in led_totals.items():
+            by_state[state] = by_state.get(state, 0.0) + secs
+        led_busy = sum(by_state.get(s, 0.0) for s in (
+            "busy_guaranteed", "busy_opportunistic", "busy_backfill"))
+        led_overhead = by_state.get("migration_downtime", 0.0)
+        tol = 1e-6 * max(1.0, span)
+        assert abs(led_busy - useful_chip_time) <= tol, (
+            f"ledger busy chip-time {led_busy} != legacy useful "
+            f"{useful_chip_time} — the chip-state books drifted from the "
+            f"hand-rolled counters")
+        assert abs(wasted_led - wasted_chip_time) <= tol, (
+            f"ledger wasted chip-time {wasted_led} != legacy "
+            f"{wasted_chip_time}")
+        legacy_overhead = (defrag.overhead_chip_time
+                           if defrag is not None else 0.0)
+        assert abs(led_overhead - legacy_overhead) <= tol, (
+            f"ledger migration_downtime {led_overhead} != legacy "
+            f"overhead {legacy_overhead}")
+        # ledger numbers become the reported ones (asserted equal above)
+        useful_chip_time = led_busy
+        wasted_chip_time = wasted_led
+        capacity_attribution = {
+            s: round(v / span, 4) for s, v in sorted(by_state.items())
+            if span and v > 0
+        }
+        ledger_gap = round(lg.conservation_gap(last_t), 6)
+        abs_errs = [abs(e - r) for e, r in eta_pairs]
+        errs = [e - r for e, r in eta_pairs]
+        eta_fields = {
+            "forecasts": len(eta_pairs) + len(eta_pending),
+            "scored": len(eta_pairs),
+            # unresolved = forecast issued but the gang never admitted
+            # before the trace ended (no realized wait to score against)
+            "unresolved": len(eta_pending),
+            "mean_abs_err_t": round(
+                sum(abs_errs) / len(abs_errs), 2) if abs_errs else None,
+            "mean_err_t": round(
+                sum(errs) / len(errs), 2) if errs else None,
+        }
     out = {
         "jobs": len(jobs),
         "scheduled": scheduled,
@@ -1008,6 +1208,12 @@ def replay_trace(cluster, jobs, gang_chips_fn, defrag=None):
         "preempt_wasted_pct": round(100.0 * wasted_chip_time / span, 1)
         if span else 0.0,
     }
+    if capacity_attribution is not None:
+        # per-state shares of chips x elapsed (obs/ledger.py CHIP_STATES);
+        # conservation gap is the bench-artifact half of check_ledger
+        out["capacity_attribution"] = capacity_attribution
+        out["ledger_conservation_gap"] = ledger_gap
+        out["eta"] = eta_fields
     if defrag is not None:
         out.update({
             "migrations": defrag.migrations,
@@ -1252,9 +1458,13 @@ if __name__ == "__main__":
                           trace_wait_packing_share=t["wait_packing_share"],
                           trace_wait_attribution=t["wait_attribution"],
                           trace_preempt_wasted_pct=t["preempt_wasted_pct"])
-            # defrag/backfill fields (absent under HIVED_DEFRAG=0)
+            # defrag/backfill fields (absent under HIVED_DEFRAG=0), and
+            # the capacity ledger's attribution + conservation gap + the
+            # wait-ETA forecast scoring (absent under HIVED_LEDGER=0)
             for k in ("migrations", "promotions", "backfills",
-                      "migrated_chips", "migration_overhead_pct"):
+                      "migrated_chips", "migration_overhead_pct",
+                      "capacity_attribution", "ledger_conservation_gap",
+                      "eta"):
                 if k in t:
                     fields[f"trace_{k}"] = t[k]
         except Exception as e:  # pragma: no cover - defensive

@@ -81,6 +81,7 @@ from hivedscheduler_tpu.algorithm.utils import (
 from hivedscheduler_tpu.k8s.types import Node, Pod
 from hivedscheduler_tpu.obs import decisions as obs_decisions
 from hivedscheduler_tpu.obs import journal as obs_journal
+from hivedscheduler_tpu.obs import ledger as obs_ledger
 from hivedscheduler_tpu.runtime import types as internal
 from hivedscheduler_tpu.runtime import utils as internal_utils
 from hivedscheduler_tpu.runtime.types import PodScheduleResult, SchedulerAlgorithm
@@ -159,6 +160,10 @@ class HivedAlgorithm(SchedulerAlgorithm):
             for leaf_cell in ccl[1]:
                 assert isinstance(leaf_cell, PhysicalCell)
                 self._leaves_by_node.setdefault(leaf_cell.nodes[0], []).append(leaf_cell)
+        # capacity ledger (obs/ledger.py): register every leaf cell before
+        # _init_bad_nodes flips them bad; no-op while the ledger is off,
+        # idempotent across crash-restarts
+        obs_ledger.register_cluster(self)
         self._init_cell_nums()
         self._init_api_cluster_status()
         self._init_pinned_cells(parsed.physical_pinned_cells)
@@ -290,6 +295,10 @@ class HivedAlgorithm(SchedulerAlgorithm):
         if node_name in self.bad_nodes:
             return
         self.bad_nodes.add(node_name)
+        if obs_ledger.LEDGER.enabled:
+            # chip-state books: the node's chips burn as bad_hardware
+            # until recovery (pre-bad states shadow and restore)
+            obs_ledger.LEDGER.set_node_bad(node_name, True)
         for leaf_cell in self._leaves_by_node.get(node_name, []):
             self._bump_chain_gen(leaf_cell.chain)
             self._set_bad_cell(leaf_cell)
@@ -299,6 +308,8 @@ class HivedAlgorithm(SchedulerAlgorithm):
         if node_name not in self.bad_nodes:
             return
         self.bad_nodes.discard(node_name)
+        if obs_ledger.LEDGER.enabled:
+            obs_ledger.LEDGER.set_node_bad(node_name, False)
         for leaf_cell in self._leaves_by_node.get(node_name, []):
             self._bump_chain_gen(leaf_cell.chain)
             self._set_healthy_cell(leaf_cell)
@@ -752,6 +763,15 @@ class HivedAlgorithm(SchedulerAlgorithm):
             while w < len(pods_list) and pods_list[w] is not None:
                 w += 1
             g.pod_index_watermark[s.leaf_cell_number] = w
+            if obs_ledger.LEDGER.enabled:
+                # capacity ledger: the pod's chips turn busy (flavor from
+                # the runtime's backfill hint, else priority class);
+                # idempotent on recovery replays, probe-suppressed
+                obs_ledger.LEDGER.transition(
+                    info.node, info.leaf_cell_isolation,
+                    obs_ledger.LEDGER.busy_state(
+                        s.affinity_group.name, s.priority),
+                    vc=s.virtual_cluster, gang=s.affinity_group.name)
 
     def delete_allocated_pod(self, pod: Pod) -> None:
         """Reference: DeleteAllocatedPod, hived_algorithm.go:272-296."""
@@ -781,6 +801,12 @@ class HivedAlgorithm(SchedulerAlgorithm):
             g.allocated_pods[s.leaf_cell_number][pod_index] = None
             if pod_index < g.pod_index_watermark.get(s.leaf_cell_number, 0):
                 g.pod_index_watermark[s.leaf_cell_number] = pod_index
+            if obs_ledger.LEDGER.enabled:
+                # capacity ledger: the pod's chips return to idle (the
+                # reservation hold state when its node is held, else the
+                # current idle diagnosis)
+                obs_ledger.LEDGER.release(info.node,
+                                          info.leaf_cell_isolation)
             if all_pods_released(g.allocated_pods):
                 self._delete_allocated_affinity_group(g, pod)
                 if (obs_journal.JOURNAL.enabled

@@ -81,6 +81,11 @@ FLEET_PATH = INSPECT_PATH + "/fleet"
 # burn rates / violation attribution
 REQUESTS_PATH = INSPECT_PATH + "/requests"
 SLO_PATH = INSPECT_PATH + "/slo"
+# capacity ledger (obs/ledger.py): live chip-second attribution with the
+# conservation invariant — per-state chip-seconds + occupancy, with a
+# per-VC drilldown at GET /v1/inspect/capacity/<vc>; the wait-ETA
+# estimator rides the gangs surface (GET /v1/inspect/gangs/<id>/eta)
+CAPACITY_PATH = INSPECT_PATH + "/capacity"
 
 # --- Config (reference: constants.go:65) ------------------------------------
 ENV_CONFIG_FILE = "CONFIG"

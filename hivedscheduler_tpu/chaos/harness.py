@@ -567,14 +567,25 @@ class ChaosHarness:
         # names reused across seeds cannot alias; restored afterwards so
         # the process-global singleton never leaks into other tests.
         from hivedscheduler_tpu.obs import journal as obs_journal
+        from hivedscheduler_tpu.obs import ledger as obs_ledger
 
         was_enabled = obs_journal.JOURNAL.enabled
         obs_journal.enable(capacity=65536)
+        # the capacity ledger rides the same way: check_ledger (in
+        # check_all) asserts the conservation invariant under the same
+        # faults. Fresh books per soak; restored afterwards.
+        ledger_was_enabled = obs_ledger.LEDGER.enabled
+        obs_ledger.LEDGER.clear()
+        obs_ledger.enable()
+        obs_ledger.register_cluster(self.algo)
         try:
             return self._run(n_schedules)
         finally:
             if not was_enabled:
                 obs_journal.disable()
+            if not ledger_was_enabled:
+                obs_ledger.disable()
+                obs_ledger.LEDGER.clear()
 
     def _run(self, n_schedules: int) -> dict:
         ops = (
@@ -593,6 +604,7 @@ class ChaosHarness:
                 self.crash_restart()
         self._check("final quiesce", quiesce=True)
         from hivedscheduler_tpu.obs import journal as obs_journal
+        from hivedscheduler_tpu.obs import ledger as obs_ledger
 
         return {
             "seed": self.seed,
@@ -604,7 +616,9 @@ class ChaosHarness:
             "migrations_planned": self.migrations_planned,
             "migrations_killed": self.migrations_killed,
             "migrations_rebound": self.migrations_rebound,
-            # non-vacuity: the soak must actually have journaled
+            # non-vacuity: the soak must actually have journaled, and the
+            # ledger must actually be accounting chips
             "journal_events": len(obs_journal.JOURNAL),
+            "ledger_chips": obs_ledger.LEDGER.chips(),
             "violations": list(self.violations),
         }

@@ -443,6 +443,55 @@ def check_journal(journal=None, ctx: str = "") -> None:
             open_state[e.gang] = True
 
 
+def check_ledger(ledger=None, ctx: str = "",
+                 at: Optional[float] = None) -> None:
+    """Structural invariants of the capacity ledger (obs/ledger.py).
+    No-op while the ledger is disabled, so every soak covers it for free
+    once the harness opts in:
+
+    - **Conservation**: the per-(state, vc, chain) chip-second buckets —
+      closed intervals plus open intervals measured to ``at`` — sum to
+      ``sum over chips (at - registered_at)``. A lost or double-opened
+      interval breaks the telescoping sum and trips here.
+    - **Occupancy totals**: the per-state chip counts sum to the
+      registered chip count (every chip is in exactly one state).
+    - **Registered states only**: no chip is in a state missing from
+      ``CHIP_STATES`` (the OBS002 runtime half).
+
+    Individual buckets are NOT asserted non-negative: the bench's
+    virtual-clock replay legitimately reattributes a moved gang's
+    checkpoint downtime out of busy *before* the gang has re-accrued it
+    (see ``CapacityLedger.reattribute``); only the total is conserved.
+    """
+    from hivedscheduler_tpu.obs import ledger as obs_ledger
+
+    l = ledger if ledger is not None else obs_ledger.LEDGER
+    if not l.enabled:
+        return
+    t = l._now(at)
+    totals = l.totals(t)
+    for (state, _vc, _chain) in totals:
+        if state not in obs_ledger.CHIP_STATES:
+            _fail(ctx, f"ledger bucket carries unregistered chip state "
+                       f"{state!r} — OBS002 registry drift")
+    expected = l.expected_chip_seconds(t)
+    got = sum(totals.values())
+    if abs(got - expected) > 1e-6 * max(1.0, expected):
+        _fail(ctx, f"ledger conservation broken: buckets sum to "
+                   f"{got!r} chip-seconds but chips x wallclock is "
+                   f"{expected!r} — an interval was lost or double-opened")
+    occ = l.occupancy()
+    for state in occ:
+        if state not in obs_ledger.CHIP_STATES:
+            _fail(ctx, f"ledger occupancy carries unregistered chip "
+                       f"state {state!r}")
+    chips = l.chips()
+    if sum(occ.values()) != chips:
+        _fail(ctx, f"ledger occupancy sums to {sum(occ.values())} chips "
+                   f"but {chips} are registered — a chip is in zero or "
+                   f"two states")
+
+
 def check_all(
     algo,
     ctx: str = "",
@@ -455,8 +504,8 @@ def check_all(
     Pass the owning ``HivedScheduler`` as ``scheduler`` to additionally
     check the defrag reservation/migration state machine, and a
     ``fleet.FleetRouter`` as ``router`` for the serving-fleet invariants.
-    The journal check piggybacks on every call (no-op while the journal
-    is off)."""
+    The journal and capacity-ledger checks piggyback on every call
+    (no-ops while disabled)."""
     check_vc_safety(algo, ctx)
     check_books(algo, ctx)
     check_cell_ownership(algo, ctx)
@@ -468,6 +517,7 @@ def check_all(
     if router is not None:
         check_fleet(router, ctx)
     check_journal(ctx=ctx)
+    check_ledger(ctx=ctx)
 
 
 # ---------------------------------------------------------------------------

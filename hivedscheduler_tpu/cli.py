@@ -62,6 +62,14 @@ def main(argv=None) -> int:
         "server and served at GET /v1/inspect/gangs",
     )
     parser.add_argument(
+        "--capacity-dump",
+        default="",
+        help="write the capacity ledger's snapshot JSON (per-state "
+        "chip-seconds, occupancy, conservation gap; obs/ledger.py) to "
+        "this path on shutdown — the same payload served live at "
+        "GET /v1/inspect/capacity",
+    )
+    parser.add_argument(
         "--drain-secs",
         type=float,
         default=2.0,
@@ -91,8 +99,10 @@ def main(argv=None) -> int:
     # land there?") and the shared span tracer (bounded ring, served at
     # /v1/inspect/traces/chrome). Library/bench users stay on the
     # zero-overhead disabled path — only this entry point opts in.
+    from hivedscheduler_tpu.common import envflags
     from hivedscheduler_tpu.obs import decisions as obs_decisions
     from hivedscheduler_tpu.obs import journal as obs_journal
+    from hivedscheduler_tpu.obs import ledger as obs_ledger
     from hivedscheduler_tpu.obs import trace as obs_trace
 
     obs_decisions.RECORDER.enable()
@@ -101,6 +111,11 @@ def main(argv=None) -> int:
     # the wait-attribution histograms; --journal-file adds the crash-safe
     # JSONL spool for post-mortem replay
     obs_journal.enable(spool_path=args.journal_file or None)
+    # the capacity ledger backs /v1/inspect/capacity + the wait-ETA
+    # forecasts; HIVED_LEDGER=0 is the kill switch. Enabled BEFORE the
+    # scheduler so the algorithm registers its leaf cells at construction.
+    if envflags.get("HIVED_LEDGER") != "0":
+        obs_ledger.enable()
     if args.explain:
         obs_decisions.RECORDER.on_commit = lambda d: log.info("%s", d.explain())
     config = api_config.load_config(args.config)
@@ -160,6 +175,12 @@ def main(argv=None) -> int:
         obs_trace.write_chrome_trace(args.trace_file)
         log.info("Chrome trace written to %s (open in https://ui.perfetto.dev)",
                  args.trace_file)
+    if args.capacity_dump:
+        import json
+
+        with open(args.capacity_dump, "w") as f:
+            json.dump(obs_ledger.LEDGER.snapshot(), f)
+        log.info("Capacity ledger snapshot written to %s", args.capacity_dump)
     return 0
 
 

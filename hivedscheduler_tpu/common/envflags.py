@@ -148,6 +148,20 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
        "otherwise); backs `/v1/inspect/gangs` and the "
        "`tpu_hive_gang_wait_seconds` attribution histograms.",
        "hivedscheduler_tpu/obs/journal.py"),
+    _f("HIVED_LEDGER", "auto",
+       "Capacity ledger (obs/ledger.py) gate: `0` is the kill switch — "
+       "the scheduler CLI skips the live ledger and `bench.py`'s trace "
+       "replay falls back to the legacy hand-rolled busy/wait/overhead "
+       "counters (the differential reference path, mirroring "
+       "`HIVED_INCR=0`); `1` enables the live ledger at import time "
+       "anywhere; unset = on in the CLI and the bench, off for library "
+       "users (programmatic `ledger.enable()`).",
+       "hivedscheduler_tpu/obs/ledger.py"),
+    _f("HIVED_ETA_DEFAULT_RUN_S", "300",
+       "Wait-ETA estimator (obs/eta.py): expected gang run time used "
+       "before any completed-gang duration has been observed (the "
+       "release-projection and horizon-fallback bases).",
+       "hivedscheduler_tpu/obs/eta.py"),
     # -- chaos fault hooks (one-shot per process; unset = unarmed) --------
     _f("HIVED_FAULT_HANG_AT", "unarmed",
        "Wedge the workload at this step index (watchdog-ladder chaos "

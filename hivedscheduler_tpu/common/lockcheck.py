@@ -66,9 +66,14 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # scheduler lock — scale backends run outside the router lock).
     "fleet_router_lock": 70,
     # observability leaves: nothing is ever acquired under these.
-    # (journal_lock and slo_lock sit just below metrics_lock: closing a
-    # wait interval / observing an SLO datapoint observes histograms and
-    # gauges while holding them — the one legal under-leaf acquisition.)
+    # (ledger_lock, journal_lock and slo_lock sit just below metrics_lock:
+    # closing a chip/wait interval / observing an SLO datapoint observes
+    # histograms and gauges while holding them — the one legal under-leaf
+    # acquisition.)
+    # obs/ledger.py — capacity-ledger chip-state books. Acquired by the
+    # algorithm chokepoints (under scheduler+algorithm locks) and by
+    # webserver reads.
+    "ledger_lock": 77,
     "journal_lock": 78,
     # obs/slo.py — SLO tracker observations/quantiles. Acquired under the
     # fleet router lock (harvest observes TTFTs) and by webserver reads.
@@ -89,6 +94,7 @@ LOCK_SITES: Dict[str, str] = {
     "watchdog_lock": "hivedscheduler_tpu/parallel/supervisor.py",
     "store_lock": "hivedscheduler_tpu/k8s/fake.py",
     "fleet_router_lock": "hivedscheduler_tpu/fleet/router.py",
+    "ledger_lock": "hivedscheduler_tpu/obs/ledger.py",
     "journal_lock": "hivedscheduler_tpu/obs/journal.py",
     "slo_lock": "hivedscheduler_tpu/obs/slo.py",
     "metrics_lock": "hivedscheduler_tpu/runtime/metrics.py",

@@ -11,6 +11,13 @@ the Prometheus registry (``runtime/metrics.py``).
 - ``obs.slo`` — declared serving objectives: windowed quantiles,
   error-budget burn rate, violation attribution by dominant leg, served
   at ``GET /v1/inspect/slo``.
+- ``obs.ledger`` — capacity ledger: live chip-second attribution over
+  the ``CHIP_STATES`` taxonomy with the conservation invariant
+  (buckets sum to chips x wallclock), served at
+  ``GET /v1/inspect/capacity``.
+- ``obs.eta`` — read-only wait-ETA estimator (capacity-without-a-move
+  forecasts for waiting gangs), served at
+  ``GET /v1/inspect/gangs/<id>/eta``.
 
 See ``doc/design/observability.md`` for the full catalogue of metric
 names, trace event schemas, leg taxonomy, and the Perfetto workflow.

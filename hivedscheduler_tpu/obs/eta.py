@@ -1,0 +1,136 @@
+"""Migration-aware wait-ETA estimator (ROADMAP item 1, read-only half).
+
+"When would this waiting gang get capacity **without a move**?" — the
+planner's migrate-vs-wait scoring, elastic grow timing, and SLO-aware
+victim selection (ROADMAP item 4b) all want the same forecast. This
+module lands it as an *observability surface first*: a pure estimator
+over the capacity ledger's running-gang ages and completed-gang
+durations plus the defrag reservations' TTL deadlines, served at
+``GET /v1/inspect/gangs/<id>/eta`` and recorded as a journal annotation
+(``eta_forecast``) so later PRs can score planner/elastic decisions
+against realized waits. No consumer changes behavior on it yet.
+
+The forecast is deliberately simple and *always finite*:
+
+1. **idle-now** — enough diagnosed-idle chips already exist: ETA 0 (the
+   gang is blocked by quota/fragmentation/reservations, not capacity —
+   exactly the case a migration or backfill exists to fix; the forecast
+   says "without a move you'd start now if the chips were reachable").
+2. **release-projection** — walk projected gang completions in time
+   order, accumulating freed chips (plus reservation-held chips at their
+   TTL deadlines) until the need is covered. A running gang's expected
+   remaining time is ``median(completed durations) - age`` (the ledger
+   supplies both), floored at half the expectation for overdue gangs —
+   an overdue gang is expected to finish within another half-median, a
+   documented heuristic, not a guarantee.
+3. **horizon-fallback** — the projection never covers the need (the gang
+   is bigger than what completions can free): the last projected release
+   plus one full expected duration. Finite by construction; the basis
+   field says the number is a horizon, not a projection.
+
+Forecast error is reported honestly wherever a realized wait exists
+(the bench replay records forecast-vs-realized per admitted gang in the
+driver artifact); there is no accuracy bar yet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from hivedscheduler_tpu.common import envflags
+from hivedscheduler_tpu.obs import journal
+
+# expected run time used before any gang has completed (seconds live,
+# trace time units in the bench's virtual-clock replay)
+DEFAULT_RUN_S = float(envflags.get("HIVED_ETA_DEFAULT_RUN_S", "300")
+                      or 300)
+
+
+@dataclasses.dataclass
+class WaitEta:
+    """One forecast: how long until ``need_chips`` free up without a
+    migration, and what the number is based on."""
+
+    gang: str
+    need_chips: int
+    eta_s: float
+    basis: str              # idle-now | release-projection | horizon-fallback
+    idle_chips: int
+    running_gangs: int
+    expected_run_s: float   # the per-gang duration expectation used
+    projected_releases: int  # completions the projection consumed
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "gang": self.gang,
+            "needChips": self.need_chips,
+            "etaS": round(self.eta_s, 6),
+            "basis": self.basis,
+            "idleChips": self.idle_chips,
+            "runningGangs": self.running_gangs,
+            "expectedRunS": round(self.expected_run_s, 6),
+            "projectedReleases": self.projected_releases,
+        }
+
+
+def _expected_run(completed_durations: Sequence[float],
+                  default_run_s: float) -> float:
+    xs = sorted(d for d in completed_durations if d > 0)
+    if not xs:
+        return default_run_s
+    return xs[len(xs) // 2]
+
+
+def estimate(
+    gang: str,
+    need_chips: int,
+    idle_chips: int,
+    running: Sequence[Tuple[str, int, float, str]],
+    reserved: Sequence[Tuple[float, int]] = (),
+    completed_durations: Sequence[float] = (),
+    default_run_s: Optional[float] = None,
+) -> WaitEta:
+    """Pure forecast. ``running`` is the ledger's ``running_gangs()``
+    shape — (gang, chips, age_s, vc); ``reserved`` is (release_eta_s,
+    chips) per reservation hold (TTL deadline relative to now). Returns
+    a finite ETA for every input."""
+    default = DEFAULT_RUN_S if default_run_s is None else default_run_s
+    expect = _expected_run(completed_durations, default)
+    if idle_chips >= need_chips:
+        return WaitEta(gang, need_chips, 0.0, "idle-now", idle_chips,
+                       len(running), expect, 0)
+    releases: List[Tuple[float, int]] = []
+    for name, chips, age_s, _vc in running:
+        if name == gang:
+            continue  # a degraded incarnation of the waiter frees nothing
+        remaining = expect - age_s
+        if remaining <= 0.0:
+            remaining = expect * 0.5  # overdue: another half-expectation
+        releases.append((remaining, chips))
+    releases.extend((max(0.0, eta), chips) for eta, chips in reserved)
+    releases.sort()
+    acc = idle_chips
+    used = 0
+    for t, chips in releases:
+        acc += chips
+        used += 1
+        if acc >= need_chips:
+            return WaitEta(gang, need_chips, t, "release-projection",
+                           idle_chips, len(running), expect, used)
+    horizon = (releases[-1][0] if releases else 0.0) + expect
+    return WaitEta(gang, need_chips, horizon, "horizon-fallback",
+                   idle_chips, len(running), expect, used)
+
+
+def record(forecast: WaitEta, jr=None,
+           at: Optional[float] = None) -> None:
+    """Journal the forecast as an annotation on the waiting gang's
+    timeline, so later PRs can score it against the realized wait."""
+    args = dict(etaS=round(forecast.eta_s, 6), basis=forecast.basis,
+                needChips=forecast.need_chips,
+                idleChips=forecast.idle_chips)
+    if jr is None:
+        journal.emit("eta_forecast", forecast.gang, at=at, **args)
+    else:  # a caller-held (e.g. virtual-clock) journal instance
+        jr.emit("eta_forecast", forecast.gang, at=at, **args)

@@ -205,6 +205,10 @@ SCHEMA: Dict[str, str] = {
                    "name; legs tile the flight, TTFT legs sum to ttft_s)",
     "request_done": "the flight's single terminal: finish reason, "
                     "measured TTFT and the leg-sum gap in args",
+    # wait-ETA estimator (obs/eta.py): forecast annotation on a waiting
+    # gang's timeline, scored against the realized wait by later PRs
+    "eta_forecast": "capacity-without-a-move forecast for a waiting gang "
+                    "(etaS/basis/needChips in args; obs/eta.py)",
     # workload supervisor (train.py / parallel/supervisor.py)
     "train_resume": "a training incarnation resumed from a committed "
                     "checkpoint (preemption/crash restart)",

@@ -1,0 +1,683 @@
+"""Cluster capacity ledger: live chip-second attribution with a
+conservation invariant.
+
+PR 11 attributed gang *waits* and PR 13 attributed request *TTFT*; this
+module closes the triangle and attributes the cluster's *capacity*: at any
+instant every registered leaf cell (chip) is in **exactly one** state from
+the :data:`CHIP_STATES` registry, transitions close intervals into
+per-``(state, vc, chain)`` chip-second accumulators, and the
+**conservation invariant** — the ledger's analogue of the journal's
+sum-to-ttft assertion — holds by construction::
+
+    sum over (state, vc, chain) buckets  ==  sum over chips (now - registered_at)
+
+``chaos.invariants.check_ledger`` asserts it in every soak and the bench
+asserts it in the driver artifact, so "where did every chip-second go?"
+is a queryable fact with a machine-checked total, not a dashboard curve
+that silently leaks time.
+
+State taxonomy (the registry is the single source of truth; hivedlint
+OBS002 cross-checks every literal call site against it and the runtime
+raises on unregistered states):
+
+- ``busy_*`` — a gang's pods own the chip (guaranteed / opportunistic /
+  backfill-admitted rider);
+- ``migration_downtime`` — the chip is fenced for a mid-migration
+  re-placement (defrag/elastic grow), or (in the bench's virtual-clock
+  replay) carries the checkpoint->restore downtime charged to a moved
+  gang — occupancy that is provably not useful work;
+- ``idle_free`` / ``idle_quota_stranded`` / ``idle_fragmented`` — free
+  chips, split by the *diagnosis* of why they are idle while gangs wait
+  (no waiter / a waiter's VC quota is exhausted elsewhere / capacity
+  exists but no contiguous placement). The split is a best-effort
+  diagnosis (driven by the oldest waiter's journal wait bucket) and does
+  not affect conservation;
+- ``idle_reserved`` — held by a defrag *waiter* reservation;
+- ``bad_hardware`` — the chip's node is bad; the pre-bad state is
+  shadowed and restored on recovery.
+
+Feeding: the algorithm's ``add_allocated_pod`` / ``delete_allocated_pod``
+/ ``_set_bad_node`` / ``_set_healthy_node`` chokepoints (every placement
+path — filter routine, recovery, gang-atomic rebinds — funnels through
+them, under the scheduler lock in the runtime), plus the
+reservation-mutation sites in ``runtime/scheduler.py``. The defrag
+what-if probes' rolled-back churn is muted exactly like the journal's:
+the ledger checks the same thread-local ``journal.suppress()`` flag.
+
+Served as ``tpu_hive_chip_seconds_total{state=,vc=}`` counters and the
+``tpu_hive_chip_state_chips{state=}`` occupancy gauges, as
+``GET /v1/inspect/capacity`` (+ ``/v1/inspect/capacity/<vc>`` drilldown,
+copy-on-read), and as per-node ``state:`` Perfetto lanes merged into
+every ``trace.to_chrome_trace()`` export. The read side also feeds the
+wait-ETA estimator (``obs/eta.py``): running-gang ages and completed-gang
+durations come from here.
+
+Contracts (the PR 1/11 obs rules):
+
+- **Zero overhead when disabled** (the default): every instrumentation
+  site gates on one attribute load (``LEDGER.enabled``); the mutators
+  return before touching the lock.
+- **Bounded**: per-node Perfetto lanes and the completed-duration ring
+  are capped; accumulators are keyed by the finite (state, vc, chain)
+  space.
+- **Thread-safe leaf**: ``ledger_lock`` sits just below the metrics leaf
+  in the lock hierarchy — closing an interval observes the chip-second
+  counter while holding it, and nothing else is ever acquired under it.
+
+Enable programmatically (``ledger.enable()``), via the scheduler CLI
+(on unless ``HIVED_LEDGER=0``), or ``HIVED_LEDGER=1`` in the environment.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from hivedscheduler_tpu.common import envflags, lockcheck
+from hivedscheduler_tpu.obs import journal as _journal
+
+# ---------------------------------------------------------------------------
+# chip-state taxonomy. At any instant every registered chip is in exactly
+# ONE of these; transitions close intervals, and the per-(state, vc, chain)
+# chip-seconds sum to chips x wallclock (the conservation invariant).
+# hivedlint OBS002 cross-checks literal call sites against this table,
+# both directions; the runtime raises on unregistered states.
+# ---------------------------------------------------------------------------
+CHIP_STATES: Dict[str, str] = {
+    "busy_guaranteed": "owned by a guaranteed-priority gang's pod (useful "
+                       "work within the VC's quota)",
+    "busy_opportunistic": "owned by a natively opportunistic gang's pod "
+                          "(preemptible beyond-quota work)",
+    "busy_backfill": "owned by a backfill-admitted rider (a quota-stranded "
+                     "guaranteed gang running opportunistically, or a "
+                     "duration-bounded guaranteed rider in a reserved hole)",
+    "migration_downtime": "fenced for a mid-migration re-placement, or (in "
+                          "the bench replay) the checkpoint->restore "
+                          "downtime charged to a moved gang — occupied but "
+                          "provably not useful work",
+    "idle_free": "free with no waiter diagnosis: genuinely spare capacity",
+    "idle_quota_stranded": "free while a guaranteed gang waits because its "
+                           "OWN VC quota is exhausted (backfill/promotion "
+                           "is the unblocking arm)",
+    "idle_fragmented": "free while a gang waits because no contiguous "
+                       "placement exists (defrag migration is the "
+                       "unblocking arm)",
+    "idle_reserved": "held by a defrag waiter reservation: fenced for the "
+                     "consolidated slice until the waiter binds or TTL",
+    "bad_hardware": "the chip's node is bad; the pre-bad state is shadowed "
+                    "and restored on node recovery",
+}
+
+# the states a free chip may be diagnosed into (reclassified as waiters
+# come and go); idle_reserved is a *hold*, not a diagnosis, and is managed
+# by the reservation sync
+IDLE_DIAG_STATES = ("idle_free", "idle_quota_stranded", "idle_fragmented")
+
+# journal wait bucket -> idle diagnosis. `capacity` waiters leave idle
+# chips as idle_free: the chips really are spare, there are just too few.
+IDLE_STATE_FOR_BUCKET: Dict[str, str] = {
+    "vc_quota": "idle_quota_stranded",
+    "fragmentation": "idle_fragmented",
+}
+
+# defrag reservation kind -> the state its held idle chips burn as (the
+# runtime's sync_reserved feeds through this; doc/design/defrag.md)
+HOLD_STATE_FOR_KIND: Dict[str, str] = {
+    "waiter": "idle_reserved",
+    "migration": "migration_downtime",
+}
+
+_BUSY_STATES = ("busy_guaranteed", "busy_opportunistic", "busy_backfill")
+
+# chip record field indices (a list per chip, mutated in place)
+_STATE, _VC, _GANG, _SINCE, _SHADOW = 0, 1, 2, 3, 4
+
+_MAX_DURATIONS = 256
+_MAX_LANE_SPANS = 512
+_LANE_TID_BASE = 20000  # Perfetto tids; journal gang lanes start at 1000
+
+
+class CapacityLedger:
+    """Per-chip state machine + chip-second accumulators.
+
+    Instantiable for tests and for the bench's virtual-clock replay; the
+    module singleton :data:`LEDGER` is what the live stack shares.
+    ``metrics`` gates the counter/gauge emission so a sim-time instance
+    never pollutes the process registry with virtual durations.
+    """
+
+    def __init__(self, metrics: bool = True):
+        self._lock = lockcheck.make_lock("ledger_lock", late=True)
+        self.enabled = False
+        self.metrics = metrics
+        # node -> {"chain": str, "bad": bool, "chips": [chip...],
+        #          "lane": [(label, start, end)...], "open": (label, since)}
+        self._nodes: Dict[str, Dict[str, Any]] = {}
+        self._acc: Dict[Tuple[str, str, str], float] = {}
+        # live busy gangs: gang -> {"chips": n, "since": t, "vc": vc}
+        self._gangs: Dict[str, Dict[str, Any]] = {}
+        # per-gang closed chip-seconds by state (bounded via gang count:
+        # closed gangs are evicted oldest-first past the cap)
+        self._gang_acc: Dict[str, Dict[str, float]] = {}
+        self._gang_acc_cap = 4096
+        self._durations: deque = deque(maxlen=_MAX_DURATIONS)
+        self._flavors: Dict[str, str] = {}  # gang -> busy flavor hint
+        self._reserved: Dict[str, str] = {}  # node -> hold state
+        self._idle_default = "idle_free"
+        self._registered: List[Tuple[int, float]] = []  # (chips, at)
+        self._occ: Dict[str, int] = {}  # live state -> chip count
+        self._next_tid = _LANE_TID_BASE
+
+    # -- internals (caller holds self._lock) -----------------------------
+    @staticmethod
+    def _now(at: Optional[float]) -> float:
+        return time.perf_counter() if at is None else at
+
+    @staticmethod
+    def _check_state(state: str) -> None:
+        if state not in CHIP_STATES:
+            raise ValueError(
+                f"{state!r} is not a registered chip state — add it to "
+                f"obs/ledger.py CHIP_STATES (OBS002)")
+
+    def _observe(self, state: str, vc: str, dur: float) -> None:
+        if self.metrics and dur > 0:
+            from hivedscheduler_tpu.runtime.metrics import REGISTRY
+            REGISTRY.inc("tpu_hive_chip_seconds_total", amount=dur,
+                         state=state, vc=vc)
+
+    def _close_chip(self, chain: str, chip: list, at: float) -> None:
+        dur = at - chip[_SINCE]
+        if dur <= 0:
+            chip[_SINCE] = at
+            return
+        key = (chip[_STATE], chip[_VC], chain)
+        self._acc[key] = self._acc.get(key, 0.0) + dur
+        gang = chip[_GANG]
+        if gang:
+            acc = self._gang_acc.get(gang)
+            if acc is None:
+                if len(self._gang_acc) >= self._gang_acc_cap:
+                    # evict the oldest entry not backing a live gang
+                    for name in list(self._gang_acc):
+                        if name not in self._gangs:
+                            del self._gang_acc[name]
+                            break
+                acc = self._gang_acc[gang] = {}
+            acc[chip[_STATE]] = acc.get(chip[_STATE], 0.0) + dur
+        self._observe(chip[_STATE], chip[_VC], dur)
+        chip[_SINCE] = at
+
+    def _gang_join(self, gang: str, vc: str, at: float) -> None:
+        rec = self._gangs.get(gang)
+        if rec is None:
+            self._gangs[gang] = {"chips": 1, "since": at, "vc": vc}
+        else:
+            rec["chips"] += 1
+
+    def _gang_leave(self, gang: str, at: float) -> None:
+        rec = self._gangs.get(gang)
+        if rec is None:
+            return
+        rec["chips"] -= 1
+        if rec["chips"] <= 0:
+            self._durations.append(max(0.0, at - rec["since"]))
+            del self._gangs[gang]
+            self._flavors.pop(gang, None)
+
+    def _set_chip(self, nrec: Dict[str, Any], chip: list, state: str,
+                  vc: str, gang: str, at: float) -> None:
+        """Core per-chip transition. On a bad chip the *shadow* state is
+        updated instead (the live state stays bad_hardware until node
+        recovery), but vc/gang attribution changes take effect
+        immediately so releases while bad stay exact."""
+        if chip[_SHADOW] is not None:
+            if (chip[_SHADOW], chip[_VC], chip[_GANG]) == (state, vc, gang):
+                return
+            self._close_chip(nrec["chain"], chip, at)
+            if chip[_GANG] != gang:
+                if chip[_GANG]:
+                    self._gang_leave(chip[_GANG], at)
+                if gang:
+                    self._gang_join(gang, vc, at)
+            chip[_SHADOW] = state
+            chip[_VC] = vc
+            chip[_GANG] = gang
+            return
+        if (chip[_STATE], chip[_VC], chip[_GANG]) == (state, vc, gang):
+            return
+        self._close_chip(nrec["chain"], chip, at)
+        if chip[_GANG] != gang:
+            if chip[_GANG]:
+                self._gang_leave(chip[_GANG], at)
+            if gang:
+                self._gang_join(gang, vc, at)
+        self._occ[chip[_STATE]] = self._occ.get(chip[_STATE], 0) - 1
+        self._occ[state] = self._occ.get(state, 0) + 1
+        chip[_STATE] = state
+        chip[_VC] = vc
+        chip[_GANG] = gang
+
+    def _relane(self, nrec: Dict[str, Any], at: float) -> None:
+        """Maintain the node's Perfetto lane: one span per period of a
+        constant dominant state."""
+        counts: Dict[str, int] = {}
+        for chip in nrec["chips"]:
+            st = chip[_STATE]
+            counts[st] = counts.get(st, 0) + 1
+        dominant = max(counts.items(), key=lambda kv: (kv[1], kv[0]))[0] \
+            if counts else "idle_free"
+        label = f"state:{dominant}"
+        open_span = nrec["open"]
+        if open_span is not None and open_span[0] == label:
+            return
+        if open_span is not None:
+            if len(nrec["lane"]) < _MAX_LANE_SPANS:
+                nrec["lane"].append((open_span[0], open_span[1], at))
+        nrec["open"] = (label, at)
+
+    def _idle_state(self, node: str) -> str:
+        return self._reserved.get(node, self._idle_default)
+
+    def _update_gauges(self) -> None:
+        if not self.metrics:
+            return
+        from hivedscheduler_tpu.runtime.metrics import REGISTRY
+        with self._lock:
+            occ = dict(self._occ)
+        for state in CHIP_STATES:
+            REGISTRY.set_gauge("tpu_hive_chip_state_chips",
+                               occ.get(state, 0), state=state)
+
+    # -- mutators (the instrumentation surface) --------------------------
+    def register_node(self, node: str, count: int, chain: str = "",
+                      at: Optional[float] = None,
+                      state: str = "idle_free") -> None:
+        """Idempotent: re-registering a known node keeps its chips and
+        their accumulated history (crash-restart continuity)."""
+        if not self.enabled or _journal.suppressed():
+            return
+        self._check_state(state)
+        t = self._now(at)
+        with self._lock:
+            if node in self._nodes:
+                return
+            self._nodes[node] = {
+                "chain": chain, "bad": False, "tid": self._next_tid,
+                "chips": [[state, "", "", t, None] for _ in range(count)],
+                "lane": [], "open": (f"state:{state}", t),
+            }
+            self._next_tid += 1
+            self._registered.append((count, t))
+            self._occ[state] = self._occ.get(state, 0) + count
+        self._update_gauges()
+
+    def _node_for(self, node: str, max_idx: int, at: float) -> Dict[str, Any]:
+        nrec = self._nodes.get(node)
+        if nrec is None:
+            # lazy fallback for a ledger enabled mid-run: register what we
+            # can see (explicit register_cluster is the full-count path)
+            self._nodes[node] = nrec = {
+                "chain": "", "bad": False, "tid": self._next_tid,
+                "chips": [], "lane": [], "open": None,
+            }
+            self._next_tid += 1
+        grow = max_idx + 1 - len(nrec["chips"])
+        if grow > 0:
+            idle = self._idle_state(node)
+            nrec["chips"].extend(
+                [idle, "", "", at, None] for _ in range(grow))
+            self._registered.append((grow, at))
+            self._occ[idle] = self._occ.get(idle, 0) + grow
+        return nrec
+
+    def transition(self, node: str, idxs, state: str, vc: str = "",
+                   gang: str = "", at: Optional[float] = None) -> None:
+        """Move the chips at ``idxs`` on ``node`` into ``state`` (closing
+        their open intervals). Same (state, vc, gang) is a no-op — the
+        interval just continues (recovery replays are idempotent)."""
+        if not self.enabled or _journal.suppressed():
+            return
+        self._check_state(state)
+        idxs = list(idxs)
+        if not idxs:
+            return
+        t = self._now(at)
+        with self._lock:
+            nrec = self._node_for(node, max(idxs), t)
+            for i in idxs:
+                self._set_chip(nrec, nrec["chips"][i], state, vc, gang, t)
+            self._relane(nrec, t)
+        self._update_gauges()
+
+    def release(self, node: str, idxs, at: Optional[float] = None) -> None:
+        """Chips return to idle: the reservation hold state when the node
+        is held, else the current idle diagnosis."""
+        if not self.enabled or _journal.suppressed():
+            return
+        self.transition(node, idxs, self._idle_state(node), at=at)
+
+    def set_node_bad(self, node: str, bad: bool,
+                     at: Optional[float] = None) -> None:
+        """All chips of a bad node burn as ``bad_hardware``; their pre-bad
+        states shadow and restore on recovery (transitions while bad
+        update the shadow, so a release-while-bad restores idle)."""
+        if not self.enabled or _journal.suppressed():
+            return
+        t = self._now(at)
+        with self._lock:
+            nrec = self._nodes.get(node)
+            if nrec is None or nrec["bad"] == bad:
+                return
+            nrec["bad"] = bad
+            for chip in nrec["chips"]:
+                self._close_chip(nrec["chain"], chip, t)
+                self._occ[chip[_STATE]] = self._occ.get(chip[_STATE], 0) - 1
+                if bad:
+                    chip[_SHADOW] = chip[_STATE]
+                    chip[_STATE] = "bad_hardware"
+                else:
+                    chip[_STATE] = chip[_SHADOW] or "idle_free"
+                    chip[_SHADOW] = None
+                self._occ[chip[_STATE]] = self._occ.get(chip[_STATE], 0) + 1
+            self._relane(nrec, t)
+        self._update_gauges()
+
+    def sync_reserved(self, holds: Dict[str, str],
+                      at: Optional[float] = None) -> None:
+        """Reconcile the reservation holds (node -> hold state, from the
+        runtime's reservation table): newly held nodes' diagnosed-idle
+        chips move into the hold state, released nodes' held chips return
+        to the idle diagnosis. Busy chips are never touched — a hold on a
+        node still running the mover only captures chips as they free."""
+        if not self.enabled or _journal.suppressed():
+            return
+        for state in set(holds.values()):
+            self._check_state(state)
+        t = self._now(at)
+        with self._lock:
+            changed = set(self._reserved) | set(holds)
+            for node in changed:
+                new = holds.get(node)
+                if self._reserved.get(node) == new:
+                    continue
+                nrec = self._nodes.get(node)
+                if nrec is not None:
+                    from_states = ((self._reserved.get(node),)
+                                   if node in self._reserved
+                                   else IDLE_DIAG_STATES)
+                    to = new if new is not None else self._idle_default
+                    for chip in nrec["chips"]:
+                        live = (chip[_SHADOW] if chip[_SHADOW] is not None
+                                else chip[_STATE])
+                        if live in from_states:
+                            self._set_chip(nrec, chip, to, "", "", t)
+                    self._relane(nrec, t)
+                if new is None:
+                    self._reserved.pop(node, None)
+                else:
+                    self._reserved[node] = new
+        self._update_gauges()
+
+    def set_idle_diagnosis(self, state: str,
+                           at: Optional[float] = None) -> None:
+        """Reclassify diagnosed-idle chips (idle_free / idle_quota_stranded
+        / idle_fragmented) under a new diagnosis — driven by the oldest
+        waiter's journal wait bucket. Reserved holds are untouched."""
+        if not self.enabled or _journal.suppressed():
+            return
+        if state not in IDLE_DIAG_STATES:
+            self._check_state(state)  # raise the OBS002 message
+            raise ValueError(
+                f"{state!r} is a registered chip state but not an idle "
+                f"diagnosis ({'/'.join(IDLE_DIAG_STATES)})")
+        t = self._now(at)
+        with self._lock:
+            if self._idle_default == state:
+                return
+            self._idle_default = state
+            for node, nrec in self._nodes.items():
+                if node in self._reserved:
+                    continue
+                touched = False
+                for chip in nrec["chips"]:
+                    live = (chip[_SHADOW] if chip[_SHADOW] is not None
+                            else chip[_STATE])
+                    if live in IDLE_DIAG_STATES and live != state:
+                        self._set_chip(nrec, chip, state, "", "", t)
+                        touched = True
+                if touched:
+                    self._relane(nrec, t)
+        self._update_gauges()
+
+    def hint_flavor(self, gang: str, state: str) -> None:
+        """The runtime knows a gang is a backfill rider before its pods
+        bind; the algorithm chokepoint reads the hint at bind time."""
+        if not self.enabled:
+            return
+        self._check_state(state)
+        self._flavors[gang] = state
+
+    def busy_state(self, gang: str, priority: int) -> str:
+        hinted = self._flavors.get(gang)
+        if hinted is not None:
+            return hinted
+        return "busy_guaranteed" if priority >= 0 else "busy_opportunistic"
+
+    def reattribute(self, chip_seconds: float,
+                    src: Tuple[str, str, str],
+                    dst: Tuple[str, str, str]) -> None:
+        """Move closed chip-seconds between buckets (conservation-
+        preserving). Sim-only hook: the bench's virtual-clock replay
+        charges a moved gang's checkpoint->restore downtime out of its
+        busy bucket the way the legacy counters subtract overhead; the
+        live ledger never needs it (live downtime is real elapsed time in
+        ``migration_downtime``). The source bucket may go transiently
+        negative mid-replay (the downtime is paid by *future* occupancy);
+        conservation of the TOTAL is unaffected."""
+        if not self.enabled:
+            return
+        self._check_state(src[0])
+        self._check_state(dst[0])
+        with self._lock:
+            self._acc[src] = self._acc.get(src, 0.0) - chip_seconds
+            self._acc[dst] = self._acc.get(dst, 0.0) + chip_seconds
+            self._observe(dst[0], dst[1], chip_seconds)
+
+    def settle(self, at: Optional[float] = None) -> None:
+        """Close every open interval at ``at`` (sim end-of-replay / dump
+        points); states are kept, intervals restart at ``at``."""
+        t = self._now(at)
+        with self._lock:
+            for nrec in self._nodes.values():
+                for chip in nrec["chips"]:
+                    self._close_chip(nrec["chain"], chip, t)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._nodes.clear()
+            self._acc.clear()
+            self._gangs.clear()
+            self._gang_acc.clear()
+            self._durations.clear()
+            self._flavors.clear()
+            self._reserved.clear()
+            self._idle_default = "idle_free"
+            self._registered = []
+            self._occ.clear()
+            self._next_tid = _LANE_TID_BASE
+
+    # -- read API (copy-on-read) -----------------------------------------
+    def totals(self, at: Optional[float] = None) -> Dict[Tuple[str, str, str],
+                                                         float]:
+        """Closed + open chip-seconds per (state, vc, chain) bucket as of
+        ``at`` — the conservation check's left-hand side."""
+        t = self._now(at)
+        with self._lock:
+            out = dict(self._acc)
+            for nrec in self._nodes.values():
+                for chip in nrec["chips"]:
+                    dur = t - chip[_SINCE]
+                    if dur > 0:
+                        key = (chip[_STATE], chip[_VC], nrec["chain"])
+                        out[key] = out.get(key, 0.0) + dur
+            return out
+
+    def expected_chip_seconds(self, at: Optional[float] = None) -> float:
+        """chips x wallclock, honoring per-chip registration times — the
+        conservation check's right-hand side."""
+        t = self._now(at)
+        with self._lock:
+            return sum(n * max(0.0, t - t0) for n, t0 in self._registered)
+
+    def conservation_gap(self, at: Optional[float] = None) -> float:
+        t = self._now(at)
+        return sum(self.totals(t).values()) - self.expected_chip_seconds(t)
+
+    def chips(self) -> int:
+        with self._lock:
+            return sum(len(nrec["chips"]) for nrec in self._nodes.values())
+
+    def occupancy(self) -> Dict[str, int]:
+        with self._lock:
+            return {s: n for s, n in self._occ.items() if n}
+
+    def running_gangs(self, at: Optional[float] = None
+                      ) -> List[Tuple[str, int, float, str]]:
+        """(gang, chips, age_s, vc) per live busy gang — the wait-ETA
+        estimator's release-projection input."""
+        t = self._now(at)
+        with self._lock:
+            return [(g, rec["chips"], max(0.0, t - rec["since"]), rec["vc"])
+                    for g, rec in self._gangs.items()]
+
+    def completed_durations(self) -> List[float]:
+        with self._lock:
+            return list(self._durations)
+
+    def gang_seconds(self, gang: str) -> Dict[str, float]:
+        """Closed chip-seconds by state for one gang (the bench's wasted-
+        work derivation)."""
+        with self._lock:
+            return dict(self._gang_acc.get(gang, {}))
+
+    def snapshot(self, at: Optional[float] = None) -> Dict[str, Any]:
+        """The ``GET /v1/inspect/capacity`` payload (copy-on-read)."""
+        t = self._now(at)
+        totals = self.totals(t)
+        by_state: Dict[str, float] = {}
+        by_vc: Dict[str, Dict[str, float]] = {}
+        for (state, vc, _chain), secs in totals.items():
+            by_state[state] = by_state.get(state, 0.0) + secs
+            if vc:
+                by_vc.setdefault(vc, {})
+                by_vc[vc][state] = by_vc[vc].get(state, 0.0) + secs
+        occ = self.occupancy()
+        expected = self.expected_chip_seconds(t)
+        durations = self.completed_durations()
+        return {
+            "enabled": self.enabled,
+            "chips": self.chips(),
+            "states": {
+                s: {"chipSeconds": round(by_state.get(s, 0.0), 6),
+                    "chips": occ.get(s, 0)}
+                for s in CHIP_STATES
+            },
+            "byVc": {vc: {s: round(v, 6) for s, v in sorted(states.items())}
+                     for vc, states in sorted(by_vc.items())},
+            "idleDiagnosis": self._idle_default,
+            "runningGangs": len(self._gangs),
+            "completedGangDurationP50S": (
+                round(sorted(durations)[len(durations) // 2], 6)
+                if durations else None),
+            "expectedChipSeconds": round(expected, 6),
+            "conservationGapChipSeconds": round(
+                sum(totals.values()) - expected, 6),
+        }
+
+    def vc_snapshot(self, vc: str, at: Optional[float] = None
+                    ) -> Dict[str, Any]:
+        """The ``GET /v1/inspect/capacity/<vc>`` drilldown: this VC's
+        capacity burn by state plus its live gangs."""
+        t = self._now(at)
+        totals = self.totals(t)
+        states: Dict[str, float] = {}
+        for (state, v, _chain), secs in totals.items():
+            if v == vc:
+                states[state] = states.get(state, 0.0) + secs
+        with self._lock:
+            gangs = [
+                {"gang": g, "chips": rec["chips"],
+                 "ageS": round(max(0.0, t - rec["since"]), 6)}
+                for g, rec in sorted(self._gangs.items())
+                if rec["vc"] == vc
+            ]
+            chips_now = sum(
+                1 for nrec in self._nodes.values()
+                for chip in nrec["chips"] if chip[_VC] == vc
+            )
+        return {
+            "vc": vc, "enabled": self.enabled,
+            "states": {s: round(v, 6) for s, v in sorted(states.items())},
+            "chipsNow": chips_now,
+            "gangs": gangs,
+        }
+
+    def chrome_events(self, t0: float) -> List[Dict[str, Any]]:
+        """Per-node Perfetto lanes: one named thread lane per node, an X
+        span per closed dominant-state period (open periods are drawn to
+        the export instant). ``t0`` is the tracer's perf_counter anchor."""
+        now = time.perf_counter()
+        with self._lock:
+            lanes = [
+                (node, nrec["tid"],
+                 list(nrec["lane"]),
+                 nrec["open"])
+                for node, nrec in self._nodes.items()
+            ]
+        out: List[Dict[str, Any]] = []
+        for node, tid, spans, open_span in lanes:
+            out.append({"name": "thread_name", "ph": "M", "pid": 1,
+                        "tid": tid, "ts": 0,
+                        "args": {"name": f"node {node}"}})
+            if open_span is not None:
+                spans = spans + [(open_span[0], open_span[1], now)]
+            for label, start, end in spans:
+                out.append({"name": label, "ph": "X", "cat": "ledger",
+                            "ts": (start - t0) * 1e6,
+                            "dur": max(0.0, (end - start) * 1e6),
+                            "pid": 1, "tid": tid, "args": {}})
+        return out
+
+
+LEDGER = CapacityLedger()
+
+
+def enabled() -> bool:
+    return LEDGER.enabled
+
+
+def enable() -> None:
+    LEDGER.enabled = True
+
+
+def disable() -> None:
+    LEDGER.enabled = False
+
+
+def register_cluster(algo, at: Optional[float] = None) -> None:
+    """Register every leaf cell of an algorithm's cell trees (node ->
+    chip count + chain), syncing current node badness. Idempotent — a
+    crash-restarted scheduler re-registers into the same timeline."""
+    if not LEDGER.enabled:
+        return
+    for node, leaves in getattr(algo, "_leaves_by_node", {}).items():
+        LEDGER.register_node(node, len(leaves),
+                             chain=str(leaves[0].chain), at=at)
+        if node in getattr(algo, "bad_nodes", ()):
+            LEDGER.set_node_bad(node, True, at=at)
+
+
+if envflags.get("HIVED_LEDGER") == "1":  # ad-hoc opt-in, like HIVED_JOURNAL
+    enable()

@@ -256,14 +256,21 @@ def to_chrome_trace() -> Dict[str, Any]:
     """The shared timeline as Chrome-trace JSON. When the gang-lifecycle
     journal is enabled, its per-gang tracks (one named lane per gang:
     lifecycle instants + wait-interval spans) are merged in — every
-    exporter (webserver, --trace-file, --metrics-dump) gets them free."""
+    exporter (webserver, --trace-file, --metrics-dump) gets them free.
+    The capacity ledger's per-node ``state:`` lanes merge the same way."""
     out = TRACER.to_chrome_trace()
     from hivedscheduler_tpu.obs import journal as _journal
+    from hivedscheduler_tpu.obs import ledger as _ledger
 
     if _journal.JOURNAL.enabled:
         out["traceEvents"] = (
             list(out["traceEvents"])
             + _journal.JOURNAL.chrome_events(TRACER._t0)
+        )
+    if _ledger.LEDGER.enabled:
+        out["traceEvents"] = (
+            list(out["traceEvents"])
+            + _ledger.LEDGER.chrome_events(TRACER._t0)
         )
     return out
 

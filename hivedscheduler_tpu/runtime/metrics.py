@@ -20,7 +20,9 @@ class Registry:
     def __init__(self):
         self._lock = lockcheck.make_lock("metrics_lock", late=True)
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
-        self._gauges: Dict[str, float] = {}
+        # gauges key like counters: (name, sorted label items) — plain
+        # set_gauge(name, v) is the ()-labels series
+        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         # (name, labels) -> (bucket counts, sum, count)
         self._histograms: Dict[
             Tuple[str, Tuple[Tuple[str, str], ...]],
@@ -36,16 +38,18 @@ class Registry:
         with self._lock:
             self._counters[key] = self._counters.get(key, 0.0) + amount
 
-    def set_gauge(self, name: str, value: float) -> None:
+    def set_gauge(self, name: str, value: float, **labels: str) -> None:
+        key = (name, tuple(sorted(labels.items())))
         with self._lock:
-            self._gauges[name] = value
+            self._gauges[key] = value
 
-    def get_gauge(self, name: str):
+    def get_gauge(self, name: str, **labels: str):
         """Last value set for a gauge, or None if never set — the
         scheduler-side admission hints read serving-published gauges
         through this (runtime/scheduler.py get_admission_hints)."""
+        key = (name, tuple(sorted(labels.items())))
         with self._lock:
-            return self._gauges.get(name)
+            return self._gauges.get(key)
 
     def observe(self, name: str, seconds: float, **labels: str) -> None:
         """Record one histogram sample. ``labels`` mirror ``inc`` (e.g. the
@@ -91,11 +95,20 @@ class Registry:
                         else ""
                     )
                     out.append(f"{name}{label_str} {self._fmt(value)}")
-            for name, value in sorted(self._gauges.items()):
+            gauge_names = sorted({n for n, _ in self._gauges})
+            for name in gauge_names:
                 if name in self._help:
                     out.append(f"# HELP {name} {self._help[name]}")
                 out.append(f"# TYPE {name} gauge")
-                out.append(f"{name} {self._fmt(value)}")
+                for (n, labels), value in sorted(self._gauges.items()):
+                    if n != name:
+                        continue
+                    label_str = (
+                        "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+                        if labels
+                        else ""
+                    )
+                    out.append(f"{name}{label_str} {self._fmt(value)}")
             hist_names = sorted({n for n, _ in self._histograms})
             for name in hist_names:
                 if name in self._help:
@@ -284,3 +297,16 @@ REGISTRY.describe("tpu_hive_train_cross_topology_resumes_total",
                   "Training incarnations that restored a checkpoint saved "
                   "on a DIFFERENT (dp, fsdp, pp, ep, tp, sp) mesh "
                   "(reshard-on-load; loss allclose, not bit-exact)")
+# capacity ledger (obs/ledger.py): live chip-second attribution — at any
+# instant every registered chip is in exactly one CHIP_STATES state, and
+# the per-state chip-seconds sum to chips x wallclock (check_ledger)
+REGISTRY.describe("tpu_hive_chip_seconds_total",
+                  "Closed chip-state intervals by state and VC (state "
+                  "label: obs/ledger.py CHIP_STATES — busy_guaranteed, "
+                  "busy_opportunistic, busy_backfill, migration_downtime, "
+                  "idle_free, idle_quota_stranded, idle_fragmented, "
+                  "idle_reserved, bad_hardware; the buckets sum to "
+                  "chips x wallclock, the conservation invariant)")
+REGISTRY.describe("tpu_hive_chip_state_chips",
+                  "Chips currently in each ledger state (occupancy "
+                  "gauge; sums to the registered chip count)")

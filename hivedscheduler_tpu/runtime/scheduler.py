@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 
 from hivedscheduler_tpu.api.constants import COMPONENT_NAME as _COMPONENT
 from hivedscheduler_tpu.obs import journal as obs_journal
+from hivedscheduler_tpu.obs import ledger as obs_ledger
 from hivedscheduler_tpu.obs import trace
 from hivedscheduler_tpu.runtime.metrics import REGISTRY as metrics
 
@@ -476,6 +477,13 @@ class HivedScheduler:
                     ).start()
                 log.info("[%s]: Pod is binding to %s",
                          internal_utils.key(pod), binding_pod.node_name)
+                if obs_ledger.LEDGER.enabled and not any(
+                    st.pod_state == internal.POD_WAITING
+                    for st in self.pod_schedule_statuses.values()
+                ):
+                    # no gang is waiting any more: idle chips are plain
+                    # spare capacity again
+                    obs_ledger.LEDGER.set_idle_diagnosis("idle_free")
                 return (
                     ei.ExtenderFilterResult(node_names=[binding_pod.node_name]),
                     "bind",
@@ -514,6 +522,16 @@ class HivedScheduler:
                     pod).affinity_group.name
                 self._defrag_waiters.setdefault(
                     group, {"pod": pod, "since": time.monotonic()})
+            if obs_ledger.LEDGER.enabled:
+                # capacity ledger: diagnose WHY idle chips are idle from
+                # this waiter's journal bucket (vc_quota -> stranded,
+                # fragmentation -> fragmented; capacity keeps idle_free)
+                bucket = obs_journal.classify_wait(
+                    result.pod_wait_info.reason
+                    if result.pod_wait_info is not None else "")
+                obs_ledger.LEDGER.set_idle_diagnosis(
+                    obs_ledger.IDLE_STATE_FOR_BUCKET.get(
+                        bucket, "idle_free"))
             log.info("[%s]: %s", internal_utils.key(pod), wait_reason)
             return (
                 ei.ExtenderFilterResult(failed_nodes={_COMPONENT: wait_reason}),
@@ -788,6 +806,10 @@ class HivedScheduler:
             if obs_journal.JOURNAL.enabled:
                 obs_journal.emit("backfill_admitted", group,
                                  outcome="admitted")
+            if obs_ledger.LEDGER.enabled:
+                # the gang's chips will bind as a backfill rider, not a
+                # plain opportunistic gang — the ledger's flavor hint
+                obs_ledger.LEDGER.hint_flavor(group, "busy_backfill")
             return False
         if (defrag_pkg.backfill_enabled() and s.duration_seconds > 0
                 and self._duration_fits_all_holds(
@@ -799,6 +821,8 @@ class HivedScheduler:
             if obs_journal.JOURNAL.enabled:
                 obs_journal.emit("backfill_admitted", group,
                                  outcome="fits-window")
+            if obs_ledger.LEDGER.enabled:
+                obs_ledger.LEDGER.hint_flavor(group, "busy_backfill")
             return False
         metrics.inc("tpu_hive_backfill_admissions_total", outcome="blocked")
         return True
@@ -815,6 +839,17 @@ class HivedScheduler:
     def _update_reservation_gauge(self) -> None:
         metrics.set_gauge("tpu_hive_defrag_reservations",
                           len(self._reservations))
+        if obs_ledger.LEDGER.enabled:
+            # capacity ledger: idle chips on held nodes burn as
+            # idle_reserved (waiter holds) / migration_downtime (move
+            # targets); called at every reservation mutation site, so the
+            # diff-based sync sees every hold change
+            holds = {}
+            for r in self._reservations.values():
+                state = obs_ledger.HOLD_STATE_FOR_KIND[r.kind]
+                for n in r.nodes:
+                    holds[n] = state
+            obs_ledger.LEDGER.sync_reserved(holds)
 
     def _sweep_expired_reservations(self) -> None:
         now = time.monotonic()
@@ -1471,6 +1506,50 @@ class HivedScheduler:
                     for group, rec in sorted(self._elastic_degraded.items())
                 },
             }
+
+    def get_gang_eta(self, group: str) -> dict:
+        """Wait-ETA forecast for a waiting gang (obs/eta.py, read-only):
+        capacity-without-a-move from the capacity ledger's running-gang
+        ages + completed-gang durations and the defrag reservations' TTL
+        deadlines; served at ``GET /v1/inspect/gangs/<id>/eta`` and
+        recorded as an ``eta_forecast`` journal annotation so later PRs
+        can score forecasts against realized waits."""
+        from hivedscheduler_tpu.obs import eta as obs_eta
+
+        with self.scheduler_lock:
+            rec = self._defrag_waiters.get(group)
+            pod = rec["pod"] if rec is not None else None
+            if pod is None:
+                for st in self.pod_schedule_statuses.values():
+                    if (st.pod is not None
+                            and not internal.is_allocated(st.pod_state)
+                            and self._group_of(st.pod) == group):
+                        pod = st.pod
+                        break
+            if pod is None:
+                raise api.WebServerError(
+                    404, f"no waiting gang named {group!r} is known to "
+                         f"the scheduler")
+            spec = GangSpec.from_pod(pod)
+            lg = obs_ledger.LEDGER
+            occ = lg.occupancy()
+            idle = sum(occ.get(s, 0) for s in obs_ledger.IDLE_DIAG_STATES)
+            held = (occ.get("idle_reserved", 0)
+                    + occ.get("migration_downtime", 0))
+            reserved = []
+            if held and self._reservations:
+                now_m = time.monotonic()
+                soonest = min(r.deadline for r in
+                              self._reservations.values())
+                reserved = [(max(0.0, soonest - now_m), held)]
+            forecast = obs_eta.estimate(
+                group, spec.chips, idle_chips=idle,
+                running=lg.running_gangs(), reserved=reserved,
+                completed_durations=lg.completed_durations())
+            obs_eta.record(forecast)
+            out = forecast.to_dict()
+            out["ledgerEnabled"] = lg.enabled
+            return out
 
     def get_admission_hints(self) -> dict:
         """Scheduler-visible admission hints: the serving tier's block-pool

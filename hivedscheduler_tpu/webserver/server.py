@@ -189,6 +189,7 @@ def _make_handler(scheduler: HivedScheduler, webserver: Optional[WebServer] = No
                         C.ADMISSION_HINTS_PATH, C.DEFRAG_PATH,
                         C.GANGS_PATH, C.FLEET_PATH,
                         C.REQUESTS_PATH, C.SLO_PATH,
+                        C.CAPACITY_PATH,
                     ]})
                 elif path == C.FLEET_PATH:
                     # serving-fleet router snapshot (copy-on-read under
@@ -234,6 +235,29 @@ def _make_handler(scheduler: HivedScheduler, webserver: Optional[WebServer] = No
                         raise WebServerError(400, "request id is empty")
                     self._reply(
                         200, obs_journal.JOURNAL.request_timeline(rid))
+                elif path == C.CAPACITY_PATH:
+                    # capacity ledger: per-state chip-seconds + occupancy
+                    # with the conservation fields (copy-on-read; valid
+                    # JSON with zeros when the ledger is off)
+                    from hivedscheduler_tpu.obs import ledger as obs_ledger
+
+                    self._reply(200, obs_ledger.LEDGER.snapshot())
+                elif full.startswith(C.CAPACITY_PATH + "/"):
+                    # /v1/inspect/capacity/<vc> — one VC's capacity burn
+                    from hivedscheduler_tpu.obs import ledger as obs_ledger
+
+                    vc = full[len(C.CAPACITY_PATH) + 1:].rstrip("/")
+                    if not vc:
+                        raise WebServerError(400, "vc name is empty")
+                    self._reply(200, obs_ledger.LEDGER.vc_snapshot(vc))
+                elif (full.startswith(C.GANGS_PATH + "/")
+                        and path.endswith("/eta")):
+                    # /v1/inspect/gangs/<id>/eta — wait-ETA forecast for
+                    # a waiting gang (slash-tolerant ids, like /timeline)
+                    gang = path[len(C.GANGS_PATH) + 1:-len("/eta")]
+                    if not gang:
+                        raise WebServerError(400, "gang id is empty")
+                    self._reply(200, scheduler.get_gang_eta(gang))
                 elif path == C.ADMISSION_HINTS_PATH:
                     # serving headroom + defrag holds, for gang admission
                     self._reply(200, scheduler.get_admission_hints())

@@ -566,6 +566,69 @@ def test_obs001_clean_leg_fixture_passes(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# OBS002: capacity-ledger chip-state registry (ISSUE 14) — seeded
+# fixtures prove both directions are non-vacuous
+# ---------------------------------------------------------------------------
+
+_LEDGER_STATES = {"busy_guaranteed": "d", "idle_free": "d",
+                  "never_produced_state": "d"}
+
+
+def test_obs002_unregistered_state_flagged(tmp_path):
+    _write(tmp_path, "pkg/mod.py", """
+        ledger.transition("n0", [0], "rogue_state")
+        obs_ledger.LEDGER.transition("n0", [1], "busy_guaranteed")
+        ledger.set_idle_diagnosis("idle_free")
+        """)
+    got = blindspots.check_ledger_states(
+        REPO, package_root=str(tmp_path / "pkg"),
+        states=dict(_LEDGER_STATES))
+    msgs = sorted(f.message for f in got)
+    assert all(f.rule == "OBS002" for f in got)
+    assert any("'rogue_state'" in m and "not registered" in m
+               for m in msgs)
+    # vice versa: the registered-but-never-produced row is flagged too
+    assert any("'never_produced_state'" in m and "never produced" in m
+               for m in msgs)
+    assert len(got) == 2
+
+
+def test_obs002_non_literal_state_is_legal_mapping_path(tmp_path):
+    # the busy_state()/IDLE_STATE_FOR_BUCKET mapping paths pass
+    # variables — the runtime validates those, the lint does not flag
+    _write(tmp_path, "pkg/mod.py", """
+        state = pick()
+        ledger.transition("n0", [0], state)
+        obs_ledger.LEDGER.hint_flavor("g", "busy_guaranteed")
+        lg.register_node("n0", 4, state="idle_free")
+        """)
+    got = blindspots.check_ledger_states(
+        REPO, package_root=str(tmp_path / "pkg"),
+        states={"busy_guaranteed": "d", "idle_free": "d"})
+    assert got == []
+
+
+def test_obs002_registry_keys_do_not_vouch_for_themselves(tmp_path):
+    # a fixture obs/ledger.py whose CHIP_STATES dict names a state no
+    # call site produces: the dict's own literals must not count
+    _write(tmp_path, "pkg/obs/ledger.py", """
+        CHIP_STATES = {"busy_guaranteed": "doc", "orphan_row": "doc"}
+        def busy_state():
+            return "busy_guaranteed"
+        """)
+    got = blindspots.check_ledger_states(
+        REPO, package_root=str(tmp_path / "pkg"),
+        states={"busy_guaranteed": "d", "orphan_row": "d"})
+    assert [f.rule for f in got] == ["OBS002"]
+    assert "'orphan_row'" in got[0].message
+
+
+def test_obs002_real_tree_registry_is_exact():
+    got = blindspots.check_ledger_states(REPO)
+    assert got == []
+
+
+# ---------------------------------------------------------------------------
 # HIVED_LOCKCHECK runtime sanitizer
 # ---------------------------------------------------------------------------
 

@@ -20,6 +20,7 @@ from hivedscheduler_tpu.api import constants as C
 from hivedscheduler_tpu.api.config import load_config
 from hivedscheduler_tpu.obs import decisions as obs_decisions
 from hivedscheduler_tpu.obs import journal as obs_journal
+from hivedscheduler_tpu.obs import ledger as obs_ledger
 from hivedscheduler_tpu.obs import trace as obs_trace
 
 FIXTURE = os.path.join(
@@ -44,10 +45,12 @@ def stack():
     from hivedscheduler_tpu.runtime.scheduler import HivedScheduler
     from hivedscheduler_tpu.webserver import WebServer
 
-    # full observability on, as the demo CLI runs it
+    # full observability on, as the demo CLI runs it (the capacity
+    # ledger BEFORE the scheduler so the algorithm registers its chips)
     obs_decisions.RECORDER.enable()
     obs_trace.enable()
     obs_journal.enable()
+    obs_ledger.enable()
     config = load_config(FIXTURE)
     config.web_server_address = "127.0.0.1:0"
     kube = FakeKubeClient()
@@ -81,6 +84,8 @@ def stack():
     obs_trace.TRACER.clear()
     obs_journal.disable()
     obs_journal.JOURNAL.clear()
+    obs_ledger.disable()
+    obs_ledger.LEDGER.clear()
 
 
 def get_json(base, path):

@@ -112,6 +112,11 @@ RULES: Dict[str, tuple] = {
                "literal wait buckets must be WAIT_BUCKETS rows; every "
                "note_leg() request leg is a REQUEST_LEGS row and vice "
                "versa; no dynamic event types or legs", "blindspots"),
+    "OBS002": ("every literal chip state at a capacity-ledger call site "
+               "is a registered obs/ledger.py CHIP_STATES row, and every "
+               "registered state is produced somewhere (call-site "
+               "literal or a ledger-module mapping); the runtime raises "
+               "on unregistered states", "blindspots"),
 }
 
 

@@ -617,6 +617,126 @@ def check_journal_schema(
 
 
 # ---------------------------------------------------------------------------
+# OBS002: capacity-ledger chip-state registry (the OBS001 pattern applied
+# to obs/ledger.py CHIP_STATES — ISSUE 14)
+#
+# Every *literal* state passed to a ledger receiver's state-taking methods
+# (`ledger.transition(node, idxs, "<state>")`, `register_node(...,
+# state=...)`, `set_idle_diagnosis("<state>")`, `hint_flavor(_,
+# "<state>")`) must be a registered CHIP_STATES row, and every CHIP_STATES
+# row must be *produced* somewhere — either a literal at a call site or a
+# literal inside obs/ledger.py itself outside the CHIP_STATES dict (the
+# busy_state()/IDLE_STATE_FOR_BUCKET mapping paths), docstrings excluded.
+# Non-literal states are legal (the mapping paths); the runtime raises on
+# unregistered ones (CapacityLedger._check_state).
+# ---------------------------------------------------------------------------
+
+_LEDGER_RECEIVERS = {"ledger", "obs_ledger", "lg", "_ledger"}
+# method -> positional index of the state arg (kw name is always "state")
+_LEDGER_STATE_METHODS = {"transition": 2, "register_node": 3,
+                         "set_idle_diagnosis": 0, "hint_flavor": 1}
+
+
+def check_ledger_states(
+    root: str,
+    package_root: Optional[str] = None,
+    states: Optional[Dict[str, str]] = None,
+) -> List[Finding]:
+    if states is None:
+        import sys
+
+        sys.path.insert(0, root)
+        try:
+            from hivedscheduler_tpu.obs.ledger import CHIP_STATES
+        finally:
+            sys.path.pop(0)
+        states = CHIP_STATES
+    pkg = package_root or os.path.join(root, "hivedscheduler_tpu")
+    base = package_root and os.path.dirname(package_root) or root
+
+    def _lit(expr) -> Optional[str]:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        return None
+
+    produced: Set[str] = set()
+    out: List[Finding] = []
+    ledger_rel = None
+    for path in _iter_py(pkg):
+        rel = os.path.relpath(path, base).replace(os.sep, "/")
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        if rel.endswith("obs/ledger.py"):
+            # the registry module itself: every string literal outside the
+            # CHIP_STATES dict and outside docstrings counts as a producer
+            # (busy_state()'s returns, the IDLE_STATE_FOR_BUCKET mapping)
+            ledger_rel = rel
+            excluded: Set[int] = set()
+            for node in ast.walk(tree):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                if any(isinstance(t, ast.Name) and t.id == "CHIP_STATES"
+                       for t in targets):
+                    excluded |= {id(n) for n in ast.walk(node)}
+                if isinstance(node, (ast.Module, ast.ClassDef,
+                                     ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    body = node.body
+                    if (body and isinstance(body[0], ast.Expr)
+                            and isinstance(body[0].value, ast.Constant)):
+                        excluded.add(id(body[0].value))
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and id(node) not in excluded
+                        and node.value in states):
+                    produced.add(node.value)
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            recv = node.func.value
+            recv_ok = (
+                (isinstance(recv, ast.Name)
+                 and recv.id in _LEDGER_RECEIVERS)
+                or (isinstance(recv, ast.Attribute)
+                    and recv.attr == "LEDGER")
+            )
+            if not recv_ok or attr not in _LEDGER_STATE_METHODS:
+                continue
+            pos = _LEDGER_STATE_METHODS[attr]
+            expr = (node.args[pos] if len(node.args) > pos
+                    else next((kw.value for kw in node.keywords
+                               if kw.arg == "state"), None))
+            if expr is None:
+                continue  # state defaulted (register_node) — idle_free
+            name = _lit(expr)
+            if name is None:
+                continue  # mapping path: the runtime validates
+            if name not in states:
+                out.append(Finding(
+                    "OBS002", rel, node.lineno,
+                    f"chip state {name!r} is not registered in "
+                    f"obs/ledger.py CHIP_STATES",
+                ))
+            else:
+                produced.add(name)
+    for name in sorted(set(states) - produced):
+        out.append(Finding(
+            "OBS002", ledger_rel or "hivedscheduler_tpu/obs/ledger.py", 1,
+            f"chip state {name!r} registered in CHIP_STATES but never "
+            f"produced in the package — drop the row or wire the "
+            f"transition",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # entry
 # ---------------------------------------------------------------------------
 
@@ -641,4 +761,5 @@ def check(root: str) -> List[Finding]:
     out += check_serializer_drift(root)
     out += check_metrics_catalogue(root)
     out += check_journal_schema(root)
+    out += check_ledger_states(root)
     return out
