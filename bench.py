@@ -46,7 +46,7 @@ from hivedscheduler_tpu.api.types import (
 )
 from hivedscheduler_tpu.algorithm.hived import HivedAlgorithm
 from hivedscheduler_tpu.common.utils import to_json
-from hivedscheduler_tpu.k8s.types import Container, Node, Pod
+from hivedscheduler_tpu.k8s.types import Container, Node, NodeCondition, Pod
 from hivedscheduler_tpu.runtime.types import FILTERING_PHASE, PREEMPTING_PHASE
 from hivedscheduler_tpu.runtime.utils import new_binding_pod
 
@@ -230,37 +230,73 @@ def run(measure_iters: int = 60, seed: int = 7):
     return p50, p99, frag_pct
 
 
-def run_scale_4096(seed: int = 7):
-    """Reproduces the PARITY.md v5p-4096 scale figure: a 1024-chip gang
-    (256 pods x 4) on a 16x16x16 cluster. Run: python bench.py --scale-4096"""
-    levels = [("l1", (2, 2, 2)), ("l2", (4, 2, 2)), ("l3", (4, 4, 2)),
-              ("l4", (4, 4, 4)), ("l5", (8, 4, 4)), ("l6", (8, 8, 4)),
-              ("l7", (8, 8, 8)), ("l8", (16, 8, 8)), ("l9", (16, 16, 8))]
-    mesh = MeshSpec(topology=(16, 16, 16), chip_type="v5p-chip",
+def build_scale_config(n_chips: int) -> Config:
+    """The scale-point cluster configs: v5p-4096 (16x16x16, the PARITY.md
+    figure — specs unchanged so ``scale4096_p50_ms`` stays comparable) and
+    v5p-16384 (16x32x32, 4096 hosts — ROADMAP item 1's production-fleet
+    order of magnitude)."""
+    if n_chips == 4096:
+        levels = [("l1", (2, 2, 2)), ("l2", (4, 2, 2)), ("l3", (4, 4, 2)),
+                  ("l4", (4, 4, 4)), ("l5", (8, 4, 4)), ("l6", (8, 8, 4)),
+                  ("l7", (8, 8, 8)), ("l8", (16, 8, 8)), ("l9", (16, 16, 8))]
+        topology, name = (16, 16, 16), "v5p-4096"
+        vcs = {
+            "vc-a": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=2, cell_type=f"{name}.l8")]),
+            "vc-b": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=16, cell_type=f"{name}.l4")]),
+        }
+    elif n_chips == 16384:
+        levels = [("l1", (2, 2, 2)), ("l2", (4, 2, 2)), ("l3", (4, 4, 2)),
+                  ("l4", (4, 4, 4)), ("l5", (8, 4, 4)), ("l6", (8, 8, 4)),
+                  ("l7", (8, 8, 8)), ("l8", (16, 8, 8)), ("l9", (16, 16, 8)),
+                  ("l10", (16, 16, 16)), ("l11", (16, 32, 16))]
+        topology, name = (16, 32, 32), "v5p-16384"
+        # guarantees: 2x4096 + 4x1024 + 8x256 = 14336 of 16384 chips; the
+        # rest is opportunistic headroom (backfill/preemption reachable)
+        vcs = {
+            "vc-a": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=2, cell_type=f"{name}.l10")]),
+            "vc-b": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=4, cell_type=f"{name}.l8")]),
+            "vc-c": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=8, cell_type=f"{name}.l6")]),
+        }
+    else:
+        raise ValueError(f"no scale config for {n_chips} chips")
+    mesh = MeshSpec(topology=topology, chip_type="v5p-chip",
                     host_shape=(2, 2, 1),
                     levels=[MeshLevelSpec(name=n, shape=sh) for n, sh in levels])
-    cfg = new_config(Config(
+    return new_config(Config(
         physical_cluster=PhysicalClusterSpec(
-            cell_types={"v5p-4096": CellTypeSpec(mesh=mesh)},
-            physical_cells=[PhysicalCellSpec(cell_type="v5p-4096",
+            cell_types={name: CellTypeSpec(mesh=mesh)},
+            physical_cells=[PhysicalCellSpec(cell_type=name,
                                              cell_address="pod0")]),
-        virtual_clusters={
-            "vc-a": VirtualClusterSpec(virtual_cells=[
-                VirtualCellSpec(cell_number=2, cell_type="v5p-4096.l8")]),
-            "vc-b": VirtualClusterSpec(virtual_cells=[
-                VirtualCellSpec(cell_number=16, cell_type="v5p-4096.l4")]),
-        }))
-    algo = HivedAlgorithm(cfg)
+        virtual_clusters=vcs))
+
+
+def build_scale_algo(n_chips: int):
+    """(algo, nodes) for a scale-point cluster with every node healthy —
+    shared by the scale stages here and profile_bench's scenarios."""
+    algo = HivedAlgorithm(build_scale_config(n_chips))
     nodes = sorted({n for ccl in algo.full_cell_list.values()
                     for c in ccl[max(ccl)] for n in c.nodes})
     for n in nodes:
         algo.add_node(Node(name=n))
+    return algo, nodes
+
+
+def _run_scale(n_chips: int, gang_pods: int, trials: int):
+    """Time ``trials`` schedule+allocate rounds of one big gang (one quarter
+    of the cluster, from vc-a's free guarantee) then release it."""
+    algo, nodes = build_scale_algo(n_chips)
     lat = []
-    for trial in range(8):
+    for trial in range(trials):
         pods = []
         t0 = time.perf_counter()
-        for i in range(256):
-            p = make_pod(f"g{trial}-{i}", "vc-a", 10, f"g{trial}", 256, 4)
+        for i in range(gang_pods):
+            p = make_pod(f"g{trial}-{i}", "vc-a", 10, f"g{trial}",
+                         gang_pods, 4)
             r = algo.schedule(p, nodes, FILTERING_PHASE)
             assert r.pod_bind_info is not None, r.pod_wait_info
             bp = new_binding_pod(p, r.pod_bind_info)
@@ -270,6 +306,293 @@ def run_scale_4096(seed: int = 7):
         for bp in pods:
             algo.delete_allocated_pod(bp)
     return statistics.median(lat) * 1000.0, max(lat) * 1000.0
+
+
+def run_scale_4096(seed: int = 7):
+    """Reproduces the PARITY.md v5p-4096 scale figure: a 1024-chip gang
+    (256 pods x 4) on a 16x16x16 cluster. Run: python bench.py --scale-4096"""
+    return _run_scale(4096, gang_pods=256, trials=8)
+
+
+def run_scale_16384(seed: int = 7):
+    """The v5p-16384 scale point (ROADMAP item 1): a 4096-chip gang
+    (1024 pods x 4) on a 16x32x32 cluster of 4096 hosts — reported as
+    ``scale16384_p50_ms``. Fewer trials than the 4096 point: one trial is
+    1024 schedule+allocate pairs. Run: python bench.py --scale-16384"""
+    return _run_scale(16384, gang_pods=1024, trials=3)
+
+
+# -- sustained churn at 16k chips (ISSUE 15 headline) ------------------------
+#
+# The raw-speed instrument for the whole scheduler-core stack: continuous
+# submit + preempt + complete driven through a REAL HivedScheduler (full
+# runtime: informers over the fake ApiServer, extender routines, defrag and
+# elastic ticks) on the v5p-16384 cluster, with the journal AND the capacity
+# ledger live — the honest production configuration, so the headline tracks
+# what a decision actually costs as the feature set grows. Event batching
+# (HIVED_EVENT_BATCH=1) is the measured configuration; the artifact also
+# pins the kill-switch differentials: a shorter identical-seed churn with
+# HIVED_EVENT_BATCH=0 and with HIVED_NATIVE=0 must reproduce byte-identical
+# decisions (placements, failure strings, journal events).
+
+_CHURN_SHAPES = [(4, 4), (8, 4), (16, 4), (32, 4), (64, 4), (128, 4)]
+
+
+def _runtime_churn(n_chips: int, ops: int, seed: int,
+                   event_batch: bool = True, py_native: bool = False):
+    """Drive ``ops`` gang schedules (interleaved with completions,
+    preemptions and defrag/elastic ticks) through a full runtime stack;
+    returns (decision log, per-gang latencies, stats). The log carries
+    every decision outcome byte-for-byte (placed nodes, failure strings,
+    journal events), so two runs at different kill-switch settings can be
+    pinned identical."""
+    import os
+
+    from hivedscheduler_tpu.chaos import invariants as chaos_invariants
+    from hivedscheduler_tpu.k8s.fake import FakeKubeClient
+    from hivedscheduler_tpu.obs import journal as obs_journal
+    from hivedscheduler_tpu.obs import ledger as obs_ledger
+    from hivedscheduler_tpu.runtime import extender as ei
+    from hivedscheduler_tpu.runtime.scheduler import HivedScheduler
+
+    saved = {k: os.environ.get(k)
+             for k in ("HIVED_EVENT_BATCH", "HIVED_NATIVE")}
+    os.environ["HIVED_EVENT_BATCH"] = "1" if event_batch else "0"
+    if py_native:
+        os.environ["HIVED_NATIVE"] = "0"
+    try:
+        random.seed(seed)  # the algorithm's victim selection draws globally
+        rng = random.Random(seed)
+        obs_journal.enable(capacity=1 << 15)
+        obs_ledger.LEDGER.clear()
+        obs_ledger.enable()
+        fake = FakeKubeClient()
+        sched = HivedScheduler(build_scale_config(n_chips), fake)
+        algo = sched.scheduler_algorithm
+        nodes = sorted({n for ccl in algo.full_cell_list.values()
+                        for c in ccl[max(ccl)] for n in c.nodes})
+        for n in nodes:
+            fake.create_node(Node(name=n))
+        sched.start()
+
+        log = []
+        groups = {}
+        latencies = []
+        stats = {"filters": 0, "preempts": 0, "binds": 0, "waits": 0,
+                 "defrag_planned": 0, "elastic_offers": 0}
+        gid = 0
+
+        _CN = C.COMPONENT_NAME
+
+        def filter_member(pod_name):
+            stats["filters"] += 1
+            pod = fake.get_pod("default", pod_name)
+            if pod is None:
+                return None
+            r = sched.filter_routine(ei.ExtenderArgs(
+                pod=pod, node_names=nodes))
+            if r.node_names:
+                return r.node_names[0]
+            log.append(("wait", pod_name,
+                        tuple(sorted((r.failed_nodes or {}).items()))))
+            if r.failed_nodes and any(k != _CN for k in r.failed_nodes):
+                return "PREEMPT"
+            stats["waits"] += 1
+            return None
+
+        def preempt_member(pod_name):
+            stats["preempts"] += 1
+            pod = fake.get_pod("default", pod_name)
+            if pod is None:
+                return
+            r = sched.preempt_routine(ei.ExtenderPreemptionArgs(
+                pod=pod, node_name_to_meta_victims={n: [] for n in nodes}))
+            victims = sorted(u for us in r.node_name_to_meta_victims.values()
+                             for u in us)
+            log.append(("preempt", pod_name, tuple(victims)))
+            for gname, gpods in list(groups.items()):
+                if any(u in victims for u in gpods):
+                    for p in groups.pop(gname):
+                        fake.delete_pod("default", p)
+
+        flapped = []
+        for op in range(ops):
+            # completions: keep a crowded steady state (the quotas saturate
+            # and guaranteed gangs preempt/wait) while still churning —
+            # free a quarter of the gangs only once genuinely crowded
+            if len(groups) > 80:
+                names = sorted(groups)
+                rng.shuffle(names)
+                for name in names[:len(names) // 4]:
+                    for p in groups.pop(name):
+                        fake.delete_pod("default", p)
+                    log.append(("free", name))
+            if op % 10 == 7:
+                # node-health churn that heals inside the same event window
+                # (folds to a no-op under HIVED_EVENT_BATCH=1; the
+                # reference round-trips the doomed-bad machinery)
+                n = rng.choice(nodes)
+                fake.update_node(Node(name=n, conditions=[
+                    NodeCondition(type="Ready", status="False")]))
+                fake.update_node(Node(name=n))
+                log.append(("flap-roundtrip", n))
+            if op % 40 == 17:
+                # a lasting bad-node window (~10 ops), healed so defrag and
+                # elastic planning get healthy-cluster windows too
+                bad = rng.choice(nodes)
+                flapped.append(bad)
+                fake.update_node(Node(name=bad, conditions=[
+                    NodeCondition(type="Ready", status="False")]))
+                log.append(("flap", bad))
+            elif op % 40 == 27 and flapped:
+                healed = flapped.pop()
+                fake.update_node(Node(name=healed))
+                log.append(("heal", healed))
+            vc = rng.choice(["vc-a", "vc-b", "vc-c"])
+            prio = rng.choice([-1, -1, 0, 5, 10])
+            pods, chips = rng.choice(_CHURN_SHAPES)
+            oversized = op % 24 == 11
+            if oversized:
+                # an oversized elastic gang: blocked at full shape while the
+                # cluster is crowded, so the wait/defrag-waiter path and the
+                # elastic shrink-offer arm stay exercised
+                vc, prio = rng.choice(["vc-b", "vc-c"]), 5
+                pods, chips = rng.choice([(192, 4), (256, 4)])
+            name = f"c{gid}"
+            gid += 1
+            spec = {
+                "virtualCluster": vc, "priority": prio,
+                "leafCellType": "v5p-chip", "leafCellNumber": chips,
+                "affinityGroup": {
+                    "name": name,
+                    "members": [{"podNumber": pods,
+                                 "leafCellNumber": chips}]},
+            }
+            if prio >= 0 and (oversized or op % 8 == 3):
+                # elastic gangs keep the shrink-offer/grow arm exercised
+                spec["elasticMinChips"] = max(chips, pods * chips // 4)
+            created, bound, ok = [], [], True
+            t0 = time.perf_counter()
+            for i in range(pods):
+                pn = f"{name}-{i}"
+                fake.create_pod(make_pod(pn, vc, prio, name, pods, chips)
+                                if "elasticMinChips" not in spec else
+                                _make_spec_pod(pn, spec))
+                created.append(pn)
+                node = None
+                for _attempt in range(6):
+                    node = filter_member(pn)
+                    if node != "PREEMPT":
+                        break
+                    preempt_member(pn)
+                if node in (None, "PREEMPT"):
+                    ok = False
+                    break
+                sched.bind_routine(ei.ExtenderBindingArgs(
+                    pod_name=pn, pod_namespace="default", pod_uid=pn,
+                    node=node))
+                stats["binds"] += 1
+                log.append(("bound", pn, node))
+                bound.append(pn)
+            latencies.append(time.perf_counter() - t0)
+            if ok:
+                groups[name] = bound
+            elif oversized:
+                # a blocked elastic gang WAITS (its pods stay pending, as
+                # the real control loop leaves them) so defrag_tick can
+                # record the waiter and offer its shrink ladder
+                log.append(("waiting", name))
+            else:
+                for pn in created:
+                    fake.delete_pod("default", pn)
+                log.append(("rollback", name))
+            if op % 6 == 5:
+                tick = sched.defrag_tick()
+                if tick.get("planned") is not None:
+                    stats["defrag_planned"] += 1
+                    log.append(("defrag",
+                                sorted(tick["planned"].get("moves", []))))
+                if tick.get("elasticOffer"):
+                    stats["elastic_offers"] += 1
+                    log.append(("elastic", tick["elasticOffer"]["group"]))
+        sched.flush_events()
+        with sched.scheduler_lock:
+            chaos_invariants.check_ledger(ctx="churn16k")
+            chaos_invariants.check_defrag(sched, ctx="churn16k")
+        log.append(("journal",
+                    tuple((e.type, e.gang, e.bucket)
+                          for e in obs_journal.JOURNAL.snapshot())))
+        pending = sched._pending
+        stats["coalesced"] = (0 if pending is None else
+                              pending.coalesced_pod_pairs
+                              + pending.coalesced_node_folds)
+        stats["event_batches"] = (0 if pending is None else
+                                  pending.drained_batches)
+        stats["events_applied"] = (0 if pending is None else
+                                   pending.drained_events)
+        obs_journal.disable()
+        obs_ledger.disable()
+        return log, latencies, stats
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _make_spec_pod(name: str, spec: dict) -> Pod:
+    return Pod(
+        name=name, uid=name,
+        annotations={C.ANNOTATION_POD_SCHEDULING_SPEC: to_json(spec)},
+        containers=[Container(
+            resource_limits={C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})],
+    )
+
+
+def run_churn_16k(ops: int = 160, parity_ops: int = 32, seed: int = 19):
+    """The sustained-churn headline plus its kill-switch differentials.
+
+    Headline: ``ops`` gang schedules through the full runtime on v5p-16384
+    with defrag ticks, elastic offers, journal, ledger AND event batching
+    all ON — schedules/sec (pod filter decisions per second of sustained
+    driving) and gang-decision p50/p99.
+
+    Differentials: three ``parity_ops`` runs at the same seed — the
+    measured configuration vs ``HIVED_EVENT_BATCH=0`` vs ``HIVED_NATIVE=0``
+    — must produce byte-identical decision logs (placements, failure
+    strings, journal events); reported as booleans so a silent divergence
+    fails loudly in the artifact, not in a dashboard."""
+    log, lat, stats = _runtime_churn(16384, ops, seed, event_batch=True)
+    lat_ms = sorted(x * 1000.0 for x in lat)
+    p50 = statistics.median(lat_ms) if lat_ms else 0.0
+    p99 = lat_ms[max(0, int(len(lat_ms) * 0.99) - 1)] if lat_ms else 0.0
+    wall = sum(lat)
+    fields = {
+        "churn16k_schedules_per_sec": round(stats["filters"] / wall, 1)
+        if wall else None,
+        "churn16k_gang_p50_ms": round(p50, 3),
+        "churn16k_gang_p99_ms": round(p99, 3),
+        "churn16k_ops": len(lat),
+        "churn16k_filters": stats["filters"],
+        "churn16k_binds": stats["binds"],
+        "churn16k_preempt_rounds": stats["preempts"],
+        "churn16k_defrag_planned": stats["defrag_planned"],
+        "churn16k_elastic_offers": stats["elastic_offers"],
+        "churn16k_events_coalesced": stats["coalesced"],
+        "churn16k_events_per_batch": round(
+            stats["events_applied"] / stats["event_batches"], 2)
+        if stats["event_batches"] else None,
+    }
+    ref_log, _, _ = _runtime_churn(16384, parity_ops, seed,
+                                   event_batch=True)
+    nobatch_log, _, _ = _runtime_churn(16384, parity_ops, seed,
+                                       event_batch=False)
+    nonative_log, _, _ = _runtime_churn(16384, parity_ops, seed,
+                                        event_batch=True, py_native=True)
+    fields["churn16k_batch_parity"] = ref_log == nobatch_log
+    fields["churn16k_native_parity"] = ref_log == nonative_log
+    return fields
 
 
 def run_recovery(n_target_pods: int = 500, seed: int = 13):
@@ -1414,6 +1737,23 @@ if __name__ == "__main__":
             "max_ms": round(mx, 3),
         }))
         sys.exit(0)
+    if "--scale-16384" in sys.argv:
+        p50, mx = run_scale_16384()
+        print(json.dumps({
+            "metric": "p50_gang_schedule_latency_4096chip_slice_v5p16384",
+            "value": round(p50, 3), "unit": "ms",
+            "vs_baseline": round(50.0 / p50, 3) if p50 > 0 else None,
+            "max_ms": round(mx, 3),
+        }))
+        sys.exit(0)
+    if "--churn-16k" in sys.argv:
+        print(json.dumps({
+            "metric": "sustained_churn_schedules_per_sec_v5p16384",
+            "unit": "schedules/s",
+            "vs_baseline": None,
+            **run_churn_16k(),
+        }))
+        sys.exit(0)
     # Probe for a TPU via env only: importing jax here would acquire the
     # single-grant TPU in THIS process and starve the bench_model child of
     # it (the axon tunnel grants one client at a time). The driver/axon env
@@ -1436,6 +1776,19 @@ if __name__ == "__main__":
                           scale4096_max_ms=round(s_max, 3))
         except Exception as e:  # pragma: no cover - defensive
             fields["scale4096_error"] = f"{type(e).__name__}: {e}"
+        try:
+            s_p50, s_max = run_scale_16384()
+            fields.update(scale16384_p50_ms=round(s_p50, 3),
+                          scale16384_max_ms=round(s_max, 3))
+        except Exception as e:  # pragma: no cover - defensive
+            fields["scale16384_error"] = f"{type(e).__name__}: {e}"
+        try:
+            # the sustained-churn headline: raw scheduler speed at 16k
+            # chips with defrag/elastic/journal/ledger ON, plus the
+            # HIVED_EVENT_BATCH=0 / HIVED_NATIVE=0 parity pins
+            fields.update(run_churn_16k())
+        except Exception as e:  # pragma: no cover - defensive
+            fields["churn16k_error"] = f"{type(e).__name__}: {e}"
         try:
             rec_ms, n_pods, n_groups, preserved = run_recovery()
             fields.update(recovery_ms=round(rec_ms, 3),

@@ -1366,6 +1366,16 @@ class HivedAlgorithm(SchedulerAlgorithm):
                             break
                         chips += ln
                         max_take += 1
+                    if max_take > 0:
+                        # native prefix-fit pre-filter: one C call per
+                        # probe phase replaces the O(take) full probes the
+                        # descent would burn on prefixes that provably
+                        # cannot pack on this chain (exact upper bound —
+                        # every surviving take still runs the real probe,
+                        # so decisions are unchanged; no-op without the
+                        # native fast path)
+                        max_take = min(max_take, self._relax_prefix_bound(
+                            sr, chain, flat[idx:idx + max_take]))
                     for take in range(max_take, 0, -1):
                         if idx == 0 and take == len(flat):
                             # the whole-group attempt on this chain already
@@ -1448,6 +1458,27 @@ class HivedAlgorithm(SchedulerAlgorithm):
         if self._decision is not None:
             self._decision.attempt(relax_where, "multi-chain-relax", "placed")
         return merged_phys, (merged_virt if guaranteed_req else None), ""
+
+    def _relax_prefix_bound(
+        self, sr: SchedulingRequest, chain: CellChain, flat_segment: List[int]
+    ) -> int:
+        """Exact upper bound on the relax descent's feasible takes for
+        ``chain``: the native prefix-fit walk on the same view the real
+        probe would search (the VC's virtual view for guaranteed requests,
+        the physical opportunistic view otherwise). Returns
+        ``len(flat_segment)`` — no pruning — when the native fast path is
+        not engaged (see TopologyAwareScheduler.max_feasible_prefix)."""
+        if sr.priority >= MIN_GUARANTEED_PRIORITY:
+            vcs = self.vc_schedulers.get(sr.vc)
+            scheduler = (None if vcs is None
+                         else vcs.non_pinned_cell_schedulers.get(chain))
+        else:
+            scheduler = self.opportunistic_schedulers.get(chain)
+        if scheduler is None:
+            return len(flat_segment)
+        return scheduler.max_feasible_prefix(
+            flat_segment, sr.priority, sr.suggested_nodes,
+            sr.ignore_suggested_nodes)
 
     def _validate_scheduling_request(self, sr: SchedulingRequest, pod: Pod) -> None:
         """Reference: validateSchedulingRequest, hived_algorithm.go:857-871."""

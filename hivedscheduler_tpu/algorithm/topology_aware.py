@@ -717,6 +717,64 @@ class TopologyAwareScheduler:
                     return picked, ""
         return _greedy_assign(cv, order, sorted_pod_nums)
 
+    def max_feasible_prefix(
+        self,
+        flat_desc: List[int],
+        p: CellPriority,
+        suggested_nodes: Set[str],
+        ignore_suggested_nodes: bool,
+    ) -> int:
+        """Largest prefix of ``flat_desc`` (gang member sizes, DESCENDING —
+        the multi-chain relax walk's ``flat`` segment) that could pack on
+        this view at either probe phase (opportunistic first, then ``p`` —
+        mirroring :meth:`schedule`'s two-phase retry), computed in one
+        native call per phase (``hived_find_nodes_prefix``).
+
+        The result is an EXACT upper bound on the relax walk's
+        descending-take descent: a take above it provably fails the same
+        packing the real probe would run first, so skipping it changes no
+        decision; every take at or below the bound still runs the full
+        probe (VC mapping can fail for reasons packing cannot see).
+
+        Returns ``len(flat_desc)`` — no pruning — whenever the native
+        packing fast path is not engaged (small view, ``HIVED_NATIVE=0``,
+        ``HIVED_INCR=0``, stale .so), so the pure-Python reference walk is
+        byte-identical to the pre-native one.
+
+        The native call sorts a SCRATCH copy of the persistent order: the
+        reference's stable-sort tie history (which the real ``_order``
+        carries) is never perturbed by probing.
+        """
+        n = len(flat_desc)
+        if n == 0 or os.environ.get("HIVED_INCR", "1") == "0":
+            return n
+        state = self._native_pack_state()
+        if state is None:
+            return n
+        import ctypes
+
+        from hivedscheduler_tpu import native
+
+        if not native.prefix_available():
+            return n
+        best = 0
+        phases = [OPPORTUNISTIC_PRIORITY]
+        if p > OPPORTUNISTIC_PRIORITY:
+            phases.append(p)
+        # one scratch order carried across phases — exactly the order
+        # evolution schedule()'s sequential phase sorts would produce
+        scratch = (ctypes.c_int32 * state["n"])(*self._order)
+        for prio in phases:
+            self._update_cluster_view(
+                prio, suggested_nodes, ignore_suggested_nodes)
+            take = native.find_nodes_prefix(
+                state, flat_desc, self.pack, scratch)
+            if take > best:
+                best = take
+                if best == n:
+                    break
+        return best
+
     def _native_pack_state(self):
         """Build (once) the persistent buffers feeding the native packing
         call: per-node score arrays in static order plus the static

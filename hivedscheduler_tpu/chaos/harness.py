@@ -200,6 +200,9 @@ class ChaosHarness:
         flushed, nothing mid-flight)."""
         if quiesce:
             self.chaos.flush_held()
+            # batched-delta mode (HIVED_EVENT_BATCH=1): the flushed watch
+            # events are now queued, not applied — quiescence means applied
+            self.scheduler.flush_events()
         full = set(self.groups) if quiesce else None
         try:
             with self.scheduler.scheduler_lock:

@@ -104,6 +104,12 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
        "its largest feasible ladder shape, grow-promote degraded gangs "
        "when capacity frees); inert for gangs without `elasticMinChips`.",
        "hivedscheduler_tpu/defrag/__init__.py"),
+    _f("HIVED_EVENT_BATCH", "0",
+       "`1` batches informer watch events into per-cycle coalesced deltas "
+       "applied under one scheduler-lock acquisition (runtime/eventbatch"
+       ".py); unset/`0` is the per-event reference path, pinned "
+       "decision-identical (the kill switch for the batched fast path).",
+       "hivedscheduler_tpu/runtime/eventbatch.py"),
     _f("HIVED_GC_FREEZE", "1",
        "`0` opts out of gc.freeze() after scheduler warmup (the scheduler "
        "then pays the gen-2 collection cost).",

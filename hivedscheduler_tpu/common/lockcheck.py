@@ -65,6 +65,11 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # events and metrics under it, and NOTHING below it (in particular the
     # scheduler lock — scale backends run outside the router lock).
     "fleet_router_lock": 70,
+    # runtime/eventbatch.py — the batched watch-event queue. LEAF: enqueue
+    # runs on informer threads that may already hold the scheduler lock
+    # (synchronous fake-ApiServer delivery), and nothing is ever acquired
+    # under it.
+    "event_queue_lock": 75,
     # observability leaves: nothing is ever acquired under these.
     # (ledger_lock, journal_lock and slo_lock sit just below metrics_lock:
     # closing a chip/wait interval / observing an SLO datapoint observes
@@ -94,6 +99,7 @@ LOCK_SITES: Dict[str, str] = {
     "watchdog_lock": "hivedscheduler_tpu/parallel/supervisor.py",
     "store_lock": "hivedscheduler_tpu/k8s/fake.py",
     "fleet_router_lock": "hivedscheduler_tpu/fleet/router.py",
+    "event_queue_lock": "hivedscheduler_tpu/runtime/eventbatch.py",
     "ledger_lock": "hivedscheduler_tpu/obs/ledger.py",
     "journal_lock": "hivedscheduler_tpu/obs/journal.py",
     "slo_lock": "hivedscheduler_tpu/obs/slo.py",

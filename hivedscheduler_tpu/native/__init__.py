@@ -109,6 +109,20 @@ def _load() -> Optional[ctypes.CDLL]:
             ]
         except AttributeError:  # stale prebuilt .so: packing entry absent
             pass
+        try:
+            p_i32 = ctypes.POINTER(ctypes.c_int32)
+            lib.hived_find_nodes_prefix.restype = ctypes.c_int32
+            lib.hived_find_nodes_prefix.argtypes = [
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,  # n, n_anc, n_ids
+                p_i32,                                           # anc_ids
+                p_i32, p_i32, p_i32, p_i32, p_i32,               # scores
+                ctypes.c_int32, ctypes.c_int32,                  # pack, do_sort
+                p_i32,                                           # order (scratch)
+                p_i32, ctypes.c_int32,                           # pod_nums, n_pods
+                p_i32,                                           # out_nodes
+            ]
+        except AttributeError:  # stale prebuilt .so: prefix entry absent
+            pass
         _lib = lib
     except Exception as e:  # toolchain missing / compile error
         if os.environ.get("HIVED_NATIVE") == "1":
@@ -198,6 +212,37 @@ def pack_available() -> bool:
     prebuilt .so without the symbol degrades to the Python path)."""
     lib = _load()
     return lib is not None and hasattr(lib, "hived_find_nodes_for_pods")
+
+
+def prefix_available() -> bool:
+    """True when the multi-chain prefix-fit entry point is loadable."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "hived_find_nodes_prefix")
+
+
+def find_nodes_prefix(state: dict, pod_nums_desc: List[int], pack: bool,
+                      order_scratch) -> int:
+    """One-call descending-take feasibility walk for the multi-chain relax
+    path: the largest prefix of ``pod_nums_desc`` (member sizes,
+    DESCENDING — the relax ``flat`` segment) whose ascending reading packs
+    on this view. ``order_scratch`` is a ctypes int32 array seeded with the
+    persistent order; it is sorted in place by the call, so the caller's
+    real order (and its stable-sort tie history) is never perturbed.
+    Returns 0 when no prefix fits."""
+    import ctypes
+
+    lib = _load()
+    assert lib is not None
+    n_pods = len(pod_nums_desc)
+    pods_arr = (ctypes.c_int32 * n_pods)(*pod_nums_desc)
+    out = (ctypes.c_int32 * n_pods)()
+    return lib.hived_find_nodes_prefix(
+        state["n"], state["n_anc"], state["n_ids"], state["anc_buf"],
+        state["healthy_buf"], state["suggested_buf"], state["same_buf"],
+        state["higher_buf"], state["free_buf"],
+        1 if pack else 0, 1, order_scratch,
+        pods_arr, n_pods, out,
+    )
 
 
 def find_nodes_for_pods(state: dict, pod_nums: List[int], pack: bool,

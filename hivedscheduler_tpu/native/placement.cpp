@@ -31,6 +31,139 @@ inline int32_t lca_level(const int32_t* ancestors, int32_t n_levels,
   }
   return kInfLevel;
 }
+
+// One cluster view's per-node buffers in STATIC node order plus the sorted
+// permutation; shared by the full-gang packing entry and the prefix-fit
+// walk below.
+struct View {
+  int32_t n_nodes, n_anc, n_ids;
+  const int32_t* anc_ids;    // [n_nodes x n_anc], levels ascending, -1 = none
+  const int32_t* healthy;
+  const int32_t* suggested;
+  const int32_t* free_at_p;
+  const int32_t* order;      // sorted permutation: rank -> static index
+};
+
+// Greedy walk over nodes given by ranks into `order` (reference:
+// findNodesForPods inner loop / _greedy_assign): a pod lands on the current
+// node if it still fits; otherwise the accumulated count resets and the walk
+// advances. `pod_at(i)` indirection lets callers feed pods in reverse
+// (descending member lists evaluated as the ascending sort the reference
+// uses).
+struct PodSeq {
+  const int32_t* nums;
+  int32_t n;
+  bool reversed;
+  inline int32_t at(int32_t i) const {
+    return nums[reversed ? n - 1 - i : i];
+  }
+};
+
+bool greedy_walk(const View& v, const int32_t* ranks, int32_t n_ranks,
+                 const PodSeq& pods, int32_t* out_nodes,
+                 int32_t* out_fail_node, int32_t* fail_code) {
+  int32_t pod = 0;
+  int32_t picked_leaf = 0;
+  int32_t oi = 0;
+  while (oi < n_ranks) {
+    const int32_t j = v.order[ranks[oi]];
+    if (v.free_at_p[j] - picked_leaf >= pods.at(pod)) {
+      if (!v.healthy[j]) {
+        if (fail_code != nullptr) { *out_fail_node = j; *fail_code = 2; }
+        return false;
+      }
+      if (!v.suggested[j]) {
+        if (fail_code != nullptr) { *out_fail_node = j; *fail_code = 3; }
+        return false;
+      }
+      if (out_nodes != nullptr) out_nodes[pod] = j;
+      picked_leaf += pods.at(pod);
+      ++pod;
+      if (pod == pods.n) return true;
+    } else {
+      picked_leaf = 0;
+      ++oi;
+    }
+  }
+  if (fail_code != nullptr) *fail_code = 1;
+  return false;
+}
+
+// Scratch buffers reused across enclosure passes (and, in the prefix walk,
+// across takes) so the descending-take descent does not reallocate per step.
+struct PackScratch {
+  std::vector<int32_t> grp_of;
+  std::vector<int64_t> grp_cap;
+  std::vector<std::vector<int32_t>> grp_ranks;
+  std::vector<int32_t> flat;
+};
+
+// The whole packing attempt for one pod multiset: the tightest-enclosure
+// pass (per ancestor level ascending, groups in ascending first-member rank
+// — the reference's (level, first-member) visit order), then the flat
+// greedy, which owns the bad/non-suggested failure codes. Returns 0 on
+// success (out_nodes = picked static indices per pod), else 1/2/3 exactly
+// like the original single entry.
+int32_t pack_attempt(const View& v, const PodSeq& pods, PackScratch& s,
+                     int32_t* out_nodes, int32_t* out_fail_node) {
+  if (pods.n > 1 && v.n_anc > 0 && v.n_ids > 0) {
+    int64_t total = 0;
+    for (int32_t i = 0; i < pods.n; ++i) total += pods.nums[i];
+    s.grp_of.assign(v.n_ids, -1);
+    for (int32_t col = 0; col < v.n_anc; ++col) {
+      std::fill(s.grp_of.begin(), s.grp_of.end(), -1);
+      s.grp_cap.clear();
+      s.grp_ranks.clear();
+      for (int32_t r = 0; r < v.n_nodes; ++r) {
+        const int32_t j = v.order[r];
+        if (!v.healthy[j] || !v.suggested[j]) continue;
+        const int32_t a = v.anc_ids[static_cast<int64_t>(j) * v.n_anc + col];
+        if (a < 0) continue;
+        int32_t gi = s.grp_of[a];
+        if (gi < 0) {
+          gi = s.grp_of[a] = static_cast<int32_t>(s.grp_cap.size());
+          s.grp_cap.push_back(0);
+          s.grp_ranks.emplace_back();
+        }
+        s.grp_cap[gi] += v.free_at_p[j];
+        s.grp_ranks[gi].push_back(r);
+      }
+      for (size_t gi = 0; gi < s.grp_cap.size(); ++gi) {
+        if (s.grp_cap[gi] < total) continue;
+        if (greedy_walk(v, s.grp_ranks[gi].data(),
+                        static_cast<int32_t>(s.grp_ranks[gi].size()), pods,
+                        out_nodes, nullptr, nullptr)) {
+          return 0;
+        }
+      }
+    }
+  }
+  s.flat.resize(v.n_nodes);
+  for (int32_t r = 0; r < v.n_nodes; ++r) s.flat[r] = r;
+  int32_t fail_code = 1;
+  if (greedy_walk(v, s.flat.data(), v.n_nodes, pods, out_nodes,
+                  out_fail_node, &fail_code)) {
+    return 0;
+  }
+  return fail_code;
+}
+
+void sort_order(int32_t* order, int32_t n_nodes, const int32_t* healthy,
+                const int32_t* suggested, const int32_t* used_same,
+                const int32_t* used_higher, int32_t pack) {
+  const int64_t sign = pack ? -1 : 1;
+  std::stable_sort(order, order + n_nodes, [&](int32_t a, int32_t b) {
+    // lexicographic (!healthy, !suggested, sign*used_same, used_higher)
+    const int32_t ha = !healthy[a], hb = !healthy[b];
+    if (ha != hb) return ha < hb;
+    const int32_t sa = !suggested[a], sb = !suggested[b];
+    if (sa != sb) return sa < sb;
+    const int64_t ua = sign * static_cast<int64_t>(used_same[a]);
+    const int64_t ub = sign * static_cast<int64_t>(used_same[b]);
+    if (ua != ub) return ua < ub;
+    return used_higher[a] < used_higher[b];
+  });
+}
 }  // namespace
 
 extern "C" {
@@ -98,7 +231,7 @@ int32_t hived_find_leaf_cells(const int32_t* ancestors, int32_t n_avail,
 
 // Cross-node packing for a whole gang in ONE call: stable sort of the
 // persistent node order, tightest-enclosure pass, then the flat greedy —
-// the single-chain common case of the Python reference
+// one chain view of the Python reference
 // (algorithm/topology_aware.py _find_nodes_for_pods; upstream semantics:
 // topology_aware_scheduler.go:268-306). Inputs are persistent per-scheduler
 // buffers in STATIC node order, kept in sync by the incremental cluster
@@ -122,97 +255,63 @@ int32_t hived_find_nodes_for_pods(
     int32_t* out_fail_node) {
   if (n_pods <= 0 || n_nodes <= 0) return 1;
   if (do_sort) {
-    const int64_t sign = pack ? -1 : 1;
-    std::stable_sort(order, order + n_nodes, [&](int32_t a, int32_t b) {
-      // lexicographic (!healthy, !suggested, sign*used_same, used_higher)
-      const int32_t ha = !healthy[a], hb = !healthy[b];
-      if (ha != hb) return ha < hb;
-      const int32_t sa = !suggested[a], sb = !suggested[b];
-      if (sa != sb) return sa < sb;
-      const int64_t ua = sign * static_cast<int64_t>(used_same[a]);
-      const int64_t ub = sign * static_cast<int64_t>(used_same[b]);
-      if (ua != ub) return ua < ub;
-      return used_higher[a] < used_higher[b];
-    });
+    sort_order(order, n_nodes, healthy, suggested, used_same, used_higher,
+               pack);
   }
-  // greedy walk over nodes given by ranks into `order` (reference:
-  // findNodesForPods inner loop / _greedy_assign): a pod lands on the
-  // current node if it still fits; otherwise the accumulated count resets
-  // and the walk advances
-  auto greedy = [&](const int32_t* ranks, int32_t n_ranks,
-                    bool detect_fail, int32_t* fail_code) -> bool {
-    int32_t pod = 0;
-    int32_t picked_leaf = 0;
-    int32_t oi = 0;
-    while (oi < n_ranks) {
-      const int32_t j = order[ranks[oi]];
-      if (free_at_p[j] - picked_leaf >= pod_nums[pod]) {
-        if (!healthy[j]) {
-          if (detect_fail) { *out_fail_node = j; *fail_code = 2; }
-          return false;
-        }
-        if (!suggested[j]) {
-          if (detect_fail) { *out_fail_node = j; *fail_code = 3; }
-          return false;
-        }
-        out_nodes[pod] = j;
-        picked_leaf += pod_nums[pod];
-        ++pod;
-        if (pod == n_pods) return true;
-      } else {
-        picked_leaf = 0;
-        ++oi;
-      }
-    }
-    if (detect_fail) *fail_code = 1;
-    return false;
-  };
+  View v{n_nodes, n_anc, n_ids, anc_ids, healthy, suggested, free_at_p,
+         order};
+  PodSeq pods{pod_nums, n_pods, /*reversed=*/false};
+  PackScratch scratch;
+  return pack_attempt(v, pods, scratch, out_nodes, out_fail_node);
+}
 
-  if (n_pods > 1 && n_anc > 0 && n_ids > 0) {
-    int64_t total = 0;
-    for (int32_t i = 0; i < n_pods; ++i) total += pod_nums[i];
-    std::vector<int32_t> rank(n_nodes);
-    for (int32_t r = 0; r < n_nodes; ++r) rank[order[r]] = r;
-    // per enclosure (discovered in ascending first-member rank, which
-    // matches the reference's (level, first-member) visit order when
-    // columns ascend by level): member ranks + usable capacity; only
-    // healthy+suggested nodes join an enclosure
-    std::vector<int32_t> grp_of(n_ids);
-    std::vector<int64_t> grp_cap;
-    std::vector<std::vector<int32_t>> grp_ranks;
-    for (int32_t col = 0; col < n_anc; ++col) {
-      std::fill(grp_of.begin(), grp_of.end(), -1);
-      grp_cap.clear();
-      grp_ranks.clear();
-      for (int32_t r = 0; r < n_nodes; ++r) {
-        const int32_t j = order[r];
-        if (!healthy[j] || !suggested[j]) continue;
-        const int32_t a = anc_ids[static_cast<int64_t>(j) * n_anc + col];
-        if (a < 0) continue;
-        int32_t gi = grp_of[a];
-        if (gi < 0) {
-          gi = grp_of[a] = static_cast<int32_t>(grp_cap.size());
-          grp_cap.push_back(0);
-          grp_ranks.emplace_back();
-        }
-        grp_cap[gi] += free_at_p[j];
-        grp_ranks[gi].push_back(r);
-      }
-      for (size_t gi = 0; gi < grp_cap.size(); ++gi) {
-        if (grp_cap[gi] < total) continue;
-        if (greedy(grp_ranks[gi].data(),
-                   static_cast<int32_t>(grp_ranks[gi].size()),
-                   /*detect_fail=*/false, nullptr)) {
-          return 0;
-        }
+// The multi-chain relax walk's descending-take descent in ONE call
+// (Python reference: hived.py _schedule_relaxed_across_chains run_pass):
+// `pod_nums` holds member sizes in DESCENDING order (the relax `flat`
+// prefix); for take = n_pods..1 the ascending reading of the first `take`
+// members (= the reference's per-probe sorted_pod_nums) is packed against
+// this view — enclosure pass + flat greedy, identical to
+// hived_find_nodes_for_pods — and the largest take that packs is returned
+// (0 if none). The caller treats the result as an EXACT upper bound on the
+// takes worth running through the full scheduling probe: every take above
+// it provably fails this same packing, every take at or below it still
+// runs the real probe, so decisions are unchanged. `order` is sorted in
+// place when `do_sort` is set — callers pass a SCRATCH copy of the
+// persistent order so the probe never perturbs the reference's tie
+// history. out_nodes (size n_pods) receives the winning take's picks.
+int32_t hived_find_nodes_prefix(
+    int32_t n_nodes, int32_t n_anc, int32_t n_ids, const int32_t* anc_ids,
+    const int32_t* healthy, const int32_t* suggested,
+    const int32_t* used_same, const int32_t* used_higher,
+    const int32_t* free_at_p, int32_t pack, int32_t do_sort, int32_t* order,
+    const int32_t* pod_nums, int32_t n_pods, int32_t* out_nodes) {
+  if (n_pods <= 0 || n_nodes <= 0) return 0;
+  if (do_sort) {
+    sort_order(order, n_nodes, healthy, suggested, used_same, used_higher,
+               pack);
+  }
+  View v{n_nodes, n_anc, n_ids, anc_ids, healthy, suggested, free_at_p,
+         order};
+  // usable capacity upper bound: a take whose chip total exceeds the
+  // healthy+suggested free sum cannot pack — skip it without a walk
+  int64_t usable = 0;
+  for (int32_t j = 0; j < n_nodes; ++j) {
+    if (v.healthy[j] && v.suggested[j]) usable += v.free_at_p[j];
+  }
+  int64_t prefix_total = 0;
+  for (int32_t i = 0; i < n_pods; ++i) prefix_total += pod_nums[i];
+  PackScratch scratch;
+  for (int32_t take = n_pods; take > 0; --take) {
+    if (prefix_total <= usable) {
+      PodSeq pods{pod_nums, take, /*reversed=*/true};
+      int32_t fail = -1;
+      if (pack_attempt(v, pods, scratch, out_nodes, &fail) == 0) {
+        return take;
       }
     }
+    prefix_total -= pod_nums[take - 1];
   }
-  std::vector<int32_t> flat(n_nodes);
-  for (int32_t r = 0; r < n_nodes; ++r) flat[r] = r;
-  int32_t fail_code = 1;
-  if (greedy(flat.data(), n_nodes, /*detect_fail=*/true, &fail_code)) return 0;
-  return fail_code;
+  return 0;
 }
 
 }  // extern "C"

@@ -1,0 +1,179 @@
+"""Batched informer deltas for the scheduler runtime (``HIVED_EVENT_BATCH``).
+
+The unbatched informer path takes the scheduler lock once **per watch
+event**: under bursty churn (tens of thousands of pod ADDED/MODIFIED/DELETED
+events per second on a 16k-chip fleet) the informer thread and the
+scheduling thread bounce the lock per event, and every bounce lands between
+two gang decisions. With ``HIVED_EVENT_BATCH=1`` the informer callbacks
+instead append to this queue under a tiny leaf lock, and the scheduler
+drains the whole backlog at the start of its next cycle (filter / preempt /
+bind / defrag tick) under the scheduler-lock acquisition that cycle already
+pays — ONE contended acquisition per cycle instead of one per event.
+
+The queue coalesces while it buffers — rules chosen so the applied delta is
+**decision-identical** to applying every event individually (the
+differential guard: tests/test_eventbatch.py pins ``HIVED_EVENT_BATCH=0``
+vs ``=1`` on bound placements, failure strings and journal events across
+chaos seeds):
+
+- **global FIFO**: events apply in arrival order (stronger than the per-
+  object ordering the informer contract requires), so cross-object effects
+  (a delete freeing cells a later add's gang needs) replay faithfully;
+- **pod add→delete dedup**: an *unbound* pod whose ADDED is still pending
+  when its DELETED arrives is dropped entirely — ``add_unallocated_pod``
+  is a no-op and the runtime status round-trips, so the scheduler provably
+  never observes the pod. Bound adds (recovery replays) are never deduped:
+  ``add_allocated_pod`` + ``delete_allocated_pod`` is only bit-exact on a
+  healthy view (the what-if-probe caveat), so the pair is applied as-is;
+- **node-flap folding**: consecutive pending updates of one node fold into
+  (first_old, last_new), and a pending add followed by updates folds into
+  add(last_new) — ``update_node`` acts only on the healthiness *edge*, so a
+  NotReady↔Ready flap that completes inside one batch window applies as a
+  no-op instead of a doomed-bad bind/unbind round trip (the round trip is
+  deterministic and state-restoring, so the fold changes no decision).
+  Node deletes are never folded away: DELETED marks the node bad whatever
+  came before, and dropping a pending add could resurrect a stale healthy
+  state.
+
+Lock contract: enqueue touches only ``event_queue_lock`` (a leaf — informer
+threads may already hold the scheduler lock via the fake ApiServer's
+synchronous delivery, and nothing is ever acquired under the queue lock).
+``drain()`` is destructive and MUST be called with the scheduler lock held:
+hivedlint's CON002 fixpoint treats a call to any attr in
+:data:`LOCKED_APPLY_ATTRS` inside ``HivedScheduler`` as an algorithm-
+mutating site, so an unlocked path to the delta apply fails lint (seeded
+fixture: tests/test_hivedlint.py::test_con002_event_batch_apply_traversed).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from hivedscheduler_tpu.common import envflags, lockcheck
+from hivedscheduler_tpu.k8s.types import Node, Pod
+from hivedscheduler_tpu.runtime import utils as internal_utils
+
+# Attrs that consume/apply the batched delta; CON002 requires every call
+# path to them inside HivedScheduler to hold the scheduler lock end-to-end
+# (the batched analogue of defrag.LOCKED_ENTRY_ATTRS).
+LOCKED_APPLY_ATTRS = frozenset({"drain"})
+
+# entry kinds, in the vocabulary of the scheduler's informer handlers
+POD_ADD = "pod_add"
+POD_UPDATE = "pod_update"
+POD_DELETE = "pod_delete"
+NODE_ADD = "node_add"
+NODE_UPDATE = "node_update"
+NODE_DELETE = "node_delete"
+
+
+def batch_enabled() -> bool:
+    """``HIVED_EVENT_BATCH=1`` opts the runtime into batched watch deltas;
+    the default (unset/`0`) keeps the per-event reference path — the
+    decision-identical differential the batched path is pinned against."""
+    return envflags.get("HIVED_EVENT_BATCH", "0") == "1"
+
+
+class PendingDeltas:
+    """The coalescing watch-event queue (see module docstring).
+
+    Enqueue methods are registered directly as informer callbacks; they are
+    safe from any thread and never block on scheduler state. ``drain()``
+    hands the backlog to the applying cycle (scheduler lock held — CON002).
+    """
+
+    __slots__ = (
+        "_lock",
+        "_entries",
+        "_last",
+        "coalesced_pod_pairs",
+        "coalesced_node_folds",
+        "drained_events",
+        "drained_batches",
+    )
+
+    def __init__(self):
+        self._lock = lockcheck.make_lock("event_queue_lock")
+        # each entry is a mutable list [kind, obj, ...]; kind None = dropped
+        self._entries: List[list] = []
+        # ("pod"|"node", key) -> the LAST pending entry for that object
+        self._last: Dict[Tuple[str, str], list] = {}
+        self.coalesced_pod_pairs = 0
+        self.coalesced_node_folds = 0
+        self.drained_events = 0
+        self.drained_batches = 0
+
+    def _push(self, key: Tuple[str, str], entry: list) -> None:
+        """Caller holds the queue lock."""
+        self._entries.append(entry)
+        self._last[key] = entry
+
+    # -- informer-side enqueue -------------------------------------------
+
+    def pod_add(self, pod: Pod) -> None:
+        with self._lock:
+            self._push(("pod", pod.uid), [POD_ADD, pod])
+
+    def pod_update(self, old_pod: Pod, new_pod: Pod) -> None:
+        with self._lock:
+            self._push(("pod", new_pod.uid), [POD_UPDATE, old_pod, new_pod])
+
+    def pod_delete(self, pod: Pod) -> None:
+        with self._lock:
+            key = ("pod", pod.uid)
+            last = self._last.get(key)
+            if (
+                last is not None
+                and last[0] == POD_ADD
+                and not internal_utils.is_bound(last[1])
+            ):
+                # add→delete dedup: the unbound pod lived and died inside
+                # one batch window — the scheduler never observes it
+                last[0] = None
+                del self._last[key]
+                self.coalesced_pod_pairs += 1
+                return
+            self._push(key, [POD_DELETE, pod])
+
+    def node_add(self, node: Node) -> None:
+        with self._lock:
+            self._push(("node", node.name), [NODE_ADD, node])
+
+    def node_update(self, old_node: Node, new_node: Node) -> None:
+        with self._lock:
+            key = ("node", new_node.name)
+            last = self._last.get(key)
+            if last is not None and last[0] == NODE_UPDATE:
+                last[2] = new_node  # flap fold: (o0,o1)+(o1,o2) -> (o0,o2)
+                self.coalesced_node_folds += 1
+                return
+            if last is not None and last[0] == NODE_ADD:
+                last[1] = new_node  # add+update -> add(latest state)
+                self.coalesced_node_folds += 1
+                return
+            self._push(key, [NODE_UPDATE, old_node, new_node])
+
+    def node_delete(self, node: Node) -> None:
+        with self._lock:
+            # never folded: DELETED must mark the node bad whatever the
+            # pending history says (see module docstring)
+            self._push(("node", node.name), [NODE_DELETE, node])
+
+    # -- scheduler-side apply --------------------------------------------
+
+    def drain(self) -> List[list]:
+        """Take the whole backlog (coalesced, arrival order). Destructive —
+        the caller MUST hold the scheduler lock and apply every returned
+        entry (CON002 traverses calls to this attr as mutating sites)."""
+        with self._lock:
+            entries, self._entries = self._entries, []
+            self._last.clear()
+        live = [e for e in entries if e[0] is not None]
+        if live:
+            self.drained_events += len(live)
+            self.drained_batches += 1
+        return live
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(1 for e in self._entries if e[0] is not None)

@@ -149,6 +149,12 @@ REGISTRY.describe("tpu_hive_k8s_retries_total",
                   "K8s REST request retries by operation and reason")
 REGISTRY.describe("tpu_hive_force_binds_total", "Force-bind escalations")
 REGISTRY.describe("tpu_hive_bad_nodes", "Nodes currently considered bad")
+REGISTRY.describe("tpu_hive_event_batches_total",
+                  "Batched watch-event deltas applied (HIVED_EVENT_BATCH=1: "
+                  "one scheduler-lock acquisition per batch)")
+REGISTRY.describe("tpu_hive_events_applied_total",
+                  "Watch events applied through batched deltas, after "
+                  "coalescing (add-delete dedup, node-flap folds)")
 REGISTRY.describe("tpu_hive_filter_latency_seconds", "filterRoutine latency")
 REGISTRY.describe("tpu_hive_preempt_latency_seconds", "preemptRoutine latency")
 # serving-engine request lifecycle (models/serving.py), split by priority

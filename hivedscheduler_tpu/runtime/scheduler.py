@@ -40,6 +40,7 @@ from hivedscheduler_tpu.defrag.probe import (
 )
 from hivedscheduler_tpu.k8s.client import KubeClient
 from hivedscheduler_tpu.k8s.types import Binding, Node, Pod
+from hivedscheduler_tpu.runtime import eventbatch
 from hivedscheduler_tpu.runtime import extender as ei
 from hivedscheduler_tpu.runtime import types as internal
 from hivedscheduler_tpu.runtime import utils as internal_utils
@@ -108,8 +109,26 @@ class HivedScheduler:
         # pure policy shared with the trace sim
         self._backfill_policy = defrag_pkg.BackfillPolicy()
 
-        kube_client.on_node_event(self._add_node, self._update_node, self._delete_node)
-        kube_client.on_pod_event(self._add_pod, self._update_pod, self._delete_pod)
+        # -- watch-event delivery (doc/design/perf.md) ---------------------
+        # HIVED_EVENT_BATCH=1: informer callbacks enqueue into a coalescing
+        # delta queue (runtime/eventbatch.py) drained at the start of every
+        # scheduling cycle under the cycle's own scheduler-lock acquisition
+        # — one contended acquisition per cycle instead of one per event.
+        # Default (=0) is the per-event reference path, pinned
+        # decision-identical by tests/test_eventbatch.py.
+        self._pending: Optional[eventbatch.PendingDeltas] = (
+            eventbatch.PendingDeltas() if eventbatch.batch_enabled() else None
+        )
+        if self._pending is not None:
+            kube_client.on_node_event(
+                self._pending.node_add, self._pending.node_update,
+                self._pending.node_delete)
+            kube_client.on_pod_event(
+                self._pending.pod_add, self._pending.pod_update,
+                self._pending.pod_delete)
+        else:
+            kube_client.on_node_event(self._add_node, self._update_node, self._delete_node)
+            kube_client.on_pod_event(self._add_pod, self._update_pod, self._delete_pod)
         # all nodes start bad until informed: publish that state immediately
         self._update_bad_node_gauge()
 
@@ -134,9 +153,57 @@ class HivedScheduler:
         runtime.utils.freeze_long_lived_state."""
         log.info("Recovering tpu-hive scheduler")
         self.kube_client.sync()
+        # batched mode: the sync's replayed events are still queued — apply
+        # them NOW so the recovery barrier holds (every bound pod is in the
+        # algorithm before any scheduling request is served)
+        self.flush_events()
         internal_utils.freeze_long_lived_state()
         self._started = True
         log.info("Running tpu-hive scheduler")
+
+    # ------------------------------------------------------------------
+    # batched watch-event application (runtime/eventbatch.py)
+    # ------------------------------------------------------------------
+
+    def flush_events(self) -> int:
+        """Apply every pending batched watch event under one scheduler-lock
+        acquisition; returns the number applied. No-op (0) when
+        ``HIVED_EVENT_BATCH`` is off. Every extender routine and defrag
+        tick flushes on entry, so embedders only need this when they read
+        scheduler state without driving a cycle."""
+        if self._pending is None:
+            return 0
+        with self.scheduler_lock:
+            return self._apply_deltas_locked()
+
+    def _apply_deltas_locked(self) -> int:
+        """Drain the coalesced backlog and replay it through the per-event
+        handlers (re-entrant under the already-held scheduler lock, so the
+        applied semantics are byte-for-byte the unbatched path's). Caller
+        holds the scheduler lock — hivedlint CON002 traverses the
+        ``drain`` call as a mutating site to enforce exactly that."""
+        if self._pending is None:
+            return 0
+        entries = self._pending.drain()
+        if not entries:
+            return 0
+        for entry in entries:
+            kind = entry[0]
+            if kind == eventbatch.POD_ADD:
+                self._add_pod(entry[1])
+            elif kind == eventbatch.POD_UPDATE:
+                self._update_pod(entry[1], entry[2])
+            elif kind == eventbatch.POD_DELETE:
+                self._delete_pod(entry[1])
+            elif kind == eventbatch.NODE_ADD:
+                self._add_node(entry[1])
+            elif kind == eventbatch.NODE_UPDATE:
+                self._update_node(entry[1], entry[2])
+            else:
+                self._delete_node(entry[1])
+        metrics.inc("tpu_hive_event_batches_total")
+        metrics.inc("tpu_hive_events_applied_total", amount=len(entries))
+        return len(entries)
 
     # ------------------------------------------------------------------
     # informer callbacks
@@ -395,6 +462,7 @@ class HivedScheduler:
         """Returns (result, metric outcome); each return site knows its own
         outcome exactly."""
         with self.scheduler_lock:
+            self._apply_deltas_locked()
             pod = args.pod
             suggested_nodes = args.node_names
             log.info("[%s]: filterRoutine: Started", internal_utils.key(pod))
@@ -555,6 +623,7 @@ class HivedScheduler:
 
     def _bind_routine(self, args: ei.ExtenderBindingArgs) -> ei.ExtenderBindingResult:
         with self.scheduler_lock:
+            self._apply_deltas_locked()
             pod_key = f"{args.pod_namespace}/{args.pod_name}"
             log.info("[%s(%s)]: bindRoutine: Started", args.pod_uid, pod_key)
             pod_status = self._general_schedule_admission_check(
@@ -651,6 +720,7 @@ class HivedScheduler:
 
     def _preempt_routine(self, args: ei.ExtenderPreemptionArgs) -> ei.ExtenderPreemptionResult:
         with self.scheduler_lock:
+            self._apply_deltas_locked()
             pod = args.pod
             suggested_nodes = list(args.node_name_to_meta_victims)
             log.info("[%s]: preemptRoutine: Started", internal_utils.key(pod))
@@ -1099,6 +1169,7 @@ class HivedScheduler:
             return {}
         report = {}
         with self.scheduler_lock:
+            self._apply_deltas_locked()
             self._sweep_expired_reservations()
             for mig in list(self._migrations.values()):
                 if not mig.active:
@@ -1283,6 +1354,7 @@ class HivedScheduler:
         if not defrag_pkg.defrag_enabled():
             return {"enabled": False}
         with self.scheduler_lock:
+            self._apply_deltas_locked()
             t0 = time.perf_counter()
             progressed = self.resume_migrations()
             t1 = time.perf_counter()
@@ -1490,6 +1562,7 @@ class HivedScheduler:
     def get_defrag_status(self) -> dict:
         """Inspect view of the reservation/migration state machine."""
         with self.scheduler_lock:
+            self._apply_deltas_locked()
             return {
                 "enabled": defrag_pkg.defrag_enabled(),
                 "backfill": defrag_pkg.backfill_enabled(),
@@ -1517,6 +1590,7 @@ class HivedScheduler:
         from hivedscheduler_tpu.obs import eta as obs_eta
 
         with self.scheduler_lock:
+            self._apply_deltas_locked()
             rec = self._defrag_waiters.get(group)
             pod = rec["pod"] if rec is not None else None
             if pod is None:
@@ -1559,6 +1633,7 @@ class HivedScheduler:
         headroom it cannot see in the cell trees."""
         occupancy = metrics.get_gauge("tpu_hive_serve_block_pool_occupancy")
         with self.scheduler_lock:
+            self._apply_deltas_locked()
             reserved_nodes = sorted({
                 n for r in self._reservations.values() for n in r.nodes
             })

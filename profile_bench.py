@@ -1,13 +1,17 @@
 """Profile one measured gang decision from bench.py's scenarios.
 
-Usage: python profile_bench.py [--scale4096] [--deletes] [--sort tottime]
-                               [--rows 40]
+Usage: python profile_bench.py [--scale4096 | --scale16384] [--deletes]
+                               [--sort tottime] [--rows 40]
 
 Default: the 256-chip gang on the v5p-1024 cluster (the headline metric).
-``--scale4096``: the 1024-chip gang (256 pods x 4) on the 16x16x16 cluster —
-the ``scale4096_p50_ms`` scale point, so regressions there are profilable
-too. ``--deletes`` profiles the release path instead of schedule+add.
-Not part of the shipped package; a dev tool for finding scheduling fat.
+``--scale4096``: the 1024-chip gang (256 pods x 4) on the 16x16x16 cluster
+(the ``scale4096_p50_ms`` point). ``--scale16384``: the 4096-chip gang
+(1024 pods x 4) on the 16x32x32 / 4096-host cluster (the
+``scale16384_p50_ms`` point), for finding the remaining fat at the
+production-fleet scale. ``--deletes`` profiles the release path instead of
+schedule+add — wired for every scenario. Cluster setup runs OUTSIDE the
+profiler in the scale scenarios (it runs once; the decision loop is the
+regression surface). Not part of the shipped package; a dev tool.
 """
 
 import cProfile
@@ -38,16 +42,35 @@ def _profile_1024(pr, deletes):
         pr.disable()
 
 
-def _profile_4096(pr, deletes):
-    """The scale4096 point: reuse run_scale_4096's exact cluster by
-    profiling around it — the function owns setup + trials, so the profile
-    includes both; setup shows up under HivedAlgorithm.__init__ and is easy
-    to discount (it runs once)."""
-    if deletes:
-        print("--deletes is only wired for the 1024 scenario", file=sys.stderr)
-    pr.enable()
-    bench.run_scale_4096()
-    pr.disable()
+def _profile_scale(pr, n_chips, deletes):
+    """The scale4096/scale16384 points: setup outside the profiler, then
+    the exact schedule+allocate (or release) loop `_run_scale` times."""
+    from hivedscheduler_tpu.runtime.types import FILTERING_PHASE
+    from hivedscheduler_tpu.runtime.utils import new_binding_pod
+
+    gang_pods = {4096: 256, 16384: 1024}[n_chips]
+    trials = {4096: 4, 16384: 2}[n_chips]
+    algo, nodes = bench.build_scale_algo(n_chips)
+    for trial in range(trials):
+        pods = []
+        if not deletes:
+            pr.enable()
+        for i in range(gang_pods):
+            p = bench.make_pod(f"g{trial}-{i}", "vc-a", 10, f"g{trial}",
+                               gang_pods, 4)
+            r = algo.schedule(p, nodes, FILTERING_PHASE)
+            assert r.pod_bind_info is not None, r.pod_wait_info
+            bp = new_binding_pod(p, r.pod_bind_info)
+            algo.add_allocated_pod(bp)
+            pods.append(bp)
+        if not deletes:
+            pr.disable()
+        if deletes:
+            pr.enable()
+        for bp in pods:
+            algo.delete_allocated_pod(bp)
+        if deletes:
+            pr.disable()
 
 
 def main():
@@ -60,8 +83,10 @@ def main():
     deletes = "--deletes" in sys.argv
 
     pr = cProfile.Profile()
-    if "--scale4096" in sys.argv:
-        _profile_4096(pr, deletes)
+    if "--scale16384" in sys.argv:
+        _profile_scale(pr, 16384, deletes)
+    elif "--scale4096" in sys.argv:
+        _profile_scale(pr, 4096, deletes)
     else:
         _profile_1024(pr, deletes)
     stats = pstats.Stats(pr)

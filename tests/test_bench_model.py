@@ -10,6 +10,10 @@ import pytest
 pytest.importorskip("jax")
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ISSUE 15): the full CPU
+# smoke is the heavy variant (~60 s); the tier-1 cousins are this file's
+# acquire/flops/degradation tests plus tests/test_bench_driver.py's
+# parse-contract suite (the driver-path failure modes the smoke guards)
 def test_bench_model_smoke(capsys):
     import bench_model
 

@@ -72,8 +72,13 @@ class TestResumeMatrix:
     the target mesh — through the same metadata path train.py uses."""
 
     @pytest.mark.parametrize("source,target,exact", [
-        # same topology: bit-exact (the existing kill -9 discipline)
-        (topology.MeshAxes(dp=4), topology.MeshAxes(dp=4), True),
+        # same topology: bit-exact (the existing kill -9 discipline).
+        # Slow: tier-1 wall-time budget (ISSUE 15) — the shrink trajectory
+        # below is the tier-1 cousin through the same restore path, and
+        # same-topology bit-exactness stays tier-1 via the kill -9
+        # bit-exact workload pin (tests/test_checkpoint.py)
+        pytest.param(topology.MeshAxes(dp=4), topology.MeshAxes(dp=4),
+                     True, marks=pytest.mark.slow),
         # shrink: half the devices
         (topology.MeshAxes(dp=4), topology.MeshAxes(dp=2), False),
         # grow: double the devices (slow: tier-1 wall-time budget,

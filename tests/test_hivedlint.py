@@ -241,6 +241,37 @@ def test_con002_defrag_entry_points_traversed(tmp_path):
         path, ["mutate"], class_name="Sched") == []
 
 
+def test_con002_event_batch_apply_traversed(tmp_path):
+    """The CON002 fixpoint treats the batched delta-apply entry points
+    (eventbatch.LOCKED_APPLY_ATTRS) as algorithm-mutating calls: a path
+    that drains the watch-event backlog without the scheduler lock is
+    flagged, the locked shape passes — and the real registry is what the
+    tree-wide check wires in."""
+    from hivedscheduler_tpu.runtime import eventbatch
+
+    assert "drain" in eventbatch.LOCKED_APPLY_ATTRS
+    path = _write(tmp_path, "sched.py", """
+        class Sched:
+            def flush_events(self):
+                self._pending.drain()          # no lock!
+            def _filter_routine(self, args):
+                with self.scheduler_lock:
+                    self._apply_deltas_locked()
+            def _apply_deltas_locked(self):
+                for e in self._pending.drain():
+                    pass
+        """)
+    got = concurrency.check_scheduler_lock_paths(
+        path, ["mutate"], class_name="Sched",
+        extra_mutator_attrs=set(eventbatch.LOCKED_APPLY_ATTRS))
+    assert [f.rule for f in got] == ["CON002"]
+    assert "flush_events()" in got[0].message
+    # without the extension the same tree sails through — the fixture is
+    # non-vacuous
+    assert concurrency.check_scheduler_lock_paths(
+        path, ["mutate"], class_name="Sched") == []
+
+
 def test_dfg001_mutator_outside_probe_flagged(tmp_path):
     """DFG001: an algorithm-mutator call in any defrag module other than
     probe.py is a lock-contract bypass; the probe itself may mutate (its

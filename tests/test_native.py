@@ -267,6 +267,276 @@ def _packing_cluster():
 
 
 @pytest.mark.parametrize("seed", range(6))
+def test_prefix_fit_exact_vs_reference_walk(seed):
+    """hived_find_nodes_prefix must return EXACTLY the largest descending-
+    flat prefix whose ascending reading packs (two-phase: opportunistic
+    then the request priority), matching a brute-force walk that probes
+    every take through the pure-Python _find_nodes — across randomized
+    load/health/suggested churn."""
+    import random as _random
+
+    from hivedscheduler_tpu.algorithm.cell_allocation import (
+        allocate_cell_walk,
+        release_cell_walk,
+    )
+    from hivedscheduler_tpu.algorithm.constants import OPPORTUNISTIC_PRIORITY
+
+    if not native.prefix_available():
+        pytest.skip("native prefix entry unavailable")
+    rng = _random.Random(2000 + seed)
+    ccl, levels = _packing_cluster()
+    s_nat = ta.TopologyAwareScheduler(ccl, levels, cross_priority_pack=False)
+    s_py = ta.TopologyAwareScheduler(ccl, levels, cross_priority_pack=False)
+    s_py._native_pack = False  # pure-Python reference feasibility walk
+    assert s_nat._native_pack_state() is not None
+
+    leaves = ccl[1]
+    all_nodes = sorted({c.nodes[0] for c in leaves})
+    allocated = []
+    for step in range(25):
+        if allocated and rng.random() < 0.45:
+            for _ in range(rng.randint(1, 8)):
+                if not allocated:
+                    break
+                c, p = allocated.pop(rng.randrange(len(allocated)))
+                release_cell_walk(c, p)
+        else:
+            for _ in range(rng.randint(1, 8)):
+                c = leaves[rng.randrange(len(leaves))]
+                p = rng.choice([-1, 0, 5])
+                allocate_cell_walk(c, p)
+                allocated.append((c, p))
+        if rng.random() < 0.3:
+            c = leaves[rng.randrange(len(leaves))]
+            c.set_healthiness("Bad" if c.healthy else "Healthy")
+        ignore = rng.random() < 0.5
+        suggested = (set() if ignore else
+                     set(rng.sample(all_nodes,
+                                    rng.randint(len(all_nodes) // 2,
+                                                len(all_nodes)))))
+        # descending member sizes, as the relax walk's flat segment
+        flat = sorted(
+            (rng.choice([4, 4, 4, 8, 16]) for _ in range(rng.randint(1, 40))),
+            reverse=True)
+        p = rng.choice([-1, 5])
+        got = s_nat.max_feasible_prefix(flat, p, suggested, ignore)
+
+        def feasible(take):
+            nums = sorted(flat[:take])
+            for prio in ([OPPORTUNISTIC_PRIORITY] if p < 0
+                         else [OPPORTUNISTIC_PRIORITY, p]):
+                s_py._update_cluster_view(prio, suggested, ignore)
+                picked, _ = s_py._find_nodes(nums, True)
+                if picked is not None:
+                    return True
+            return False
+
+        want = 0
+        for take in range(len(flat), 0, -1):
+            if feasible(take):
+                want = take
+                break
+        assert got == want, (step, flat, got, want)
+        # keep the two views' sort histories in lockstep for the next step
+        for s in (s_nat, s_py):
+            s._update_cluster_view(
+                OPPORTUNISTIC_PRIORITY, suggested, ignore)
+            s._find_nodes([4], True)
+
+
+# ---------------------------------------------------------------------------
+# multi-chain relax parity: native prefix walk vs HIVED_NATIVE=0 reference
+# ---------------------------------------------------------------------------
+
+
+def _two_chain_config():
+    """Two 128-chip v5p chains (32 hosts each — above the native packing
+    threshold on both the physical and the fully-assigned VC views) sharing
+    one leaf cell type, so an oversized vc-r gang must relax across chains."""
+    from hivedscheduler_tpu.api.types import VirtualCellSpec
+
+    def mesh(prefix):
+        return MeshSpec(
+            topology=(8, 4, 4), chip_type="v5p-chip", host_shape=(2, 2, 1),
+            levels=[
+                MeshLevelSpec(name=f"{prefix}-8", shape=(2, 2, 2)),
+                MeshLevelSpec(name=f"{prefix}-16", shape=(4, 2, 2)),
+                MeshLevelSpec(name=f"{prefix}-32", shape=(4, 4, 2)),
+                MeshLevelSpec(name=f"{prefix}-64", shape=(4, 4, 4)),
+            ],
+        )
+
+    return new_config(Config(
+        physical_cluster=PhysicalClusterSpec(
+            cell_types={"chainA": CellTypeSpec(mesh=mesh("a")),
+                        "chainB": CellTypeSpec(mesh=mesh("b"))},
+            physical_cells=[
+                PhysicalCellSpec(cell_type="chainA", cell_address="pa"),
+                PhysicalCellSpec(cell_type="chainB", cell_address="pb"),
+            ],
+        ),
+        virtual_clusters={
+            "vc-r": VirtualClusterSpec(virtual_cells=[
+                VirtualCellSpec(cell_number=2, cell_type="chainA.a-64"),
+                VirtualCellSpec(cell_number=2, cell_type="chainB.b-64"),
+            ]),
+        },
+    ))
+
+
+def _relax_churn(seed: int, py_reference: bool):
+    """Drive one seeded gang churn (multi-chain relaxation reachable)
+    through a fresh HivedAlgorithm; returns the per-step decision log:
+    placements at chip granularity and failure strings."""
+    import os as _os
+    import random as _random
+
+    from hivedscheduler_tpu.algorithm.hived import HivedAlgorithm
+    from hivedscheduler_tpu.common.utils import to_json
+    from hivedscheduler_tpu.k8s.types import Container, Node, Pod
+    from hivedscheduler_tpu.runtime.types import FILTERING_PHASE
+    from hivedscheduler_tpu.runtime.utils import new_binding_pod
+    from hivedscheduler_tpu.api import constants as C
+
+    saved = _os.environ.get("HIVED_NATIVE")
+    if py_reference:
+        _os.environ["HIVED_NATIVE"] = "0"
+    try:
+        _random.seed(seed)
+        rng = _random.Random(seed)
+        algo = HivedAlgorithm(_two_chain_config())
+        nodes = sorted({n for ccl in algo.full_cell_list.values()
+                        for c in ccl[max(ccl)] for n in c.nodes})
+        for n in nodes:
+            algo.add_node(Node(name=n))
+        log = []
+        groups = {}
+        gid = 0
+        bad = set()
+        for step in range(30):
+            op = rng.random()
+            if op < 0.2 and groups:
+                name = rng.choice(sorted(groups))
+                for bp in groups.pop(name):
+                    algo.delete_allocated_pod(bp)
+                log.append(("free", name))
+                continue
+            if op < 0.3:
+                n = rng.choice(nodes)
+                if n in bad:
+                    bad.discard(n)
+                    algo.update_node(
+                        Node(name=n, conditions=[]), Node(name=n))
+                else:
+                    from hivedscheduler_tpu.k8s.types import NodeCondition
+                    bad.add(n)
+                    algo.update_node(Node(name=n), Node(
+                        name=n,
+                        conditions=[NodeCondition(type="Ready",
+                                                  status="False")]))
+                log.append(("flip", n))
+                continue
+            # schedule a gang; ~half are too big for one chain (relax)
+            pods = rng.choice([2, 4, 8, 20, 24, 36, 40])
+            prio = rng.choice([-1, 5])
+            name = f"rg{gid}"
+            gid += 1
+            spec = {
+                "virtualCluster": "vc-r", "priority": prio,
+                "leafCellType": "v5p-chip", "leafCellNumber": 4,
+                "multiChainRelaxPolicy": rng.choice(["fewest", "balanced"]),
+                "affinityGroup": {
+                    "name": name,
+                    "members": [{"podNumber": pods, "leafCellNumber": 4}],
+                },
+            }
+            bound = []
+            ok = True
+            outcome = None
+            for i in range(pods):
+                pod = Pod(
+                    name=f"{name}-{i}", uid=f"{name}-{i}",
+                    annotations={C.ANNOTATION_POD_SCHEDULING_SPEC:
+                                 to_json(spec)},
+                    containers=[Container(resource_limits={
+                        C.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})],
+                )
+                r = algo.schedule(pod, nodes, FILTERING_PHASE)
+                if r.pod_bind_info is None:
+                    outcome = (
+                        "wait",
+                        r.pod_wait_info.reason
+                        if r.pod_wait_info is not None else "",
+                        tuple(sorted(
+                            (v.uid for v
+                             in r.pod_preempt_info.victim_pods)))
+                        if r.pod_preempt_info is not None else (),
+                    )
+                    ok = False
+                    break
+                bp = new_binding_pod(pod, r.pod_bind_info)
+                algo.add_allocated_pod(bp)
+                bound.append(bp)
+                outcome = ("bind", tuple(sorted(
+                    (m.physical_node,
+                     tuple(m.physical_leaf_cell_indices))
+                    for gms in r.pod_bind_info.affinity_group_bind_info
+                    for m in gms.pod_placements)))
+                log.append(("pod", f"{name}-{i}") + outcome)
+            if ok:
+                groups[name] = bound
+            else:
+                for bp in bound:
+                    algo.delete_allocated_pod(bp)
+                log.append(("gang-fail", name) + (outcome or ()))
+        return log
+    finally:
+        if saved is None:
+            _os.environ.pop("HIVED_NATIVE", None)
+        else:
+            _os.environ["HIVED_NATIVE"] = saved
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_multi_chain_relax_native_parity(seed):
+    """The PR 4 single-chain pin, extended to multi-chain clusters: gang
+    churn whose oversized gangs relax across two chains must produce
+    bit-equal placements (node + chip indices) and byte-identical failure
+    strings with the native prefix walk engaged vs HIVED_NATIVE=0 —
+    across load/health churn and both relax policies."""
+    if not native.prefix_available():
+        pytest.skip("native prefix entry unavailable")
+    ref = _relax_churn(seed, py_reference=True)
+    fast = _relax_churn(seed, py_reference=False)
+    assert ref == fast
+
+
+def test_multi_chain_relax_prefix_bound_non_vacuous():
+    """The parity above would be vacuous if the native prefix walk never
+    engaged or never pruned a take: pin that the two-chain churn really
+    routes through max_feasible_prefix and skips provably-unpackable
+    prefixes."""
+    if not native.prefix_available():
+        pytest.skip("native prefix entry unavailable")
+    calls = {"n": 0, "pruned": 0}
+    orig = ta.TopologyAwareScheduler.max_feasible_prefix
+
+    def spy(self, flat, p, sugg, ign):
+        r = orig(self, flat, p, sugg, ign)
+        calls["n"] += 1
+        if r < len(flat):
+            calls["pruned"] += 1
+        return r
+
+    ta.TopologyAwareScheduler.max_feasible_prefix = spy
+    try:
+        _relax_churn(0, py_reference=False)
+    finally:
+        ta.TopologyAwareScheduler.max_feasible_prefix = orig
+    assert calls["n"] > 0 and calls["pruned"] > 0, calls
+
+
+@pytest.mark.parametrize("seed", range(6))
 def test_packing_native_vs_python_parity(seed):
     """HIVED_NATIVE=0 vs native parity for the cross-node packing entry
     point: two schedulers over the SAME cells — one using the one-call C

@@ -71,7 +71,11 @@ def test_forward_matches_dense(causal):
     assert jnp.max(jnp.abs(out - ref)) < 1e-4
 
 
-@pytest.mark.parametrize("h_kv", [H, 2, 1])
+# h_kv=1 (MQA) is slow-marked: tier-1 wall-time budget (ISSUE 15) — the
+# MHA (H) and GQA (2) variants are the tier-1 cousins through the same
+# grouped-head read path (mirrors tests/test_decode.py's MQA mark)
+@pytest.mark.parametrize(
+    "h_kv", [H, 2, pytest.param(1, marks=pytest.mark.slow)])
 def test_gradients_match_dense(h_kv):
     """Forward AND backward parity, incl. compact GQA/MQA k/v (the flash
     kernels consume the shared head directly)."""

@@ -117,6 +117,11 @@ class TestServingEngine:
         with pytest.raises(ValueError, match="exceeds"):
             eng.submit([1, 2], 64)
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 15): heavy
+    # variant; tier-1 cousins: test_interleaved_requests_match_vanilla_
+    # generate (greedy interleaving exactness) + test_sampling_smoke_and_
+    # validation, and the sampled spec-serving determinism suite
+    # (tests/test_serving_speculative_sampled.py)
     def test_sampled_streams_reproducible_under_interleaving(self, setup):
         """Counter-based sampling keys (fold_in(seed, rid, n_emitted)):
         a request's sampled stream is a function of (seed, rid, prompt)

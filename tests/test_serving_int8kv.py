@@ -95,6 +95,9 @@ def test_int8_chunked_matches_int8_monolithic(setup):
     assert eng.prefill_chunks_done > 0
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ISSUE 15): composition
+# variant; tier-1 cousins: test_int8_vs_float_logits_bounded (int8 core)
+# and the dense prefix exactness suite (tests/test_serving_prefix.py)
 def test_int8_prefix_cache_matches_int8_plain(setup):
     """A restored quantized prefix (values + scales travel together) is
     bit-identical to the stored row."""

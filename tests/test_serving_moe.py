@@ -68,6 +68,10 @@ class TestMoEServing:
         assert [r.tokens_out for r in reqs] == [r.tokens_out for r in refs]
         assert eng.prefill_chunks_done > 0
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 15): composition
+    # variant; tier-1 cousins: test_moe_chunked_prefill_exact +
+    # test_moe_mesh_sharded_engine_exact here, and the dense prefix
+    # exactness suite (tests/test_serving_prefix.py)
     def test_moe_prefix_cache_exact(self, setup):
         cfg, params = setup
         system = list(range(30, 62))

@@ -88,6 +88,11 @@ class TestPagedDifferential:
         for (toks, _reason), p, n in zip(out, PROMPTS, BUDGETS):
             assert toks == vanilla(params, cfg, p, n)
 
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 15): heavy
+    # variant; tier-1 cousins: test_interleaved_matches_dense_and_vanilla
+    # (the broad paged differential) + the block-pool invariant seeds
+    # (test_invariant_checker_catches_seeded_leak) + the dense prefix
+    # exactness suite (tests/test_serving_prefix.py)
     def test_prefix_sharing_and_cow_mid_block(self, setup):
         """Three prompts sharing the 10-token system prefix: the second
         matches the cached blocks (one full + one partial), COWs the

@@ -69,6 +69,9 @@ def test_longest_prefix_wins(setup):
     assert eng.prefix_tokens_reused == len(p1) + len(p2)
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ISSUE 15): boundary
+# variant; tier-1 cousins: test_prefix_hits_are_exact +
+# test_longest_prefix_wins through the same hit/extend path
 def test_identical_prompt_matches_block_boundary(setup):
     cfg, params = setup
     prompts = [SYSTEM + [5], SYSTEM + [5]]

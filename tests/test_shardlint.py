@@ -457,6 +457,10 @@ def test_cli_explain_json_lists_all_rules():
     assert all("doc" in v and "module" in v for v in docs.values())
 
 
+@pytest.mark.slow  # tier-1 wall-time budget (ISSUE 15): a second full
+# subprocess lint pass; tier-1 cousins: test_hivedlint_clean_on_tree
+# (tree-clean, tests/test_hivedlint.py) + test_cli_explain_json_lists_
+# all_rules (the --json surface, no tree scan)
 def test_cli_json_findings_clean():
     proc = _run_cli("--rule", "ENV001,ENV002", "--json")
     assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -35,6 +35,10 @@ def setup(cfg, b=2, t=7, seed=0):
 
 
 class TestSpeculative:
+    @pytest.mark.slow  # tier-1 wall-time budget (ISSUE 15): heavy
+    # variant; tier-1 cousins: test_self_draft_accepts_everything +
+    # test_jits_whole_loop here, and the serving-level greedy spec-decode
+    # parity suite (tests/test_serving_speculative.py)
     def test_greedy_matches_vanilla(self):
         """Greedy speculative == target-only greedy, even with an unrelated
         random draft model (rejections just fall back to the target argmax)."""

@@ -63,8 +63,11 @@ RULES: Dict[str, tuple] = {
                "contract and wraps its body in the algorithm lock",
                "concurrency"),
     "CON002": ("every HivedScheduler path from an entry point to an "
-               "algorithm mutating call holds scheduler_lock",
-               "concurrency"),
+               "algorithm mutating call holds scheduler_lock; the defrag "
+               "probe/planner entries (defrag.LOCKED_ENTRY_ATTRS) and the "
+               "batched delta-apply entries (eventbatch.LOCKED_APPLY_ATTRS "
+               "— drain consumes the watch-event backlog destructively) "
+               "are traversed as mutating calls", "concurrency"),
     "CON003": ("no file outside runtime/scheduler.py calls a mutating "
                "method on a scheduler_algorithm attribute", "concurrency"),
     "CON004": ("the fake ApiServer never fires informer handlers while "

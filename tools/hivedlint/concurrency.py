@@ -445,6 +445,7 @@ def check(root: str) -> List[Finding]:
     try:
         from hivedscheduler_tpu.common import lockcheck
         from hivedscheduler_tpu import defrag as defrag_pkg
+        from hivedscheduler_tpu.runtime import eventbatch
     finally:
         sys.path.pop(0)
     pkg = os.path.join(root, "hivedscheduler_tpu")
@@ -458,7 +459,8 @@ def check(root: str) -> List[Finding]:
         os.path.join(pkg, "algorithm", "hived.py"), mutators)
     out += check_scheduler_lock_paths(
         os.path.join(pkg, "runtime", "scheduler.py"), mutators,
-        extra_mutator_attrs=set(defrag_pkg.LOCKED_ENTRY_ATTRS))
+        extra_mutator_attrs=(set(defrag_pkg.LOCKED_ENTRY_ATTRS)
+                             | set(eventbatch.LOCKED_APPLY_ATTRS)))
     out += check_algorithm_bypass(pkg, mutators)
     out += check_defrag_mutator_confinement(pkg, mutators)
     out += check_store_leaf_fire(os.path.join(pkg, "k8s", "fake.py"))
