@@ -955,6 +955,12 @@ def main(argv=None) -> int:
     parser.add_argument("--batch", type=int, default=None)
     parser.add_argument("--grad-accum", type=int, default=1)
     parser.add_argument("--skip-train", action="store_true")
+    parser.add_argument("--skip-goodput", action="store_true",
+                        help="skip the fault-injected goodput episode "
+                        "(kill -9 -> elastic shrink -> grow in CPU-only "
+                        "subprocesses; reports the badput breakdown, "
+                        "effective MFU and the workload<->capacity-ledger "
+                        "bridge check — doc/design/observability.md)")
     parser.add_argument("--decode-steps", type=int, default=1,
                         help="decode fusion window: unrolls the static "
                              "generate loop and fuses K iterations per "
@@ -1187,6 +1193,34 @@ def main(argv=None) -> int:
                 f"{type(e).__name__}: {str(e)[:200]}"
             )
 
+    # goodput stage (ISSUE 16): a fault-injected elastic episode — kill -9
+    # mid-step on the full slice, shrink resume, SIGTERM grow offer, grow
+    # to completion — in CPU-only subprocesses (cpu_only_env: never a TPU
+    # grant at risk), with the step-phase conservation invariant asserted
+    # per incarnation and the workload-observed seconds reconciled against
+    # the scheduler-side busy_guaranteed interval for the gang
+    goodput_stage = None
+    if not args.skip_goodput:
+        import tempfile
+
+        from hivedscheduler_tpu.chaos import workload as workload_chaos
+
+        try:
+            with tempfile.TemporaryDirectory(prefix="hived-goodput-") as gd:
+                # seed 3 = the pinned elastic baseline
+                # (tools/check_workload_seeds.py): kill@3 lands between
+                # commits, so rework attribution is guaranteed non-vacuous
+                gh = workload_chaos.ElasticWorkloadHarness(
+                    seed=3, workdir=gd, bridge_ledger=True, reference=False)
+                greport = gh.run()
+            goodput_stage = dict(greport["goodput"])
+            goodput_stage["conservation_ok"] = not greport["violations"]
+            goodput_stage["violations"] = greport["violations"][:8]
+        except Exception as e:
+            stage_errors["goodput_error"] = (
+                f"{type(e).__name__}: {str(e)[:200]}"
+            )
+
     # bar inputs, computed once (dec_batch cancels: per-occupied-slot serve
     # throughput over per-row static decode throughput). The BARS apply to
     # the real flagship config only: a smoke/CPU run reports the measured
@@ -1285,6 +1319,25 @@ def main(argv=None) -> int:
         "fleet_slo_burn_autoscaled": (
             (serve_fleet or {}).get("autoscaled_slo") or {}).get(
                 "burn_rate"),
+        # workload goodput ledger (ISSUE 16, doc/design/observability.md):
+        # step-phase badput breakdown of the fault-injected elastic episode
+        # (Σ phases == wallclock asserted per incarnation), the rework
+        # attribution, and the bridge reconciliation against the capacity
+        # ledger's busy_guaranteed interval. effective_mfu discounts the
+        # train-step MFU by the episode's goodput fraction — the number the
+        # paper's preemption story actually delivers to a faulted job.
+        "goodput": goodput_stage,
+        "goodput_fraction": (
+            round(goodput_stage["goodput_fraction"], 4)
+            if goodput_stage is not None
+            and goodput_stage.get("goodput_fraction") is not None else None),
+        "goodput_conservation_ok": (
+            goodput_stage["conservation_ok"]
+            if goodput_stage is not None else None),
+        "effective_mfu_pct": (
+            round(mfu * goodput_stage["goodput_fraction"] * 100.0, 2)
+            if mfu is not None and goodput_stage is not None
+            and goodput_stage.get("goodput_fraction") is not None else None),
         # null (not vacuously true) when no training ran
         "loss_finite": math.isfinite(loss) if not args.skip_train else None,
         "model": {

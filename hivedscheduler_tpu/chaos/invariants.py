@@ -492,6 +492,48 @@ def check_ledger(ledger=None, ctx: str = "",
                    f"two states")
 
 
+def check_goodput(goodput=None, ctx: str = "",
+                  at: Optional[float] = None) -> None:
+    """Structural invariants of the workload goodput ledger
+    (obs/goodput.py). No-op while disabled, so every soak covers it for
+    free once the workload opts in:
+
+    - **Conservation**: the per-phase seconds — closed intervals plus
+      the open phase measured to ``at`` — sum to the process wallclock
+      since ``start()``. A lost or double-opened interval breaks the
+      telescoping sum and trips here.
+    - **Registered phases only**: no accumulated time in a phase missing
+      from ``STEP_PHASES`` (the OBS003 runtime half).
+    - **Exactly one open phase**: once started and not yet closed, the
+      workload is always *in* a phase (the per-instant analogue of the
+      capacity ledger's one-state-per-chip rule).
+
+    The cross-process form — per-incarnation conservation from a shared
+    ``--goodput-file`` spool — is ``goodput.check_spool``; the chaos
+    workload harnesses run it after every soak."""
+    from hivedscheduler_tpu.obs import goodput as obs_goodput
+
+    g = goodput if goodput is not None else obs_goodput.GOODPUT
+    if not g.enabled:
+        return
+    t = g._now(at)
+    totals = g.totals(t)
+    for phase in totals:
+        if phase not in obs_goodput.STEP_PHASES:
+            _fail(ctx, f"goodput accumulator carries unregistered step "
+                       f"phase {phase!r} — OBS003 registry drift")
+    wall = g.wallclock(t)
+    got = sum(totals.values())
+    if abs(got - wall) > 1e-6 * max(1.0, wall):
+        _fail(ctx, f"goodput conservation broken: phases sum to {got!r}s "
+                   f"but the process wallclock is {wall!r}s — an interval "
+                   f"was lost or double-opened")
+    if wall > 0 and not g._closed and g.current_phase() is None:
+        _fail(ctx, "goodput ledger started but in no phase — the "
+                   "workload must be in exactly one STEP_PHASES phase "
+                   "at every instant")
+
+
 def check_all(
     algo,
     ctx: str = "",
@@ -504,8 +546,8 @@ def check_all(
     Pass the owning ``HivedScheduler`` as ``scheduler`` to additionally
     check the defrag reservation/migration state machine, and a
     ``fleet.FleetRouter`` as ``router`` for the serving-fleet invariants.
-    The journal and capacity-ledger checks piggyback on every call
-    (no-ops while disabled)."""
+    The journal, capacity-ledger and goodput-ledger checks piggyback on
+    every call (no-ops while disabled)."""
     check_vc_safety(algo, ctx)
     check_books(algo, ctx)
     check_cell_ownership(algo, ctx)
@@ -518,6 +560,7 @@ def check_all(
         check_fleet(router, ctx)
     check_journal(ctx=ctx)
     check_ledger(ctx=ctx)
+    check_goodput(ctx=ctx)
 
 
 # ---------------------------------------------------------------------------

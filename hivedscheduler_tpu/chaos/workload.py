@@ -140,8 +140,9 @@ class WorkloadChaosHarness:
 
     # -- building blocks ---------------------------------------------------
     def train_cmd(self, ckpt_dir: str, timeline: str,
-                  steps: Optional[int] = None) -> List[str]:
-        return [
+                  steps: Optional[int] = None,
+                  goodput: str = "") -> List[str]:
+        cmd = [
             sys.executable, "-m", "hivedscheduler_tpu.train",
             "--steps", str(steps if steps is not None else self.steps),
             "--batch", "2", "--seq-len", "16", "--vocab-size", "64",
@@ -153,6 +154,9 @@ class WorkloadChaosHarness:
             "--grace-secs", str(self.grace_secs),
             "--watchdog-secs", str(self.watchdog_secs),
         ]
+        if goodput:
+            cmd += ["--goodput-file", goodput]
+        return cmd
 
     def _wait_for_step(self, proc: subprocess.Popen, timeline: str,
                        step: int) -> bool:
@@ -191,10 +195,52 @@ class WorkloadChaosHarness:
             self.violations.append(f"reference run exited {rc}")
         return read_timeline(tl)
 
+    def check_goodput(self, gp: str, want_torn: int) -> dict:
+        """Post-soak goodput audit over the shared spool: conservation per
+        summarized incarnation (``check_spool``), the rework classification
+        replay, incarnation/torn bookkeeping against the *observed* exits
+        (``want_torn`` = incarnations that exited nonzero: SIGKILL and the
+        watchdog's ``os._exit`` skip the atexit summary — a fault whose
+        step predates the resume point never fires and completes cleanly),
+        and SIGTERM → ``checkpoint_save`` non-vacuity. Violations land in
+        ``self.violations``; returns the report's ``goodput`` block."""
+        from hivedscheduler_tpu.obs import goodput as obs_goodput
+
+        self.violations += obs_goodput.check_spool(gp)
+        records = obs_goodput.read_spool(gp)
+        self.violations += obs_goodput.check_rework_classification(records)
+        agg = obs_goodput.aggregate_spool(records)
+        want = len(self.episodes) + 1
+        if agg["incarnations"] != want:
+            self.violations.append(
+                f"goodput spool records {agg['incarnations']} incarnations, "
+                f"expected {want} (enable() unreached, or the spool was not "
+                f"shared across incarnations)")
+        if agg["torn"] != want_torn:
+            self.violations.append(
+                f"goodput spool has {agg['torn']} torn incarnations, "
+                f"expected {want_torn} (incarnations that exited nonzero)")
+        if any(kind == "sigterm" for kind, _ in self.episodes):
+            if not any(s.get("phases", {}).get("checkpoint_save", 0.0) > 0.0
+                       for s in agg["summaries"]):
+                self.violations.append(
+                    "no summarized incarnation attributed checkpoint_save "
+                    "time despite a SIGTERM checkpoint-and-exit episode")
+        return {
+            "phases": {p: round(s, 6) for p, s in sorted(agg["phases"].items())},
+            "goodput_fraction": agg["goodput_fraction"],
+            "steps": agg["steps"],
+            "rework_steps": agg["rework_steps"],
+            "incarnations": agg["incarnations"],
+            "torn": agg["torn"],
+        }
+
     # -- the soak ----------------------------------------------------------
     def run(self) -> dict:
         ck = os.path.join(self.workdir, "soak-ck")
+        gp = os.path.join(self.workdir, "soak-goodput.jsonl")
         timelines: List[str] = []
+        soak_rcs: List[Optional[int]] = []  # goodput torn accounting
         reference = self.reference_run()
         if len(reference) != self.steps:
             self.violations.append(
@@ -207,7 +253,7 @@ class WorkloadChaosHarness:
             if kind == "hang":
                 extra[sup_lib.ENV_FAULT_HANG_AT] = str(at_step)
             proc = subprocess.Popen(
-                self.train_cmd(ck, tl), cwd=_REPO_ROOT,
+                self.train_cmd(ck, tl, goodput=gp), cwd=_REPO_ROOT,
                 env=cpu_only_env(**extra),
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             )
@@ -215,6 +261,7 @@ class WorkloadChaosHarness:
                 if self._wait_for_step(proc, tl, at_step):
                     proc.send_signal(signal.SIGKILL)
                 rc = self._wait(proc, f"episode {i} ({kind}@{at_step})")
+                soak_rcs.append(rc)
                 if rc == 0 and read_timeline(tl).get(self.steps) is None:
                     self.violations.append(
                         f"episode {i}: sigkill incarnation exited 0 without "
@@ -223,6 +270,7 @@ class WorkloadChaosHarness:
                 if self._wait_for_step(proc, tl, at_step):
                     proc.send_signal(signal.SIGTERM)
                 rc = self._wait(proc, f"episode {i} ({kind}@{at_step})")
+                soak_rcs.append(rc)
                 if rc != 0:
                     self.violations.append(
                         f"episode {i}: SIGTERM incarnation exited {rc}, "
@@ -234,6 +282,7 @@ class WorkloadChaosHarness:
                         f"episode {i}: SIGTERM left no committed checkpoint")
             else:  # hang
                 rc = self._wait(proc, f"episode {i} ({kind}@{at_step})")
+                soak_rcs.append(rc)
                 if rc != sup_lib.EXIT_STALLED:
                     self.violations.append(
                         f"episode {i}: hung incarnation exited {rc}, "
@@ -247,10 +296,12 @@ class WorkloadChaosHarness:
         tl = os.path.join(self.workdir, "incarnation-final.jsonl")
         timelines.append(tl)
         proc = subprocess.Popen(
-            self.train_cmd(ck, tl), cwd=_REPO_ROOT, env=cpu_only_env(),
+            self.train_cmd(ck, tl, goodput=gp), cwd=_REPO_ROOT,
+            env=cpu_only_env(),
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
         rc = self._wait(proc, "final incarnation")
+        soak_rcs.append(rc)
         if rc != 0:
             self.violations.append(f"final incarnation exited {rc}")
 
@@ -276,11 +327,15 @@ class WorkloadChaosHarness:
             self.violations.append(
                 f"steps never executed by any incarnation: {sorted(missing)}")
 
+        goodput_report = self.check_goodput(
+            gp, want_torn=sum(1 for r in soak_rcs if r != 0))
+
         return {
             "seed": self.seed,
             "episodes": [list(e) for e in self.episodes],
             "steps": self.steps,
             "incarnations": len(self.episodes) + 1,
+            "goodput": goodput_report,
             "violations": list(self.violations),
         }
 
@@ -310,9 +365,17 @@ class ElasticWorkloadHarness:
     # step, replayed/skipped data: whole-loss-scale errors) detectable
     LOSS_ATOL = 0.02
 
+    # scheduler-busy vs workload-observed slack per incarnation: interpreter
+    # startup + jax import before goodput.enable(), teardown after close,
+    # and the killed incarnation's open interval all burn busy_guaranteed
+    # seconds the workload never attributes (measured ~2-4 s each on the
+    # 1-core dev box; generous so a loaded box doesn't flake)
+    BRIDGE_SLACK_PER_INCARNATION_S = 20.0
+
     def __init__(self, seed: int, workdir: str, *, steps: int = 8,
                  checkpoint_every: int = 2, step_delay_s: float = 0.25,
-                 grace_secs: float = 30.0, run_timeout_s: float = 240.0):
+                 grace_secs: float = 30.0, run_timeout_s: float = 240.0,
+                 bridge_ledger: bool = False, reference: bool = True):
         self.seed = seed
         rng = random.Random(seed)
         self.workdir = workdir
@@ -321,6 +384,15 @@ class ElasticWorkloadHarness:
         self.step_delay_s = step_delay_s
         self.grace_secs = grace_secs
         self.run_timeout_s = run_timeout_s
+        # bridge_ledger: meter each incarnation's lifetime as a
+        # busy_guaranteed interval on a parent-side CapacityLedger and
+        # reconcile it against the workload's own phase accounting
+        # (goodput.reconcile_busy) — the workload<->capacity bridge.
+        # reference=False skips the uninterrupted reference run and the
+        # loss comparison (the bench's goodput stage only needs the fault
+        # episode + the accounting, not the trajectory pin).
+        self.bridge_ledger = bridge_ledger
+        self.reference = reference
         # the hard kill lands after the first possible commit; the
         # cooperative preemption (grow offer) lands strictly later so the
         # degraded incarnation does real work first
@@ -328,8 +400,9 @@ class ElasticWorkloadHarness:
         self.preempt_step = rng.randint(self.kill_step + 1, steps - 2)
         self.violations: List[str] = []
 
-    def train_cmd(self, ckpt_dir: str, timeline: str) -> List[str]:
-        return [
+    def train_cmd(self, ckpt_dir: str, timeline: str,
+                  goodput: str = "") -> List[str]:
+        cmd = [
             sys.executable, "-m", "hivedscheduler_tpu.train",
             "--steps", str(self.steps),
             "--batch", "2", "--seq-len", "16", "--vocab-size", "64",
@@ -341,13 +414,16 @@ class ElasticWorkloadHarness:
             "--timeline", timeline,
             "--grace-secs", str(self.grace_secs),
         ]
+        if goodput:
+            cmd += ["--goodput-file", goodput]
+        return cmd
 
     def _spawn(self, ckpt: str, timeline: str, devices: int,
-               paced: bool) -> subprocess.Popen:
+               paced: bool, goodput: str = "") -> subprocess.Popen:
         extra = ({sup_lib.ENV_FAULT_STEP_DELAY: str(self.step_delay_s)}
                  if paced else {})
         return subprocess.Popen(
-            self.train_cmd(ckpt, timeline), cwd=_REPO_ROOT,
+            self.train_cmd(ckpt, timeline, goodput=goodput), cwd=_REPO_ROOT,
             env=cpu_only_env(devices=devices, **extra),
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
@@ -378,28 +454,53 @@ class ElasticWorkloadHarness:
 
         return ckpt_lib.read_metadata(ckpt).get("mesh")
 
+    def _bridge_begin(self, ledger) -> None:
+        if ledger is not None:
+            ledger.transition("workload-host", [0], "busy_guaranteed",
+                              gang="elastic-train")
+
+    def _bridge_end(self, ledger) -> None:
+        if ledger is not None:
+            ledger.release("workload-host", [0])
+
     def run(self) -> dict:
         ck = os.path.join(self.workdir, "elastic-ck")
+        gp = os.path.join(self.workdir, "elastic-goodput.jsonl")
         timelines: List[str] = []
 
-        # uninterrupted full-slice reference (own checkpoint dir)
-        ref_tl = os.path.join(self.workdir, "elastic-ref.jsonl")
-        proc = self._spawn(os.path.join(self.workdir, "elastic-ref-ck"),
-                           ref_tl, self.FULL_DEVICES, paced=False)
-        if self._wait(proc, "reference") != 0:
-            self.violations.append("reference run failed")
-        reference = read_timeline(ref_tl)
-        if len(reference) != self.steps:
-            self.violations.append(
-                f"reference covered {len(reference)}/{self.steps} steps")
+        ledger = None
+        if self.bridge_ledger:
+            # the scheduler side of the bridge: a private 1-chip ledger
+            # metering each incarnation's spawn->exit span as the gang's
+            # busy_guaranteed interval (what the cluster would bill)
+            from hivedscheduler_tpu.obs import ledger as ledger_lib
+
+            ledger = ledger_lib.CapacityLedger(metrics=False)
+            ledger.enabled = True
+            ledger.register_node("workload-host", 1)
+
+        reference: Dict[int, float] = {}
+        if self.reference:
+            # uninterrupted full-slice reference (own checkpoint dir)
+            ref_tl = os.path.join(self.workdir, "elastic-ref.jsonl")
+            proc = self._spawn(os.path.join(self.workdir, "elastic-ref-ck"),
+                               ref_tl, self.FULL_DEVICES, paced=False)
+            if self._wait(proc, "reference") != 0:
+                self.violations.append("reference run failed")
+            reference = read_timeline(ref_tl)
+            if len(reference) != self.steps:
+                self.violations.append(
+                    f"reference covered {len(reference)}/{self.steps} steps")
 
         # 1. full slice, kill -9 mid-step
         tl = os.path.join(self.workdir, "elastic-full.jsonl")
         timelines.append(tl)
-        proc = self._spawn(ck, tl, self.FULL_DEVICES, paced=True)
+        self._bridge_begin(ledger)
+        proc = self._spawn(ck, tl, self.FULL_DEVICES, paced=True, goodput=gp)
         if self._wait_for_step(proc, tl, self.kill_step):
             proc.send_signal(signal.SIGKILL)
         self._wait(proc, f"full incarnation (sigkill@{self.kill_step})")
+        self._bridge_end(ledger)
         mesh = self._checkpoint_mesh(ck)
         if mesh is None:
             self.violations.append("full incarnation left no committed "
@@ -413,7 +514,9 @@ class ElasticWorkloadHarness:
         #    evicting it (checkpoint-and-exit-0)
         tl = os.path.join(self.workdir, "elastic-shrunk.jsonl")
         timelines.append(tl)
-        proc = self._spawn(ck, tl, self.SHRUNK_DEVICES, paced=True)
+        self._bridge_begin(ledger)
+        proc = self._spawn(ck, tl, self.SHRUNK_DEVICES, paced=True,
+                           goodput=gp)
         if self._wait_for_step(proc, tl, self.preempt_step):
             proc.send_signal(signal.SIGTERM)
         rc = self._wait(proc, f"shrunk incarnation (sigterm@{self.preempt_step})")
@@ -421,6 +524,7 @@ class ElasticWorkloadHarness:
             self.violations.append(
                 f"shrunk incarnation exited {rc}, expected a clean "
                 f"checkpoint-and-exit (0)")
+        self._bridge_end(ledger)
         mesh = self._checkpoint_mesh(ck)
         if mesh is not None and mesh.get("dp") != self.SHRUNK_DEVICES:
             self.violations.append(
@@ -431,10 +535,12 @@ class ElasticWorkloadHarness:
         # 3. grow promote: back to the full slice, run to completion
         tl = os.path.join(self.workdir, "elastic-grown.jsonl")
         timelines.append(tl)
-        proc = self._spawn(ck, tl, self.FULL_DEVICES, paced=False)
+        self._bridge_begin(ledger)
+        proc = self._spawn(ck, tl, self.FULL_DEVICES, paced=False, goodput=gp)
         rc = self._wait(proc, "grown incarnation")
         if rc != 0:
             self.violations.append(f"grown incarnation exited {rc}")
+        self._bridge_end(ledger)
 
         # the merged trajectory stays allclose to the uninterrupted
         # reference: a resume that replayed/skipped data or restored the
@@ -443,6 +549,8 @@ class ElasticWorkloadHarness:
         for t in timelines:
             for step, loss in read_timeline(t).items():
                 covered.add(step)
+                if not self.reference:
+                    continue
                 ref = reference.get(step)
                 if ref is None:
                     self.violations.append(
@@ -458,6 +566,11 @@ class ElasticWorkloadHarness:
             self.violations.append(
                 f"steps never executed by any incarnation: {sorted(missing)}")
 
+        busy_s = None
+        if ledger is not None:
+            busy_s = sum(ledger.gang_seconds("elastic-train").values())
+        goodput_report = self.check_goodput(gp, busy_s)
+
         return {
             "seed": self.seed,
             "kind": "elastic",
@@ -465,5 +578,62 @@ class ElasticWorkloadHarness:
             "preempt_step": self.preempt_step,
             "steps": self.steps,
             "incarnations": 3,
+            "goodput": goodput_report,
             "violations": list(self.violations),
         }
+
+    def check_goodput(self, gp: str, busy_s: Optional[float]) -> dict:
+        """Post-episode goodput audit: conservation (``check_spool``), the
+        rework replay, torn/incarnation bookkeeping (exactly the sigkilled
+        full-slice incarnation is torn), rework and ``checkpoint_save``
+        non-vacuity, and — when the bridge ledger ran — the
+        workload<->capacity reconciliation (``reconcile_busy``)."""
+        from hivedscheduler_tpu.obs import goodput as obs_goodput
+
+        self.violations += obs_goodput.check_spool(gp)
+        records = obs_goodput.read_spool(gp)
+        self.violations += obs_goodput.check_rework_classification(records)
+        agg = obs_goodput.aggregate_spool(records)
+        if agg["incarnations"] != 3:
+            self.violations.append(
+                f"goodput spool records {agg['incarnations']} incarnations, "
+                f"expected 3 (kill -> shrink -> grow)")
+        if agg["torn"] != 1:
+            self.violations.append(
+                f"goodput spool has {agg['torn']} torn incarnations, "
+                f"expected exactly the sigkilled full-slice one")
+        if self.kill_step % self.checkpoint_every != 0 \
+                and agg["rework_steps"] == 0:
+            # a kill between commits forces the shrink resume to re-train
+            # from the last committed step; zero rework here means the
+            # classification (or the cross-incarnation seed replay) broke
+            self.violations.append(
+                f"kill@{self.kill_step} landed between commits "
+                f"(checkpoint_every={self.checkpoint_every}) yet the spool "
+                f"attributes 0 rework steps")
+        if not any(s.get("phases", {}).get("checkpoint_save", 0.0) > 0.0
+                   for s in agg["summaries"]):
+            self.violations.append(
+                "no summarized incarnation attributed checkpoint_save time "
+                "despite the SIGTERM grow offer's checkpoint-and-exit")
+        report = {
+            "phases": {p: round(s, 6) for p, s in sorted(agg["phases"].items())},
+            "goodput_fraction": agg["goodput_fraction"],
+            "steps": agg["steps"],
+            "rework_steps": agg["rework_steps"],
+            "incarnations": agg["incarnations"],
+            "torn": agg["torn"],
+        }
+        if busy_s is not None:
+            slack = 3 * self.BRIDGE_SLACK_PER_INCARNATION_S
+            violation = obs_goodput.reconcile_busy(
+                busy_s, agg["observed_s"], slack_s=slack)
+            if violation:
+                self.violations.append(violation)
+            report["bridge"] = {
+                "busy_guaranteed_s": round(busy_s, 6),
+                "observed_s": round(agg["observed_s"], 6),
+                "uncovered_s": round(busy_s - agg["observed_s"], 6),
+                "slack_s": slack,
+            }
+        return report

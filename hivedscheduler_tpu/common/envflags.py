@@ -163,6 +163,13 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
        "anywhere; unset = on in the CLI and the bench, off for library "
        "users (programmatic `ledger.enable()`).",
        "hivedscheduler_tpu/obs/ledger.py"),
+    _f("HIVED_GOODPUT", "0",
+       "`1` enables the workload goodput ledger (obs/goodput.py) at "
+       "import time (programmatic `goodput.enable()` / the workload "
+       "CLIs' `--goodput-file` otherwise); exports "
+       "`tpu_hive_goodput_seconds_total{phase=}` and the `workload "
+       "goodput` Perfetto phase lane.",
+       "hivedscheduler_tpu/obs/goodput.py"),
     _f("HIVED_ETA_DEFAULT_RUN_S", "300",
        "Wait-ETA estimator (obs/eta.py): expected gang run time used "
        "before any completed-gang duration has been observed (the "

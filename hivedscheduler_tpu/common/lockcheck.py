@@ -79,6 +79,9 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # algorithm chokepoints (under scheduler+algorithm locks) and by
     # webserver reads.
     "ledger_lock": 77,
+    # obs/goodput.py — workload step-phase books. A pure leaf like the
+    # ledger: phase transitions observe the goodput counter under it.
+    "goodput_lock": 76,
     "journal_lock": 78,
     # obs/slo.py — SLO tracker observations/quantiles. Acquired under the
     # fleet router lock (harvest observes TTFTs) and by webserver reads.
@@ -101,6 +104,7 @@ LOCK_SITES: Dict[str, str] = {
     "fleet_router_lock": "hivedscheduler_tpu/fleet/router.py",
     "event_queue_lock": "hivedscheduler_tpu/runtime/eventbatch.py",
     "ledger_lock": "hivedscheduler_tpu/obs/ledger.py",
+    "goodput_lock": "hivedscheduler_tpu/obs/goodput.py",
     "journal_lock": "hivedscheduler_tpu/obs/journal.py",
     "slo_lock": "hivedscheduler_tpu/obs/slo.py",
     "metrics_lock": "hivedscheduler_tpu/runtime/metrics.py",

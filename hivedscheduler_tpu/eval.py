@@ -61,10 +61,18 @@ def main(argv=None) -> int:
     parser.add_argument("--checkpoint-dir", default="",
                         help="checkpoint to evaluate (random init when "
                              "omitted — smoke only)")
+    parser.add_argument("--goodput-file", default="",
+                        help="enable the workload goodput ledger "
+                        "(obs/goodput.py) and append this run's step-phase "
+                        "records to this JSONL spool")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
     common.init_all(logging.DEBUG if args.verbose else logging.INFO)
+    from hivedscheduler_tpu.obs import goodput as obs_goodput
+
+    if args.goodput_file:
+        obs_goodput.enable(spool_path=args.goodput_file)
 
     from hivedscheduler_tpu.parallel.distributed import initialize_from_gang
 
@@ -130,6 +138,7 @@ def main(argv=None) -> int:
     rows = args.batch // n_proc
 
     t0 = time.perf_counter()
+    obs_goodput.phase("eval")
     # accumulate on device; one host sync at the end (float() per window
     # would serialize batch prep with device compute)
     total_loss = None
@@ -148,6 +157,7 @@ def main(argv=None) -> int:
     # every window contributes batch*(seq-1) scored positions, so the mean
     # of per-window means IS the corpus token-level mean over scored targets
     loss = float(total_loss) / n_steps
+    obs_goodput.phase("idle")
     ppl = math.exp(min(loss, 30.0))
     log.info(
         "%s windows (%s tokens) in %.2fs (%.0f tok/s)",

@@ -93,10 +93,18 @@ def main(argv=None) -> int:
                         help="draft width (default: half the target)")
     parser.add_argument("--gamma", type=int, default=4,
                         help="draft tokens proposed per verification round")
+    parser.add_argument("--goodput-file", default="",
+                        help="enable the workload goodput ledger "
+                        "(obs/goodput.py) and append this run's step-phase "
+                        "records to this JSONL spool")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
     common.init_all(logging.DEBUG if args.verbose else logging.INFO)
+    from hivedscheduler_tpu.obs import goodput as obs_goodput
+
+    if args.goodput_file:
+        obs_goodput.enable(spool_path=args.goodput_file)
     import jax
     import jax.numpy as jnp
 
@@ -156,6 +164,10 @@ def main(argv=None) -> int:
         log.error("--top-k %s exceeds --vocab-size %s", args.top_k, cfg.vocab_size)
         return 1
     key = jax.random.PRNGKey(args.seed + 2) if args.temperature > 0 else None
+    # single-shot decode: compile + decode run inside one jitted call, so
+    # the whole generation is attributed to step_compute (the goodput doc
+    # notes the folding; train.py's per-step loop separates compile)
+    obs_goodput.phase("step_compute")
     if args.draft_layers > 0:
         if args.gamma < 1:
             log.error("--gamma must be >= 1, got %s", args.gamma)
@@ -203,7 +215,9 @@ def main(argv=None) -> int:
             int(stats.rounds), int(stats.accepted), int(stats.drafted),
             100.0 * int(stats.accepted) / max(1, int(stats.drafted)),
         )
-        for row in jax.device_get(out):
+        rows = jax.device_get(out)  # the host sync: decode ends here
+        obs_goodput.phase("idle")
+        for row in rows:
             print(" ".join(str(int(t)) for t in row))
         return 0
     if args.tp > 1 or args.dp > 1:
@@ -228,7 +242,9 @@ def main(argv=None) -> int:
             temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
             key=key, decode_steps=args.decode_steps,
         )
-    for row in jax.device_get(out):
+    rows = jax.device_get(out)  # the host sync: decode ends here
+    obs_goodput.phase("idle")
+    for row in rows:
         print(" ".join(str(int(t)) for t in row))
     return 0
 

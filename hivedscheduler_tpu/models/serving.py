@@ -84,6 +84,7 @@ from hivedscheduler_tpu.models.transformer import (
     _rms_norm,
     load_weight,
 )
+from hivedscheduler_tpu.obs import goodput as obs_goodput
 from hivedscheduler_tpu.obs import journal as obs_journal
 from hivedscheduler_tpu.obs import trace as obs_trace
 from hivedscheduler_tpu.ops.attention import NEG_INF, block_coords, gather_block_kv
@@ -1924,32 +1925,38 @@ class ServingEngine:
         guarantee a preempting scheduler needs (SIGTERM must not wait on an
         unbounded decode tail)."""
         self.begin_drain()
-        t0 = self._clock()
-        steps = 0
-        while self.step():
-            steps += 1
-            expired = (deadline_s is not None
-                       and self._clock() - t0 > deadline_s)
-            if expired or steps >= max_steps:
-                now = self._clock()
-                leftovers = list(self.queue) + [
-                    r for r in self.slots if r is not None
-                ]
-                for req in leftovers:
-                    req.done = True
-                    req.done_at = now
-                    req.finish_reason = "preempted"
-                    if req.flight_local and obs_journal.JOURNAL.enabled:
-                        obs_journal.note_request_done(
-                            req.flight, "preempted",
-                            first_token_at=req.first_token_at, at=now)
-                self.queue.clear()
-                for slot in range(self.max_batch):
-                    if self.slots[slot] is not None:
-                        self._retire(slot)  # paged: return the blocks
-                self._prefilling.clear()
-                return False
-        return True
+        # goodput: finishing admitted work while refusing new is its own
+        # badput phase (the elastic preemption handshake's workload cost)
+        obs_goodput.phase("drain")
+        try:
+            t0 = self._clock()
+            steps = 0
+            while self.step():
+                steps += 1
+                expired = (deadline_s is not None
+                           and self._clock() - t0 > deadline_s)
+                if expired or steps >= max_steps:
+                    now = self._clock()
+                    leftovers = list(self.queue) + [
+                        r for r in self.slots if r is not None
+                    ]
+                    for req in leftovers:
+                        req.done = True
+                        req.done_at = now
+                        req.finish_reason = "preempted"
+                        if req.flight_local and obs_journal.JOURNAL.enabled:
+                            obs_journal.note_request_done(
+                                req.flight, "preempted",
+                                first_token_at=req.first_token_at, at=now)
+                    self.queue.clear()
+                    for slot in range(self.max_batch):
+                        if self.slots[slot] is not None:
+                            self._retire(slot)  # paged: return the blocks
+                    self._prefilling.clear()
+                    return False
+            return True
+        finally:
+            obs_goodput.phase("idle")
 
     @property
     def occupancy(self) -> float:

@@ -257,8 +257,11 @@ def to_chrome_trace() -> Dict[str, Any]:
     journal is enabled, its per-gang tracks (one named lane per gang:
     lifecycle instants + wait-interval spans) are merged in — every
     exporter (webserver, --trace-file, --metrics-dump) gets them free.
-    The capacity ledger's per-node ``state:`` lanes merge the same way."""
+    The capacity ledger's per-node ``state:`` lanes and the workload
+    goodput ledger's ``workload goodput`` phase lane merge the same
+    way."""
     out = TRACER.to_chrome_trace()
+    from hivedscheduler_tpu.obs import goodput as _goodput
     from hivedscheduler_tpu.obs import journal as _journal
     from hivedscheduler_tpu.obs import ledger as _ledger
 
@@ -271,6 +274,11 @@ def to_chrome_trace() -> Dict[str, Any]:
         out["traceEvents"] = (
             list(out["traceEvents"])
             + _ledger.LEDGER.chrome_events(TRACER._t0)
+        )
+    if _goodput.GOODPUT.enabled:
+        out["traceEvents"] = (
+            list(out["traceEvents"])
+            + _goodput.GOODPUT.chrome_events(TRACER._t0)
         )
     return out
 

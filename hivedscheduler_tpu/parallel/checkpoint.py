@@ -184,19 +184,22 @@ def save(directory: str, step: int, params: Any, opt_state: Any,
     :func:`read_metadata`."""
     import orbax.checkpoint as ocp
 
-    mgr = _manager(directory, create=True)
-    mgr.save(step, args=ocp.args.Composite(
-        params=ocp.args.StandardSave(params),
-        opt_state=ocp.args.StandardSave(opt_state),
-    ))
-    mgr.wait_until_finished()
-    mgr.close()
-    marker = {"step": step, "format": "orbax-composite-v1"}
-    if extra:
-        marker["extra"] = extra
-    atomic_write_bytes(
-        _marker_path(directory, step), json.dumps(marker).encode(),
-    )
+    from hivedscheduler_tpu.obs import goodput as _goodput
+
+    with _goodput.span("checkpoint_save"):
+        mgr = _manager(directory, create=True)
+        mgr.save(step, args=ocp.args.Composite(
+            params=ocp.args.StandardSave(params),
+            opt_state=ocp.args.StandardSave(opt_state),
+        ))
+        mgr.wait_until_finished()
+        mgr.close()
+        marker = {"step": step, "format": "orbax-composite-v1"}
+        if extra:
+            marker["extra"] = extra
+        atomic_write_bytes(
+            _marker_path(directory, step), json.dumps(marker).encode(),
+        )
 
 
 def read_metadata(directory: str, step: Optional[int] = None) -> dict:
@@ -283,23 +286,26 @@ def restore_params(
     matches the pretraining checkpoint's)."""
     import orbax.checkpoint as ocp
 
+    from hivedscheduler_tpu.obs import goodput as _goodput
+
     def as_abstract(tree):
         return jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
             tree,
         )
 
-    step, restored = _restore_ladder(directory, step, lambda mgr, s: mgr.restore(
-        s, args=ocp.args.Composite(
-            params=ocp.args.StandardRestore(as_abstract(params_template)),
-        )))
-    params = jax.tree.map(
-        lambda x, t: (
-            jax.device_put(x, t.sharding) if getattr(t, "sharding", None) is not None else x
-        ),
-        restored["params"],
-        params_template,
-    )
+    with _goodput.span("checkpoint_restore"):
+        step, restored = _restore_ladder(directory, step, lambda mgr, s: mgr.restore(
+            s, args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(as_abstract(params_template)),
+            )))
+        params = jax.tree.map(
+            lambda x, t: (
+                jax.device_put(x, t.sharding) if getattr(t, "sharding", None) is not None else x
+            ),
+            restored["params"],
+            params_template,
+        )
     return step, params
 
 
@@ -350,17 +356,20 @@ def restore(
     those shards."""
     import orbax.checkpoint as ocp
 
+    from hivedscheduler_tpu.obs import goodput as _goodput
+
     def as_abstract(tree):
         return jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
             tree,
         )
 
-    step, restored = _restore_ladder(directory, step, lambda mgr, s: mgr.restore(
-        s, args=ocp.args.Composite(
-            params=ocp.args.StandardRestore(as_abstract(params_template)),
-            opt_state=ocp.args.StandardRestore(as_abstract(opt_state_template)),
-        )))
+    with _goodput.span("checkpoint_restore"):
+        step, restored = _restore_ladder(directory, step, lambda mgr, s: mgr.restore(
+            s, args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(as_abstract(params_template)),
+                opt_state=ocp.args.StandardRestore(as_abstract(opt_state_template)),
+            )))
 
     # guarantee every leaf lands exactly on its template's sharding (orbax can
     # fall back to single-device placement for leaves without sharding info)

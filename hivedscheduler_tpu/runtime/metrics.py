@@ -316,3 +316,13 @@ REGISTRY.describe("tpu_hive_chip_seconds_total",
 REGISTRY.describe("tpu_hive_chip_state_chips",
                   "Chips currently in each ledger state (occupancy "
                   "gauge; sums to the registered chip count)")
+# workload goodput ledger (obs/goodput.py): step-phase badput
+# attribution — the process is in exactly one STEP_PHASES phase and the
+# per-phase seconds sum to the process wallclock (check_goodput)
+REGISTRY.describe("tpu_hive_goodput_seconds_total",
+                  "Closed workload step-phase intervals by phase (phase "
+                  "label: obs/goodput.py STEP_PHASES — init, compile, "
+                  "step_compute, data_wait, checkpoint_save, "
+                  "checkpoint_restore, rework, eval, drain, idle; the "
+                  "phases sum to the process wallclock, the conservation "
+                  "invariant; step_compute alone is goodput)")

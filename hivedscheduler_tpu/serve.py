@@ -257,6 +257,11 @@ def main(argv=None) -> int:
                         "per-request flight legs (REQUEST_LEGS) — to this "
                         "JSONL spool (one line per event, flushed per "
                         "append)")
+    parser.add_argument("--goodput-file", default="",
+                        help="enable the workload goodput ledger "
+                        "(obs/goodput.py) and append this run's step-phase "
+                        "records — engine steps, the drain handshake — to "
+                        "this JSONL spool")
     parser.add_argument("--slo-ttft-p99", type=float, default=0.0,
                         help="declare a p99 TTFT objective (seconds): the "
                         "SLO tracker (obs/slo.py) then reports windowed "
@@ -364,6 +369,10 @@ def main(argv=None) -> int:
         from hivedscheduler_tpu.obs import journal as obs_journal
 
         obs_journal.enable(spool_path=args.journal_file)
+    from hivedscheduler_tpu.obs import goodput as obs_goodput
+
+    if args.goodput_file:
+        obs_goodput.enable(spool_path=args.goodput_file)
     import jax
     import jax.numpy as jnp
 
@@ -562,8 +571,10 @@ def main(argv=None) -> int:
                                        priority=prio_of(len(reqs))))
                 log.info("admitted request %s (prompt %s, budget %s, prio %s)",
                          reqs[-1].rid, len(prompt), budget, reqs[-1].priority)
+            obs_goodput.phase("step_compute")
             eng.step()
             steps += 1
+        obs_goodput.phase("idle")
         if listener.requested:
             # drain: admission off first (503 + Retry-After analogue for the
             # not-yet-submitted synthetic arrivals), then finish in-flight

@@ -125,6 +125,12 @@ def main(argv=None) -> int:
                         help="enable the gang-lifecycle journal "
                         "(obs/journal.py) and append this incarnation's "
                         "resume/rollback events to this JSONL spool")
+    parser.add_argument("--goodput-file", default="",
+                        help="enable the workload goodput ledger "
+                        "(obs/goodput.py) and append this incarnation's "
+                        "step-phase records to this JSONL spool; sharing "
+                        "one spool across a gang's incarnations makes "
+                        "rework classification exact across kills")
     parser.add_argument("--timeline", default="",
                         help="write a per-step JSONL timeline (step, wall_s, "
                         "tokens_per_sec, loss, compile flag) to this path — "
@@ -200,6 +206,12 @@ def main(argv=None) -> int:
         from hivedscheduler_tpu.obs import journal as obs_journal
 
         obs_journal.enable(spool_path=args.journal_file)
+    # goodput ledger: anchors the process wallclock here (phase `init`),
+    # BEFORE the jax import — bring-up is attributed, not leaked
+    from hivedscheduler_tpu.obs import goodput as obs_goodput
+
+    if args.goodput_file:
+        obs_goodput.enable(spool_path=args.goodput_file)
 
     # 1. multi-host wiring from the scheduler's gang handoff (no-op when
     #    single-host / not scheduled)
@@ -487,12 +499,16 @@ def main(argv=None) -> int:
                     profiling = False
                     log.info("profiler trace written to %s", args.profile_dir)
             step_t0 = time.perf_counter()
+            obs_goodput.phase("data_wait")
             try:
                 local_batch, snap = next(batches)
             except StopIteration:
                 # the preemption event woke a consumer blocked on data
                 preempted = True
                 break
+            # compile / rework / step_compute, decided against the step
+            # high-water mark (rework = re-doing steps a kill threw away)
+            obs_goodput.note_step(step + 1, is_compile=step == start_step)
             tokens = data_lib.device_put_global(
                 local_batch, token_sharding, args.batch
             )
@@ -509,6 +525,7 @@ def main(argv=None) -> int:
             # checkpoint can commit it (small dispatch-overlap cost, same
             # trade --timeline already makes)
             loss_f = float(loss)
+            obs_goodput.note_step_done(step + 1)
             loader_snap = snap
             if timeline is not None:
                 wall = time.perf_counter() - step_t0
@@ -579,6 +596,7 @@ def main(argv=None) -> int:
         if timeline is not None:
             timeline.close()
             log.info("step timeline written to %s", args.timeline)
+        obs_goodput.phase("idle")  # loop done; final save spans itself
         if diverged is not None:
             log.error(
                 "divergence: %s — halting with the last committed "

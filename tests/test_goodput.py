@@ -1,0 +1,285 @@
+"""Workload goodput ledger (ISSUE 16, doc/design/observability.md): the
+step-phase taxonomy is a registry (OBS003), Σ phase-seconds == process
+wallclock is the conservation invariant, rework classification replays
+exactly across incarnations through the shared spool, and the
+scheduler-side busy interval must cover the workload-observed seconds
+(the capacity-ledger bridge). All fake-clock — no jax, no subprocesses
+(the subprocess form rides the slow chaos soaks)."""
+
+import json
+import os
+
+import pytest
+
+from hivedscheduler_tpu.obs import goodput
+
+
+def _ledger():
+    led = goodput.GoodputLedger(metrics=False)
+    led.enabled = True
+    return led
+
+
+# ---------------------------------------------------------------------------
+# registry + conservation
+# ---------------------------------------------------------------------------
+
+def test_unregistered_phase_raises_obs003():
+    led = _ledger()
+    led.start(at=0.0)
+    with pytest.raises(ValueError, match="not a registered step phase"):
+        led.phase("made_up_phase", at=1.0)
+
+
+def test_conservation_exact_under_fake_clock():
+    led = _ledger()
+    led.start(at=0.0)                      # init
+    led.phase("compile", at=1.5)
+    led.phase("step_compute", at=4.0)
+    led.phase("data_wait", at=7.0)
+    led.phase("step_compute", at=7.25)
+    totals = led.totals(at=10.0)
+    assert totals == {"init": 1.5, "compile": 2.5, "step_compute": 5.75,
+                      "data_wait": 0.25}
+    assert led.wallclock(at=10.0) == 10.0
+    assert led.conservation_gap(at=10.0) == 0.0
+    assert led.goodput_fraction(at=10.0) == 5.75 / 10.0
+
+
+def test_same_phase_is_noop_and_exactly_one_open():
+    led = _ledger()
+    led.start(at=0.0)
+    led.phase("step_compute", at=1.0)
+    led.phase("step_compute", at=2.0)      # no-op: interval continues
+    assert led.current_phase() == "step_compute"
+    assert led.totals(at=3.0) == {"init": 1.0, "step_compute": 2.0}
+
+
+def test_close_freezes_wallclock_and_is_idempotent():
+    led = _ledger()
+    led.start(at=0.0)
+    led.phase("step_compute", at=1.0)
+    led.close(at=5.0)
+    led.close(at=99.0)                     # idempotent
+    assert led.wallclock(at=50.0) == 5.0   # frozen at close
+    assert led.conservation_gap(at=50.0) == 0.0
+    assert led.current_phase() is None
+
+
+def test_span_restores_surrounding_phase():
+    led = _ledger()
+    led.start(at=0.0)
+    led.phase("step_compute", at=1.0)
+    with led.span("checkpoint_save", at=2.0):
+        assert led.current_phase() == "checkpoint_save"
+    # the span exit restores the surrounding phase at the REAL clock (the
+    # runtime contract), so assert conservation at real-now, not fake time
+    assert led.current_phase() == "step_compute"
+    assert led.totals()["checkpoint_save"] > 0.0
+    assert abs(led.conservation_gap()) < 1e-6
+
+
+def test_disabled_is_inert():
+    led = goodput.GoodputLedger(metrics=False)  # enabled=False
+    led.start(at=0.0)
+    led.phase("step_compute", at=1.0)
+    led.note_step(1, at=2.0)
+    assert led.totals(at=3.0) == {}
+    assert led.wallclock(at=3.0) == 0.0
+    assert led.goodput_fraction(at=3.0) is None
+    # span on a disabled ledger is the shared no-op context manager
+    with led.span("drain", at=1.0):
+        pass
+    assert led.current_phase() is None
+
+
+def test_snapshot_keys_cover_registry():
+    led = _ledger()
+    led.start(at=0.0)
+    led.note_step(1, is_compile=True, at=1.0)
+    led.note_step_done(1, at=2.0)
+    snap = led.snapshot(at=3.0)
+    assert set(snap["phases"]) == set(goodput.STEP_PHASES)
+    assert snap["conservationGapS"] == 0.0
+    assert snap["steps"] == 1 and snap["maxStep"] == 1
+    assert snap["enabled"] is True
+
+
+# ---------------------------------------------------------------------------
+# rework classification + the cross-incarnation spool
+# ---------------------------------------------------------------------------
+
+def test_note_step_classifies_rework_over_compile():
+    # a resumed incarnation's first step is both its compile step and a
+    # re-trained step: ALL of it is fault-caused badput, so rework wins
+    led = _ledger()
+    led.seed_max_step(3)
+    led.start(at=0.0)
+    led.note_step(3, is_compile=True, at=1.0)
+    assert led.current_phase() == "rework"
+    led.note_step_done(3, at=2.0)
+    led.note_step(4, at=2.0)
+    assert led.current_phase() == "step_compute"
+    led.note_step_done(4, at=3.0)
+    snap = led.snapshot(at=3.0)
+    assert snap["reworkSteps"] == 1 and snap["maxStep"] == 4
+
+
+def test_spool_round_trip_and_cross_incarnation_replay(tmp_path):
+    sp = str(tmp_path / "goodput.jsonl")
+    led = _ledger()
+    led.open_spool(sp)
+    led.start(at=0.0)
+    led.note_step(1, is_compile=True, at=1.0)
+    led.note_step_done(1, at=2.0)
+    led.note_step(2, at=2.0)
+    led.note_step_done(2, at=3.0)
+    led.close(at=4.0)
+
+    # incarnation 2 resumes from the step-1 checkpoint: step 2 is rework
+    led2 = _ledger()
+    led2.seed_max_step(goodput.spool_max_step(sp))
+    led2.open_spool(sp)
+    led2.start(at=10.0)
+    led2.note_step(2, is_compile=True, at=11.0)
+    assert led2.current_phase() == "rework"
+    led2.note_step_done(2, at=12.0)
+    led2.note_step(3, at=12.0)
+    led2.note_step_done(3, at=13.0)
+    led2.close(at=14.0)
+
+    records = goodput.read_spool(sp)
+    assert goodput.check_spool(sp) == []
+    assert goodput.check_rework_classification(records) == []
+    agg = goodput.aggregate_spool(records)
+    assert agg["incarnations"] == 2 and agg["torn"] == 0
+    assert agg["steps"] == 4 and agg["rework_steps"] == 1
+    assert agg["summarized_wallclock_s"] == 8.0
+
+
+def test_torn_incarnation_counted_and_steps_still_attributed(tmp_path):
+    sp = str(tmp_path / "goodput.jsonl")
+    led = _ledger()
+    led.open_spool(sp)
+    led.start(at=0.0)
+    led.note_step(1, at=1.0)
+    led.note_step_done(1, at=2.0)
+    # no close(): the kill -9 shape — start + step records, no summary
+    agg = goodput.aggregate_spool(goodput.read_spool(sp))
+    assert agg["torn"] == 1 and agg["incarnations"] == 1
+    assert agg["steps"] == 1
+
+
+def test_check_rework_classification_flags_drift():
+    recs = [
+        {"kind": "step", "pid": 1, "step": 1, "rework": False},
+        {"kind": "step", "pid": 1, "step": 2, "rework": False},
+        {"kind": "step", "pid": 2, "step": 2, "rework": False},  # drifted
+    ]
+    got = goodput.check_rework_classification(recs)
+    assert len(got) == 1 and "misclassified" in got[0]
+
+
+def test_check_spool_flags_conservation_and_registry(tmp_path):
+    sp = str(tmp_path / "bad.jsonl")
+    with open(sp, "w") as f:
+        f.write(json.dumps({"kind": "start", "pid": 1, "t0": 0.0,
+                            "phase": "init"}) + "\n")
+        f.write(json.dumps({"kind": "phase", "pid": 1,
+                            "phase": "rogue_phase",
+                            "start": 0.0, "end": 1.0}) + "\n")
+        f.write(json.dumps({"kind": "summary", "pid": 1,
+                            "wallclock_s": 5.0,
+                            "phases": {"init": 1.0},  # gap: 1.0 != 5.0
+                            "steps": 0, "rework_steps": 0,
+                            "max_step": 0}) + "\n")
+        f.write("{torn trailing li")  # tolerated, never a violation
+    got = goodput.check_spool(sp)
+    assert any("rogue_phase" in v for v in got)
+    assert any("wallclock" in v for v in got)
+
+
+def test_dead_spool_does_not_fail_emit(tmp_path):
+    led = _ledger()
+    led.open_spool(str(tmp_path / "sp.jsonl"))
+    led._spool.close()  # yank the file out from under the ledger
+    led.start(at=0.0)   # must not raise; spool degrades to None
+    led.note_step(1, at=1.0)
+    assert led._spool is None
+
+
+# ---------------------------------------------------------------------------
+# the capacity-ledger bridge
+# ---------------------------------------------------------------------------
+
+def test_reconcile_busy_contract():
+    assert goodput.reconcile_busy(10.0, 9.0, slack_s=5.0) is None
+    # workload observed MORE than the scheduler billed: accounting bug
+    neg = goodput.reconcile_busy(7.0, 8.0, slack_s=5.0)
+    assert neg is not None and "covered" in neg
+    # busy exceeds observed beyond slack: unattributed busy time
+    over = goodput.reconcile_busy(20.0, 8.0, slack_s=5.0)
+    assert over is not None and "slack" in over
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def test_chrome_events_single_named_lane():
+    led = _ledger()
+    led.start(at=0.0)
+    led.phase("compile", at=1.0)
+    led.phase("step_compute", at=2.0)
+    events = led.chrome_events(t0=0.0)
+    meta = [e for e in events if e["ph"] == "M"]
+    spans = [e for e in events if e["ph"] == "X"]
+    assert len(meta) == 1
+    assert meta[0]["args"]["name"] == "workload goodput"
+    assert {e["tid"] for e in events} == {goodput._LANE_TID}
+    names = [e["name"] for e in spans]
+    assert names[:2] == ["phase:init", "phase:compile"]
+    assert all(e["cat"] == "goodput" for e in spans)
+
+
+def test_trace_merge_carries_goodput_lane():
+    from hivedscheduler_tpu.obs import trace
+
+    goodput.GOODPUT.clear()
+    goodput.GOODPUT.enabled = True
+    try:
+        goodput.GOODPUT.start()
+        goodput.GOODPUT.phase("step_compute")
+        out = trace.to_chrome_trace()
+        names = {e.get("name") for e in out["traceEvents"]}
+        assert any(str(n).startswith("phase:") for n in names)
+    finally:
+        goodput.GOODPUT.enabled = False
+        goodput.GOODPUT.clear()
+
+
+def test_module_enable_spools_and_seeds(tmp_path):
+    sp = str(tmp_path / "spool.jsonl")
+    with open(sp, "w") as f:
+        f.write(json.dumps({"kind": "step", "pid": 9, "step": 7,
+                            "rework": False}) + "\n")
+    try:
+        goodput.enable(spool_path=sp)
+        assert goodput.enabled()
+        # the prior incarnation's high-water mark was replayed from the
+        # shared spool, so a re-trained step classifies as rework
+        goodput.note_step(7)
+        assert goodput.GOODPUT.current_phase() == "rework"
+        goodput.GOODPUT.close()
+        agg = goodput.aggregate_spool(goodput.read_spool(sp))
+        assert agg["incarnations"] == 1  # only OUR start record
+        assert agg["summaries"][0]["max_step"] == 7
+    finally:
+        goodput.disable()
+        goodput.GOODPUT.clear()
+
+
+def test_envflag_registered():
+    from hivedscheduler_tpu.common import envflags
+
+    assert "HIVED_GOODPUT" in envflags.REGISTRY

@@ -660,6 +660,72 @@ def test_obs002_real_tree_registry_is_exact():
 
 
 # ---------------------------------------------------------------------------
+# OBS003: workload goodput step-phase registry (ISSUE 16) — seeded
+# fixtures prove both directions are non-vacuous
+# ---------------------------------------------------------------------------
+
+_GOODPUT_PHASES = {"step_compute": "d", "data_wait": "d",
+                   "never_produced_phase": "d"}
+
+
+def test_obs003_unregistered_phase_flagged(tmp_path):
+    _write(tmp_path, "pkg/mod.py", """
+        obs_goodput.phase("rogue_phase")
+        goodput.GOODPUT.phase("step_compute")
+        with goodput.span("data_wait"):
+            pass
+        """)
+    got = blindspots.check_goodput_phases(
+        REPO, package_root=str(tmp_path / "pkg"),
+        phases=dict(_GOODPUT_PHASES))
+    msgs = sorted(f.message for f in got)
+    assert all(f.rule == "OBS003" for f in got)
+    assert any("'rogue_phase'" in m and "not registered" in m
+               for m in msgs)
+    # vice versa: the registered-but-never-produced row is flagged too
+    assert any("'never_produced_phase'" in m and "never produced" in m
+               for m in msgs)
+    assert len(got) == 2
+
+
+def test_obs003_non_literal_phase_is_legal(tmp_path):
+    # computed phases (the note_step classification passes variables
+    # through self.phase) are validated by the runtime, not the lint;
+    # start() with the phase defaulted is legal too
+    _write(tmp_path, "pkg/mod.py", """
+        ph = classify()
+        goodput.phase(ph)
+        obs_goodput.GOODPUT.start()
+        gp.span(phase="data_wait")
+        _goodput.phase("step_compute")
+        """)
+    got = blindspots.check_goodput_phases(
+        REPO, package_root=str(tmp_path / "pkg"),
+        phases={"step_compute": "d", "data_wait": "d"})
+    assert got == []
+
+
+def test_obs003_registry_keys_do_not_vouch_for_themselves(tmp_path):
+    # a fixture obs/goodput.py whose STEP_PHASES dict names a phase no
+    # call site produces: the dict's own literals must not count
+    _write(tmp_path, "pkg/obs/goodput.py", """
+        STEP_PHASES = {"step_compute": "doc", "orphan_row": "doc"}
+        def classify():
+            return "step_compute"
+        """)
+    got = blindspots.check_goodput_phases(
+        REPO, package_root=str(tmp_path / "pkg"),
+        phases={"step_compute": "d", "orphan_row": "d"})
+    assert [f.rule for f in got] == ["OBS003"]
+    assert "'orphan_row'" in got[0].message
+
+
+def test_obs003_real_tree_registry_is_exact():
+    got = blindspots.check_goodput_phases(REPO)
+    assert got == []
+
+
+# ---------------------------------------------------------------------------
 # HIVED_LOCKCHECK runtime sanitizer
 # ---------------------------------------------------------------------------
 

@@ -481,5 +481,5 @@ def test_rule_registry_matches_implementations():
         "DFG001",
         "SHD001", "SHD002", "SHD003", "SHD004", "ENV001", "ENV002",
         "CLI001", "CLI002", "GRD001", "SER001", "MET001", "OBS001",
-        "OBS002",
+        "OBS002", "OBS003",
     }

@@ -79,7 +79,11 @@ def replay_elastic(seed: int, workdir: str | None = None) -> dict:
     from hivedscheduler_tpu.chaos.workload import ElasticWorkloadHarness
 
     def _run(d: str) -> dict:
-        return ElasticWorkloadHarness(seed=seed, workdir=d).run()
+        # bridge_ledger: the pinned elastic replay also reconciles the
+        # workload's goodput accounting against the scheduler-side
+        # busy_guaranteed interval (doc/design/observability.md)
+        return ElasticWorkloadHarness(seed=seed, workdir=d,
+                                      bridge_ledger=True).run()
 
     if workdir is not None:
         return _run(workdir)
@@ -117,10 +121,13 @@ def main(argv=None) -> int:
             for v in report["violations"]:
                 print(f"  {v}")
         else:
+            gp = report["goodput"]
             print(f"seed {seed} [{episodes} episode(s)] OK — "
                   f"episodes {json.dumps(report['episodes'])}, "
                   f"{report['incarnations']} incarnations, "
-                  f"{report['steps']} steps bit-exact")
+                  f"{report['steps']} steps bit-exact, goodput "
+                  f"{gp['goodput_fraction']:.2f} "
+                  f"({gp['rework_steps']} rework step(s))")
     for seed, why in elastic_targets:
         report = replay_elastic(seed)
         if report["violations"]:
@@ -130,10 +137,13 @@ def main(argv=None) -> int:
             for v in report["violations"]:
                 print(f"  {v}")
         else:
+            bridge = report["goodput"].get("bridge") or {}
             print(f"elastic seed {seed} OK — kill@{report['kill_step']}, "
                   f"grow offer@{report['preempt_step']}, "
                   f"{report['incarnations']} incarnations, "
-                  f"{report['steps']} steps allclose")
+                  f"{report['steps']} steps allclose, goodput "
+                  f"{report['goodput']['goodput_fraction']:.2f}, bridge "
+                  f"uncovered {bridge.get('uncovered_s', 0.0):.1f}s")
     total = len(targets) + len(elastic_targets)
     if ok:
         print(f"check_workload_seeds: OK ({total} seed(s) clean)")

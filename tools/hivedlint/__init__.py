@@ -120,6 +120,11 @@ RULES: Dict[str, tuple] = {
                "registered state is produced somewhere (call-site "
                "literal or a ledger-module mapping); the runtime raises "
                "on unregistered states", "blindspots"),
+    "OBS003": ("every literal step phase at a goodput-ledger call site "
+               "is a registered obs/goodput.py STEP_PHASES row, and "
+               "every registered phase is produced somewhere (call-site "
+               "literal or a goodput-module classification branch); the "
+               "runtime raises on unregistered phases", "blindspots"),
 }
 
 

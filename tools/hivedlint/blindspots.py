@@ -737,6 +737,125 @@ def check_ledger_states(
 
 
 # ---------------------------------------------------------------------------
+# OBS003: workload goodput step-phase registry (the OBS002 pattern applied
+# to obs/goodput.py STEP_PHASES — ISSUE 16)
+#
+# Every *literal* phase passed to a goodput receiver's phase-taking methods
+# (`goodput.phase("<phase>")`, `span("<phase>")`, `start(phase=...)`) must
+# be a registered STEP_PHASES row, and every STEP_PHASES row must be
+# *produced* somewhere — either a literal at a call site or a literal
+# inside obs/goodput.py itself outside the STEP_PHASES dict (note_step's
+# compile/rework/step_compute classification, start()'s "init" default),
+# docstrings excluded. Non-literal phases are legal; the runtime raises on
+# unregistered ones (GoodputLedger._check_phase).
+# ---------------------------------------------------------------------------
+
+_GOODPUT_RECEIVERS = {"goodput", "obs_goodput", "gp", "_goodput"}
+# method -> positional index of the phase arg (kw name is always "phase")
+_GOODPUT_PHASE_METHODS = {"phase": 0, "span": 0, "start": 0}
+
+
+def check_goodput_phases(
+    root: str,
+    package_root: Optional[str] = None,
+    phases: Optional[Dict[str, str]] = None,
+) -> List[Finding]:
+    if phases is None:
+        import sys
+
+        sys.path.insert(0, root)
+        try:
+            from hivedscheduler_tpu.obs.goodput import STEP_PHASES
+        finally:
+            sys.path.pop(0)
+        phases = STEP_PHASES
+    pkg = package_root or os.path.join(root, "hivedscheduler_tpu")
+    base = package_root and os.path.dirname(package_root) or root
+
+    def _lit(expr) -> Optional[str]:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        return None
+
+    produced: Set[str] = set()
+    out: List[Finding] = []
+    goodput_rel = None
+    for path in _iter_py(pkg):
+        rel = os.path.relpath(path, base).replace(os.sep, "/")
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        if rel.endswith("obs/goodput.py"):
+            # the registry module itself: every string literal outside the
+            # STEP_PHASES dict and outside docstrings counts as a producer
+            # (note_step's classification branches, start()'s default) —
+            # the dict's own keys cannot vouch for themselves
+            goodput_rel = rel
+            excluded: Set[int] = set()
+            for node in ast.walk(tree):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                if any(isinstance(t, ast.Name) and t.id == "STEP_PHASES"
+                       for t in targets):
+                    excluded |= {id(n) for n in ast.walk(node)}
+                if isinstance(node, (ast.Module, ast.ClassDef,
+                                     ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    body = node.body
+                    if (body and isinstance(body[0], ast.Expr)
+                            and isinstance(body[0].value, ast.Constant)):
+                        excluded.add(id(body[0].value))
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and id(node) not in excluded
+                        and node.value in phases):
+                    produced.add(node.value)
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            recv = node.func.value
+            recv_ok = (
+                (isinstance(recv, ast.Name)
+                 and recv.id in _GOODPUT_RECEIVERS)
+                or (isinstance(recv, ast.Attribute)
+                    and recv.attr == "GOODPUT")
+            )
+            if not recv_ok or attr not in _GOODPUT_PHASE_METHODS:
+                continue
+            pos = _GOODPUT_PHASE_METHODS[attr]
+            expr = (node.args[pos] if len(node.args) > pos
+                    else next((kw.value for kw in node.keywords
+                               if kw.arg == "phase"), None))
+            if expr is None:
+                continue  # phase defaulted (start) — init
+            name = _lit(expr)
+            if name is None:
+                continue  # computed phase: the runtime validates
+            if name not in phases:
+                out.append(Finding(
+                    "OBS003", rel, node.lineno,
+                    f"step phase {name!r} is not registered in "
+                    f"obs/goodput.py STEP_PHASES",
+                ))
+            else:
+                produced.add(name)
+    for name in sorted(set(phases) - produced):
+        out.append(Finding(
+            "OBS003", goodput_rel or "hivedscheduler_tpu/obs/goodput.py", 1,
+            f"step phase {name!r} registered in STEP_PHASES but never "
+            f"produced in the package — drop the row or wire the "
+            f"transition",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # entry
 # ---------------------------------------------------------------------------
 
@@ -762,4 +881,5 @@ def check(root: str) -> List[Finding]:
     out += check_metrics_catalogue(root)
     out += check_journal_schema(root)
     out += check_ledger_states(root)
+    out += check_goodput_phases(root)
     return out
